@@ -1,0 +1,11 @@
+"""Root conftest: force JAX onto a virtual 8-device CPU platform for tests.
+
+Must run before jax is imported anywhere. Bench (bench.py) and the graft entry
+are run outside pytest and therefore use the real TPU.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

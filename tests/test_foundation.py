@@ -1,0 +1,325 @@
+"""Tests for the foundation layer: fsm, locking, resource, conf, dispatcher, log.
+
+Mirrors the reference's unit-test strategy for pkg/common, pkg/conf,
+pkg/dispatcher (SURVEY.md §4 tier 1).
+"""
+import threading
+import time
+
+import pytest
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.events import AppEventRecord, EventRecorder, TaskEventRecord
+from yunikorn_tpu.common.objects import Container, make_node, make_pod, Pod, PodSpec, ObjectMeta
+from yunikorn_tpu.common.resource import (
+    Resource,
+    ResourceBuilder,
+    get_pod_resource,
+    parse_quantity,
+)
+from yunikorn_tpu.conf import schedulerconf as conf
+from yunikorn_tpu.dispatcher.dispatcher import Dispatcher, EventType
+from yunikorn_tpu.locking.locking import Mutex, RWMutex
+from yunikorn_tpu.log.logger import log, resolve_level, update_logging_config
+from yunikorn_tpu.utils.fsm import FSM, InvalidEventError, Transition, UnknownEventError
+
+
+# ---------------------------------------------------------------------------
+# FSM
+# ---------------------------------------------------------------------------
+
+def make_fsm(callbacks=None):
+    return FSM(
+        "New",
+        [
+            Transition("Submit", ["New"], "Submitted"),
+            Transition("Accept", ["Submitted"], "Accepted"),
+            Transition("Run", ["Accepted", "Running"], "Running"),
+            Transition("Fail", ["New", "Submitted", "Accepted", "Running"], "Failed"),
+        ],
+        callbacks,
+    )
+
+
+def test_fsm_basic_transitions():
+    f = make_fsm()
+    assert f.current == "New"
+    assert f.can("Submit")
+    assert not f.can("Run")
+    assert f.event("Submit") is True
+    assert f.current == "Submitted"
+    f.event("Accept")
+    f.event("Run")
+    assert f.current == "Running"
+    # self-transition allowed, returns False (no state change)
+    assert f.event("Run") is False
+
+
+def test_fsm_invalid_and_unknown_events():
+    f = make_fsm()
+    with pytest.raises(InvalidEventError):
+        f.event("Run")
+    with pytest.raises(UnknownEventError):
+        f.event("NoSuchEvent")
+
+
+def test_fsm_callbacks_order():
+    calls = []
+    f = make_fsm(
+        {
+            "before_Submit": lambda e: calls.append("before"),
+            "leave_New": lambda e: calls.append("leave"),
+            "enter_Submitted": lambda e: calls.append("enter"),
+            "enter_state": lambda e: calls.append("enter_state"),
+            "after_Submit": lambda e: calls.append("after"),
+        }
+    )
+    f.event("Submit", "arg1")
+    assert calls == ["before", "leave", "enter", "enter_state", "after"]
+
+
+# ---------------------------------------------------------------------------
+# Locking
+# ---------------------------------------------------------------------------
+
+def test_mutex_exclusion():
+    m = Mutex()
+    counter = {"v": 0}
+
+    def work():
+        for _ in range(1000):
+            with m:
+                counter["v"] += 1
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert counter["v"] == 4000
+
+
+def test_rwmutex_readers_concurrent_writers_exclusive():
+    rw = RWMutex()
+    state = {"readers": 0, "max_readers": 0, "value": 0}
+    lock = threading.Lock()
+
+    def reader():
+        with rw.reader():
+            with lock:
+                state["readers"] += 1
+                state["max_readers"] = max(state["max_readers"], state["readers"])
+            time.sleep(0.01)
+            with lock:
+                state["readers"] -= 1
+
+    def writer():
+        with rw:
+            state["value"] += 1
+
+    threads = [threading.Thread(target=reader) for _ in range(4)] + [
+        threading.Thread(target=writer) for _ in range(2)
+    ]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert state["max_readers"] >= 2
+    assert state["value"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Resource model
+# ---------------------------------------------------------------------------
+
+def test_parse_quantity():
+    assert parse_quantity("100m", as_milli=True) == 100
+    assert parse_quantity("2", as_milli=True) == 2000
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("500M") == 500_000_000
+    assert parse_quantity(4) == 4
+    assert parse_quantity("1.5Gi") == int(1.5 * 2**30)
+    assert parse_quantity("", as_milli=True) == 0
+
+
+def test_resource_arithmetic():
+    a = ResourceBuilder().cpu(1000).memory(2**30).build()
+    b = ResourceBuilder().cpu(500).memory(2**29).pods(1).build()
+    s = a.add(b)
+    assert s.get("cpu") == 1500
+    assert s.get("pods") == 1
+    d = s.sub(b)
+    assert d == a
+    small = ResourceBuilder().cpu(500).memory(2**29).build()
+    assert small.fits_in(a)
+    assert not a.fits_in(small)
+    assert not b.fits_in(a)  # a has no "pods" capacity
+
+
+def test_get_pod_resource_sum_and_init_max():
+    pod = make_pod("p1", cpu_milli=500, memory=1000)
+    r = get_pod_resource(pod)
+    assert r.get("cpu") == 500
+    assert r.get("memory") == 1000
+    assert r.get("pods") == 1
+
+    # init container larger than container sum → max rule
+    pod.spec.init_containers = [
+        Container(name="init", resources_requests={"cpu": "2", "memory": "100"})
+    ]
+    r = get_pod_resource(pod)
+    assert r.get("cpu") == 2000
+    assert r.get("memory") == 1000
+
+    # sidecar init container (restartPolicy Always) adds to the base sum
+    pod.spec.init_containers.append(
+        Container(name="sidecar", resources_requests={"cpu": "250m"}, restart_policy="Always")
+    )
+    r = get_pod_resource(pod)
+    assert r.get("cpu") == 2000  # max(500+250, 2000) still init-dominated
+    pod.spec.init_containers[0].resources_requests = {"cpu": "100m"}
+    r = get_pod_resource(pod)
+    assert r.get("cpu") == 750
+
+
+# ---------------------------------------------------------------------------
+# Conf
+# ---------------------------------------------------------------------------
+
+def test_conf_defaults_match_reference():
+    c = conf.SchedulerConf()
+    assert c.interval == 1.0
+    assert c.event_channel_capacity == 1024 * 1024
+    assert c.dispatch_timeout == 300.0
+    assert c.kube_qps == 1000
+    assert c.volume_bind_timeout == 600.0
+    assert c.enable_config_hot_refresh is True
+    assert c.disable_gang_scheduling is False
+
+
+def test_conf_parse_and_overlay():
+    flat = conf.flatten_config_maps(
+        [
+            {"service.schedulingInterval": "2s", "service.clusterId": "c1"},
+            {"service.clusterId": "c2", "kubernetes.qps": "500"},
+        ]
+    )
+    c = conf.parse_config_map(flat)
+    assert c.cluster_id == "c2"  # override wins
+    assert c.interval == 2.0
+    assert c.kube_qps == 500
+
+
+def test_conf_duration_parsing():
+    c = conf.parse_config_map({"service.volumeBindTimeout": "1h30m"})
+    assert c.volume_bind_timeout == 5400.0
+    c = conf.parse_config_map({"service.volumeBindTimeout": "250ms"})
+    assert c.volume_bind_timeout == 0.25
+
+
+def test_conf_hot_reload_keeps_non_reloadable():
+    holder = conf.ConfHolder()
+    holder.update_config_maps([{"service.clusterId": "orig", "service.schedulingInterval": "5s"}], initial=True)
+    holder.update_config_maps([{"service.clusterId": "changed", "service.disableGangScheduling": "true"}])
+    c = holder.get()
+    assert c.cluster_id == "orig"          # non-reloadable kept
+    assert c.interval == 5.0               # non-reloadable kept
+    assert c.disable_gang_scheduling is False  # non-reloadable kept
+
+
+def test_conf_gzip_decompress():
+    import gzip
+
+    payload = gzip.compress(b"queues: {}")
+    flat = conf.flatten_config_maps([{"a": "b"}], [{"queues.yaml.gz": payload}])
+    assert flat["queues.yaml"] == "queues: {}"
+
+
+def test_conf_queues_config_extraction():
+    holder = conf.ConfHolder()
+    holder.update_config_maps([{"queues.yaml": "partitions: []"}], initial=True)
+    assert holder.queues_config() == "partitions: []"
+
+
+# ---------------------------------------------------------------------------
+# Logging
+# ---------------------------------------------------------------------------
+
+def test_log_level_inheritance():
+    cfg = {"log.shim.level": "debug", "log.level": "warn"}
+    import logging
+
+    assert resolve_level("shim.cache.task", cfg) == logging.DEBUG
+    assert resolve_level("core", cfg) == logging.WARNING
+    update_logging_config(cfg)
+    assert log("shim.cache.task").getEffectiveLevel() == logging.DEBUG
+    assert log("core").getEffectiveLevel() == logging.WARNING
+    update_logging_config({})  # reset
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_routes_by_type_and_serializes():
+    d = Dispatcher(capacity=1000)
+    seen_app, seen_task = [], []
+    d.register_event_handler("app", EventType.APPLICATION, lambda e: seen_app.append(e))
+    d.register_event_handler("task", EventType.TASK, lambda e: seen_task.append(e))
+    d.start()
+    try:
+        for i in range(50):
+            d.dispatch(AppEventRecord(f"app-{i}", "Submit"))
+            d.dispatch(TaskEventRecord("app-0", f"task-{i}", "Init"))
+        assert d.drain(5)
+        assert len(seen_app) == 50
+        assert len(seen_task) == 50
+        # order preserved (single consumer)
+        assert [e.application_id for e in seen_app] == [f"app-{i}" for i in range(50)]
+    finally:
+        d.stop()
+
+
+def test_dispatcher_async_fallback_when_full():
+    d = Dispatcher(capacity=2)
+    got = []
+    release = threading.Event()
+
+    def slow_handler(e):
+        release.wait(5)
+        got.append(e)
+
+    d.register_event_handler("app", EventType.APPLICATION, slow_handler)
+    d.start()
+    try:
+        for i in range(6):  # more than capacity; extras go the async path
+            d.dispatch(AppEventRecord(f"app-{i}", "Submit"))
+        release.set()
+        assert d.drain(10)
+        deadline = time.time() + 10
+        while len(got) < 6 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(got) == 6
+    finally:
+        d.stop()
+
+
+def test_dispatcher_not_running_raises():
+    d = Dispatcher(capacity=10)
+    with pytest.raises(Exception):
+        d.dispatch(AppEventRecord("a", "Submit"))
+
+
+# ---------------------------------------------------------------------------
+# Event recorder
+# ---------------------------------------------------------------------------
+
+def test_event_recorder():
+    rec = EventRecorder()
+    rec.eventf("Pod", "default/p1", "Normal", "Scheduling", "app %s", "app-1")
+    rec.eventf("Pod", "default/p2", "Warning", "TaskFailed", "boom")
+    assert len(rec.events()) == 2
+    assert rec.events(object_key="default/p1")[0].message == "app app-1"
+    assert rec.events(reason="TaskFailed")[0].event_type == "Warning"
+
+
+def test_constants_wire_compat():
+    assert constants.CANONICAL_LABEL_APP_ID == "yunikorn.apache.org/app-id"
+    assert constants.SCHEDULER_NAME == "yunikorn"
+    assert constants.PLACEHOLDER_CONTAINER_IMAGE.startswith("registry.k8s.io/pause")

@@ -1,0 +1,8 @@
+"""yunikorn_tpu: a TPU-native batch scheduling framework.
+
+Capability-equivalent to apache/yunikorn-k8shim + in-process yunikorn-core, with
+the per-pod scheduling loop reframed as a batched constraint solve on TPU
+(JAX/XLA/Pallas). See SURVEY.md for the capability blueprint.
+"""
+
+__version__ = "0.1.0"

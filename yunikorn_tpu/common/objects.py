@@ -1,0 +1,318 @@
+"""K8s-lite object model.
+
+The reference consumes `k8s.io/api/core/v1` types through informers. This framework
+is cluster-agnostic: it defines its own light-weight typed object model carrying
+exactly the fields the scheduling path reads (reference usage sites: pod metadata /
+spec in pkg/cache/metadata.go, pkg/common/resource.go, predicate inputs in
+pkg/plugin/predicates/predicate_manager.go). A real-K8s adapter can map API objects
+onto these dataclasses without touching the rest of the stack.
+
+All objects are plain mutable dataclasses; identity is (namespace, name) + uid.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+_uid_counter = itertools.count(1)
+
+
+def generate_uid(prefix: str = "uid") -> str:
+    return f"{prefix}-{next(_uid_counter):08d}"
+
+
+@dataclasses.dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Dict[str, str] = dataclasses.field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    owner_references: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    resource_version: int = 0
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = generate_uid(self.name or "obj")
+        if not self.creation_timestamp:
+            self.creation_timestamp = time.time()
+
+
+# ---------------------------------------------------------------------------
+# Pod
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Container:
+    name: str
+    resources_requests: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    resources_limits: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ports: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # ports entries: {"hostPort": int, "protocol": "TCP", "hostIP": "0.0.0.0"}
+    restart_policy: Optional[str] = None  # init containers: "Always" => sidecar
+
+
+@dataclasses.dataclass
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects; NoSchedule | PreferNoSchedule | NoExecute
+    toleration_seconds: Optional[int] = None
+
+
+@dataclasses.dataclass
+class NodeSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist | Gt | Lt
+    values: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class NodeSelectorTerm:
+    match_expressions: List[NodeSelectorRequirement] = dataclasses.field(default_factory=list)
+    match_fields: List[NodeSelectorRequirement] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class PodAffinityTerm:
+    label_selector: Optional[Dict[str, Any]] = None  # {"matchLabels": {...}, "matchExpressions": [...]}
+    topology_key: str = ""
+    namespaces: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Affinity:
+    # requiredDuringSchedulingIgnoredDuringExecution
+    node_required_terms: List[NodeSelectorTerm] = dataclasses.field(default_factory=list)
+    # preferredDuringScheduling: [(weight, NodeSelectorTerm)]
+    node_preferred_terms: List[tuple] = dataclasses.field(default_factory=list)
+    pod_affinity_required: List[PodAffinityTerm] = dataclasses.field(default_factory=list)
+    pod_affinity_preferred: List[tuple] = dataclasses.field(default_factory=list)
+    pod_anti_affinity_required: List[PodAffinityTerm] = dataclasses.field(default_factory=list)
+    pod_anti_affinity_preferred: List[tuple] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Optional[Dict[str, Any]] = None
+
+
+@dataclasses.dataclass
+class Volume:
+    name: str = ""
+    pvc_claim_name: Optional[str] = None  # persistentVolumeClaim.claimName
+    ephemeral: bool = False
+
+
+@dataclasses.dataclass
+class PodSpec:
+    node_name: str = ""
+    scheduler_name: str = ""
+    containers: List[Container] = dataclasses.field(default_factory=list)
+    init_containers: List[Container] = dataclasses.field(default_factory=list)
+    node_selector: Dict[str, str] = dataclasses.field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = dataclasses.field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = dataclasses.field(default_factory=list)
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    preemption_policy: Optional[str] = None  # PreemptLowerPriority | Never
+    scheduling_gates: List[str] = dataclasses.field(default_factory=list)
+    volumes: List[Volume] = dataclasses.field(default_factory=list)
+    restart_policy: str = "Always"
+    overhead: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    service_account: str = ""
+
+
+@dataclasses.dataclass
+class PodCondition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+
+
+@dataclasses.dataclass
+class PodStatus:
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    conditions: List[PodCondition] = dataclasses.field(default_factory=list)
+    nominated_node_name: str = ""
+    container_statuses: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class Pod:
+    metadata: ObjectMeta
+    spec: PodSpec = dataclasses.field(default_factory=PodSpec)
+    status: PodStatus = dataclasses.field(default_factory=PodStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def is_terminated(self) -> bool:
+        return self.status.phase in ("Succeeded", "Failed")
+
+    def is_assigned(self) -> bool:
+        return bool(self.spec.node_name)
+
+    def deepcopy(self) -> "Pod":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = "NoSchedule"  # NoSchedule | PreferNoSchedule | NoExecute
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class NodeStatus:
+    allocatable: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    capacity: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    conditions: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Node:
+    metadata: ObjectMeta
+    spec: NodeSpec = dataclasses.field(default_factory=NodeSpec)
+    status: NodeStatus = dataclasses.field(default_factory=NodeStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def deepcopy(self) -> "Node":
+        import copy
+
+        return copy.deepcopy(self)
+
+
+# ---------------------------------------------------------------------------
+# Other cluster objects the shim watches
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ConfigMap:
+    metadata: ObjectMeta
+    data: Dict[str, str] = dataclasses.field(default_factory=dict)
+    binary_data: Dict[str, bytes] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class PriorityClass:
+    metadata: ObjectMeta
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclasses.dataclass
+class Namespace:
+    metadata: ObjectMeta
+
+
+@dataclasses.dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta
+    storage_class: str = ""
+    bound: bool = False
+    volume_name: str = ""
+
+
+def make_pod(
+    name: str,
+    namespace: str = "default",
+    cpu_milli: int = 0,
+    memory: int = 0,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    scheduler_name: str = "",
+    phase: str = "Pending",
+    priority: Optional[int] = None,
+    extra_resources: Optional[Dict[str, int]] = None,
+    **spec_kwargs,
+) -> Pod:
+    """Test/driver helper to build a pod with one container."""
+    requests: Dict[str, Any] = {}
+    if cpu_milli:
+        requests["cpu"] = f"{cpu_milli}m"
+    if memory:
+        requests["memory"] = str(memory)
+    for k, v in (extra_resources or {}).items():
+        requests[k] = v
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {}),
+                            annotations=dict(annotations or {})),
+        spec=PodSpec(
+            node_name=node_name,
+            scheduler_name=scheduler_name,
+            containers=[Container(name="c0", resources_requests=requests)],
+            priority=priority,
+            **spec_kwargs,
+        ),
+        status=PodStatus(phase=phase),
+    )
+
+
+def make_node(
+    name: str,
+    cpu_milli: int = 16000,
+    memory: int = 16 * 2**30,
+    pods: int = 110,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[Taint]] = None,
+    extra_resources: Optional[Dict[str, int]] = None,
+    unschedulable: bool = False,
+) -> Node:
+    """Test/driver helper to build a node."""
+    allocatable: Dict[str, Any] = {
+        "cpu": f"{cpu_milli}m",
+        "memory": str(memory),
+        "pods": pods,
+    }
+    for k, v in (extra_resources or {}).items():
+        allocatable[k] = v
+    return Node(
+        metadata=ObjectMeta(name=name, namespace="", labels=dict(labels or {})),
+        spec=NodeSpec(unschedulable=unschedulable, taints=list(taints or [])),
+        status=NodeStatus(allocatable=allocatable, capacity=dict(allocatable)),
+    )

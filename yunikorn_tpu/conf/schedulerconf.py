@@ -1,0 +1,319 @@
+"""Scheduler configuration.
+
+Role-equivalent to pkg/conf/schedulerconf.go: a `SchedulerConf` holder (:114-135)
+populated from two ConfigMaps — `yunikorn-defaults` overlaid by `yunikorn-configs`
+(FlattenConfigMaps, :508-523) — keyed `service.*` / `kubernetes.*` / `log.*`
+(:344-448), with gzip-compressed values supported (Decompress, :482-507), defaults
+(:83-97), hot-reload via an atomic holder swap, and warnings for non-reloadable
+keys (:210-265). The solver-specific knobs (`solver.*`) are new: they size the
+device-array buckets and the assignment loop.
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import gzip
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.log.logger import log, update_logging_config
+
+logger = log("shim.config")
+
+PREFIX_SERVICE = "service."
+PREFIX_KUBERNETES = "kubernetes."
+PREFIX_LOG = "log."
+PREFIX_SOLVER = "solver."
+
+# service.* keys
+CM_SVC_CLUSTER_ID = PREFIX_SERVICE + "clusterId"
+CM_SVC_POLICY_GROUP = PREFIX_SERVICE + "policyGroup"
+CM_SVC_SCHEDULING_INTERVAL = PREFIX_SERVICE + "schedulingInterval"
+CM_SVC_VOLUME_BIND_TIMEOUT = PREFIX_SERVICE + "volumeBindTimeout"
+CM_SVC_EVENT_CHANNEL_CAPACITY = PREFIX_SERVICE + "eventChannelCapacity"
+CM_SVC_DISPATCH_TIMEOUT = PREFIX_SERVICE + "dispatchTimeout"
+CM_SVC_DISABLE_GANG = PREFIX_SERVICE + "disableGangScheduling"
+CM_SVC_ENABLE_HOT_REFRESH = PREFIX_SERVICE + "enableConfigHotRefresh"
+CM_SVC_PLACEHOLDER_IMAGE = PREFIX_SERVICE + "placeholderImage"
+CM_SVC_PLACEHOLDER_RUN_AS_USER = PREFIX_SERVICE + "placeholderRunAsUser"
+CM_SVC_PLACEHOLDER_RUN_AS_GROUP = PREFIX_SERVICE + "placeholderRunAsGroup"
+CM_SVC_PLACEHOLDER_FS_GROUP = PREFIX_SERVICE + "placeholderFsGroup"
+CM_SVC_INSTANCE_TYPE_LABEL = PREFIX_SERVICE + "nodeInstanceTypeNodeLabelKey"
+CM_SVC_OPERATOR_PLUGINS = PREFIX_SERVICE + "operatorPlugins"
+
+# kubernetes.* keys
+CM_KUBE_QPS = PREFIX_KUBERNETES + "qps"
+CM_KUBE_BURST = PREFIX_KUBERNETES + "burst"
+
+# solver.* keys (TPU-native additions)
+CM_SOLVER_MAX_ROUNDS = PREFIX_SOLVER + "maxAssignRounds"
+CM_SOLVER_POD_CHUNK = PREFIX_SOLVER + "podChunk"
+CM_SOLVER_SCORING_POLICY = PREFIX_SOLVER + "scoringPolicy"
+CM_SOLVER_DEVICE_PLATFORM = PREFIX_SOLVER + "platform"
+
+# The queues.yaml payload key inside the configmap (opaque to the shim).
+POLICY_GROUP_DEFAULT = "queues"
+
+
+@dataclasses.dataclass
+class PlaceholderConfig:
+    image: str = constants.PLACEHOLDER_CONTAINER_IMAGE
+    run_as_user: int = -1
+    run_as_group: int = -1
+    fs_group: int = -1
+
+
+@dataclasses.dataclass
+class SchedulerConf:
+    cluster_id: str = "mycluster"
+    cluster_version: str = "latest"
+    policy_group: str = POLICY_GROUP_DEFAULT
+    interval: float = 1.0                      # scheduling pump cadence, seconds
+    volume_bind_timeout: float = 600.0
+    event_channel_capacity: int = 1024 * 1024
+    dispatch_timeout: float = 300.0
+    kube_qps: int = 1000
+    kube_burst: int = 1000
+    enable_config_hot_refresh: bool = True
+    disable_gang_scheduling: bool = False
+    user_label_key: str = constants.DEFAULT_USER_LABEL
+    instance_type_node_label_key: str = constants.NODE_INSTANCE_TYPE_LABEL
+    generate_unique_app_ids: bool = False
+    namespace: str = "yunikorn"
+    operator_plugins: str = "general"
+    placeholder: PlaceholderConfig = dataclasses.field(default_factory=PlaceholderConfig)
+    # --- solver knobs ---
+    solver_max_rounds: int = 32
+    solver_pod_chunk: int = 1024
+    solver_scoring_policy: str = "binpacking"  # binpacking | fair | spread
+    solver_platform: str = ""                  # "" = jax default; "cpu" forces host
+
+    def clone(self) -> "SchedulerConf":
+        c = dataclasses.replace(self)
+        c.placeholder = dataclasses.replace(self.placeholder)
+        return c
+
+
+# Keys that cannot change across a hot reload (reference :212-226).
+_NON_RELOADABLE = [
+    CM_SVC_CLUSTER_ID,
+    CM_SVC_POLICY_GROUP,
+    CM_SVC_SCHEDULING_INTERVAL,
+    CM_SVC_VOLUME_BIND_TIMEOUT,
+    CM_SVC_EVENT_CHANNEL_CAPACITY,
+    CM_SVC_DISPATCH_TIMEOUT,
+    CM_KUBE_QPS,
+    CM_KUBE_BURST,
+    CM_SVC_DISABLE_GANG,
+    CM_SVC_INSTANCE_TYPE_LABEL,
+    CM_SVC_PLACEHOLDER_IMAGE,
+    CM_SVC_PLACEHOLDER_RUN_AS_USER,
+    CM_SVC_PLACEHOLDER_RUN_AS_GROUP,
+    CM_SVC_PLACEHOLDER_FS_GROUP,
+]
+
+
+def _parse_bool(v: str, default: bool) -> bool:
+    s = v.strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    logger.warning("invalid bool value %r, keeping %s", v, default)
+    return default
+
+
+def _parse_duration(v: str, default: float) -> float:
+    """Parse Go-style durations ("10s", "5m", "1h30m", "300ms") or bare seconds."""
+    s = v.strip()
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    import re
+
+    total = 0.0
+    matched = False
+    for num, unit in re.findall(r"([0-9.]+)(ns|us|µs|ms|s|m|h)", s):
+        matched = True
+        mult = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[unit]
+        total += float(num) * mult
+    if not matched:
+        logger.warning("invalid duration %r, keeping %s", v, default)
+        return default
+    return total
+
+
+def _parse_int(v: str, default: int) -> int:
+    try:
+        return int(v.strip())
+    except ValueError:
+        logger.warning("invalid int value %r, keeping %s", v, default)
+        return default
+
+
+def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None) -> SchedulerConf:
+    """Parse a flattened configmap into a SchedulerConf (reference :344-448)."""
+    conf = (base or SchedulerConf()).clone()
+
+    def s(key: str, cur: str) -> str:
+        return data.get(key, cur)
+
+    conf.cluster_id = s(CM_SVC_CLUSTER_ID, conf.cluster_id)
+    conf.policy_group = s(CM_SVC_POLICY_GROUP, conf.policy_group)
+    conf.operator_plugins = s(CM_SVC_OPERATOR_PLUGINS, conf.operator_plugins)
+    conf.placeholder.image = s(CM_SVC_PLACEHOLDER_IMAGE, conf.placeholder.image)
+    conf.instance_type_node_label_key = s(CM_SVC_INSTANCE_TYPE_LABEL, conf.instance_type_node_label_key)
+    conf.solver_scoring_policy = s(CM_SOLVER_SCORING_POLICY, conf.solver_scoring_policy)
+    conf.solver_platform = s(CM_SOLVER_DEVICE_PLATFORM, conf.solver_platform)
+    if CM_SVC_SCHEDULING_INTERVAL in data:
+        conf.interval = _parse_duration(data[CM_SVC_SCHEDULING_INTERVAL], conf.interval)
+    if CM_SVC_VOLUME_BIND_TIMEOUT in data:
+        conf.volume_bind_timeout = _parse_duration(data[CM_SVC_VOLUME_BIND_TIMEOUT], conf.volume_bind_timeout)
+    if CM_SVC_DISPATCH_TIMEOUT in data:
+        conf.dispatch_timeout = _parse_duration(data[CM_SVC_DISPATCH_TIMEOUT], conf.dispatch_timeout)
+    if CM_SVC_EVENT_CHANNEL_CAPACITY in data:
+        conf.event_channel_capacity = _parse_int(data[CM_SVC_EVENT_CHANNEL_CAPACITY], conf.event_channel_capacity)
+    if CM_KUBE_QPS in data:
+        conf.kube_qps = _parse_int(data[CM_KUBE_QPS], conf.kube_qps)
+    if CM_KUBE_BURST in data:
+        conf.kube_burst = _parse_int(data[CM_KUBE_BURST], conf.kube_burst)
+    if CM_SVC_DISABLE_GANG in data:
+        conf.disable_gang_scheduling = _parse_bool(data[CM_SVC_DISABLE_GANG], conf.disable_gang_scheduling)
+    if CM_SVC_ENABLE_HOT_REFRESH in data:
+        conf.enable_config_hot_refresh = _parse_bool(data[CM_SVC_ENABLE_HOT_REFRESH], conf.enable_config_hot_refresh)
+    if CM_SVC_PLACEHOLDER_RUN_AS_USER in data:
+        conf.placeholder.run_as_user = _parse_int(data[CM_SVC_PLACEHOLDER_RUN_AS_USER], conf.placeholder.run_as_user)
+    if CM_SVC_PLACEHOLDER_RUN_AS_GROUP in data:
+        conf.placeholder.run_as_group = _parse_int(data[CM_SVC_PLACEHOLDER_RUN_AS_GROUP], conf.placeholder.run_as_group)
+    if CM_SVC_PLACEHOLDER_FS_GROUP in data:
+        conf.placeholder.fs_group = _parse_int(data[CM_SVC_PLACEHOLDER_FS_GROUP], conf.placeholder.fs_group)
+    if CM_SOLVER_MAX_ROUNDS in data:
+        conf.solver_max_rounds = _parse_int(data[CM_SOLVER_MAX_ROUNDS], conf.solver_max_rounds)
+    if CM_SOLVER_POD_CHUNK in data:
+        conf.solver_pod_chunk = _parse_int(data[CM_SOLVER_POD_CHUNK], conf.solver_pod_chunk)
+    return conf
+
+
+def decompress(key: str, value: bytes) -> Tuple[str, str]:
+    """Decompress a gzip-compressed binaryData configmap entry.
+
+    The key convention is ``<real-key>.gz`` (reference Decompress, :482-507).
+    """
+    real_key = key[:-3] if key.endswith(".gz") else key
+    try:
+        raw = gzip.decompress(value)
+    except OSError:
+        try:
+            raw = gzip.decompress(base64.b64decode(value))
+        except Exception:
+            logger.error("failed to decompress configmap value for key %s", key)
+            return real_key, ""
+    return real_key, raw.decode("utf-8")
+
+
+def flatten_config_maps(config_maps: List[Optional[Dict]], binary_maps: Optional[List[Dict[str, bytes]]] = None) -> Dict[str, str]:
+    """Overlay configmaps in order: later maps win (reference FlattenConfigMaps).
+
+    Index 0 is yunikorn-defaults, index 1 is yunikorn-configs.
+    """
+    out: Dict[str, str] = {}
+    for i, cm in enumerate(config_maps):
+        if not cm:
+            continue
+        out.update({k: str(v) for k, v in cm.items()})
+        if binary_maps and i < len(binary_maps) and binary_maps[i]:
+            for k, v in binary_maps[i].items():
+                rk, rv = decompress(k, v)
+                out[rk] = rv
+    return out
+
+
+def check_non_reloadable(old: SchedulerConf, new: SchedulerConf) -> List[str]:
+    """Return the list of non-reloadable keys whose values changed (warn-only)."""
+    changed = []
+    pairs = {
+        CM_SVC_CLUSTER_ID: (old.cluster_id, new.cluster_id),
+        CM_SVC_POLICY_GROUP: (old.policy_group, new.policy_group),
+        CM_SVC_SCHEDULING_INTERVAL: (old.interval, new.interval),
+        CM_SVC_VOLUME_BIND_TIMEOUT: (old.volume_bind_timeout, new.volume_bind_timeout),
+        CM_SVC_EVENT_CHANNEL_CAPACITY: (old.event_channel_capacity, new.event_channel_capacity),
+        CM_SVC_DISPATCH_TIMEOUT: (old.dispatch_timeout, new.dispatch_timeout),
+        CM_KUBE_QPS: (old.kube_qps, new.kube_qps),
+        CM_KUBE_BURST: (old.kube_burst, new.kube_burst),
+        CM_SVC_DISABLE_GANG: (old.disable_gang_scheduling, new.disable_gang_scheduling),
+        CM_SVC_INSTANCE_TYPE_LABEL: (old.instance_type_node_label_key, new.instance_type_node_label_key),
+        CM_SVC_PLACEHOLDER_IMAGE: (old.placeholder.image, new.placeholder.image),
+        CM_SVC_PLACEHOLDER_RUN_AS_USER: (old.placeholder.run_as_user, new.placeholder.run_as_user),
+        CM_SVC_PLACEHOLDER_RUN_AS_GROUP: (old.placeholder.run_as_group, new.placeholder.run_as_group),
+        CM_SVC_PLACEHOLDER_FS_GROUP: (old.placeholder.fs_group, new.placeholder.fs_group),
+    }
+    for key, (a, b) in pairs.items():
+        if a != b:
+            changed.append(key)
+            logger.warning("ignoring non-reloadable configmap key change: %s (%r -> %r)", key, a, b)
+    return changed
+
+
+class ConfHolder:
+    """Atomic config holder with hot-reload semantics (reference confHolder)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conf = SchedulerConf()
+        self._queues_config: str = ""
+        self._extra: Dict[str, str] = {}
+
+    def get(self) -> SchedulerConf:
+        with self._lock:
+            return self._conf
+
+    def queues_config(self) -> str:
+        with self._lock:
+            return self._queues_config
+
+    def update_config_maps(self, config_maps: List[Optional[Dict]], initial: bool = False,
+                           binary_maps: Optional[List[Dict[str, bytes]]] = None) -> SchedulerConf:
+        flat = flatten_config_maps(config_maps, binary_maps)
+        with self._lock:
+            new_conf = parse_config_map(flat, SchedulerConf())
+            if not initial:
+                check_non_reloadable(self._conf, new_conf)
+                # keep old values for non-reloadable fields
+                keep = self._conf
+                new_conf.cluster_id = keep.cluster_id
+                new_conf.policy_group = keep.policy_group
+                new_conf.interval = keep.interval
+                new_conf.volume_bind_timeout = keep.volume_bind_timeout
+                new_conf.event_channel_capacity = keep.event_channel_capacity
+                new_conf.dispatch_timeout = keep.dispatch_timeout
+                new_conf.kube_qps = keep.kube_qps
+                new_conf.kube_burst = keep.kube_burst
+                new_conf.disable_gang_scheduling = keep.disable_gang_scheduling
+                new_conf.instance_type_node_label_key = keep.instance_type_node_label_key
+                new_conf.placeholder = dataclasses.replace(keep.placeholder)
+            self._conf = new_conf
+            # queues.yaml payload keyed by "<policyGroup>.yaml" or the bare policy group
+            self._queues_config = flat.get(
+                f"{new_conf.policy_group}.yaml", flat.get(new_conf.policy_group, "")
+            )
+            self._extra = {k: v for k, v in flat.items() if k.startswith(PREFIX_LOG)}
+        update_logging_config(self._extra)
+        return new_conf
+
+
+_holder = ConfHolder()
+
+
+def get_scheduler_conf() -> SchedulerConf:
+    return _holder.get()
+
+
+def get_holder() -> ConfHolder:
+    return _holder
+
+
+def reset_for_tests() -> None:
+    global _holder
+    _holder = ConfHolder()

@@ -1,0 +1,200 @@
+"""The central event dispatcher.
+
+Role-equivalent to pkg/dispatcher/dispatcher.go: a singleton with typed handlers
+for Application / Task / Node / Scheduler events (:40-46), a large buffered channel
+(capacity = conf EventChannelCapacity, default 1,048,576), non-blocking enqueue with
+an async-retry fallback (retry every 3s up to DispatchTimeout, :157-201), a hard
+failure when the number of in-flight async retries exceeds max(10000, cap/10)
+(:73,176-180), and a single consumer thread that routes by event type (:220-242).
+
+The single consumer is the concurrency linchpin: events for any one object are
+processed serially, so the FSMs never race. The TPU solver runs outside this
+thread; its results re-enter through dispatched events, same as the reference's
+core callbacks do.
+"""
+from __future__ import annotations
+
+import enum
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from yunikorn_tpu.common.events import (
+    ApplicationEvent,
+    SchedulerNodeEvent,
+    SchedulingEvent,
+    TaskEvent,
+)
+from yunikorn_tpu.log.logger import log
+
+logger = log("dispatcher")
+
+ASYNC_RETRY_INTERVAL = 3.0
+
+
+class EventType(enum.Enum):
+    APPLICATION = 1
+    TASK = 2
+    NODE = 3
+    SCHEDULER = 4
+
+
+class DispatchError(RuntimeError):
+    pass
+
+
+class Dispatcher:
+    def __init__(self, capacity: int = 1024 * 1024, dispatch_timeout: float = 300.0):
+        self._queue: "queue.Queue[Optional[SchedulingEvent]]" = queue.Queue(maxsize=capacity)
+        self._handlers: Dict[EventType, List[Callable[[SchedulingEvent], None]]] = {}
+        self._lock = threading.Lock()
+        self._running = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dispatch_timeout = dispatch_timeout
+        self._async_limit = max(10000, capacity // 10)
+        self._inflight_async = 0
+        self._drained = threading.Event()
+        self._drained.set()
+
+    # -- registration -------------------------------------------------------
+    def register_event_handler(self, name: str, event_type: EventType,
+                               handler: Callable[[SchedulingEvent], None]) -> None:
+        with self._lock:
+            self._handlers.setdefault(event_type, []).append(handler)
+        logger.debug("registered event handler %s for %s", name, event_type)
+
+    def unregister_all(self) -> None:
+        with self._lock:
+            self._handlers.clear()
+
+    # -- dispatch -----------------------------------------------------------
+    def dispatch(self, event: SchedulingEvent) -> None:
+        """Non-blocking enqueue; falls back to an async retry thread when full."""
+        if not self._running.is_set():
+            raise DispatchError("dispatcher is not running")
+        self._drained.clear()
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            with self._lock:
+                if self._inflight_async >= self._async_limit:
+                    raise DispatchError(
+                        f"dispatcher exceeded async-dispatch limit {self._async_limit}"
+                    )
+                self._inflight_async += 1
+            t = threading.Thread(target=self._async_retry, args=(event,), daemon=True)
+            t.start()
+
+    def _async_retry(self, event: SchedulingEvent) -> None:
+        deadline = time.time() + self._dispatch_timeout
+        try:
+            while self._running.is_set():
+                try:
+                    self._queue.put(event, timeout=ASYNC_RETRY_INTERVAL)
+                    return
+                except queue.Full:
+                    if time.time() > deadline:
+                        logger.error("dispatch timeout for event %s", event)
+                        return
+        finally:
+            with self._lock:
+                self._inflight_async -= 1
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        if self._running.is_set():
+            return
+        self._running.set()
+        self._thread = threading.Thread(target=self._run, name="dispatcher", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop the consumer after draining what is already queued."""
+        if not self._running.is_set():
+            return
+        self._running.clear()
+        self._queue.put(None)  # wake the consumer
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Block until the queue is empty and the consumer is idle (test helper)."""
+        return self._drained.wait(timeout=timeout)
+
+    def _run(self) -> None:
+        while True:
+            try:
+                event = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._queue.unfinished_tasks == 0:
+                    self._drained.set()
+                if not self._running.is_set():
+                    return
+                continue
+            if event is None:
+                self._queue.task_done()
+                if not self._running.is_set() and self._queue.empty():
+                    self._drained.set()
+                    return
+                continue
+            try:
+                self._route(event)
+            except Exception:
+                logger.exception("event handler failed for %s", event)
+            finally:
+                self._queue.task_done()
+                if self._queue.unfinished_tasks == 0:
+                    self._drained.set()
+
+    def _route(self, event: SchedulingEvent) -> None:
+        if isinstance(event, ApplicationEvent):
+            etype = EventType.APPLICATION
+        elif isinstance(event, TaskEvent):
+            etype = EventType.TASK
+        elif isinstance(event, SchedulerNodeEvent):
+            etype = EventType.NODE
+        else:
+            etype = EventType.SCHEDULER
+        with self._lock:
+            handlers = list(self._handlers.get(etype, ()))
+        if not handlers:
+            logger.warning("no handler registered for %s event %s", etype, event)
+        for h in handlers:
+            h(event)
+
+
+# ---------------------------------------------------------------------------
+# Module-level singleton (the reference dispatcher is package-global)
+# ---------------------------------------------------------------------------
+
+_instance: Optional[Dispatcher] = None
+_instance_lock = threading.Lock()
+
+
+def get_dispatcher() -> Dispatcher:
+    global _instance
+    with _instance_lock:
+        if _instance is None:
+            _instance = Dispatcher()
+        return _instance
+
+
+def reset_dispatcher(capacity: int = 1024 * 1024, dispatch_timeout: float = 300.0) -> Dispatcher:
+    """Replace the singleton (tests); stops any previous instance."""
+    global _instance
+    with _instance_lock:
+        if _instance is not None:
+            _instance.stop()
+        _instance = Dispatcher(capacity=capacity, dispatch_timeout=dispatch_timeout)
+        return _instance
+
+
+def dispatch(event: SchedulingEvent) -> None:
+    get_dispatcher().dispatch(event)
+
+
+def register_event_handler(name: str, event_type: EventType,
+                           handler: Callable[[SchedulingEvent], None]) -> None:
+    get_dispatcher().register_event_handler(name, event_type, handler)
