@@ -1,11 +1,19 @@
 """Root conftest: force JAX onto a virtual 8-device CPU platform for tests.
 
-Must run before jax is imported anywhere. Bench (bench.py) and the graft entry
-are run outside pytest and therefore use the real TPU.
+The environment ships an axon TPU plugin that registers at interpreter start
+(sitecustomize) and forces jax_platforms="axon,cpu" via jax.config — overriding
+the JAX_PLATFORMS env var. Tests must be hermetic (and must not dial the TPU
+relay), so this conftest re-forces the config to cpu before any backend is
+initialized. Bench (bench.py) and the graft entry run outside pytest and keep
+the real TPU.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (already imported by sitecustomize; cheap)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,194 @@
+"""Tests for the external SchedulerCache + FakeCluster informers.
+
+Mirrors the reference's scheduler_cache_test.go coverage: assign/unassign,
+assume/forget, orphan adoption, PVC refcounts, terminated-pod cleanup.
+"""
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.client.fake import FakeCluster
+from yunikorn_tpu.client.interfaces import InformerType, ResourceEventHandlers
+from yunikorn_tpu.common.objects import Volume, make_node, make_pod
+
+
+def test_add_node_and_assigned_pod():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1", cpu_milli=4000))
+    pod = make_pod("p1", cpu_milli=1000, node_name="n1", phase="Running")
+    assert cache.update_pod(pod) is True
+    info = cache.get_node("n1")
+    assert info.requested.get("cpu") == 1000
+    assert info.available().get("cpu") == 3000
+    assert cache.get_pod_node_name(pod.uid) == "n1"
+
+
+def test_orphan_pod_adopted_when_node_appears():
+    cache = SchedulerCache()
+    pod = make_pod("p1", cpu_milli=500, node_name="ghost", phase="Running")
+    assert cache.update_pod(pod) is False
+    assert cache.is_pod_orphaned(pod.uid)
+    adopted = cache.update_node(make_node("ghost"))
+    assert [p.uid for p in adopted] == [pod.uid]
+    assert not cache.is_pod_orphaned(pod.uid)
+    assert cache.get_node("ghost").requested.get("cpu") == 500
+
+
+def test_node_removal_orphans_pods():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1"))
+    pod = make_pod("p1", cpu_milli=500, node_name="n1", phase="Running")
+    cache.update_pod(pod)
+    orphans = cache.remove_node("n1")
+    assert [p.uid for p in orphans] == [pod.uid]
+    assert cache.is_pod_orphaned(pod.uid)
+
+
+def test_assume_and_forget():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1", cpu_milli=4000))
+    pod = make_pod("p1", cpu_milli=1000)
+    cache.update_pod(pod)
+    pod.spec.node_name = "n1"
+    cache.assume_pod(pod, all_volumes_bound=True)
+    assert cache.is_assumed_pod(pod.uid)
+    assert cache.are_pod_volumes_all_bound(pod.uid)
+    assert cache.get_node("n1").requested.get("cpu") == 1000
+
+    cache.forget_pod(pod)
+    assert not cache.is_assumed_pod(pod.uid)
+    assert cache.get_node("n1").requested.get("cpu") == 0
+    assert pod.spec.node_name == ""
+
+
+def test_running_update_clears_assumed():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1"))
+    pod = make_pod("p1", cpu_milli=100)
+    cache.update_pod(pod)
+    pod.spec.node_name = "n1"
+    cache.assume_pod(pod, all_volumes_bound=False)
+    bound = pod.deepcopy()
+    bound.status.phase = "Running"
+    cache.update_pod(bound)
+    assert not cache.is_assumed_pod(pod.uid)
+    assert cache.get_node("n1").requested.get("cpu") == 100  # still assigned
+
+
+def test_terminated_pod_fully_removed():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1"))
+    pod = make_pod("p1", cpu_milli=100, node_name="n1", phase="Running")
+    cache.update_pod(pod)
+    done = pod.deepcopy()
+    done.status.phase = "Succeeded"
+    cache.update_pod(done)
+    assert cache.get_pod(pod.uid) is None
+    assert cache.get_node("n1").requested.get("cpu") == 0
+
+
+def test_update_preserves_existing_assignment():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1"))
+    pod = make_pod("p1", cpu_milli=100, node_name="n1", phase="Running")
+    cache.update_pod(pod)
+    newer = pod.deepcopy()
+    newer.spec.node_name = ""  # update without nodeName keeps assignment
+    cache.update_pod(newer)
+    assert newer.spec.node_name == "n1"
+    assert cache.get_pod_node_name(pod.uid) == "n1"
+
+
+def test_pvc_ref_counts():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1"))
+    pod = make_pod("p1", cpu_milli=100, node_name="n1", phase="Running")
+    pod.spec.volumes = [Volume(name="v", pvc_claim_name="claim-a")]
+    cache.update_pod(pod)
+    assert cache.is_pvc_used_by_pods("default/claim-a")
+    cache.remove_pod(pod)
+    assert not cache.is_pvc_used_by_pods("default/claim-a")
+
+
+def test_dirty_node_tracking():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1"))
+    cache.update_node(make_node("n2"))
+    cache.take_dirty_nodes()
+    g0 = cache.generation()
+    pod = make_pod("p1", cpu_milli=100, node_name="n2", phase="Running")
+    cache.update_pod(pod)
+    assert cache.generation() > g0
+    assert cache.take_dirty_nodes() == {"n2"}
+    assert cache.take_dirty_nodes() == set()
+
+
+# ---------------------------------------------------------------------------
+# FakeCluster informer semantics
+# ---------------------------------------------------------------------------
+
+def test_fake_cluster_informer_fanout_and_replay():
+    cluster = FakeCluster()
+    seen = {"add": [], "update": [], "delete": []}
+    cluster.add_node(make_node("n1"))  # before start: stored, no event yet
+    cluster.add_event_handler(
+        InformerType.NODE,
+        ResourceEventHandlers(
+            add_fn=lambda o: seen["add"].append(o.name),
+            update_fn=lambda old, new: seen["update"].append(new.name),
+            delete_fn=lambda o: seen["delete"].append(o.name),
+        ),
+    )
+    cluster.start()  # replays existing objects
+    assert seen["add"] == ["n1"]
+    cluster.add_node(make_node("n2"))
+    cluster.update_node(make_node("n1"))
+    cluster.delete_node("n2")
+    assert seen["add"] == ["n1", "n2"]
+    assert seen["update"] == ["n1"]
+    assert seen["delete"] == ["n2"]
+
+
+def test_fake_cluster_bind_fires_update_and_stats():
+    cluster = FakeCluster()
+    cluster.start()
+    cluster.add_node(make_node("n1"))
+    pod = make_pod("p1", cpu_milli=100)
+    cluster.add_pod(pod)
+    updates = []
+    cluster.add_event_handler(
+        InformerType.POD,
+        ResourceEventHandlers(update_fn=lambda old, new: updates.append((old.spec.node_name, new.spec.node_name))),
+    )
+    client = cluster.get_client()
+    client.bind(pod, "n1")
+    assert pod.spec.node_name == "n1"
+    assert pod.status.phase == "Running"
+    assert updates == [("", "n1")]
+    assert client.bind_stats.success_count == 1
+    assert client.bind_stats.throughput() > 0
+
+
+def test_fake_cluster_filter_fn():
+    cluster = FakeCluster()
+    cluster.start()
+    seen = []
+    cluster.add_event_handler(
+        InformerType.POD,
+        ResourceEventHandlers(
+            filter_fn=lambda p: p.namespace == "wanted",
+            add_fn=lambda p: seen.append(p.name),
+        ),
+    )
+    cluster.add_pod(make_pod("a", namespace="wanted"))
+    cluster.add_pod(make_pod("b", namespace="other"))
+    assert seen == ["a"]
+
+
+def test_synthetic_generators():
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+
+    nodes = make_kwok_nodes(5)
+    assert len(nodes) == 5
+    assert nodes[0].status.allocatable["pods"] == 110
+    pods = make_sleep_pods(3, "app-1", queue="root.q1")
+    assert len(pods) == 3
+    assert pods[0].metadata.labels["applicationId"] == "app-1"
+    assert pods[0].spec.scheduler_name == "yunikorn"

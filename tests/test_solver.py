@@ -1,0 +1,267 @@
+"""Solver tests: snapshot encoding + batched predicates + assignment.
+
+Covers the reference predicate semantics (predicate_manager_test.go analog) and
+the conflict-free assignment invariants: no node oversubscription, rank order
+respected per node, unschedulable pods left unassigned.
+"""
+import numpy as np
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import (
+    Affinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+    make_node,
+    make_pod,
+)
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.ops.assign import solve_batch
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+
+def make_env(nodes):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.update_node(n)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return cache, enc
+
+
+def ask_for(pod, cpu=100, memory=2**20, key=None):
+    from yunikorn_tpu.common.resource import get_pod_resource
+
+    return AllocationAsk(
+        allocation_key=key or pod.uid,
+        application_id="app-1",
+        resource=get_pod_resource(pod),
+        pod=pod,
+    )
+
+
+def names_of(enc, result, batch):
+    out = {}
+    assigned = np.asarray(result.assigned)
+    for i, key in enumerate(batch.ask_keys):
+        idx = int(assigned[i])
+        out[key] = enc.nodes.name_of(idx) if idx >= 0 else None
+    return out
+
+
+def test_simple_fit_and_binpack():
+    cache, enc = make_env([
+        make_node("n1", cpu_milli=4000, memory=8 * 2**30),
+        make_node("n2", cpu_milli=2000, memory=4 * 2**30),
+    ])
+    pods = [make_pod(f"p{i}", cpu_milli=1000, memory=2**30) for i in range(3)]
+    asks = [ask_for(p) for p in pods]
+    batch = enc.build_batch(asks)
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert all(v is not None for v in got.values())
+    # no oversubscription
+    free = np.asarray(res.free_after)
+    assert (free >= 0).all()
+
+
+def test_no_oversubscription_under_conflict():
+    # one node that fits exactly 2 pods; 5 pods all want it
+    cache, enc = make_env([make_node("n1", cpu_milli=2000, memory=8 * 2**30, pods=110)])
+    pods = [make_pod(f"p{i}", cpu_milli=1000) for i in range(5)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    placed = [k for k, v in got.items() if v == "n1"]
+    assert len(placed) == 2
+    # FIFO: the first two by rank won
+    assert set(placed) == {pods[0].uid, pods[1].uid}
+    assert (np.asarray(res.free_after) >= 0).all()
+
+
+def test_rank_orders_scarce_capacity():
+    cache, enc = make_env([make_node("n1", cpu_milli=1000)])
+    pods = [make_pod(f"p{i}", cpu_milli=1000) for i in range(3)]
+    # rank: p2 first
+    batch = enc.build_batch([ask_for(p) for p in pods], ranks=[3.0, 2.0, 1.0])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert got[pods[2].uid] == "n1"
+    assert got[pods[0].uid] is None and got[pods[1].uid] is None
+
+
+def test_node_selector():
+    cache, enc = make_env([
+        make_node("gpu-node", labels={"accelerator": "tpu"}),
+        make_node("plain-node"),
+    ])
+    pod = make_pod("p1", cpu_milli=100, node_selector={"accelerator": "tpu"})
+    batch = enc.build_batch([ask_for(pod)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[pod.uid] == "gpu-node"
+
+
+def test_node_selector_no_match():
+    cache, enc = make_env([make_node("n1", labels={"zone": "a"})])
+    pod = make_pod("p1", cpu_milli=100, node_selector={"zone": "b"})
+    batch = enc.build_batch([ask_for(pod)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[pod.uid] is None
+
+
+def test_taints_and_tolerations():
+    taint = Taint(key="dedicated", value="batch", effect="NoSchedule")
+    cache, enc = make_env([
+        make_node("tainted", taints=[taint], cpu_milli=16000),
+        make_node("open", cpu_milli=100),  # tiny: forces toleration check to matter
+    ])
+    # intolerant pod: cannot land on tainted; fits on open
+    p1 = make_pod("intolerant", cpu_milli=50)
+    # tolerant pod: Equal match
+    p2 = make_pod("tolerant", cpu_milli=4000)
+    p2.spec.tolerations = [Toleration(key="dedicated", operator="Equal", value="batch", effect="NoSchedule")]
+    # exists-key toleration
+    p3 = make_pod("exists-tol", cpu_milli=4000)
+    p3.spec.tolerations = [Toleration(key="dedicated", operator="Exists")]
+    batch = enc.build_batch([ask_for(p) for p in (p1, p2, p3)])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert got[p1.uid] == "open"
+    assert got[p2.uid] == "tainted"
+    assert got[p3.uid] == "tainted"
+
+
+def test_node_affinity_in_and_notin():
+    cache, enc = make_env([
+        make_node("a1", labels={"zone": "a"}),
+        make_node("b1", labels={"zone": "b"}),
+        make_node("c1", labels={"zone": "c"}),
+    ])
+    # In with multiple values (any-of path)
+    p1 = make_pod("multi-in", cpu_milli=100)
+    p1.spec.affinity = Affinity(node_required_terms=[
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("zone", "In", ["a", "b"])])
+    ])
+    # NotIn
+    p2 = make_pod("notin", cpu_milli=100)
+    p2.spec.affinity = Affinity(node_required_terms=[
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("zone", "NotIn", ["a", "b"])])
+    ])
+    # Exists
+    p3 = make_pod("exists", cpu_milli=100)
+    p3.spec.affinity = Affinity(node_required_terms=[
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("zone", "Exists", [])])
+    ])
+    batch = enc.build_batch([ask_for(p) for p in (p1, p2, p3)])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert got[p1.uid] in ("a1", "b1")
+    assert got[p2.uid] == "c1"
+    assert got[p3.uid] in ("a1", "b1", "c1")
+
+
+def test_affinity_or_terms():
+    cache, enc = make_env([
+        make_node("a1", labels={"zone": "a"}),
+        make_node("b1", labels={"disk": "ssd"}),
+    ])
+    pod = make_pod("or-terms", cpu_milli=100)
+    pod.spec.affinity = Affinity(node_required_terms=[
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("zone", "In", ["zzz"])]),
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("disk", "In", ["ssd"])]),
+    ])
+    batch = enc.build_batch([ask_for(pod)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[pod.uid] == "b1"
+
+
+def test_gt_host_fallback():
+    cache, enc = make_env([
+        make_node("small", labels={"cores": "8"}),
+        make_node("big", labels={"cores": "64"}),
+    ])
+    pod = make_pod("gt", cpu_milli=100)
+    pod.spec.affinity = Affinity(node_required_terms=[
+        NodeSelectorTerm(match_expressions=[NodeSelectorRequirement("cores", "Gt", ["16"])])
+    ])
+    batch = enc.build_batch([ask_for(pod)])
+    assert batch.g_host_mask is not None
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[pod.uid] == "big"
+
+
+def test_host_port_conflict():
+    cache, enc = make_env([make_node("n1"), make_node("n2")])
+    # existing pod occupies port 8080 on n1
+    occupant = make_pod("occupant", cpu_milli=100, node_name="n1", phase="Running")
+    occupant.spec.containers[0].ports = [{"hostPort": 8080, "protocol": "TCP"}]
+    cache.update_pod(occupant)
+    enc.sync_nodes()
+    pod = make_pod("wants-8080", cpu_milli=100)
+    pod.spec.containers[0].ports = [{"hostPort": 8080, "protocol": "TCP"}]
+    batch = enc.build_batch([ask_for(pod)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[pod.uid] == "n2"
+
+
+def test_unschedulable_node_excluded():
+    cache, enc = make_env([
+        make_node("cordoned", unschedulable=True),
+        make_node("ready"),
+    ])
+    pod = make_pod("p", cpu_milli=100)
+    batch = enc.build_batch([ask_for(pod)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[pod.uid] == "ready"
+
+
+def test_incremental_capacity_update():
+    cache, enc = make_env([make_node("n1", cpu_milli=2000)])
+    # occupy half via the cache (simulates informer-observed pod)
+    occupant = make_pod("occ", cpu_milli=1000, node_name="n1", phase="Running")
+    cache.update_pod(occupant)
+    enc.sync_nodes()  # only dirty node re-encoded
+    p = make_pod("p", cpu_milli=1500)
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p.uid] is None  # only 1000m free
+    p2 = make_pod("p2", cpu_milli=900)
+    batch = enc.build_batch([ask_for(p2)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p2.uid] == "n1"
+
+
+def test_large_batch_many_nodes():
+    nodes = [make_node(f"n{i}", cpu_milli=16000, memory=16 * 2**30, pods=110) for i in range(64)]
+    cache, enc = make_env(nodes)
+    pods = [make_pod(f"p{i}", cpu_milli=500, memory=2**28) for i in range(500)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes, chunk=128)
+    got = names_of(enc, res, batch)
+    assert all(v is not None for v in got.values())
+    free = np.asarray(res.free_after)
+    assert (free >= 0).all()
+    # per-node pod count <= 110
+    counts = {}
+    for v in got.values():
+        counts[v] = counts.get(v, 0) + 1
+    assert max(counts.values()) <= 110
+
+
+def test_binpacking_prefers_packed_node():
+    cache, enc = make_env([
+        make_node("empty", cpu_milli=16000),
+        make_node("half", cpu_milli=16000),
+    ])
+    occ = make_pod("occ", cpu_milli=8000, node_name="half", phase="Running")
+    cache.update_pod(occ)
+    enc.sync_nodes()
+    p = make_pod("p", cpu_milli=1000)
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes, policy="binpacking")
+    assert names_of(enc, res, batch)[p.uid] == "half"
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    assert names_of(enc, res, batch)[p.uid] == "empty"

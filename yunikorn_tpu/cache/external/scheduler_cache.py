@@ -1,0 +1,310 @@
+"""External scheduler cache: the kube-scheduler-style view of cluster state.
+
+Role-equivalent to pkg/cache/external/scheduler_cache.go:43-60 — nodesMap /
+podsMap / assignedPods / **assumedPods** (value = volumes-all-bound) /
+**orphanedPods** (pod referencing an unknown node) / pvcRefCounts, with
+AssumePod/ForgetPod (:428-470), UpdatePod assign/unassign/orphan-adoption
+(:288-374), and updatePVCRefCounts (:559-578).
+
+Two framework-specific additions:
+  - a monotonically increasing **generation** plus per-node dirty tracking, which
+    the snapshot encoder uses for incremental device-array updates;
+  - NodeInfo keeps an exact aggregated `requested` Resource so encoding a node's
+    free capacity is O(1).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Set
+
+from yunikorn_tpu.common.objects import Node, Pod
+from yunikorn_tpu.common.resource import Resource, get_node_resource, get_pod_resource
+from yunikorn_tpu.locking.locking import RWMutex
+from yunikorn_tpu.log.logger import log
+
+logger = log("shim.cache.external")
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    """Per-node aggregate (analog of framework.NodeInfo the reference borrows)."""
+
+    node: Node
+    pods: Dict[str, Pod] = dataclasses.field(default_factory=dict)
+    requested: Resource = dataclasses.field(default_factory=Resource)
+    allocatable: Resource = dataclasses.field(default_factory=Resource)
+
+    def add_pod(self, pod: Pod) -> None:
+        key = pod.uid
+        if key in self.pods:
+            return
+        self.pods[key] = pod
+        self.requested = self.requested.add(get_pod_resource(pod))
+
+    def remove_pod(self, pod: Pod) -> bool:
+        key = pod.uid
+        if key not in self.pods:
+            return False
+        old = self.pods.pop(key)
+        self.requested = self.requested.sub(get_pod_resource(old))
+        return True
+
+    def set_node(self, node: Node) -> None:
+        self.node = node
+        self.allocatable = get_node_resource(node.status.allocatable)
+
+    def available(self) -> Resource:
+        return self.allocatable.sub(self.requested)
+
+
+class SchedulerCache:
+    def __init__(self):
+        self._lock = RWMutex()
+        self.nodes_map: Dict[str, NodeInfo] = {}
+        self.pods_map: Dict[str, Pod] = {}
+        self.pc_map: Dict[str, object] = {}
+        self.assigned_pods: Dict[str, str] = {}   # pod uid -> node name
+        self.assumed_pods: Dict[str, bool] = {}   # pod uid -> volumes all bound
+        self.orphaned_pods: Dict[str, Pod] = {}
+        self.pvc_ref_counts: Dict[str, int] = {}  # "ns/claim" -> count
+        # generation tracking for incremental snapshot encoding
+        self._generation = 0
+        self._dirty_nodes: Set[str] = set()
+        self._listeners: List[Callable[[str], None]] = []
+
+    # ------------------------------------------------------------------ nodes
+    def update_node(self, node: Node) -> List[Pod]:
+        """Add or update a node. Returns orphaned pods adopted by this node."""
+        with self._lock:
+            info = self.nodes_map.get(node.name)
+            adopted: List[Pod] = []
+            if info is None:
+                info = NodeInfo(node=node)
+                info.set_node(node)
+                self.nodes_map[node.name] = info
+                # adopt orphans that were waiting for this node (reference :296-374)
+                for key, pod in list(self.orphaned_pods.items()):
+                    if pod.spec.node_name == node.name:
+                        del self.orphaned_pods[key]
+                        info.add_pod(pod)
+                        self.assigned_pods[key] = node.name
+                        self._update_pvc_refs(pod, add=True)
+                        adopted.append(pod)
+                        logger.info("adopted orphan pod %s onto node %s", pod.key(), node.name)
+            else:
+                info.set_node(node)
+            self._mark_dirty(node.name)
+            return adopted
+
+    def remove_node(self, node_name: str) -> List[Pod]:
+        """Remove a node; its pods become orphans. Returns the orphaned pods."""
+        with self._lock:
+            info = self.nodes_map.pop(node_name, None)
+            if info is None:
+                return []
+            orphans = []
+            for key, pod in info.pods.items():
+                self.assigned_pods.pop(key, None)
+                self.orphaned_pods[key] = pod
+                self._update_pvc_refs(pod, add=False)
+                orphans.append(pod)
+            self._mark_dirty(node_name)
+            return orphans
+
+    def get_node(self, name: str) -> Optional[NodeInfo]:
+        with self._lock.reader():
+            return self.nodes_map.get(name)
+
+    def node_names(self) -> List[str]:
+        with self._lock.reader():
+            return list(self.nodes_map)
+
+    def node_count(self) -> int:
+        with self._lock.reader():
+            return len(self.nodes_map)
+
+    # ------------------------------------------------------------------- pods
+    def update_pod(self, pod: Pod) -> bool:
+        """Insert/refresh a pod; handles assignment and orphaning.
+
+        Returns False when the pod is orphaned (node not in cache), True
+        otherwise — reference updatePod (:295-374).
+        """
+        with self._lock:
+            return self._update_pod_locked(pod)
+
+    def _update_pod_locked(self, pod: Pod) -> bool:
+        key = pod.uid
+        result = True
+        cur = self.pods_map.get(key)
+        if cur is not None:
+            self.pods_map.pop(key, None)
+            self.orphaned_pods.pop(key, None)
+            node_name = self.assigned_pods.pop(key, None)
+            if node_name is not None:
+                info = self.nodes_map.get(node_name)
+                if info is not None:
+                    if not info.remove_pod(cur):
+                        logger.warning("BUG: failed to remove pod %s from node %s", cur.key(), node_name)
+                    self._update_pvc_refs(cur, add=False)
+                    self._mark_dirty(node_name)
+                if not pod.spec.node_name:
+                    # new version not assigned: keep existing assignment
+                    pod.spec.node_name = node_name
+
+        if pod.status.phase in ("Running", "Succeeded", "Failed"):
+            # pod has been bound (or finished): assumed state is obsolete
+            self.assumed_pods.pop(key, None)
+
+        if pod.is_assigned() and not pod.is_terminated():
+            info = self.nodes_map.get(pod.spec.node_name)
+            if info is None:
+                logger.info("marking pod %s as orphan (node %s not present)", pod.key(), pod.spec.node_name)
+                self.orphaned_pods[key] = pod
+                result = False
+            else:
+                info.add_pod(pod)
+                self.assigned_pods[key] = pod.spec.node_name
+                self._update_pvc_refs(pod, add=True)
+                self._mark_dirty(pod.spec.node_name)
+
+        if not pod.is_terminated():
+            self.pods_map[key] = pod
+        else:
+            self.pods_map.pop(key, None)
+            self.assigned_pods.pop(key, None)
+            self.assumed_pods.pop(key, None)
+            self.orphaned_pods.pop(key, None)
+        return result
+
+    def remove_pod(self, pod: Pod) -> None:
+        with self._lock:
+            key = pod.uid
+            node_name = self.assigned_pods.pop(key, None)
+            cur = self.pods_map.pop(key, None)
+            if node_name is not None and cur is not None:
+                info = self.nodes_map.get(node_name)
+                if info is not None:
+                    info.remove_pod(cur)
+                    self._update_pvc_refs(cur, add=False)
+                    self._mark_dirty(node_name)
+            self.assumed_pods.pop(key, None)
+            self.orphaned_pods.pop(key, None)
+
+    def get_pod(self, uid: str) -> Optional[Pod]:
+        with self._lock.reader():
+            return self.pods_map.get(uid)
+
+    def get_pod_node_name(self, uid: str) -> Optional[str]:
+        with self._lock.reader():
+            return self.assigned_pods.get(uid)
+
+    def is_pod_orphaned(self, uid: str) -> bool:
+        with self._lock.reader():
+            return uid in self.orphaned_pods
+
+    # ------------------------------------------------------------ assume/forget
+    def assume_pod(self, pod: Pod, all_volumes_bound: bool) -> None:
+        """Optimistically place a pod on its chosen node before the bind lands.
+
+        Reference AssumePod (:428-452): the pod object must already carry
+        spec.node_name. A later informer update with phase Running clears the
+        assumed flag.
+        """
+        with self._lock:
+            key = pod.uid
+            self._update_pod_locked(pod)
+            self.assumed_pods[key] = all_volumes_bound
+
+    def forget_pod(self, pod: Pod) -> None:
+        """Undo an assume (bind failed / rejected) — reference ForgetPod (:455-470)."""
+        with self._lock:
+            key = pod.uid
+            node_name = self.assigned_pods.pop(key, None)
+            cur = self.pods_map.get(key)
+            if node_name is not None and cur is not None:
+                info = self.nodes_map.get(node_name)
+                if info is not None:
+                    info.remove_pod(cur)
+                    self._update_pvc_refs(cur, add=False)
+                    self._mark_dirty(node_name)
+                # keep the pod in pods_map but unassigned
+                cur.spec.node_name = ""
+            self.assumed_pods.pop(key, None)
+
+    def is_assumed_pod(self, uid: str) -> bool:
+        with self._lock.reader():
+            return uid in self.assumed_pods
+
+    def are_pod_volumes_all_bound(self, uid: str) -> bool:
+        with self._lock.reader():
+            return self.assumed_pods.get(uid, False)
+
+    # --------------------------------------------------------- priority classes
+    def update_priority_class(self, pc) -> None:
+        with self._lock:
+            self.pc_map[pc.name] = pc
+
+    def remove_priority_class(self, name: str) -> None:
+        with self._lock:
+            self.pc_map.pop(name, None)
+
+    def get_priority_class(self, name: str):
+        with self._lock.reader():
+            return self.pc_map.get(name)
+
+    # ----------------------------------------------------------------- PVC refs
+    def _update_pvc_refs(self, pod: Pod, add: bool) -> None:
+        for vol in pod.spec.volumes:
+            if vol.pvc_claim_name:
+                key = f"{pod.namespace}/{vol.pvc_claim_name}"
+                n = self.pvc_ref_counts.get(key, 0) + (1 if add else -1)
+                if n <= 0:
+                    self.pvc_ref_counts.pop(key, None)
+                else:
+                    self.pvc_ref_counts[key] = n
+
+    def is_pvc_used_by_pods(self, key: str) -> bool:
+        with self._lock.reader():
+            return key in self.pvc_ref_counts
+
+    # ------------------------------------------------------------- generations
+    def _mark_dirty(self, node_name: str) -> None:
+        self._generation += 1
+        self._dirty_nodes.add(node_name)
+
+    def generation(self) -> int:
+        with self._lock.reader():
+            return self._generation
+
+    def take_dirty_nodes(self) -> Set[str]:
+        """Return and clear the set of nodes whose aggregates changed."""
+        with self._lock:
+            dirty = self._dirty_nodes
+            self._dirty_nodes = set()
+            return dirty
+
+    # ---------------------------------------------------------------- snapshot
+    def snapshot_nodes(self) -> List[NodeInfo]:
+        """Stable-ordered node list for the encoder."""
+        with self._lock.reader():
+            return [self.nodes_map[name] for name in sorted(self.nodes_map)]
+
+    def dao(self) -> dict:
+        """Diagnostic state dump (reference scheduler_cache_dao.go:28-117)."""
+        with self._lock.reader():
+            return {
+                "nodes": {
+                    name: {
+                        "allocatable": dict(info.allocatable.resources),
+                        "requested": dict(info.requested.resources),
+                        "podCount": len(info.pods),
+                    }
+                    for name, info in self.nodes_map.items()
+                },
+                "podCount": len(self.pods_map),
+                "assignedPods": dict(self.assigned_pods),
+                "assumedPods": dict(self.assumed_pods),
+                "orphanedPods": sorted(p.key() for p in self.orphaned_pods.values()),
+                "pvcRefCounts": dict(self.pvc_ref_counts),
+            }

@@ -1,0 +1,288 @@
+"""FakeCluster: an in-memory cluster with informer semantics.
+
+This is the framework's MockedAPIProvider + KubeClientMock analog (reference
+pkg/client/apifactory_mock.go:42-599, kubeclient_mock.go:36-235) and, scaled up,
+its kwok-style perf harness (reference deployments/kwok-perf-test). It holds the
+object store (pods/nodes/configmaps/priorityclasses), fans events out to
+registered handlers (synchronously, like client-go informers on a single informer
+goroutine), executes binds by mutating the store and re-firing update events, and
+records BindStats (first/last bind time + count) for throughput measurement
+(reference kubeclient_mock.go:51-64, used by scheduler_perf_test.go:138-142).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from yunikorn_tpu.client.interfaces import (
+    APIProvider,
+    InformerType,
+    KubeClient,
+    ResourceEventHandlers,
+)
+from yunikorn_tpu.common.objects import (
+    ConfigMap,
+    Node,
+    Pod,
+    PodCondition,
+    PriorityClass,
+)
+from yunikorn_tpu.log.logger import log
+
+logger = log("shim.client")
+
+
+@dataclasses.dataclass
+class BindStats:
+    first_bind_time: Optional[float] = None
+    last_bind_time: Optional[float] = None
+    success_count: int = 0
+    fail_count: int = 0
+
+    def throughput(self) -> float:
+        """Binds per second over the observed window (reference perf metric)."""
+        if not self.success_count or self.first_bind_time is None:
+            return 0.0
+        span = (self.last_bind_time or 0) - self.first_bind_time
+        if span <= 0:
+            return float(self.success_count)
+        return self.success_count / span
+
+
+class FakeKubeClient(KubeClient):
+    def __init__(self, cluster: "FakeCluster"):
+        self._cluster = cluster
+        self.bind_stats = BindStats()
+        self.bind_fn = None      # test hook: override bind behavior
+        self.create_fn = None
+        self.delete_fn = None
+        self._lock = threading.Lock()
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        try:
+            if self.bind_fn is not None:
+                self.bind_fn(pod, node_name)
+            else:
+                self._cluster.bind_pod(pod.uid, node_name)
+        except Exception:
+            with self._lock:
+                self.bind_stats.fail_count += 1
+            raise
+        now = time.time()
+        with self._lock:
+            if self.bind_stats.first_bind_time is None:
+                self.bind_stats.first_bind_time = now
+            self.bind_stats.last_bind_time = now
+            self.bind_stats.success_count += 1
+
+    def create(self, pod: Pod) -> Pod:
+        if self.create_fn is not None:
+            return self.create_fn(pod)
+        return self._cluster.add_pod(pod)
+
+    def delete(self, pod: Pod) -> None:
+        if self.delete_fn is not None:
+            self.delete_fn(pod)
+            return
+        self._cluster.delete_pod(pod.uid)
+
+    def update_pod_condition(self, pod: Pod, condition: PodCondition) -> bool:
+        # dedup identical conditions (reference task.go:577-597)
+        for existing in pod.status.conditions:
+            if (existing.type == condition.type and existing.status == condition.status
+                    and existing.reason == condition.reason and existing.message == condition.message):
+                return False
+        pod.status.conditions = [c for c in pod.status.conditions if c.type != condition.type]
+        pod.status.conditions.append(condition)
+        return True
+
+    def get_configmap(self, namespace: str, name: str) -> Optional[ConfigMap]:
+        return self._cluster.get_configmap(namespace, name)
+
+
+class FakeCluster(APIProvider):
+    """In-memory cluster: object store + synchronous informer fan-out."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods: Dict[str, Pod] = {}
+        self._nodes: Dict[str, Node] = {}
+        self._configmaps: Dict[str, ConfigMap] = {}
+        self._priority_classes: Dict[str, PriorityClass] = {}
+        self._handlers: Dict[InformerType, List[ResourceEventHandlers]] = {}
+        self._client = FakeKubeClient(self)
+        self._started = False
+
+    # ------------------------------------------------------------ APIProvider
+    def add_event_handler(self, informer: InformerType, handlers: ResourceEventHandlers) -> None:
+        with self._lock:
+            self._handlers.setdefault(informer, []).append(handlers)
+            # late registration replays adds, like informer cache sync
+            if self._started:
+                for obj in self._objects_of(informer):
+                    self._fire_one(handlers, "add", obj)
+
+    def get_client(self) -> FakeKubeClient:
+        return self._client
+
+    def start(self) -> None:
+        with self._lock:
+            self._started = True
+            # replay existing objects to all handlers (informer initial sync)
+            for informer, hs in self._handlers.items():
+                for obj in self._objects_of(informer):
+                    for h in hs:
+                        self._fire_one(h, "add", obj)
+
+    def stop(self) -> None:
+        self._started = False
+
+    def wait_for_sync(self) -> None:
+        return  # synchronous fan-out: always in sync
+
+    def list_pods(self) -> List[Pod]:
+        with self._lock:
+            return list(self._pods.values())
+
+    def list_nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def list_priority_classes(self) -> List[PriorityClass]:
+        with self._lock:
+            return list(self._priority_classes.values())
+
+    # ------------------------------------------------------------ object CRUD
+    def add_pod(self, pod: Pod) -> Pod:
+        with self._lock:
+            self._pods[pod.uid] = pod
+        self._fire(InformerType.POD, "add", pod)
+        return pod
+
+    def update_pod(self, pod: Pod, old: Optional[Pod] = None) -> None:
+        with self._lock:
+            prev = old if old is not None else self._pods.get(pod.uid, pod)
+            self._pods[pod.uid] = pod
+        self._fire(InformerType.POD, "update", pod, prev)
+
+    def delete_pod(self, uid: str) -> None:
+        with self._lock:
+            pod = self._pods.pop(uid, None)
+        if pod is not None:
+            self._fire(InformerType.POD, "delete", pod)
+
+    def get_pod(self, uid: str) -> Optional[Pod]:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def bind_pod(self, uid: str, node_name: str) -> None:
+        """Execute a bind: set nodeName + phase Running, fire an update event."""
+        with self._lock:
+            pod = self._pods.get(uid)
+            if pod is None:
+                raise KeyError(f"bind: pod {uid} not found")
+            if node_name not in self._nodes:
+                raise KeyError(f"bind: node {node_name} not found")
+            old = pod.deepcopy()
+            pod.spec.node_name = node_name
+            pod.status.phase = "Running"
+        self._fire(InformerType.POD, "update", pod, old)
+
+    def succeed_pod(self, uid: str) -> None:
+        with self._lock:
+            pod = self._pods.get(uid)
+            if pod is None:
+                return
+            old = pod.deepcopy()
+            pod.status.phase = "Succeeded"
+        self._fire(InformerType.POD, "update", pod, old)
+
+    def fail_pod(self, uid: str, reason: str = "Error") -> None:
+        with self._lock:
+            pod = self._pods.get(uid)
+            if pod is None:
+                return
+            old = pod.deepcopy()
+            pod.status.phase = "Failed"
+            pod.status.reason = reason
+        self._fire(InformerType.POD, "update", pod, old)
+
+    def add_node(self, node: Node) -> Node:
+        with self._lock:
+            self._nodes[node.name] = node
+        self._fire(InformerType.NODE, "add", node)
+        return node
+
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            old = self._nodes.get(node.name, node)
+            self._nodes[node.name] = node
+        self._fire(InformerType.NODE, "update", node, old)
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+        if node is not None:
+            self._fire(InformerType.NODE, "delete", node)
+
+    def get_node(self, name: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def add_configmap(self, cm: ConfigMap) -> None:
+        with self._lock:
+            old = self._configmaps.get(f"{cm.metadata.namespace}/{cm.metadata.name}")
+            self._configmaps[f"{cm.metadata.namespace}/{cm.metadata.name}"] = cm
+        self._fire(InformerType.CONFIGMAP, "update" if old else "add", cm, old)
+
+    def get_configmap(self, namespace: str, name: str) -> Optional[ConfigMap]:
+        with self._lock:
+            return self._configmaps.get(f"{namespace}/{name}")
+
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self._priority_classes[pc.name] = pc
+        self._fire(InformerType.PRIORITY_CLASS, "add", pc)
+
+    def delete_priority_class(self, name: str) -> None:
+        with self._lock:
+            pc = self._priority_classes.pop(name, None)
+        if pc is not None:
+            self._fire(InformerType.PRIORITY_CLASS, "delete", pc)
+
+    # ----------------------------------------------------------------- events
+    def _objects_of(self, informer: InformerType) -> List[object]:
+        if informer == InformerType.POD:
+            return list(self._pods.values())
+        if informer == InformerType.NODE:
+            return list(self._nodes.values())
+        if informer == InformerType.CONFIGMAP:
+            return list(self._configmaps.values())
+        if informer == InformerType.PRIORITY_CLASS:
+            return list(self._priority_classes.values())
+        return []
+
+    def _fire(self, informer: InformerType, kind: str, obj, old=None) -> None:
+        with self._lock:
+            handlers = list(self._handlers.get(informer, ()))
+            started = self._started
+        if not started:
+            return
+        for h in handlers:
+            self._fire_one(h, kind, obj, old)
+
+    @staticmethod
+    def _fire_one(h: ResourceEventHandlers, kind: str, obj, old=None) -> None:
+        try:
+            if h.filter_fn is not None and not h.filter_fn(obj):
+                return
+            if kind == "add" and h.add_fn is not None:
+                h.add_fn(obj)
+            elif kind == "update" and h.update_fn is not None:
+                h.update_fn(old, obj)
+            elif kind == "delete" and h.delete_fn is not None:
+                h.delete_fn(obj)
+        except Exception:
+            logger.exception("informer handler failed (%s %s)", kind, obj)

@@ -1,0 +1,94 @@
+"""Synthetic cluster/workload generators: the kwok-perf-test analog.
+
+Reference: deployments/kwok-perf-test/kwok-setup.sh:30-60 (N fake nodes with
+32 CPU / 256 Gi / 110 pods) and deploy-tool.sh:35-67 (sleep-pod deployments
+labeled applicationId + queue). These helpers produce the same shapes against
+FakeCluster for benchmarks and tests, covering the five BASELINE.md configs.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import Node, Pod, Taint, make_node, make_pod
+
+
+def make_kwok_nodes(
+    count: int,
+    cpu_milli: int = 32000,
+    memory: int = 256 * 2**30,
+    pods: int = 110,
+    labels: Optional[Dict[str, str]] = None,
+    name_prefix: str = "kwok-node",
+) -> List[Node]:
+    base_labels = {"type": "kwok", "kubernetes.io/role": "agent"}
+    base_labels.update(labels or {})
+    return [
+        make_node(
+            f"{name_prefix}-{i}",
+            cpu_milli=cpu_milli,
+            memory=memory,
+            pods=pods,
+            labels=dict(base_labels),
+        )
+        for i in range(count)
+    ]
+
+
+def make_sleep_pods(
+    count: int,
+    app_id: str,
+    queue: str = "root.default",
+    namespace: str = "default",
+    cpu_milli: int = 100,
+    memory: int = 50 * 2**20,
+    name_prefix: Optional[str] = None,
+    priority: Optional[int] = None,
+    extra_labels: Optional[Dict[str, str]] = None,
+) -> List[Pod]:
+    prefix = name_prefix or f"{app_id}-pod"
+    labels = {
+        constants.LABEL_APPLICATION_ID: app_id,
+        constants.LABEL_QUEUE_NAME: queue,
+    }
+    labels.update(extra_labels or {})
+    return [
+        make_pod(
+            f"{prefix}-{i}",
+            namespace=namespace,
+            cpu_milli=cpu_milli,
+            memory=memory,
+            labels=dict(labels),
+            scheduler_name=constants.SCHEDULER_NAME,
+            priority=priority,
+        )
+        for i in range(count)
+    ]
+
+
+def make_mixed_binpack_pods(
+    count: int,
+    app_id: str,
+    queue: str = "root.default",
+    seed: int = 0,
+    gpu_fraction: float = 0.3,
+) -> List[Pod]:
+    """Config #5 workload: GPU+CPU+mem pods with varied shapes."""
+    rng = random.Random(seed)
+    pods = []
+    for i in range(count):
+        has_gpu = rng.random() < gpu_fraction
+        pod = make_pod(
+            f"{app_id}-mix-{i}",
+            cpu_milli=rng.choice([250, 500, 1000, 2000, 4000]),
+            memory=rng.choice([2**28, 2**29, 2**30, 2**31]),
+            labels={
+                constants.LABEL_APPLICATION_ID: app_id,
+                constants.LABEL_QUEUE_NAME: queue,
+            },
+            scheduler_name=constants.SCHEDULER_NAME,
+            extra_resources={"nvidia.com/gpu": rng.choice([1, 2, 4])} if has_gpu else None,
+        )
+        pods.append(pod)
+    return pods

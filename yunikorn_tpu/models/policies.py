@@ -1,0 +1,49 @@
+"""Node scoring policies.
+
+The reference delegates scoring to yunikorn-core's node-sorting policies
+(binpacking / fair, configured per partition in queues.yaml). Here scoring is a
+device function over the node state; policies are pure and separable where
+possible (a per-node score shared by every pod in the batch maximizes fusion and
+avoids an [N, M] materialization), with an optional MXU alignment term that is a
+[C, R] × [R, M] matmul computed per pod-chunk.
+
+Policies:
+  binpacking — prefer nodes with the least normalized free capacity (tight
+               packing, the reference's bin-packing e2e behavior)
+  spread     — prefer nodes with the most normalized free capacity
+               (resource_fairness behavior)
+  align      — binpacking plus a request/free alignment dot-product, so pods
+               go to nodes whose free-resource *shape* matches the request
+               (reduces stranding of unbalanced capacity; MXU-friendly)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+POLICIES = ("binpacking", "spread", "align")
+
+
+def node_base_scores(free_i32, capacity_i32, policy: str) -> jnp.ndarray:
+    """Per-node score [M] shared by all pods; higher is better."""
+    free = free_i32.astype(jnp.float32)
+    cap = jnp.maximum(capacity_i32.astype(jnp.float32), 1.0)
+    # mean normalized free capacity in [0, 1]
+    norm_free = jnp.mean(free / cap, axis=1)
+    if policy == "spread":
+        return norm_free
+    # binpacking and align share the packed base
+    return 1.0 - norm_free
+
+
+def alignment_scores(req_chunk_i32, free_i32, capacity_i32) -> jnp.ndarray:
+    """[C, M] request/free shape-alignment bonus (MXU matmul).
+
+    Normalized dot product between the request vector and each node's free
+    vector. Scaled small so the packing base dominates and alignment breaks
+    ties.
+    """
+    cap = jnp.maximum(capacity_i32.astype(jnp.float32), 1.0)
+    free_n = free_i32.astype(jnp.float32) / cap                     # [M, R]
+    req = req_chunk_i32.astype(jnp.float32)
+    req_n = req / jnp.maximum(jnp.linalg.norm(req, axis=1, keepdims=True), 1e-6)
+    return 0.125 * (req_n @ free_n.T)                                # [C, M]

@@ -1,0 +1,74 @@
+"""Batched predicate evaluation on device.
+
+Replaces the reference's per-(pod,node) predicate upcall hot loop
+(pkg/plugin/predicates/predicate_manager.go:130-215 — PreFilter+Filter per probe;
+invoked once per pod×node by the core, scheduler_callback.go:196-198). Here the
+same checks run for all constraint-groups × all nodes in one XLA program:
+
+  - node selector / required node affinity (In/NotIn/Exists/DoesNotExist, OR of
+    terms, AND of expressions, multi-value In via any-of bitsets)
+  - taints/tolerations (NoSchedule + NoExecute are hard filters, matching the
+    reference's TaintToleration filter)
+  - host-port conflicts (NodePorts plugin analog)
+  - node schedulable/valid state (NodeUnschedulable plugin analog)
+
+Resource fit (NodeResourcesFit analog) is *not* here: it is per-pod, changes as
+capacity updates during assignment rounds, and therefore lives inside the
+assignment loop (ops/assign.py). Group feasibility is round-invariant, so it is
+evaluated once per solve.
+
+All loops over bitset words/terms are static Python loops — XLA unrolls and
+fuses them into a single elementwise kernel over [G, M].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def group_feasibility(
+    g_term_req,    # [G, T, W] uint32
+    g_term_forb,   # [G, T, W] uint32
+    g_term_valid,  # [G, T] bool
+    g_anyof,       # [G, T, E, W] uint32
+    g_anyof_valid, # [G, T, E] bool
+    g_tol,         # [G, Wt] uint32
+    g_ports,       # [G, Wp] uint32
+    node_labels,   # [M, W] uint32
+    node_taints,   # [M, Wt] uint32 (hard effects only)
+    node_ports,    # [M, Wp] uint32
+    node_ok,       # [M] bool (valid & schedulable)
+) -> jnp.ndarray:  # [G, M] bool
+    G, T, W = g_term_req.shape
+    E = g_anyof.shape[2]
+    M = node_labels.shape[0]
+    Wt = g_tol.shape[1]
+    Wp = g_ports.shape[1]
+
+    # --- selector / affinity terms ---
+    term_ok = jnp.ones((G, T, M), bool)
+    for w in range(W):
+        nl = node_labels[:, w][None, None, :]                      # [1,1,M]
+        term_ok &= (g_term_req[:, :, w][:, :, None] & ~nl) == 0
+        term_ok &= (g_term_forb[:, :, w][:, :, None] & nl) == 0
+    for e in range(E):
+        hit = jnp.zeros((G, T, M), bool)
+        for w in range(W):
+            hit |= (g_anyof[:, :, e, w][:, :, None] & node_labels[:, w][None, None, :]) != 0
+        term_ok &= (~g_anyof_valid[:, :, e][:, :, None]) | hit
+    sel_ok = jnp.any(term_ok & g_term_valid[:, :, None], axis=1)   # [G, M]
+
+    # --- taints vs tolerations ---
+    taint_bad = jnp.zeros((G, M), bool)
+    for w in range(Wt):
+        taint_bad |= (node_taints[:, w][None, :] & ~g_tol[:, w][:, None]) != 0
+
+    # --- host-port conflicts ---
+    port_bad = jnp.zeros((G, M), bool)
+    for w in range(Wp):
+        port_bad |= (g_ports[:, w][:, None] & node_ports[:, w][None, :]) != 0
+
+    return sel_ok & ~taint_bad & ~port_bad & node_ok[None, :]
+
+
+group_feasibility_jit = jax.jit(group_feasibility)

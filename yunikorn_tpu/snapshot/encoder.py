@@ -1,0 +1,609 @@
+"""Snapshot encoder: incremental cluster state → dense device-ready arrays.
+
+This layer replaces the role the reference's SchedulerCache plays for the
+predicate plugins (pkg/cache/external/scheduler_cache.go feeding
+pkg/plugin/predicates): instead of handing framework.NodeInfo objects to Go
+plugins one (pod,node) pair at a time, it maintains the cluster as dense
+host-side numpy buffers that upload to the TPU per solve:
+
+  node arrays  free[M,R] f32, labels[M,W] u32, taints_hard[M,Wt] u32,
+               taints_soft[M,Wt] u32, ports[M,Wp] u32, schedulable[M] bool,
+               valid[M] bool
+  pod batches  req[N,R] f32, group_id[N] i32, rank[N] f32, valid[N] bool
+  constraint groups (deduped by signature — a deployment's pods share one):
+               req/forb bitsets [G,T,W], any-of bitsets [G,T,E,W],
+               tolerations [G,Wt], ports [G,Wp], host_mask [G,M]
+
+Symbolic predicates (selectors, affinity expressions, tolerations) become
+bitset tests via snapshot/vocab.py. Expressions that cannot be tensorized
+(Gt/Lt) are evaluated per-group on the host into `host_mask` — still O(G·M)
+vectorized numpy, never per-pod.
+
+Incrementality: node rows are re-encoded only for nodes the SchedulerCache
+marked dirty; groups are re-encoded only when the taint vocab grew (Exists
+tolerations are expanded against the taint vocab at encode time).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from yunikorn_tpu.cache.external.scheduler_cache import NodeInfo, SchedulerCache
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import Affinity, Node, Pod, Toleration
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.snapshot.vocab import (
+    BitVocab,
+    Vocabs,
+    label_bit,
+    label_key_bit,
+    port_bit,
+    taint_bit,
+)
+
+logger = log("shim.snapshot")
+
+MAX_TERMS = 8        # OR-terms per group (nodeSelector + affinity terms)
+MAX_ANYOF = 8        # multi-value In expressions per term
+
+
+from yunikorn_tpu.snapshot.vocab import _next_pow2 as _bucket
+
+
+def _set_bit(arr: np.ndarray, bit: int) -> None:
+    arr[bit // 32] |= np.uint32(1 << (bit % 32))
+
+
+@dataclasses.dataclass
+class GroupSpec:
+    """Decoded constraint signature for one group."""
+
+    term_req: np.ndarray       # [T, W] u32
+    term_forb: np.ndarray      # [T, W] u32
+    term_valid: np.ndarray     # [T] bool
+    anyof: np.ndarray          # [T, E, W] u32
+    anyof_valid: np.ndarray    # [T, E] bool
+    tolerations: np.ndarray    # [Wt] u32
+    ports: np.ndarray          # [Wp] u32
+    needs_host_eval: bool
+    host_exprs: List[Tuple[str, str, str]]  # (key, op, value) Gt/Lt expressions
+    taint_vocab_version: int
+
+
+@dataclasses.dataclass
+class PodBatch:
+    """One solve batch: everything the assignment kernel needs for N pods."""
+
+    ask_keys: List[str]             # ask index -> allocation key (unpadded length)
+    req: np.ndarray                 # [N, R] f32
+    group_id: np.ndarray            # [N] i32
+    rank: np.ndarray                # [N] f32 (lower = scheduled first)
+    valid: np.ndarray               # [N] bool
+    queue_id: np.ndarray            # [N] i32 (leaf queue index; -1 = no quota)
+    # group tensors
+    g_term_req: np.ndarray          # [G, T, W]
+    g_term_forb: np.ndarray         # [G, T, W]
+    g_term_valid: np.ndarray        # [G, T]
+    g_anyof: np.ndarray             # [G, T, E, W]
+    g_anyof_valid: np.ndarray       # [G, T, E]
+    g_tol: np.ndarray               # [G, Wt]
+    g_ports: np.ndarray             # [G, Wp]
+    g_host_mask: Optional[np.ndarray]  # [G, M] bool or None
+    num_pods: int
+    num_groups: int
+
+
+class NodeArrays:
+    """Incrementally maintained dense node-side state."""
+
+    def __init__(self, vocabs: Vocabs, min_capacity: int = 128):
+        self.vocabs = vocabs
+        self.capacity = min_capacity
+        self._name_to_idx: Dict[str, int] = {}
+        self._idx_to_name: Dict[int, str] = {}
+        self._free_rows: List[int] = list(range(min_capacity))
+        self._R = vocabs.resources.num_slots
+        self._W = vocabs.labels.num_words
+        self._Wt = vocabs.taints.num_words
+        self._Wp = vocabs.ports.num_words
+        self._alloc_arrays()
+        self.version = 0
+
+    def _alloc_arrays(self) -> None:
+        m = self.capacity
+        self.free = np.zeros((m, self._R), np.float32)
+        self.capacity_arr = np.zeros((m, self._R), np.float32)
+        self.labels = np.zeros((m, self._W), np.uint32)
+        self.taints_hard = np.zeros((m, self._Wt), np.uint32)
+        self.taints_soft = np.zeros((m, self._Wt), np.uint32)
+        self.ports = np.zeros((m, self._Wp), np.uint32)
+        self.schedulable = np.zeros((m,), bool)
+        self.valid = np.zeros((m,), bool)
+
+    def ensure_padding(self) -> None:
+        """Repad arrays after external vocab growth (e.g. during group encode)."""
+        self._maybe_grow()
+
+    def _maybe_grow(self) -> None:
+        grew = False
+        if not self._free_rows:
+            old = self.capacity
+            self.capacity *= 2
+            for arr_name in ("free", "capacity_arr", "labels", "taints_hard", "taints_soft", "ports"):
+                arr = getattr(self, arr_name)
+                new = np.zeros((self.capacity,) + arr.shape[1:], arr.dtype)
+                new[:old] = arr
+                setattr(self, arr_name, new)
+            for arr_name in ("schedulable", "valid"):
+                arr = getattr(self, arr_name)
+                new = np.zeros((self.capacity,), arr.dtype)
+                new[:old] = arr
+                setattr(self, arr_name, new)
+            self._free_rows = list(range(old, self.capacity))
+            grew = True
+        # vocab growth: re-pad the bitset/resource dims
+        R, W = self.vocabs.resources.num_slots, self.vocabs.labels.num_words
+        Wt, Wp = self.vocabs.taints.num_words, self.vocabs.ports.num_words
+        if (R, W, Wt, Wp) != (self._R, self._W, self._Wt, self._Wp):
+            def repad(arr, dim):
+                if arr.shape[1] == dim:
+                    return arr
+                new = np.zeros((arr.shape[0], dim), arr.dtype)
+                new[:, : arr.shape[1]] = arr
+                return new
+
+            self.free = repad(self.free, R)
+            self.capacity_arr = repad(self.capacity_arr, R)
+            self.labels = repad(self.labels, W)
+            self.taints_hard = repad(self.taints_hard, Wt)
+            self.taints_soft = repad(self.taints_soft, Wt)
+            self.ports = repad(self.ports, Wp)
+            self._R, self._W, self._Wt, self._Wp = R, W, Wt, Wp
+            grew = True
+        if grew:
+            self.version += 1
+
+    def index_of(self, name: str) -> Optional[int]:
+        return self._name_to_idx.get(name)
+
+    def name_of(self, idx: int) -> Optional[str]:
+        return self._idx_to_name.get(idx)
+
+    def encode_node(self, info: NodeInfo, schedulable: bool = True) -> int:
+        """(Re-)encode one node row. Returns the row index."""
+        rv = self.vocabs.resources
+        # Intern all symbols first (may grow vocabs → repad before writing).
+        node = info.node
+        res_slots = [(rv.slot(name), value / rv.scale(name))
+                     for name, value in info.available().resources.items()]
+        cap_slots = [(rv.slot(name), value / rv.scale(name))
+                     for name, value in info.allocatable.resources.items()]
+        label_bits: List[int] = []
+        for k, v in node.metadata.labels.items():
+            label_bits.append(self.vocabs.labels.bit(label_bit(k, v)))
+            label_bits.append(self.vocabs.labels.bit(label_key_bit(k)))
+        # the node name is matchable via the well-known hostname label
+        label_bits.append(self.vocabs.labels.bit(label_bit("kubernetes.io/hostname", node.name)))
+        label_bits.append(self.vocabs.labels.bit(label_key_bit("kubernetes.io/hostname")))
+        hard_bits: List[int] = []
+        soft_bits: List[int] = []
+        for t in node.spec.taints:
+            b = self.vocabs.taints.bit(taint_bit(t.key, t.value, t.effect))
+            if t.effect == constants.TAINT_EFFECT_PREFER_NO_SCHEDULE:
+                soft_bits.append(b)
+            else:
+                hard_bits.append(b)
+        port_bits: List[int] = []
+        for pod in info.pods.values():
+            for c in pod.spec.containers:
+                for p in c.ports:
+                    hp = p.get("hostPort")
+                    if hp:
+                        port_bits.append(self.vocabs.ports.bit(port_bit(p.get("protocol", "TCP"), hp)))
+
+        self._maybe_grow()
+        idx = self._name_to_idx.get(node.name)
+        if idx is None:
+            idx = self._free_rows.pop(0)
+            self._name_to_idx[node.name] = idx
+            self._idx_to_name[idx] = node.name
+
+        self.free[idx] = 0.0
+        for slot, val in res_slots:
+            self.free[idx, slot] = val
+        self.capacity_arr[idx] = 0.0
+        for slot, val in cap_slots:
+            self.capacity_arr[idx, slot] = val
+        self.labels[idx] = 0
+        for b in label_bits:
+            _set_bit(self.labels[idx], b)
+        self.taints_hard[idx] = 0
+        for b in hard_bits:
+            _set_bit(self.taints_hard[idx], b)
+        self.taints_soft[idx] = 0
+        for b in soft_bits:
+            _set_bit(self.taints_soft[idx], b)
+        self.ports[idx] = 0
+        for b in port_bits:
+            _set_bit(self.ports[idx], b)
+        self.schedulable[idx] = schedulable and not node.spec.unschedulable
+        self.valid[idx] = True
+        self.version += 1
+        return idx
+
+    def remove_node(self, name: str) -> None:
+        idx = self._name_to_idx.pop(name, None)
+        if idx is None:
+            return
+        self._idx_to_name.pop(idx, None)
+        self.valid[idx] = False
+        self.schedulable[idx] = False
+        self.free[idx] = 0.0
+        self._free_rows.append(idx)
+        self.version += 1
+
+    def set_schedulable(self, name: str, schedulable: bool) -> None:
+        idx = self._name_to_idx.get(name)
+        if idx is not None:
+            self.schedulable[idx] = schedulable
+            self.version += 1
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self._name_to_idx)
+
+
+class SnapshotEncoder:
+    """Maintains NodeArrays against a SchedulerCache + encodes pod batches."""
+
+    def __init__(self, cache: SchedulerCache, vocabs: Optional[Vocabs] = None):
+        self.cache = cache
+        self.vocabs = vocabs or Vocabs()
+        self.nodes = NodeArrays(self.vocabs)
+        self._group_cache: Dict[tuple, Tuple[int, GroupSpec]] = {}
+        self._unschedulable_overrides: Dict[str, bool] = {}
+        self._taint_version = 0
+
+    # ------------------------------------------------------------------ nodes
+    def sync_nodes(self, full: bool = False) -> None:
+        """Re-encode dirty (or all) nodes from the scheduler cache."""
+        if full:
+            names = set(self.cache.node_names())
+            # also drop rows for nodes no longer in the cache
+            for name in list(self.nodes._name_to_idx):
+                if name not in names:
+                    self.nodes.remove_node(name)
+            dirty = names
+        else:
+            dirty = self.cache.take_dirty_nodes()
+        for name in dirty:
+            info = self.cache.get_node(name)
+            if info is None:
+                self.nodes.remove_node(name)
+            else:
+                sched = self._unschedulable_overrides.get(name, True)
+                self.nodes.encode_node(info, schedulable=sched)
+        # taint vocab may have grown; bump group invalidation version
+        self._taint_version = self.vocabs.taints.used_bits()
+
+    def set_node_schedulable(self, name: str, schedulable: bool) -> None:
+        """Core-driven schedulable state (DRAIN vs READY), kept across re-encodes."""
+        self._unschedulable_overrides[name] = schedulable
+        self.nodes.set_schedulable(name, schedulable)
+
+    # ------------------------------------------------------------------- pods
+    def _group_signature(self, pod: Pod) -> tuple:
+        sel = tuple(sorted(pod.spec.node_selector.items()))
+        tols = tuple(
+            (t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations
+        )
+        aff: tuple = ()
+        if pod.spec.affinity is not None:
+            parts = []
+            for term in pod.spec.affinity.node_required_terms:
+                exprs = tuple(
+                    (e.key, e.operator, tuple(e.values)) for e in term.match_expressions
+                ) + tuple(
+                    ("__field__" + e.key, e.operator, tuple(e.values)) for e in term.match_fields
+                )
+                parts.append(exprs)
+            aff = tuple(parts)
+        ports = tuple(
+            sorted(
+                (p.get("protocol", "TCP"), p["hostPort"])
+                for c in pod.spec.containers
+                for p in c.ports
+                if p.get("hostPort")
+            )
+        )
+        return (sel, tols, aff, ports)
+
+    def _encode_group(self, pod: Pod) -> GroupSpec:
+        W = self.vocabs.labels.num_words
+        Wt = self.vocabs.taints.num_words
+        Wp = self.vocabs.ports.num_words
+        lv, tv, pv = self.vocabs.labels, self.vocabs.taints, self.vocabs.ports
+
+        # --- node selector + affinity terms ---
+        base_req = np.zeros((W,), np.uint32)
+        for k, v in pod.spec.node_selector.items():
+            _set_bit(base_req, lv.bit(label_bit(k, v)))
+
+        affinity_terms = (
+            pod.spec.affinity.node_required_terms if pod.spec.affinity else []
+        )
+        n_terms = max(1, len(affinity_terms))
+        host_exprs: List[Tuple[str, str, str]] = []
+        term_req = np.zeros((MAX_TERMS, W), np.uint32)
+        term_forb = np.zeros((MAX_TERMS, W), np.uint32)
+        term_valid = np.zeros((MAX_TERMS,), bool)
+        anyof = np.zeros((MAX_TERMS, MAX_ANYOF, W), np.uint32)
+        anyof_valid = np.zeros((MAX_TERMS, MAX_ANYOF), bool)
+        if n_terms > MAX_TERMS:
+            logger.warning("pod %s has %d affinity terms; truncating to %d", pod.key(), n_terms, MAX_TERMS)
+            n_terms = MAX_TERMS
+        for t in range(n_terms):
+            term_valid[t] = True
+            term_req[t] = base_req
+            if t < len(affinity_terms):
+                e_idx = 0
+                for e in affinity_terms[t].match_expressions:
+                    if e.operator == "In":
+                        if len(e.values) == 1:
+                            _set_bit(term_req[t], lv.bit(label_bit(e.key, e.values[0])))
+                        else:
+                            if e_idx >= MAX_ANYOF:
+                                logger.warning("pod %s: too many multi-value In exprs; host fallback", pod.key())
+                                host_exprs.append((e.key, "In", ",".join(e.values)))
+                                continue
+                            for v in e.values:
+                                _set_bit(anyof[t, e_idx], lv.bit(label_bit(e.key, v)))
+                            anyof_valid[t, e_idx] = True
+                            e_idx += 1
+                    elif e.operator == "NotIn":
+                        for v in e.values:
+                            _set_bit(term_forb[t], lv.bit(label_bit(e.key, v)))
+                    elif e.operator == "Exists":
+                        _set_bit(term_req[t], lv.bit(label_key_bit(e.key)))
+                    elif e.operator == "DoesNotExist":
+                        _set_bit(term_forb[t], lv.bit(label_key_bit(e.key)))
+                    elif e.operator in ("Gt", "Lt"):
+                        host_exprs.append((e.key, e.operator, e.values[0] if e.values else "0"))
+                    else:
+                        logger.warning("unsupported node-affinity operator %s", e.operator)
+                for e in affinity_terms[t].match_fields:
+                    # metadata.name is the only supported field (as in K8s);
+                    # it is matchable through the hostname label bits
+                    if e.key != "metadata.name":
+                        logger.warning("unsupported matchFields key %s", e.key)
+                    elif e.operator == "In":
+                        if len(e.values) == 1:
+                            _set_bit(term_req[t], lv.bit(label_bit("kubernetes.io/hostname", e.values[0])))
+                        else:
+                            host_exprs.append(("metadata.name", "In", ",".join(e.values)))
+                    elif e.operator == "NotIn":
+                        for v in e.values:
+                            _set_bit(term_forb[t], lv.bit(label_bit("kubernetes.io/hostname", v)))
+                    else:
+                        logger.warning("unsupported matchFields operator %s", e.operator)
+
+        # --- tolerations (expand Exists against the current taint vocab) ---
+        tol = np.zeros((Wt,), np.uint32)
+        for t in pod.spec.tolerations:
+            effects = (
+                [t.effect]
+                if t.effect
+                else [constants.TAINT_EFFECT_NO_SCHEDULE,
+                      constants.TAINT_EFFECT_PREFER_NO_SCHEDULE,
+                      constants.TAINT_EFFECT_NO_EXECUTE]
+            )
+            if t.operator == "Exists" and not t.key:
+                tol[:] = np.uint32(0xFFFFFFFF)  # tolerate everything
+                continue
+            for eff in effects:
+                if t.operator == "Exists":
+                    # tolerate every known (key, value, eff) triple with this key
+                    for sym, bit in self.vocabs.taints.symbols():
+                        if sym[1] == t.key and sym[3] == eff:
+                            _set_bit(tol, bit)
+                    # and intern a marker so future encodes see the key
+                    _set_bit(tol, tv.bit(taint_bit(t.key, t.value or "", eff)))
+                else:
+                    b = tv.lookup(taint_bit(t.key, t.value, eff))
+                    if b >= 0:
+                        _set_bit(tol, b)
+        # --- host ports ---
+        ports = np.zeros((Wp,), np.uint32)
+        for c in pod.spec.containers:
+            for p in c.ports:
+                hp = p.get("hostPort")
+                if hp:
+                    _set_bit(ports, pv.bit(port_bit(p.get("protocol", "TCP"), hp)))
+
+        return GroupSpec(
+            term_req=term_req,
+            term_forb=term_forb,
+            term_valid=term_valid,
+            anyof=anyof,
+            anyof_valid=anyof_valid,
+            tolerations=tol,
+            ports=ports,
+            needs_host_eval=bool(host_exprs),
+            host_exprs=host_exprs,
+            taint_vocab_version=self.vocabs.taints.used_bits(),
+        )
+
+    def _host_eval_mask(self, spec: GroupSpec) -> np.ndarray:
+        """Evaluate non-tensorizable expressions for every node.
+
+        Single pass over the node table per call (one cache read per node, not
+        per expression); expression dispatch happens inside the pass.
+        """
+        M = self.nodes.capacity
+        mask = np.ones((M,), bool)
+        rows = [(idx, self.cache.get_node(name))
+                for idx, name in list(self.nodes._idx_to_name.items())]
+        for key, op, raw in spec.host_exprs:
+            in_values = set(raw.split(",")) if op == "In" else None
+            for idx, info in rows:
+                if info is None:
+                    continue
+                name = info.node.name
+                if key == "metadata.name":
+                    if op == "In":
+                        mask[idx] &= name in in_values
+                    continue
+                val = info.node.metadata.labels.get(key)
+                if val is None:
+                    mask[idx] = False
+                elif op == "In":
+                    mask[idx] &= val in in_values
+                elif op in ("Gt", "Lt"):
+                    try:
+                        ival, target = int(val), int(raw)
+                    except ValueError:
+                        mask[idx] = False
+                        continue
+                    mask[idx] &= (ival > target) if op == "Gt" else (ival < target)
+        return mask
+
+    def build_batch(
+        self,
+        asks: Sequence[AllocationAsk],
+        ranks: Optional[Sequence[float]] = None,
+        queue_ids: Optional[Sequence[int]] = None,
+        min_batch: int = 64,
+    ) -> PodBatch:
+        """Encode a list of pending asks into one padded solve batch."""
+        rv = self.vocabs.resources
+        n = len(asks)
+        N = _bucket(max(n, 1), min_batch)
+        R = rv.num_slots
+
+        # group dedup
+        group_specs: List[GroupSpec] = []
+        group_ids: List[int] = []
+        sig_to_gid: Dict[tuple, int] = {}
+        for ask in asks:
+            pod = ask.pod
+            if pod is None:
+                sig: tuple = ("<none>",)
+            else:
+                sig = self._group_signature(pod)
+            gid = sig_to_gid.get(sig)
+            if gid is not None:
+                # re-encode if the taint vocab grew since this group was cached
+                if group_specs[gid].taint_vocab_version != self.vocabs.taints.used_bits() and pod is not None:
+                    group_specs[gid] = self._encode_group(pod)
+            else:
+                gid = len(group_specs)
+                sig_to_gid[sig] = gid
+                if pod is None:
+                    spec = self._empty_group()
+                else:
+                    cached = self._group_cache.get(sig)
+                    if cached is not None and cached[1].taint_vocab_version == self.vocabs.taints.used_bits():
+                        spec = cached[1]
+                    else:
+                        spec = self._encode_group(pod)
+                        self._group_cache[sig] = (0, spec)
+                group_specs.append(spec)
+            group_ids.append(gid)
+
+        # Group encoding may have grown the vocabs past a word boundary; repad
+        # the node arrays now so group and node tensors agree on W/Wt/Wp.
+        self.nodes.ensure_padding()
+        G = _bucket(max(len(group_specs), 1), 4)
+        W = self.vocabs.labels.num_words
+        Wt = self.vocabs.taints.num_words
+        Wp = self.vocabs.ports.num_words
+
+        req = np.zeros((N, R), np.float32)
+        for i, ask in enumerate(asks):
+            for name, value in ask.resource.resources.items():
+                slot = rv.slot(name)
+                if slot >= R:
+                    R = rv.num_slots  # vocab grew: restart encode with wider R
+                    return self.build_batch(asks, ranks, queue_ids, min_batch)
+                req[i, slot] = math.ceil(value / rv.scale(name))
+
+        g_term_req = np.zeros((G, MAX_TERMS, W), np.uint32)
+        g_term_forb = np.zeros((G, MAX_TERMS, W), np.uint32)
+        g_term_valid = np.zeros((G, MAX_TERMS), bool)
+        g_anyof = np.zeros((G, MAX_TERMS, MAX_ANYOF, W), np.uint32)
+        g_anyof_valid = np.zeros((G, MAX_TERMS, MAX_ANYOF), bool)
+        g_tol = np.zeros((G, Wt), np.uint32)
+        g_ports = np.zeros((G, Wp), np.uint32)
+        host_mask: Optional[np.ndarray] = None
+        for gi, spec in enumerate(group_specs):
+            T, Wg = spec.term_req.shape
+            g_term_req[gi, :T, :Wg] = spec.term_req
+            g_term_forb[gi, :T, :Wg] = spec.term_forb
+            g_term_valid[gi, :T] = spec.term_valid
+            g_anyof[gi, :T, :, :Wg] = spec.anyof
+            g_anyof_valid[gi, :T] = spec.anyof_valid
+            g_tol[gi, : spec.tolerations.shape[0]] = spec.tolerations
+            g_ports[gi, : spec.ports.shape[0]] = spec.ports
+            if spec.needs_host_eval:
+                if host_mask is None:
+                    host_mask = np.ones((G, self.nodes.capacity), bool)
+                host_mask[gi] = self._host_eval_mask(spec)
+
+        rank_arr = np.zeros((N,), np.float32)
+        if ranks is not None:
+            rank_arr[:n] = np.asarray(list(ranks), np.float32)
+        else:
+            rank_arr[:n] = np.arange(n, dtype=np.float32)
+        rank_arr[n:] = np.float32(1e30)
+
+        queue_arr = np.full((N,), -1, np.int32)
+        if queue_ids is not None:
+            queue_arr[:n] = np.asarray(list(queue_ids), np.int32)
+
+        gid_arr = np.zeros((N,), np.int32)
+        gid_arr[:n] = np.asarray(group_ids, np.int32)
+        valid = np.zeros((N,), bool)
+        valid[:n] = True
+
+        return PodBatch(
+            ask_keys=[a.allocation_key for a in asks],
+            req=req,
+            group_id=gid_arr,
+            rank=rank_arr,
+            valid=valid,
+            queue_id=queue_arr,
+            g_term_req=g_term_req,
+            g_term_forb=g_term_forb,
+            g_term_valid=g_term_valid,
+            g_anyof=g_anyof,
+            g_anyof_valid=g_anyof_valid,
+            g_tol=g_tol,
+            g_ports=g_ports,
+            g_host_mask=host_mask,
+            num_pods=n,
+            num_groups=len(group_specs),
+        )
+
+    def _empty_group(self) -> GroupSpec:
+        W = self.vocabs.labels.num_words
+        Wt = self.vocabs.taints.num_words
+        Wp = self.vocabs.ports.num_words
+        spec = GroupSpec(
+            term_req=np.zeros((MAX_TERMS, W), np.uint32),
+            term_forb=np.zeros((MAX_TERMS, W), np.uint32),
+            term_valid=np.zeros((MAX_TERMS,), bool),
+            anyof=np.zeros((MAX_TERMS, MAX_ANYOF, W), np.uint32),
+            anyof_valid=np.zeros((MAX_TERMS, MAX_ANYOF), bool),
+            tolerations=np.zeros((Wt,), np.uint32),
+            ports=np.zeros((Wp,), np.uint32),
+            needs_host_eval=False,
+            host_exprs=[],
+            taint_vocab_version=self.vocabs.taints.used_bits(),
+        )
+        spec.term_valid[0] = True
+        return spec

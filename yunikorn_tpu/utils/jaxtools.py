@@ -1,0 +1,31 @@
+"""JAX runtime configuration helpers.
+
+Central place for compilation-cache setup: solver shapes are bucketed, so every
+distinct (N, M, G, ...) bucket pays one XLA compile — with the persistent cache
+enabled that cost is paid once per machine, not once per process. Called by the
+core scheduler, bench.py and the graft entry before the first solve.
+"""
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def ensure_compilation_cache(path: str | None = None) -> None:
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "YUNIKORN_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/yunikorn_tpu_xla")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # cache is an optimization; never fail on it
+        pass
+    _initialized = True
