@@ -1,0 +1,376 @@
+"""Core scheduler tests: queue tree, quotas, DRF ordering, solve cycle,
+placeholder replacement/timeout — against a recording callback (no shim).
+"""
+import time
+from typing import List
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.common.resource import Resource, ResourceBuilder, get_pod_resource
+from yunikorn_tpu.common.si import (
+    AddApplicationRequest,
+    Allocation,
+    AllocationAsk,
+    AllocationRelease,
+    AllocationRequest,
+    ApplicationRequest,
+    NodeAction,
+    NodeInfo,
+    NodeRequest,
+    RegisterResourceManagerRequest,
+    RemoveApplicationRequest,
+    ResourceManagerCallback,
+    TerminationType,
+    UserGroupInfo,
+)
+from yunikorn_tpu.core.queues import QueueTree, parse_queues_yaml
+from yunikorn_tpu.core.scheduler import CoreScheduler
+
+QUEUES_YAML = """
+partitions:
+  - name: default
+    nodesortpolicy:
+      type: binpacking
+    queues:
+      - name: root
+        queues:
+          - name: default
+          - name: limited
+            resources:
+              max: {vcore: 2, memory: 4Gi}
+          - name: parent
+            resources:
+              max: {vcore: 10}
+            queues:
+              - name: childa
+              - name: childb
+"""
+
+
+class RecordingCallback(ResourceManagerCallback):
+    def __init__(self):
+        self.allocations: List = []
+        self.releases: List = []
+        self.rejected_asks: List = []
+        self.accepted_apps: List = []
+        self.rejected_apps: List = []
+        self.updated_apps: List = []
+        self.accepted_nodes: List = []
+        self.container_updates: List = []
+        self.events: List = []
+
+    def update_allocation(self, response):
+        self.allocations.extend(response.new)
+        self.releases.extend(response.released)
+        self.rejected_asks.extend(response.rejected)
+
+    def update_application(self, response):
+        self.accepted_apps.extend(a.application_id for a in response.accepted)
+        self.rejected_apps.extend((a.application_id, a.reason) for a in response.rejected)
+        self.updated_apps.extend(response.updated)
+
+    def update_node(self, response):
+        self.accepted_nodes.extend(n.node_id for n in response.accepted)
+
+    def predicates(self, args):
+        return None
+
+    def preemption_predicates(self, args):
+        raise NotImplementedError
+
+    def send_event(self, events):
+        self.events.extend(events)
+
+    def update_container_scheduling_state(self, request):
+        self.container_updates.append(request)
+
+    def get_state_dump(self) -> str:
+        return "{}"
+
+
+def make_core(nodes=2, node_cpu=8000, queues_yaml=QUEUES_YAML):
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="rm-1", policy_group="queues", config=queues_yaml), cb
+    )
+    node_infos = []
+    for i in range(nodes):
+        n = make_node(f"node-{i}", cpu_milli=node_cpu, memory=16 * 2**30)
+        cache.update_node(n)
+        node_infos.append(NodeInfo(node_id=n.name, action=NodeAction.CREATE,
+                                   schedulable_resource=ResourceBuilder().cpu(node_cpu).build()))
+    core.update_node(NodeRequest(nodes=node_infos))
+    return cache, cb, core
+
+
+def add_app(core, app_id, queue="root.default", **kw):
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id=app_id, queue_name=queue,
+                              user=UserGroupInfo(user="u1"), **kw)
+    ]))
+
+
+def ask_of(app_id, key, cpu=1000, mem=2**30, priority=0, **kw):
+    pod = make_pod(key, cpu_milli=cpu, memory=mem)
+    return AllocationAsk(allocation_key=key, application_id=app_id,
+                         resource=get_pod_resource(pod), priority=priority, pod=pod, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Queue tree
+# ---------------------------------------------------------------------------
+
+def test_parse_queues_yaml():
+    cfg = parse_queues_yaml(QUEUES_YAML)
+    assert cfg.name == "root"
+    names = [c.name for c in cfg.children]
+    assert names == ["default", "limited", "parent"]
+    limited = cfg.children[1]
+    assert limited.max_resource.get("cpu") == 2000
+    assert limited.max_resource.get("memory") == 4 * 2**30
+
+
+def test_queue_tree_resolution_and_dynamic_creation():
+    tree = QueueTree(parse_queues_yaml(QUEUES_YAML))
+    q = tree.resolve("root.default")
+    assert q.full_name == "root.default"
+    # dynamic creation under root
+    q2 = tree.resolve("root.newqueue")
+    assert q2 is not None and q2.dynamic
+    # submitting to a parent queue fails
+    assert tree.resolve("root.parent") is None
+    # child under configured parent
+    assert tree.resolve("root.parent.childa").full_name == "root.parent.childa"
+
+
+def test_queue_accounting_and_quota():
+    tree = QueueTree(parse_queues_yaml(QUEUES_YAML))
+    q = tree.resolve("root.limited")
+    r = ResourceBuilder().cpu(1000).memory(2**30).build()
+    assert q.fits_quota(r)
+    q.add_allocated(r)
+    assert tree.root.allocated.get("cpu") == 1000  # rolls up
+    big = ResourceBuilder().cpu(1500).build()
+    assert not q.fits_quota(big)  # 1000 + 1500 > 2000
+    q.remove_allocated(r)
+    assert q.fits_quota(big)
+
+
+def test_parent_quota_constrains_children():
+    tree = QueueTree(parse_queues_yaml(QUEUES_YAML))
+    qa = tree.resolve("root.parent.childa")
+    qb = tree.resolve("root.parent.childb")
+    qa.add_allocated(ResourceBuilder().cpu(8000).build())
+    assert not qb.fits_quota(ResourceBuilder().cpu(3000).build())  # parent max 10
+    assert qb.fits_quota(ResourceBuilder().cpu(2000).build())
+
+
+# ---------------------------------------------------------------------------
+# Core scheduler protocol
+# ---------------------------------------------------------------------------
+
+def test_node_registration_and_accept():
+    cache, cb, core = make_core(nodes=3)
+    assert sorted(cb.accepted_nodes) == ["node-0", "node-1", "node-2"]
+    assert core.partition.active_node_count() == 3
+
+
+def test_app_accept_and_reject():
+    cache, cb, core = make_core()
+    add_app(core, "app-ok", "root.default")
+    add_app(core, "app-bad", "root.parent")  # parent queue: reject
+    assert "app-ok" in cb.accepted_apps
+    assert cb.rejected_apps and cb.rejected_apps[0][0] == "app-bad"
+
+
+def test_end_to_end_allocation_cycle():
+    cache, cb, core = make_core(nodes=2, node_cpu=8000)
+    add_app(core, "app-1")
+    asks = [ask_of("app-1", f"pod-{i}", cpu=1000) for i in range(4)]
+    core.update_allocation(AllocationRequest(asks=asks))
+    n = core.schedule_once()
+    assert n == 4
+    assert len(cb.allocations) == 4
+    nodes = {a.node_id for a in cb.allocations}
+    assert nodes <= {"node-0", "node-1"}
+    app = core.partition.get_application("app-1")
+    assert app.state == "Running"
+    assert not app.pending_asks
+    # queue accounting rolled up
+    leaf = core.queues.resolve("root.default", create=False)
+    assert leaf.allocated.get("cpu") == 4000
+
+
+def test_quota_holds_asks_back():
+    cache, cb, core = make_core(nodes=2, node_cpu=16000)
+    add_app(core, "app-1", "root.limited")  # max 2 vcore
+    asks = [ask_of("app-1", f"pod-{i}", cpu=1000, mem=2**20) for i in range(5)]
+    core.update_allocation(AllocationRequest(asks=asks))
+    n = core.schedule_once()
+    assert n == 2  # quota-capped
+    leaf = core.queues.resolve("root.limited", create=False)
+    assert leaf.allocated.get("cpu") == 2000
+    # release one → next cycle admits one more
+    rel = AllocationRelease(application_id="app-1",
+                            allocation_key=cb.allocations[0].allocation_key,
+                            termination_type=TerminationType.STOPPED_BY_RM)
+    core.update_allocation(AllocationRequest(releases=[rel]))
+    assert len(cb.releases) == 1
+    n = core.schedule_once()
+    assert n == 1
+
+
+def test_sibling_queues_respect_parent_quota_same_cycle():
+    cache, cb, core = make_core(nodes=4, node_cpu=16000)
+    add_app(core, "app-a", "root.parent.childa")
+    add_app(core, "app-b", "root.parent.childb")
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("app-a", f"a-{i}", cpu=1000, mem=2**20) for i in range(8)]
+             + [ask_of("app-b", f"b-{i}", cpu=1000, mem=2**20) for i in range(8)]))
+    n = core.schedule_once()
+    assert n == 10  # parent max 10 vcore caps the joint admission
+    parent = core.queues.resolve("root.parent.childa", create=False).parent
+    assert parent.allocated.get("cpu") == 10000
+
+
+def test_priority_order_wins_scarce_capacity():
+    cache, cb, core = make_core(nodes=1, node_cpu=2000)
+    add_app(core, "app-1")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-1", "low", cpu=2000, priority=0),
+        ask_of("app-1", "high", cpu=2000, priority=100),
+    ]))
+    core.schedule_once()
+    assert [a.allocation_key for a in cb.allocations] == ["high"]
+    # the loser got an autoscaler SKIPPED update
+    assert any(u.allocation_key == "low" for u in cb.container_updates)
+
+
+def test_drf_fair_share_between_queues():
+    # queue A already uses most of the cluster; queue B's asks go first
+    cache, cb, core = make_core(nodes=1, node_cpu=4000)
+    add_app(core, "app-a", "root.default")
+    add_app(core, "app-b", "root.newq")
+    core.update_allocation(AllocationRequest(asks=[ask_of("app-a", "a-0", cpu=2000, mem=2**20)]))
+    core.schedule_once()
+    assert len(cb.allocations) == 1
+    # both queues now ask for the remaining 2000m; B (share 0) outranks A
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-a", "a-1", cpu=2000, mem=2**20),
+        ask_of("app-b", "b-0", cpu=2000, mem=2**20),
+    ]))
+    core.schedule_once()
+    winners = [a.allocation_key for a in cb.allocations]
+    assert "b-0" in winners and "a-1" not in winners
+
+
+def test_remove_application_releases_accounting():
+    cache, cb, core = make_core()
+    add_app(core, "app-1")
+    core.update_allocation(AllocationRequest(asks=[ask_of("app-1", "p0", cpu=1000)]))
+    core.schedule_once()
+    leaf = core.queues.resolve("root.default", create=False)
+    assert leaf.allocated.get("cpu") == 1000
+    core.update_application(ApplicationRequest(remove=[RemoveApplicationRequest("app-1")]))
+    assert leaf.allocated.get("cpu") == 0
+    assert core.partition.get_application("app-1") is None
+
+
+def test_recovery_restores_existing_allocation():
+    cache, cb, core = make_core()
+    add_app(core, "app-1")
+    existing = Allocation(allocation_key="p0", application_id="app-1",
+                          node_id="node-0", resource=ResourceBuilder().cpu(2000).pods(1).build())
+    core.update_allocation(AllocationRequest(allocations=[existing]))
+    leaf = core.queues.resolve("root.default", create=False)
+    assert leaf.allocated.get("cpu") == 2000
+    app = core.partition.get_application("app-1")
+    assert "p0" in app.allocations
+
+
+def test_foreign_allocation_tracked_as_occupied():
+    cache, cb, core = make_core()
+    foreign = Allocation(allocation_key="f0", application_id="", node_id="node-0",
+                         resource=ResourceBuilder().cpu(3000).build(), foreign=True)
+    core.update_allocation(AllocationRequest(allocations=[foreign]))
+    assert core.partition.nodes["node-0"].occupied.get("cpu") == 3000
+    core.update_allocation(AllocationRequest(releases=[
+        AllocationRelease(application_id="", allocation_key="f0")]))
+    assert core.partition.nodes["node-0"].occupied.get("cpu") == 0
+
+
+# ---------------------------------------------------------------------------
+# Gang: placeholder replacement + timeout
+# ---------------------------------------------------------------------------
+
+def test_placeholder_replacement():
+    cache, cb, core = make_core(nodes=2, node_cpu=8000)
+    add_app(core, "app-g", gang_scheduling_style="Soft")
+    ph_asks = [ask_of("app-g", f"ph-{i}", cpu=1000, placeholder=True,
+                      task_group_name="tg-1") for i in range(2)]
+    core.update_allocation(AllocationRequest(asks=ph_asks))
+    core.schedule_once()
+    assert len(cb.allocations) == 2
+    ph_nodes = {a.allocation_key: a.node_id for a in cb.allocations}
+    # real task arrives for the same group
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-g", "real-0", cpu=1000, task_group_name="tg-1")]))
+    core.schedule_once()
+    real = [a for a in cb.allocations if a.allocation_key == "real-0"]
+    assert len(real) == 1
+    assert real[0].node_id in ph_nodes.values()  # landed on a placeholder node
+    released = [r for r in cb.releases if r.termination_type == TerminationType.PLACEHOLDER_REPLACED]
+    assert len(released) == 1
+
+
+def test_placeholder_timeout_soft_resumes():
+    cache, cb, core = make_core()
+    add_app(core, "app-g", gang_scheduling_style="Soft", execution_timeout_seconds=0.05)
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-g", "ph-0", cpu=1000, placeholder=True, task_group_name="tg-1")]))
+    core.schedule_once()
+    assert len(cb.allocations) == 1
+    time.sleep(0.1)
+    core.schedule_once()  # first cycle marks reserving_since... already set on alloc cycle
+    time.sleep(0.1)
+    core.schedule_once()
+    resumed = [u for u in cb.updated_apps if u.state == "Resuming"]
+    assert resumed and resumed[0].application_id == "app-g"
+    timeout_rel = [r for r in cb.releases if r.termination_type == TerminationType.TIMEOUT]
+    assert timeout_rel
+
+
+def test_placeholder_timeout_hard_fails():
+    cache, cb, core = make_core()
+    add_app(core, "app-h", gang_scheduling_style="Hard", execution_timeout_seconds=0.05)
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-h", "ph-0", cpu=1000, placeholder=True, task_group_name="tg-1")]))
+    core.schedule_once()
+    time.sleep(0.15)
+    core.schedule_once()
+    time.sleep(0.05)
+    core.schedule_once()
+    failing = [u for u in cb.updated_apps if u.state == "Failing"]
+    assert failing and failing[0].application_id == "app-h"
+
+
+def test_validate_configuration():
+    cache, cb, core = make_core()
+    ok, _ = core.validate_configuration(QUEUES_YAML)
+    assert ok
+    ok, msg = core.validate_configuration("partitions: [{name: default, queues: [{name: notroot}]}]")
+    assert not ok
+    ok, msg = core.validate_configuration(":::bad yaml {{{")
+    assert not ok
+
+
+def test_state_dump():
+    cache, cb, core = make_core()
+    add_app(core, "app-1")
+    import json
+
+    dump = json.loads(core.state_dump())
+    assert "partition" in dump and "queues" in dump
+    assert dump["queues"]["queuename"] == "root"

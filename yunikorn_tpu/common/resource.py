@@ -116,6 +116,10 @@ class Resource:
     def fits_in(self, capacity: "Resource") -> bool:
         return all(capacity.resources.get(k, 0) >= v for k, v in self.resources.items())
 
+    def within_limit(self, limit: "Resource") -> bool:
+        """Quota semantics: only resources the limit names are constrained."""
+        return all(self.resources.get(k, 0) <= v for k, v in limit.resources.items())
+
     def is_zero(self) -> bool:
         return all(v == 0 for v in self.resources.values())
 

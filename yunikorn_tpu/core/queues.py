@@ -1,0 +1,285 @@
+"""Hierarchical queues with quotas and DRF fair-share.
+
+Role-equivalent to yunikorn-core's queue subsystem (the reference shim delegates
+all queue/quota decisions to it in-process; config arrives as the opaque
+queues.yaml payload — reference pkg/common/utils/utils.go:368-390 passes it
+through, conf keyed by policy group). This implementation keeps exact integer
+Resource accounting on the host; the solver consumes the *ordering* (DRF ranks)
+and the *admission* decisions (quota headroom) it produces.
+
+queues.yaml schema (the subset the reference e2e suites exercise):
+
+    partitions:
+      - name: default
+        nodesortpolicy: {type: binpacking}
+        preemption: {enabled: true}
+        placementrules: [...]
+        queues:
+          - name: root
+            submitacl: "*"
+            queues:
+              - name: default
+                resources:
+                  guaranteed: {memory: 1Gi, vcore: 1}
+                  max: {memory: 10Gi, vcore: 10}
+                properties: {application.sort.policy: fifo}
+              - name: parent
+                parent: true
+                queues: [...]
+
+"vcore" maps to cpu millicores ("1" == 1000m, "100m" == 100m), matching the
+core's convention.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+import yaml
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.resource import Resource, parse_quantity
+from yunikorn_tpu.log.logger import log
+
+logger = log("core.queue")
+
+ROOT = constants.ROOT_QUEUE
+
+
+def _parse_res_map(m: Optional[dict]) -> Optional[Resource]:
+    if not m:
+        return None
+    out = {}
+    for k, v in m.items():
+        if k in ("vcore", "cpu"):
+            out["cpu"] = parse_quantity(v, as_milli=True)
+        else:
+            out[k] = parse_quantity(v)
+    return Resource(out)
+
+
+@dataclasses.dataclass
+class QueueConfig:
+    name: str
+    parent: bool = False
+    submit_acl: str = ""
+    guaranteed: Optional[Resource] = None
+    max_resource: Optional[Resource] = None
+    max_applications: int = 0
+    properties: Dict[str, str] = dataclasses.field(default_factory=dict)
+    children: List["QueueConfig"] = dataclasses.field(default_factory=list)
+
+
+def parse_queues_yaml(text: str, partition: str = "default") -> Optional[QueueConfig]:
+    """Parse queues.yaml; returns the root QueueConfig of the partition."""
+    if not text.strip():
+        return None
+    doc = yaml.safe_load(text)
+    if not doc or "partitions" not in doc:
+        return None
+    for part in doc["partitions"]:
+        if part.get("name", "default") != partition:
+            continue
+        queues = part.get("queues") or []
+        for q in queues:
+            if q.get("name") == ROOT:
+                return _parse_queue_config(q)
+    return None
+
+
+def _parse_queue_config(node: dict) -> QueueConfig:
+    res = node.get("resources") or {}
+    return QueueConfig(
+        name=node.get("name", ""),
+        parent=bool(node.get("parent", False)) or bool(node.get("queues")),
+        submit_acl=node.get("submitacl", ""),
+        guaranteed=_parse_res_map(res.get("guaranteed")),
+        max_resource=_parse_res_map(res.get("max")),
+        max_applications=int(node.get("maxapplications", 0) or 0),
+        properties={str(k): str(v) for k, v in (node.get("properties") or {}).items()},
+        children=[_parse_queue_config(c) for c in (node.get("queues") or [])],
+    )
+
+
+class Queue:
+    """One node of the live queue tree. Exact integer accounting."""
+
+    def __init__(self, name: str, parent: Optional["Queue"], config: Optional[QueueConfig] = None,
+                 dynamic: bool = False):
+        self.name = name                     # short name
+        self.parent = parent
+        self.children: Dict[str, Queue] = {}
+        self.dynamic = dynamic               # created by placement, removable
+        self.allocated = Resource()
+        self.pending = Resource()
+        self.app_ids: set[str] = set()
+        self.config = config or QueueConfig(name=name)
+
+    # ------------------------------------------------------------------ shape
+    @property
+    def full_name(self) -> str:
+        if self.parent is None:
+            return self.name
+        return f"{self.parent.full_name}.{self.name}"
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.children and not self.config.parent
+
+    def ancestors_and_self(self) -> List["Queue"]:
+        out, q = [], self
+        while q is not None:
+            out.append(q)
+            q = q.parent
+        return out
+
+    # ------------------------------------------------------------- accounting
+    def add_allocated(self, r: Resource) -> None:
+        for q in self.ancestors_and_self():
+            q.allocated = q.allocated.add(r)
+
+    def remove_allocated(self, r: Resource) -> None:
+        for q in self.ancestors_and_self():
+            q.allocated = q.allocated.sub(r)
+
+    def headroom(self, total_cluster: Optional[Resource] = None) -> Optional[Resource]:
+        """Tightest remaining quota across self and ancestors (None = unlimited)."""
+        room: Optional[Resource] = None
+        for q in self.ancestors_and_self():
+            if q.config.max_resource is None:
+                continue
+            rem = q.config.max_resource.sub(q.allocated)
+            room = rem if room is None else Resource({
+                k: min(room.get(k) if k in room.resources else rem.get(k), rem.get(k))
+                for k in set(room.resources) | set(rem.resources)
+            })
+        return room
+
+    def fits_quota(self, r: Resource) -> bool:
+        """Would allocating r keep every ancestor within its max?"""
+        for q in self.ancestors_and_self():
+            if q.config.max_resource is not None:
+                if not q.allocated.add(r).within_limit(q.config.max_resource):
+                    return False
+        return True
+
+    def dominant_share(self, cluster_capacity: Resource) -> float:
+        """DRF dominant share: max over resources of allocated/denominator.
+
+        The denominator is the queue's guaranteed amount when configured (the
+        core's fair-share uses guaranteed as the fair denominator), otherwise
+        the cluster capacity.
+        """
+        share = 0.0
+        guar = self.config.guaranteed
+        for name, used in self.allocated.resources.items():
+            if used <= 0:
+                continue
+            denom = 0
+            if guar is not None and guar.get(name) > 0:
+                denom = guar.get(name)
+            else:
+                denom = cluster_capacity.get(name)
+            if denom > 0:
+                share = max(share, used / denom)
+        return share
+
+
+class QueueTree:
+    """The live hierarchy + placement: resolve app queue names to leaves."""
+
+    def __init__(self, config: Optional[QueueConfig] = None):
+        self._lock = threading.RLock()
+        self.root = Queue(ROOT, None, config)
+        if config is not None:
+            self._build(self.root, config)
+
+    def _build(self, q: Queue, cfg: QueueConfig) -> None:
+        for child_cfg in cfg.children:
+            child = Queue(child_cfg.name, q, child_cfg)
+            q.children[child_cfg.name] = child
+            self._build(child, child_cfg)
+
+    def reload(self, config: Optional[QueueConfig]) -> None:
+        """Hot-reload the config: update limits in place, add new queues,
+        mark removed static queues dynamic (kept while they hold apps)."""
+        with self._lock:
+            if config is None:
+                return
+            self._reload_into(self.root, config)
+
+    def _reload_into(self, q: Queue, cfg: QueueConfig) -> None:
+        q.config = cfg
+        seen = set()
+        for child_cfg in cfg.children:
+            seen.add(child_cfg.name)
+            child = q.children.get(child_cfg.name)
+            if child is None:
+                child = Queue(child_cfg.name, q, child_cfg)
+                q.children[child_cfg.name] = child
+                self._build(child, child_cfg)
+            else:
+                self._reload_into(child, child_cfg)
+        for name, child in q.children.items():
+            if name not in seen and not child.dynamic:
+                child.dynamic = True  # keep until drained
+
+    def resolve(self, queue_name: str, create: bool = True) -> Optional[Queue]:
+        """Find (or dynamically create) the leaf queue for a full name.
+
+        Accepts "root.a.b" or "a.b" (root-relative). Returns None when the
+        path crosses a static leaf or creation is disallowed.
+        """
+        with self._lock:
+            if not queue_name:
+                queue_name = f"{ROOT}.default"
+            parts = queue_name.split(".")
+            if parts[0] == ROOT:
+                parts = parts[1:]
+            q = self.root
+            for i, part in enumerate(parts):
+                child = q.children.get(part)
+                if child is None:
+                    if not create:
+                        return None
+                    if q.is_leaf and q is not self.root:
+                        logger.warning("cannot create %s under leaf queue %s", part, q.full_name)
+                        return None
+                    child = Queue(part, q, dynamic=True)
+                    q.children[part] = child
+                q = child
+            if not q.is_leaf:
+                # app submitted to a parent queue: reject (reference behavior)
+                return None
+            return q
+
+    def leaves(self) -> List[Queue]:
+        with self._lock:
+            out: List[Queue] = []
+
+            def walk(q: Queue):
+                if q.is_leaf:
+                    out.append(q)
+                for c in q.children.values():
+                    walk(c)
+
+            walk(self.root)
+            return out
+
+    def dao(self) -> dict:
+        """State-dump view (REST /ws/v1/queues analog)."""
+        with self._lock:
+            def walk(q: Queue) -> dict:
+                return {
+                    "queuename": q.full_name,
+                    "allocatedResource": dict(q.allocated.resources),
+                    "pendingResource": dict(q.pending.resources),
+                    "maxResource": dict(q.config.max_resource.resources) if q.config.max_resource else None,
+                    "guaranteedResource": dict(q.config.guaranteed.resources) if q.config.guaranteed else None,
+                    "isLeaf": q.is_leaf,
+                    "applications": sorted(q.app_ids),
+                    "children": [walk(c) for c in q.children.values()],
+                }
+
+            return walk(self.root)

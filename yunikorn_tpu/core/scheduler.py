@@ -1,0 +1,607 @@
+"""The core scheduler engine: SchedulerAPI implementation driving the TPU solver.
+
+Role-equivalent to the in-process yunikorn-core the reference starts via
+entrypoint.StartAllServicesWithLogger (reference pkg/cmd/shim/main.go:54) plus
+its RMProxy: the shim talks SchedulerAPI to it, it talks ResourceManagerCallback
+back (reference pkg/cache/scheduler_callback.go consumes those calls).
+
+The decisive architectural difference from the reference: the core's sequential
+scheduling cycle — pick app → pick ask → probe nodes one by one via the
+Predicates upcall (reference hot loop, scheduler_callback.go:196-198) — is
+replaced by a batched cycle:
+
+    collect pending asks → quota-gate per queue (exact host-side integer
+    accounting) → DRF/priority/FIFO rank → encode batch → ONE jitted solve on
+    TPU (predicates + scoring + conflict-free assignment for all pods × all
+    nodes) → emit AllocationResponse
+
+Gang semantics (placeholder replacement, timeout → Resuming/Failing) and
+recovery (existing allocations) are handled host-side around the solve, exactly
+at the same protocol seams the reference uses.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.common.si import (
+    AcceptedApplication,
+    AcceptedNode,
+    Allocation,
+    AllocationRelease,
+    AllocationRequest,
+    AllocationResponse,
+    ApplicationRequest,
+    ApplicationResponse,
+    ContainerSchedulingState,
+    NodeAction,
+    NodeRequest,
+    NodeResponse,
+    RegisterResourceManagerRequest,
+    RejectedAllocationAsk,
+    RejectedApplication,
+    RejectedNode,
+    ResourceManagerCallback,
+    SchedulerAPI,
+    TerminationType,
+    UpdateContainerSchedulingStateRequest,
+    UpdatedApplication,
+)
+from yunikorn_tpu.core.partition import (
+    APP_ACCEPTED,
+    APP_COMPLETED,
+    APP_COMPLETING,
+    APP_FAILING,
+    APP_NEW,
+    APP_REJECTED,
+    APP_RESUMING,
+    APP_RUNNING,
+    CoreApplication,
+    CoreNode,
+    Partition,
+)
+from yunikorn_tpu.core.queues import QueueTree, parse_queues_yaml
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.ops.assign import solve_batch
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+logger = log("core.scheduler")
+
+DEFAULT_PLACEHOLDER_TIMEOUT = 15 * 60.0  # core default when the app sets none
+
+
+class CoreScheduler(SchedulerAPI):
+    """One partition, one solver. Thread-safe via a single core lock."""
+
+    def __init__(self, cache: SchedulerCache, interval: float = 0.1,
+                 solver_policy: Optional[str] = None):
+        self._lock = threading.RLock()
+        self.cache = cache
+        self.encoder = SnapshotEncoder(cache)
+        self.partition = Partition()
+        self.queues = QueueTree()
+        self.callback: Optional[ResourceManagerCallback] = None
+        self.rm_id = ""
+        self._policy = solver_policy or "binpacking"
+        self._policy_forced = solver_policy is not None
+        self._preemption_enabled = True
+        self._interval = interval
+        self._ask_seq = 0
+        # Allocations committed by the core but not yet visible in the shim
+        # cache (AssumePod pending). The reference core tracks node allocations
+        # itself; here the cache is shared, so this overlay closes the window
+        # where a freshly committed allocation would be double-counted as free.
+        self._inflight: Dict[str, Allocation] = {}
+        self._running = threading.Event()
+        self._wake = threading.Condition()
+        self._dirty = False
+        self._thread: Optional[threading.Thread] = None
+        # metrics (Prometheus-counter analogs, reference perf test samples
+        # yunikorn_scheduler_container_allocation_attempt_total)
+        self.metrics: Dict[str, int] = {
+            "allocation_attempt_allocated": 0,
+            "allocation_attempt_failed": 0,
+            "solve_count": 0,
+            "solve_time_ms_total": 0,
+        }
+
+    # ------------------------------------------------------------ SchedulerAPI
+    def register_resource_manager(self, request: RegisterResourceManagerRequest,
+                                  callback: ResourceManagerCallback) -> None:
+        with self._lock:
+            self.rm_id = request.rm_id
+            self.callback = callback
+            self._load_config(request.config)
+        logger.info("resource manager %s registered (policy=%s)", request.rm_id, self._policy)
+
+    def update_configuration(self, config: str, extra_config: Dict[str, str]) -> None:
+        with self._lock:
+            self._load_config(config)
+        self.trigger()
+
+    def _load_config(self, config_text: str) -> None:
+        cfg = parse_queues_yaml(config_text or "")
+        self.queues.reload(cfg)
+        if config_text and not self._policy_forced:
+            try:
+                doc = yaml.safe_load(config_text) or {}
+                for part in doc.get("partitions", []):
+                    if part.get("name", "default") == self.partition.name:
+                        nsp = (part.get("nodesortpolicy") or {}).get("type", "")
+                        if nsp == "binpacking":
+                            self._policy = "binpacking"
+                        elif nsp in ("fair", "fairness"):
+                            self._policy = "spread"
+                        pre = part.get("preemption") or {}
+                        if "enabled" in pre:
+                            self._preemption_enabled = bool(pre["enabled"])
+            except yaml.YAMLError:
+                logger.warning("invalid queues.yaml ignored")
+
+    def validate_configuration(self, config_text: str) -> Tuple[bool, str]:
+        """/ws/v1/validate-conf analog (used by the admission controller)."""
+        try:
+            cfg = parse_queues_yaml(config_text or "")
+            if config_text.strip() and cfg is None:
+                return False, "no root queue found for partition"
+            return True, ""
+        except yaml.YAMLError as e:
+            return False, f"invalid yaml: {e}"
+
+    def update_node(self, request: NodeRequest) -> None:
+        resp = NodeResponse()
+        with self._lock:
+            for info in request.nodes:
+                nid = info.node_id
+                if info.action in (NodeAction.CREATE, NodeAction.CREATE_DRAIN):
+                    if nid in self.partition.nodes:
+                        resp.rejected.append(RejectedNode(nid, "node already registered"))
+                        continue
+                    node = CoreNode(
+                        node_id=nid,
+                        schedulable=(info.action == NodeAction.CREATE),
+                        attributes=dict(info.attributes),
+                        capacity=info.schedulable_resource or Resource(),
+                        occupied=info.occupied_resource or Resource(),
+                    )
+                    self.partition.nodes[nid] = node
+                    self.encoder.set_node_schedulable(nid, node.schedulable)
+                    for alloc in info.existing_allocations:
+                        self._restore_allocation(alloc)
+                    resp.accepted.append(AcceptedNode(nid))
+                elif info.action == NodeAction.UPDATE:
+                    node = self.partition.nodes.get(nid)
+                    if node is None:
+                        resp.rejected.append(RejectedNode(nid, "unknown node"))
+                        continue
+                    if info.schedulable_resource is not None:
+                        node.capacity = info.schedulable_resource
+                    if info.occupied_resource is not None:
+                        node.occupied = info.occupied_resource
+                elif info.action == NodeAction.DRAIN_TO_SCHEDULABLE:
+                    node = self.partition.nodes.get(nid)
+                    if node is not None:
+                        node.schedulable = True
+                        self.encoder.set_node_schedulable(nid, True)
+                elif info.action == NodeAction.DRAIN_NODE:
+                    node = self.partition.nodes.get(nid)
+                    if node is not None:
+                        node.schedulable = False
+                        self.encoder.set_node_schedulable(nid, False)
+                elif info.action == NodeAction.DECOMISSION:
+                    self.partition.nodes.pop(nid, None)
+                    self.encoder.set_node_schedulable(nid, False)
+        if (resp.accepted or resp.rejected) and self.callback is not None:
+            self.callback.update_node(resp)
+        self.trigger()
+
+    def update_application(self, request: ApplicationRequest) -> None:
+        resp = ApplicationResponse()
+        with self._lock:
+            for add in request.new:
+                if add.application_id in self.partition.applications:
+                    continue  # duplicate submission is idempotent
+                leaf = self.queues.resolve(add.queue_name)
+                if leaf is None:
+                    resp.rejected.append(RejectedApplication(
+                        add.application_id, f"failed to place application: queue {add.queue_name!r} not usable"))
+                    continue
+                app = CoreApplication(
+                    application_id=add.application_id,
+                    queue_name=leaf.full_name,
+                    user=add.user,
+                    tags=dict(add.tags),
+                    state=APP_ACCEPTED,
+                    task_groups=list(add.task_groups),
+                    gang_style=add.gang_scheduling_style or constants.GANG_STYLE_SOFT,
+                    placeholder_ask=add.placeholder_ask,
+                    placeholder_timeout=add.execution_timeout_seconds,
+                )
+                self.partition.applications[add.application_id] = app
+                leaf.app_ids.add(add.application_id)
+                resp.accepted.append(AcceptedApplication(add.application_id))
+            for rem in request.remove:
+                self._remove_application(rem.application_id)
+        if (resp.accepted or resp.rejected or resp.updated) and self.callback is not None:
+            self.callback.update_application(resp)
+        self.trigger()
+
+    def _remove_application(self, app_id: str) -> None:
+        app = self.partition.applications.pop(app_id, None)
+        if app is None:
+            return
+        leaf = self.queues.resolve(app.queue_name, create=False)
+        if leaf is not None:
+            leaf.app_ids.discard(app_id)
+            for alloc in app.allocations.values():
+                leaf.remove_allocated(alloc.resource)
+
+    def update_allocation(self, request: AllocationRequest) -> None:
+        resp = AllocationResponse()
+        with self._lock:
+            for ask in request.asks:
+                app = self.partition.applications.get(ask.application_id)
+                if app is None or app.state in (APP_REJECTED, APP_COMPLETED):
+                    resp.rejected.append(RejectedAllocationAsk(
+                        ask.application_id, ask.allocation_key, "application not running"))
+                    continue
+                self._ask_seq += 1
+                ask.tags.setdefault("__seq__", str(self._ask_seq))
+                app.pending_asks[ask.allocation_key] = ask
+            for alloc in request.allocations:
+                if alloc.foreign:
+                    self._track_foreign(alloc)
+                else:
+                    self._restore_allocation(alloc)
+            for release in request.releases:
+                rel = self._release_allocation(release)
+                if rel is not None:
+                    resp.released.append(rel)
+        if (resp.new or resp.released or resp.rejected) and self.callback is not None:
+            self.callback.update_allocation(resp)
+        self.trigger()
+
+    # -------------------------------------------------- allocation bookkeeping
+    def _restore_allocation(self, alloc: Allocation) -> None:
+        """Recovery path: an allocation that already exists in the cluster."""
+        app = self.partition.applications.get(alloc.application_id)
+        if app is None:
+            logger.warning("restore: unknown application %s", alloc.application_id)
+            return
+        if alloc.allocation_key in app.allocations:
+            return
+        app.allocations[alloc.allocation_key] = alloc
+        app.pending_asks.pop(alloc.allocation_key, None)
+        leaf = self.queues.resolve(app.queue_name, create=False)
+        if leaf is not None:
+            leaf.add_allocated(alloc.resource)
+
+    def _track_foreign(self, alloc: Allocation) -> None:
+        self.partition.foreign_allocations[alloc.allocation_key] = alloc
+        node = self.partition.nodes.get(alloc.node_id)
+        if node is not None:
+            node.occupied = node.occupied.add(alloc.resource)
+
+    def _release_allocation(self, release: AllocationRelease) -> Optional[AllocationRelease]:
+        # foreign release
+        foreign = self.partition.foreign_allocations.pop(release.allocation_key, None)
+        if foreign is not None:
+            node = self.partition.nodes.get(foreign.node_id)
+            if node is not None:
+                node.occupied = node.occupied.sub(foreign.resource)
+            return None
+        app = self.partition.applications.get(release.application_id)
+        if app is None:
+            return None
+        app.pending_asks.pop(release.allocation_key, None)
+        self._inflight.pop(release.allocation_key, None)
+        alloc = app.allocations.pop(release.allocation_key, None)
+        if alloc is None:
+            return None
+        leaf = self.queues.resolve(app.queue_name, create=False)
+        if leaf is not None:
+            leaf.remove_allocated(alloc.resource)
+        return AllocationRelease(
+            application_id=release.application_id,
+            allocation_key=release.allocation_key,
+            termination_type=release.termination_type,
+            message=release.message,
+        )
+
+    # ----------------------------------------------------------- solve cycle
+    def start(self) -> None:
+        if self._running.is_set():
+            return
+        self._running.set()
+        self._thread = threading.Thread(target=self._run_loop, name="core-scheduler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running.clear()
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def trigger(self) -> None:
+        with self._wake:
+            self._dirty = True
+            self._wake.notify_all()
+
+    def _run_loop(self) -> None:
+        while self._running.is_set():
+            with self._wake:
+                if not self._dirty:
+                    self._wake.wait(timeout=self._interval)
+                self._dirty = False
+            try:
+                self.schedule_once()
+            except Exception:
+                logger.exception("scheduling cycle failed")
+
+    def schedule_once(self) -> int:
+        """One full scheduling cycle. Returns the number of new allocations."""
+        t0 = time.time()
+        with self._lock:
+            self._check_placeholder_timeouts()
+            replaced = self._replace_placeholders()
+            admitted, ranks, held = self._collect_and_gate()
+            new_allocs: List[Allocation] = []
+            skipped_keys: List[Tuple[str, str]] = []
+            if admitted:
+                # overlay BEFORE sync: an assume landing in between then counts
+                # twice (once in the overlay, once in synced free) — strictly
+                # conservative, never over-committing
+                overlay = self._inflight_overlay()
+                self.encoder.sync_nodes()
+                batch = self.encoder.build_batch(admitted, ranks=ranks)
+                result = solve_batch(batch, self.encoder.nodes, policy=self._policy,
+                                     free_delta=overlay)
+                import numpy as np
+
+                assigned = np.asarray(result.assigned)[: batch.num_pods]
+                for i, ask in enumerate(admitted):
+                    idx = int(assigned[i])
+                    if idx < 0:
+                        skipped_keys.append((ask.application_id, ask.allocation_key))
+                        continue
+                    node_name = self.encoder.nodes.name_of(idx)
+                    if node_name is None:
+                        continue
+                    alloc = Allocation(
+                        allocation_key=ask.allocation_key,
+                        application_id=ask.application_id,
+                        node_id=node_name,
+                        resource=ask.resource,
+                        priority=ask.priority,
+                        placeholder=ask.placeholder,
+                        task_group_name=ask.task_group_name,
+                        tags=dict(ask.tags),
+                    )
+                    self._commit_allocation(alloc)
+                    new_allocs.append(alloc)
+            self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
+            self.metrics["allocation_attempt_failed"] += len(skipped_keys)
+            self.metrics["solve_count"] += 1
+            self.metrics["solve_time_ms_total"] += int((time.time() - t0) * 1000)
+
+        if self.callback is not None:
+            if replaced.new or replaced.released:
+                self.callback.update_allocation(replaced)
+            if new_allocs:
+                self.callback.update_allocation(AllocationResponse(new=new_allocs))
+            for app_id, key in skipped_keys:
+                self.callback.update_container_scheduling_state(
+                    UpdateContainerSchedulingStateRequest(
+                        application_id=app_id,
+                        allocation_key=key,
+                        state=ContainerSchedulingState.SKIPPED,
+                        reason="insufficient cluster resources or no feasible node",
+                    )
+                )
+        return len(new_allocs)
+
+    def _commit_allocation(self, alloc: Allocation) -> None:
+        app = self.partition.applications[alloc.application_id]
+        app.allocations[alloc.allocation_key] = alloc
+        app.pending_asks.pop(alloc.allocation_key, None)
+        self._inflight[alloc.allocation_key] = alloc
+        if app.state == APP_ACCEPTED:
+            app.state = APP_RUNNING
+        leaf = self.queues.resolve(app.queue_name, create=False)
+        if leaf is not None:
+            leaf.add_allocated(alloc.resource)
+
+    def _inflight_overlay(self):
+        """[capacity, R] overlay of committed-but-not-yet-assumed allocations."""
+        import numpy as np
+
+        drop = [k for k in self._inflight
+                if self.cache.get_pod_node_name(k) is not None]
+        for k in drop:
+            self._inflight.pop(k, None)
+        if not self._inflight:
+            return None
+        overlay = np.zeros((self.encoder.nodes.capacity, self.encoder.vocabs.resources.num_slots),
+                           np.float32)
+        for alloc in self._inflight.values():
+            idx = self.encoder.nodes.index_of(alloc.node_id)
+            if idx is not None:
+                row = self.encoder.quantize_request(alloc.resource)
+                overlay[idx, : row.shape[0]] += row
+        return overlay
+
+    def _collect_and_gate(self):
+        """Collect pending asks, enforce quotas, produce the global rank order.
+
+        Ordering: queues by DRF dominant share ascending (fair share), then
+        priority descending, then app submit time, then ask sequence (FIFO) —
+        replicating the core's fair/fifo sort policies.
+        """
+        cluster_cap = Resource()
+        for info in self.cache.snapshot_nodes():
+            cluster_cap = cluster_cap.add(info.allocatable)
+
+        by_queue: Dict[str, List[Tuple[CoreApplication, object]]] = {}
+        for app in self.partition.applications.values():
+            if app.state not in (APP_ACCEPTED, APP_RUNNING, APP_RESUMING):
+                continue
+            for ask in app.pending_asks.values():
+                by_queue.setdefault(app.queue_name, []).append((app, ask))
+
+        queue_shares = []
+        for qname in by_queue:
+            leaf = self.queues.resolve(qname, create=False)
+            share = leaf.dominant_share(cluster_cap) if leaf else 0.0
+            queue_shares.append((share, qname))
+        queue_shares.sort()
+
+        admitted: List[object] = []
+        held = 0
+        # in-cycle admissions accumulate per queue NODE (keyed by full name) so
+        # sibling leaves cannot jointly blow through a shared parent's max
+        cycle_extra: Dict[str, Resource] = {}
+        for share, qname in queue_shares:
+            leaf = self.queues.resolve(qname, create=False)
+            entries = by_queue[qname]
+            entries.sort(key=lambda e: (
+                -(e[1].priority or 0),
+                e[0].submit_time,
+                int(e[1].tags.get("__seq__", "0")),
+            ))
+            for app, ask in entries:
+                if leaf is not None and not _fits_quota_with(leaf, cycle_extra, ask.resource):
+                    held += 1
+                    continue
+                if leaf is not None:
+                    for q in leaf.ancestors_and_self():
+                        cycle_extra[q.full_name] = cycle_extra.get(q.full_name, Resource()).add(ask.resource)
+                admitted.append(ask)
+        ranks = list(range(len(admitted)))
+        return admitted, ranks, held
+
+    # ------------------------------------------------------------------- gang
+    def _replace_placeholders(self) -> AllocationResponse:
+        """Real task asks replace Bound placeholders of the same task group.
+
+        Core gang semantics: when an app holds placeholder allocations and a
+        real (non-placeholder) ask arrives with a matching taskGroupName, the
+        placeholder is released with PLACEHOLDER_REPLACED and the real
+        allocation lands on the placeholder's node.
+        """
+        resp = AllocationResponse()
+        for app in self.partition.applications.values():
+            if not app.has_placeholder_allocations():
+                continue
+            for key, ask in list(app.pending_asks.items()):
+                if ask.placeholder or not ask.task_group_name:
+                    continue
+                ph = next(
+                    (a for a in app.allocations.values()
+                     if a.placeholder and a.task_group_name == ask.task_group_name),
+                    None,
+                )
+                if ph is None:
+                    continue
+                # release placeholder
+                app.allocations.pop(ph.allocation_key, None)
+                leaf = self.queues.resolve(app.queue_name, create=False)
+                if leaf is not None:
+                    leaf.remove_allocated(ph.resource)
+                resp.released.append(AllocationRelease(
+                    application_id=app.application_id,
+                    allocation_key=ph.allocation_key,
+                    termination_type=TerminationType.PLACEHOLDER_REPLACED,
+                    message=f"replaced by {ask.allocation_key}",
+                ))
+                alloc = Allocation(
+                    allocation_key=ask.allocation_key,
+                    application_id=app.application_id,
+                    node_id=ph.node_id,
+                    resource=ask.resource,
+                    priority=ask.priority,
+                    placeholder=False,
+                    task_group_name=ask.task_group_name,
+                    tags=dict(ask.tags),
+                )
+                self._commit_allocation(alloc)
+                resp.new.append(alloc)
+        return resp
+
+    def _check_placeholder_timeouts(self) -> None:
+        """Placeholder timeout → release placeholders + app Resuming/Failing."""
+        now = time.time()
+        updates: List[UpdatedApplication] = []
+        for app in self.partition.applications.values():
+            if not app.has_placeholder_allocations() and not any(
+                a.placeholder for a in app.pending_asks.values()
+            ):
+                continue
+            if app.reserving_since is None:
+                app.reserving_since = now
+                continue
+            timeout = app.placeholder_timeout or DEFAULT_PLACEHOLDER_TIMEOUT
+            if now - app.reserving_since < timeout:
+                continue
+            if not any(not a.placeholder for a in app.allocations.values()):
+                # no real allocations arrived before the timeout
+                released = [a for a in app.allocations.values() if a.placeholder]
+                for ph in released:
+                    app.allocations.pop(ph.allocation_key, None)
+                    leaf = self.queues.resolve(app.queue_name, create=False)
+                    if leaf is not None:
+                        leaf.remove_allocated(ph.resource)
+                for key in [k for k, a in app.pending_asks.items() if a.placeholder]:
+                    app.pending_asks.pop(key, None)
+                new_state = (
+                    APP_FAILING if app.gang_style == constants.GANG_STYLE_HARD else APP_RESUMING
+                )
+                app.state = new_state
+                app.reserving_since = None
+                updates.append(UpdatedApplication(
+                    application_id=app.application_id,
+                    state=new_state,
+                    message=constants.APP_FAIL_RESERVATION_TIMEOUT,
+                ))
+                if released and self.callback is not None:
+                    self.callback.update_allocation(AllocationResponse(released=[
+                        AllocationRelease(
+                            application_id=app.application_id,
+                            allocation_key=ph.allocation_key,
+                            termination_type=TerminationType.TIMEOUT,
+                            message="placeholder timeout",
+                        )
+                        for ph in released
+                    ]))
+        if updates and self.callback is not None:
+            self.callback.update_application(ApplicationResponse(updated=updates))
+
+    # ------------------------------------------------------------- inspection
+    def get_partition_dao(self) -> dict:
+        with self._lock:
+            return {
+                "partition": self.partition.dao(),
+                "queues": self.queues.dao(),
+                "metrics": dict(self.metrics),
+            }
+
+    def state_dump(self) -> str:
+        return json.dumps(self.get_partition_dao(), default=str)
+
+
+def _fits_quota_with(leaf, cycle_extra: Dict[str, Resource], req: Resource) -> bool:
+    """fits_quota overlaying the in-cycle per-queue-node admissions."""
+    for q in leaf.ancestors_and_self():
+        if q.config.max_resource is not None:
+            extra = cycle_extra.get(q.full_name, Resource())
+            if not q.allocated.add(extra).add(req).within_limit(q.config.max_resource):
+                return False
+    return True

@@ -255,12 +255,23 @@ def solve(
 
 
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
-                device=None) -> SolveResult:
-    """Convenience host wrapper: numpy in → SolveResult out."""
+                free_delta=None, device=None) -> SolveResult:
+    """Convenience host wrapper: numpy in → SolveResult out.
+
+    free_delta: optional [capacity, R] float array subtracted from node free
+    capacity before the solve (the core's in-flight allocation overlay).
+    """
     import numpy as np
 
     na = node_arrays
     free_i = np.floor(na.free).astype(np.int32)
+    if free_delta is not None:
+        # overlay may be narrower/shorter than the (possibly grown) node arrays
+        d = np.zeros_like(free_i)
+        rows = min(free_i.shape[0], free_delta.shape[0])
+        cols = min(free_i.shape[1], free_delta.shape[1])
+        d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
+        free_i = free_i - d
     cap_i = np.floor(na.capacity_arr).astype(np.int32)
     node_ok = na.valid & na.schedulable
     host_mask = batch.g_host_mask

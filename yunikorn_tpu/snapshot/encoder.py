@@ -525,12 +525,11 @@ class SnapshotEncoder:
 
         req = np.zeros((N, R), np.float32)
         for i, ask in enumerate(asks):
-            for name, value in ask.resource.resources.items():
-                slot = rv.slot(name)
-                if slot >= R:
-                    R = rv.num_slots  # vocab grew: restart encode with wider R
-                    return self.build_batch(asks, ranks, queue_ids, min_batch)
-                req[i, slot] = math.ceil(value / rv.scale(name))
+            row = self.quantize_request(ask.resource)
+            if row.shape[0] > R:
+                # vocab grew past the padded width: restart with the wider R
+                return self.build_batch(asks, ranks, queue_ids, min_batch)
+            req[i, : row.shape[0]] = row
 
         g_term_req = np.zeros((G, MAX_TERMS, W), np.uint32)
         g_term_forb = np.zeros((G, MAX_TERMS, W), np.uint32)
@@ -588,6 +587,19 @@ class SnapshotEncoder:
             num_pods=n,
             num_groups=len(group_specs),
         )
+
+    def quantize_request(self, r: Resource) -> np.ndarray:
+        """Resource → device-unit row [R] (ceil, request semantics).
+
+        Interns every resource name *before* sizing the row, so vocab growth
+        mid-call cannot produce an out-of-range slot or a short row.
+        """
+        rv = self.vocabs.resources
+        slots = [(rv.slot(name), name, value) for name, value in r.resources.items()]
+        out = np.zeros((rv.num_slots,), np.float32)
+        for slot, name, value in slots:
+            out[slot] = math.ceil(rv.quantize(name, value))
+        return out
 
     def _empty_group(self) -> GroupSpec:
         W = self.vocabs.labels.num_words
