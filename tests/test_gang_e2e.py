@@ -1,0 +1,167 @@
+"""Gang scheduling end-to-end (reference test/e2e/gang_scheduling suite model):
+placeholder creation, reservation, all-bound → Running, replacement, Soft/Hard
+timeout semantics, placeholder cleanup.
+"""
+import json
+import time
+
+import pytest
+
+from yunikorn_tpu.cache import application as app_mod
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+
+
+@pytest.fixture
+def sched():
+    ms = MockScheduler()
+    ms.init("")
+    ms.start()
+    yield ms
+    ms.stop()
+
+
+def gang_pod(name, app_id, task_groups, tg_name="", cpu=500,
+             timeout_s=None, style=None):
+    annotations = {constants.ANNOTATION_TASK_GROUPS: json.dumps(task_groups)}
+    if tg_name:
+        annotations[constants.ANNOTATION_TASK_GROUP_NAME] = tg_name
+    params = []
+    if timeout_s is not None:
+        params.append(f"{constants.SCHED_POLICY_TIMEOUT_PARAM}={timeout_s}")
+    if style is not None:
+        params.append(f"{constants.SCHED_POLICY_STYLE_PARAM}={style}")
+    if params:
+        annotations[constants.ANNOTATION_SCHED_POLICY_PARAM] = \
+            constants.SCHED_POLICY_PARAM_DELIMITER.join(params)
+    return make_pod(
+        name,
+        cpu_milli=cpu,
+        memory=2**28,
+        labels={constants.LABEL_APPLICATION_ID: app_id},
+        annotations=annotations,
+        scheduler_name=constants.SCHEDULER_NAME,
+    )
+
+
+TG = [{"name": "workers", "minMember": 3,
+       "minResource": {"cpu": "500m", "memory": "256Mi"}}]
+
+
+def count_placeholders(sched, app_id):
+    return sum(1 for p in sched.cluster.list_pods()
+               if p.metadata.annotations.get(constants.ANNOTATION_PLACEHOLDER_FLAG)
+               == constants.TRUE
+               and p.metadata.labels.get(constants.LABEL_APPLICATION_ID) == app_id)
+
+
+def test_gang_reserve_then_run(sched):
+    sched.add_nodes([make_node(f"n{i}", cpu_milli=4000) for i in range(2)])
+    origin = gang_pod("driver", "gang-1", TG, tg_name="", cpu=500)
+    sched.add_pod(origin)
+    # app goes Reserving and creates minMember placeholders
+    sched.wait_for_app_state("gang-1", app_mod.RUNNING, timeout=15)
+    assert count_placeholders(sched, "gang-1") == 3
+    # originator (non-placeholder, no task group) is bound after gang is up
+    sched.wait_for_task_state("gang-1", origin.uid, task_mod.BOUND)
+
+
+def test_gang_replacement(sched):
+    sched.add_nodes([make_node(f"n{i}", cpu_milli=4000) for i in range(2)])
+    origin = gang_pod("driver", "gang-2", TG)
+    sched.add_pod(origin)
+    sched.wait_for_app_state("gang-2", app_mod.RUNNING, timeout=15)
+    # real member pods arrive tagged with the task group
+    members = [gang_pod(f"worker-{i}", "gang-2", TG, tg_name="workers")
+               for i in range(3)]
+    ph_nodes = {p.spec.node_name for p in sched.cluster.list_pods()
+                if p.metadata.annotations.get(constants.ANNOTATION_PLACEHOLDER_FLAG)}
+    for m in members:
+        sched.add_pod(m)
+    for m in members:
+        sched.wait_for_task_state("gang-2", m.uid, task_mod.BOUND, timeout=15)
+        assert sched.get_pod_assignment(m) in ph_nodes
+    # placeholders replaced and deleted from the cluster
+    deadline = time.time() + 10
+    while time.time() < deadline and count_placeholders(sched, "gang-2") > 0:
+        time.sleep(0.05)
+    assert count_placeholders(sched, "gang-2") == 0
+
+
+def test_gang_soft_timeout_falls_back(sched):
+    # placeholders can never fit (huge minResource) → timeout → Soft: Resuming → Running
+    sched.add_node(make_node("n0", cpu_milli=2000))
+    big_tg = [{"name": "big", "minMember": 2,
+               "minResource": {"cpu": "100", "memory": "1Gi"}}]
+    origin = gang_pod("driver", "gang-soft", big_tg, cpu=500,
+                      timeout_s=1, style="Soft")
+    sched.add_pod(origin)
+    # app eventually runs without the gang (Soft fallback)
+    sched.wait_for_app_state("gang-soft", app_mod.RUNNING, timeout=20)
+    sched.wait_for_task_state("gang-soft", origin.uid, task_mod.BOUND, timeout=15)
+
+
+def test_gang_hard_timeout_fails_app(sched):
+    sched.add_node(make_node("n0", cpu_milli=2000))
+    big_tg = [{"name": "big", "minMember": 2,
+               "minResource": {"cpu": "100", "memory": "1Gi"}}]
+    origin = gang_pod("driver", "gang-hard", big_tg, cpu=500,
+                      timeout_s=1, style="Hard")
+    sched.add_pod(origin)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        app = sched.context.get_application("gang-hard")
+        if app is not None and app.state in (app_mod.FAILING, app_mod.FAILED):
+            break
+        time.sleep(0.05)
+    app = sched.context.get_application("gang-hard")
+    assert app is not None and app.state in (app_mod.FAILING, app_mod.FAILED)
+
+
+def test_gang_disabled_by_conf():
+    ms = MockScheduler()
+    ms.init("")
+    from yunikorn_tpu.conf.schedulerconf import get_holder
+
+    get_holder().get().disable_gang_scheduling = True
+    ms.context.conf.disable_gang_scheduling = True
+    ms.start()
+    try:
+        ms.add_node(make_node("n0", cpu_milli=4000))
+        origin = gang_pod("driver", "nogang", TG)
+        ms.add_pod(origin)
+        ms.wait_for_task_state("nogang", origin.uid, task_mod.BOUND, timeout=15)
+        assert count_placeholders(ms, "nogang") == 0
+    finally:
+        ms.stop()
+
+
+def test_placeholder_spec_copies_constraints():
+    from yunikorn_tpu.cache.placeholder import gen_placeholder_name, new_placeholder
+    from yunikorn_tpu.common.si import TaskGroup
+
+    class FakeApp:
+        application_id = "app-x"
+        queue_name = "root.q"
+
+        class metadata:
+            owner_references = [{"kind": "Pod", "name": "o"}]
+
+    tg = TaskGroup(name="tg1", min_member=2,
+                   min_resource={"cpu": "1", "memory": "1Gi"},
+                   node_selector={"zone": "a"},
+                   tolerations=[{"key": "k", "operator": "Equal", "value": "v",
+                                 "effect": "NoSchedule"}])
+    name = gen_placeholder_name("app-x", "tg1")
+    assert name.startswith("tg-app-x-tg1-") and len(name.split("-")[-1]) == 10
+    pod = new_placeholder(name, FakeApp, tg, None)
+    assert pod.spec.node_selector == {"zone": "a"}
+    assert pod.spec.tolerations[0].key == "k"
+    assert pod.spec.scheduler_name == constants.SCHEDULER_NAME
+    assert pod.metadata.annotations[constants.ANNOTATION_PLACEHOLDER_FLAG] == constants.TRUE
+    from yunikorn_tpu.common.resource import get_pod_resource
+
+    r = get_pod_resource(pod)
+    assert r.get("cpu") == 1000 and r.get("memory") == 2**30

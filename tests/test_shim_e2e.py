@@ -1,0 +1,233 @@
+"""End-to-end shim tests over MockScheduler: real core + real shim + fake
+cluster, full submit→bind cycles (reference scheduler_test.go /
+scheduler_mock_test.go pattern).
+"""
+import time
+
+import pytest
+
+from yunikorn_tpu.cache import application as app_mod
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+
+QUEUES_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: default
+          - name: tiny
+            resources:
+              max: {vcore: 1, memory: 1Gi}
+"""
+
+
+@pytest.fixture
+def sched():
+    ms = MockScheduler()
+    ms.init(QUEUES_YAML)
+    ms.start()
+    yield ms
+    ms.stop()
+
+
+def yk_pod(name, app_id="app-1", queue="root.default", cpu=500, mem=2**28, **kw):
+    return make_pod(
+        name,
+        cpu_milli=cpu,
+        memory=mem,
+        labels={constants.LABEL_APPLICATION_ID: app_id,
+                constants.LABEL_QUEUE_NAME: queue},
+        scheduler_name=constants.SCHEDULER_NAME,
+        **kw,
+    )
+
+
+def test_submit_to_bind_cycle(sched):
+    sched.add_node(make_node("node-1", cpu_milli=4000))
+    pod = sched.add_pod(yk_pod("pod-1"))
+    sched.wait_for_task_state("app-1", pod.uid, task_mod.BOUND)
+    sched.wait_for_app_state("app-1", app_mod.RUNNING)
+    assert sched.get_pod_assignment(pod) == "node-1"
+    assert sched.get_active_node_count_in_core() == 1
+    assert sched.bind_stats().success_count == 1
+
+
+def test_many_pods_many_nodes(sched):
+    sched.add_nodes([make_node(f"node-{i}", cpu_milli=8000) for i in range(4)])
+    pods = [sched.add_pod(yk_pod(f"pod-{i}", cpu=1000)) for i in range(20)]
+    sched.wait_for_bound_count(20)
+    for p in pods:
+        assert sched.get_pod_assignment(p)
+    # per-node capacity respected: max 8 pods of 1000m on an 8000m node
+    counts = {}
+    for p in pods:
+        n = sched.get_pod_assignment(p)
+        counts[n] = counts.get(n, 0) + 1
+    assert max(counts.values()) <= 8
+
+
+def test_pod_completion_releases_capacity(sched):
+    sched.add_node(make_node("node-1", cpu_milli=1000))
+    p1 = sched.add_pod(yk_pod("pod-1", cpu=1000))
+    sched.wait_for_task_state("app-1", p1.uid, task_mod.BOUND)
+    p2 = sched.add_pod(yk_pod("pod-2", cpu=1000))
+    time.sleep(0.3)
+    assert sched.get_pod_assignment(p2) == ""  # no capacity yet
+    sched.succeed_pod(p1)
+    sched.wait_for_task_state("app-1", p2.uid, task_mod.BOUND)
+    assert sched.get_pod_assignment(p2) == "node-1"
+
+
+def test_queue_quota_enforced_e2e(sched):
+    sched.add_node(make_node("node-1", cpu_milli=16000))
+    pods = [sched.add_pod(yk_pod(f"pod-{i}", app_id="tiny-app", queue="root.tiny",
+                                 cpu=500, mem=2**28)) for i in range(4)]
+    sched.wait_for_bound_count(2)  # 1 vcore max → two 500m pods
+    time.sleep(0.3)
+    assert sched.bind_stats().success_count == 2
+
+
+def test_app_rejected_for_parent_queue(sched):
+    sched.add_node(make_node("node-1"))
+    pod = sched.add_pod(yk_pod("pod-1", app_id="bad-app", queue="root"))
+    sched.wait_for_app_state("bad-app", app_mod.FAILED)
+    task = sched.context.get_application("bad-app").get_task(pod.uid)
+    assert task.state == task_mod.FAILED
+
+
+def test_unschedulable_pod_gets_condition(sched):
+    sched.add_node(make_node("node-1", cpu_milli=1000))
+    pod = sched.add_pod(yk_pod("pod-1", cpu=4000))  # never fits
+    deadline = time.time() + 5
+    cur = None
+    while time.time() < deadline:
+        cur = sched.cluster.get_pod(pod.uid)
+        if any(c.type == "PodScheduled" and c.status == "False" for c in cur.status.conditions):
+            break
+        time.sleep(0.05)
+    conds = [c for c in cur.status.conditions if c.type == "PodScheduled"]
+    assert conds and conds[0].reason == "Unschedulable"
+
+
+def test_node_selector_respected_e2e(sched):
+    sched.add_nodes([
+        make_node("gpu-node", labels={"accel": "tpu"}),
+        make_node("cpu-node"),
+    ])
+    pod = yk_pod("pod-1")
+    pod.spec.node_selector = {"accel": "tpu"}
+    sched.add_pod(pod)
+    sched.wait_for_task_state("app-1", pod.uid, task_mod.BOUND)
+    assert sched.get_pod_assignment(pod) == "gpu-node"
+
+
+def test_foreign_pod_occupies_capacity(sched):
+    sched.add_node(make_node("node-1", cpu_milli=2000))
+    # a foreign pod (no app id, not our scheduler) already running on the node
+    foreign = make_pod("foreign-1", cpu_milli=1500, node_name="node-1", phase="Running")
+    sched.add_pod(foreign)
+    time.sleep(0.2)
+    ours = sched.add_pod(yk_pod("pod-1", cpu=1000))
+    time.sleep(0.5)
+    assert sched.get_pod_assignment(ours) == ""  # 1500 of 2000 occupied
+    # foreign pod finishes → capacity frees
+    sched.cluster.succeed_pod(foreign.uid)
+    sched.wait_for_task_state("app-1", ours.uid, task_mod.BOUND)
+
+
+def test_pod_deleted_releases(sched):
+    sched.add_node(make_node("node-1", cpu_milli=1000))
+    p1 = sched.add_pod(yk_pod("pod-1", cpu=1000))
+    sched.wait_for_task_state("app-1", p1.uid, task_mod.BOUND)
+    sched.delete_pod(p1)
+    p2 = sched.add_pod(yk_pod("pod-2", cpu=1000))
+    sched.wait_for_task_state("app-1", p2.uid, task_mod.BOUND)
+
+
+def test_two_apps_two_queues(sched):
+    sched.add_nodes([make_node(f"n{i}", cpu_milli=4000) for i in range(2)])
+    a = sched.add_pod(yk_pod("a-pod", app_id="app-a", queue="root.default"))
+    b = sched.add_pod(yk_pod("b-pod", app_id="app-b", queue="root.dynamic"))
+    sched.wait_for_task_state("app-a", a.uid, task_mod.BOUND)
+    sched.wait_for_task_state("app-b", b.uid, task_mod.BOUND)
+    dao = sched.core.get_partition_dao()
+    assert dao["partition"]["applications"]["app-a"]["queue"] == "root.default"
+    assert dao["partition"]["applications"]["app-b"]["queue"] == "root.dynamic"
+
+
+# ---------------------------------------------------------------------------
+# Recovery
+# ---------------------------------------------------------------------------
+
+def test_recovery_restores_bound_pods():
+    ms = MockScheduler()
+    ms.init(QUEUES_YAML)
+    # cluster state exists BEFORE the scheduler starts
+    ms.cluster.add_node(make_node("node-1", cpu_milli=4000))
+    bound = yk_pod("already-bound", cpu=1000)
+    bound.spec.node_name = "node-1"
+    bound.status.phase = "Running"
+    ms.cluster.add_pod(bound)
+    pending = yk_pod("pending-pod", cpu=1000)
+    ms.cluster.add_pod(pending)
+    ms.start()
+    try:
+        # recovered pod fast-forwarded to Bound without a new bind
+        ms.wait_for_task_state("app-1", bound.uid, task_mod.BOUND)
+        # pending pod gets scheduled normally after recovery
+        ms.wait_for_task_state("app-1", pending.uid, task_mod.BOUND)
+        # recovered allocation occupies capacity in the core's accounting
+        leaf = ms.core.queues.resolve("root.default", create=False)
+        assert leaf.allocated.get("cpu") == 2000
+        # only ONE bind happened (the pending pod); the recovered pod was not rebound
+        assert ms.bind_stats().success_count == 1
+    finally:
+        ms.stop()
+
+
+def test_recovery_orphaned_pod_adopted():
+    ms = MockScheduler()
+    ms.init(QUEUES_YAML)
+    # pod references a node that doesn't exist yet
+    orphan = yk_pod("orphan", cpu=500)
+    orphan.spec.node_name = "late-node"
+    orphan.status.phase = "Running"
+    ms.cluster.add_pod(orphan)
+    ms.start()
+    try:
+        assert ms.context.schedulers_cache.is_pod_orphaned(orphan.uid)
+        ms.add_node(make_node("late-node"))
+        deadline = time.time() + 5
+        while time.time() < deadline and ms.context.schedulers_cache.is_pod_orphaned(orphan.uid):
+            time.sleep(0.05)
+        assert not ms.context.schedulers_cache.is_pod_orphaned(orphan.uid)
+        info = ms.context.schedulers_cache.get_node("late-node")
+        assert info.requested.get("cpu") == 500
+    finally:
+        ms.stop()
+
+
+def test_config_hot_reload_updates_quota():
+    ms = MockScheduler()
+    ms.init(QUEUES_YAML)
+    ms.start()
+    try:
+        ms.add_node(make_node("node-1", cpu_milli=16000))
+        new_yaml = QUEUES_YAML.replace("vcore: 1,", "vcore: 3,")
+        ms.update_config(new_yaml)
+        deadline = time.time() + 5
+        ok = False
+        while time.time() < deadline:
+            leaf = ms.core.queues.resolve("root.tiny", create=False)
+            if leaf is not None and leaf.config.max_resource and \
+                    leaf.config.max_resource.get("cpu") == 3000:
+                ok = True
+                break
+            time.sleep(0.05)
+        assert ok, "queue config did not hot-reload"
+    finally:
+        ms.stop()

@@ -1,0 +1,445 @@
+"""Context: the shim's brain — informer event handling, app/task bookkeeping,
+assume/forget, config hot-reload, recovery.
+
+Role-equivalent to pkg/cache/context.go (struct :72-84): informer registration
+:134-178, node handlers :180-315, pod handlers with the YuniKorn/foreign split
+:316-535, configmap hot reload :536-601,648-677, priorityClass :602-647,
+volume binding :747-827, AssumePod/ForgetPod :828-899, app/task CRUD :976-1144,
+PublishEvents :1157-1200, HandleContainerStateUpdate :1222-1261, recovery
+InitializeState :1380-1455.
+
+The reference wraps all of this in one big context lock because its predicates
+read cache state concurrently with informer writes. Here the predicate path is
+a device-array snapshot (the encoder reads the cache once per solve under the
+cache's own lock), so the Context only needs a lock around its app/task maps —
+the serialization point the TPU design removes (SURVEY.md L2 note).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from yunikorn_tpu.cache import application as app_mod
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.cache.application import Application
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.cache.metadata import (
+    get_app_metadata,
+    get_task_metadata,
+)
+from yunikorn_tpu.cache.placeholder_manager import PlaceholderManager
+from yunikorn_tpu.cache.task import Task, TaskSchedulingState
+from yunikorn_tpu.client.interfaces import APIProvider, InformerType, ResourceEventHandlers
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.events import (
+    AppEventRecord,
+    NodeEventRecord,
+    TaskEventRecord,
+    get_recorder,
+)
+from yunikorn_tpu.common.objects import Node, Pod, PriorityClass
+from yunikorn_tpu.common.resource import Resource, get_node_resource, get_pod_resource
+from yunikorn_tpu.common.si import (
+    Allocation,
+    AllocationRelease,
+    AllocationRequest,
+    ContainerSchedulingState,
+    NodeAction,
+    NodeInfo,
+    NodeRequest,
+    SchedulerAPI,
+    TerminationType,
+)
+from yunikorn_tpu.conf.schedulerconf import SchedulerConf, get_holder
+from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
+from yunikorn_tpu.log.logger import log
+
+logger = log("shim.context")
+
+
+class VolumeBinder:
+    """Volume binding seam (reference volumebinding.NewVolumeBinder).
+
+    The in-repo implementation treats volumes as bound when their PVCs are
+    bound in the cluster store; a real-K8s adapter replaces this with the
+    scheduler-framework volume binder.
+    """
+
+    def __init__(self, api_provider: APIProvider):
+        self.api = api_provider
+
+    def all_bound(self, pod: Pod) -> bool:
+        return all(not v.pvc_claim_name for v in pod.spec.volumes)
+
+    def bind_pod_volumes(self, pod: Pod) -> None:
+        return  # in-memory cluster: nothing to bind
+
+
+class Context:
+    def __init__(self, api_provider: APIProvider, scheduler_api: SchedulerAPI,
+                 conf: Optional[SchedulerConf] = None,
+                 cache: Optional[SchedulerCache] = None):
+        self.api_provider = api_provider
+        self.scheduler_api = scheduler_api
+        self.conf = conf or get_holder().get()
+        # the cache is shared with the in-process core (its encoder reads it)
+        self.schedulers_cache = cache if cache is not None else SchedulerCache()
+        self.placeholder_manager = PlaceholderManager(api_provider)
+        self.volume_binder = VolumeBinder(api_provider)
+        self._apps: Dict[str, Application] = {}
+        self._pvcs: Dict[str, object] = {}
+        # foreign pods already reported to the core: uid -> (node, resource)
+        self._foreign_sent: Dict[str, tuple] = {}
+        self._lock = threading.RLock()
+        self._initialized = False
+
+    # convenience alias matching the reference naming
+    @property
+    def scheduler_cache(self) -> SchedulerCache:
+        return self.schedulers_cache
+
+    # ------------------------------------------------------------- informers
+    def add_scheduling_event_handlers(self) -> None:
+        """Register informer handlers (reference context.go:134-178)."""
+        self.api_provider.add_event_handler(InformerType.POD, ResourceEventHandlers(
+            add_fn=self.add_pod, update_fn=self.update_pod, delete_fn=self.delete_pod))
+        self.api_provider.add_event_handler(InformerType.NODE, ResourceEventHandlers(
+            add_fn=self.add_node, update_fn=self.update_node, delete_fn=self.delete_node))
+        self.api_provider.add_event_handler(InformerType.CONFIGMAP, ResourceEventHandlers(
+            filter_fn=self._is_yunikorn_configmap,
+            add_fn=self._on_configmap, update_fn=lambda old, new: self._on_configmap(new),
+            delete_fn=self._on_configmap))
+        self.api_provider.add_event_handler(InformerType.PRIORITY_CLASS, ResourceEventHandlers(
+            add_fn=self.add_priority_class,
+            update_fn=lambda old, new: self.add_priority_class(new),
+            delete_fn=self.delete_priority_class))
+
+    # ----------------------------------------------------------------- nodes
+    def add_node(self, node: Node) -> None:
+        adopted = self.schedulers_cache.update_node(node)
+        capacity = get_node_resource(node.status.allocatable)
+        self.scheduler_api.update_node(NodeRequest(nodes=[NodeInfo(
+            node_id=node.name,
+            action=NodeAction.CREATE if self._initialized else NodeAction.CREATE_DRAIN,
+            attributes={
+                constants.NODE_ATTRIBUTE_HOSTNAME: node.name,
+                constants.NODE_ATTRIBUTE_RACKNAME: constants.DEFAULT_RACK,
+                "instance-type": node.metadata.labels.get(self.conf.instance_type_node_label_key, ""),
+            },
+            schedulable_resource=capacity,
+            node=node,
+        )]))
+        for pod in adopted:
+            self.update_pod(None, pod)
+
+    def update_node(self, old: Optional[Node], node: Node) -> None:
+        self.schedulers_cache.update_node(node)
+        capacity = get_node_resource(node.status.allocatable)
+        infos = [NodeInfo(node_id=node.name, action=NodeAction.UPDATE,
+                          schedulable_resource=capacity, node=node)]
+        # only toggle drain state when schedulability actually changed
+        if old is None or old.spec.unschedulable != node.spec.unschedulable:
+            infos.append(NodeInfo(
+                node_id=node.name,
+                action=(NodeAction.DRAIN_NODE if node.spec.unschedulable
+                        else NodeAction.DRAIN_TO_SCHEDULABLE)))
+        self.scheduler_api.update_node(NodeRequest(nodes=infos))
+
+    def delete_node(self, node: Node) -> None:
+        self.schedulers_cache.remove_node(node.name)
+        self.scheduler_api.update_node(NodeRequest(nodes=[NodeInfo(
+            node_id=node.name, action=NodeAction.DECOMISSION)]))
+        get_recorder().eventf("Node", node.name, "Normal", "NodeDeleted",
+                              "node %s is deleted from the scheduler", node.name)
+
+    # ------------------------------------------------------------------ pods
+    def add_pod(self, pod: Pod) -> None:
+        self.update_pod(None, pod)
+
+    def update_pod(self, _old: Optional[Pod], pod: Pod) -> None:
+        """Pod add/update with YuniKorn/foreign split (reference :316-351)."""
+        if get_task_metadata(pod, self.conf.generate_unique_app_ids) is not None:
+            self._update_yunikorn_pod(pod)
+        else:
+            self._update_foreign_pod(pod)
+
+    def _update_yunikorn_pod(self, pod: Pod) -> None:
+        # scheduling gates hold pods out of scheduling (reference :372-386)
+        if pod.spec.scheduling_gates:
+            logger.debug("pod %s is gated, ignoring", pod.key())
+            return
+        if pod.is_terminated():
+            self.schedulers_cache.update_pod(pod)
+            self._notify_task_complete(pod)
+            return
+        self.schedulers_cache.update_pod(pod)
+        self._ensure_app_and_task(pod)
+
+    def _update_foreign_pod(self, pod: Pod) -> None:
+        """Non-YuniKorn pods become occupied resource (reference :422-486).
+
+        Routine status updates re-fire this handler; only changes in
+        (node, resource) are forwarded to the core so occupied accounting
+        stays exact.
+        """
+        key = pod.uid
+        if pod.is_assigned() and not pod.is_terminated():
+            in_cache = self.schedulers_cache.update_pod(pod)
+            if in_cache:
+                resource = get_pod_resource(pod)
+                sig = (pod.spec.node_name, tuple(sorted(resource.resources.items())))
+                if self._foreign_sent.get(key) == sig:
+                    return
+                self._foreign_sent[key] = sig
+                self.scheduler_api.update_allocation(AllocationRequest(allocations=[
+                    Allocation(
+                        allocation_key=key,
+                        application_id="",
+                        node_id=pod.spec.node_name,
+                        resource=resource,
+                        foreign=True,
+                        tags={"kubernetes.io/meta/podType": "foreign"},
+                    )
+                ]))
+        elif pod.is_terminated():
+            self.schedulers_cache.remove_pod(pod)
+            if self._foreign_sent.pop(key, None) is not None:
+                self.scheduler_api.update_allocation(AllocationRequest(releases=[
+                    AllocationRelease(application_id="", allocation_key=key,
+                                      termination_type=TerminationType.STOPPED_BY_RM)
+                ]))
+
+    def delete_pod(self, pod: Pod) -> None:
+        if get_task_metadata(pod, self.conf.generate_unique_app_ids) is not None:
+            self.schedulers_cache.remove_pod(pod)
+            self._notify_task_complete(pod)
+        else:
+            self.schedulers_cache.remove_pod(pod)
+            if self._foreign_sent.pop(pod.uid, None) is not None:
+                self.scheduler_api.update_allocation(AllocationRequest(releases=[
+                    AllocationRelease(application_id="", allocation_key=pod.uid,
+                                      termination_type=TerminationType.STOPPED_BY_RM)
+                ]))
+
+    def _notify_task_complete(self, pod: Pod) -> None:
+        meta = get_task_metadata(pod, self.conf.generate_unique_app_ids)
+        if meta is None:
+            return
+        app = self.get_application(meta.application_id)
+        if app is None:
+            return
+        task = app.get_task(meta.task_id)
+        if task is not None and not task.is_terminated():
+            dispatch_mod.dispatch(TaskEventRecord(
+                meta.application_id, meta.task_id, task_mod.COMPLETE_TASK))
+
+    # ------------------------------------------------------------- app/task
+    def _ensure_app_and_task(self, pod: Pod) -> None:
+        """reference ensureAppAndTaskCreated (:976-1144)."""
+        app_meta = get_app_metadata(pod, self.conf.generate_unique_app_ids)
+        if app_meta is None:
+            return
+        with self._lock:
+            app = self._apps.get(app_meta.application_id)
+            if app is None:
+                app = Application(app_meta, self)
+                self._apps[app_meta.application_id] = app
+                logger.info("app %s added to context (queue=%s)",
+                            app.application_id, app.queue_name)
+        task_meta = get_task_metadata(pod, self.conf.generate_unique_app_ids)
+        task = app.get_task(task_meta.task_id)
+        if task is None:
+            originator = not app.task_list() and not task_meta.placeholder
+            task = Task(app, pod, self, placeholder=task_meta.placeholder,
+                        task_group_name=task_meta.task_group_name, originator=originator)
+            app.add_task(task)
+            # recovery fast-path: already-bound pods skip scheduling
+            # (reference context.go:1071-1114)
+            if pod.is_assigned() and not pod.is_terminated():
+                task.mark_previously_allocated(pod.spec.node_name)
+
+    def get_application(self, app_id: str) -> Optional[Application]:
+        with self._lock:
+            return self._apps.get(app_id)
+
+    def applications(self) -> List[Application]:
+        with self._lock:
+            return list(self._apps.values())
+
+    def remove_application(self, app_id: str) -> None:
+        with self._lock:
+            app = self._apps.pop(app_id, None)
+        if app is not None:
+            app.remove_from_core()
+
+    # ------------------------------------------------------ assume / forget
+    def assume_pod(self, pod_uid: str, node_name: str) -> bool:
+        """Optimistically place the pod in the cache (reference :828-888)."""
+        pod = self.schedulers_cache.get_pod(pod_uid)
+        if pod is None:
+            logger.warning("assume: pod %s not in cache", pod_uid)
+            return False
+        all_bound = self.volume_binder.all_bound(pod)
+        assumed = pod.deepcopy()
+        assumed.spec.node_name = node_name
+        self.schedulers_cache.assume_pod(assumed, all_bound)
+        return True
+
+    def forget_pod(self, pod_uid: str) -> None:
+        pod = self.schedulers_cache.get_pod(pod_uid)
+        if pod is not None:
+            self.schedulers_cache.forget_pod(pod)
+
+    def bind_pod_volumes(self, pod: Pod) -> None:
+        if not self.schedulers_cache.are_pod_volumes_all_bound(pod.uid):
+            self.volume_binder.bind_pod_volumes(pod)
+
+    def get_pvc(self, namespace: str, name: str):
+        return self._pvcs.get(f"{namespace}/{name}")
+
+    # ------------------------------------------------------ priority classes
+    def add_priority_class(self, pc: PriorityClass) -> None:
+        self.schedulers_cache.update_priority_class(pc)
+
+    def delete_priority_class(self, pc: PriorityClass) -> None:
+        self.schedulers_cache.remove_priority_class(pc.name)
+
+    def is_preempt_self_allowed(self, pc_name: str) -> bool:
+        pc = self.schedulers_cache.get_priority_class(pc_name)
+        if pc is None:
+            return True
+        val = pc.metadata.annotations.get(constants.ANNOTATION_ALLOW_PREEMPTION)
+        return val != constants.FALSE
+
+    # ---------------------------------------------------------- config maps
+    def _is_yunikorn_configmap(self, cm) -> bool:
+        return (cm.metadata.namespace == self.conf.namespace
+                and cm.metadata.name in (constants.CONFIGMAP_NAME, constants.DEFAULT_CONFIGMAP_NAME))
+
+    def _on_configmap(self, cm) -> None:
+        """Config hot reload (reference triggerReloadConfig :648-677)."""
+        if not self.conf.enable_config_hot_refresh:
+            logger.info("config hot refresh disabled, ignoring configmap change")
+            return
+        defaults = self.api_provider.get_client().get_configmap(
+            self.conf.namespace, constants.DEFAULT_CONFIGMAP_NAME)
+        overrides = self.api_provider.get_client().get_configmap(
+            self.conf.namespace, constants.CONFIGMAP_NAME)
+        holder = get_holder()
+        holder.update_config_maps(
+            [defaults.data if defaults else None, overrides.data if overrides else None],
+            binary_maps=[defaults.binary_data if defaults else {},
+                         overrides.binary_data if overrides else {}],
+        )
+        self.conf = holder.get()
+        self.scheduler_api.update_configuration(holder.queues_config(), {})
+
+    # ---------------------------------------------------------- autoscaler
+    def handle_container_state_update(self, request) -> None:
+        """Core 'skipped/failed' container states → pod conditions
+        (reference HandleContainerStateUpdate :1222-1261)."""
+        app = self.get_application(request.application_id)
+        if app is None:
+            return
+        task = app.get_task(request.allocation_key)
+        if task is None:
+            return
+        if request.state == ContainerSchedulingState.SKIPPED:
+            task.set_task_scheduling_state(TaskSchedulingState.SKIPPED, request.reason)
+        elif request.state == ContainerSchedulingState.FAILED:
+            task.set_task_scheduling_state(TaskSchedulingState.FAILED, request.reason)
+
+    # -------------------------------------------------------------- recovery
+    def initialize_state(self) -> None:
+        """Cold-start recovery (reference InitializeState :1380-1455):
+        priority classes → nodes registered draining → pods replayed in
+        creation order (assigned ones become existing Allocations in the core)
+        → nodes enabled → handlers attached."""
+        logger.info("initializing state (recovery)")
+        # 1. priority classes
+        for pc in self.api_provider.list_priority_classes():
+            self.add_priority_class(pc)
+        # 2. nodes, registered draining
+        nodes = self.api_provider.list_nodes()
+        infos = []
+        for node in nodes:
+            self.schedulers_cache.update_node(node)
+            infos.append(NodeInfo(
+                node_id=node.name, action=NodeAction.CREATE_DRAIN,
+                attributes={constants.NODE_ATTRIBUTE_HOSTNAME: node.name},
+                schedulable_resource=get_node_resource(node.status.allocatable),
+                node=node,
+            ))
+        if infos:
+            self.scheduler_api.update_node(NodeRequest(nodes=infos))
+        # 3. pods in creation order; existing assignments become allocations
+        pods = sorted(self.api_provider.list_pods(), key=lambda p: p.metadata.creation_timestamp)
+        existing: List[Allocation] = []
+        for pod in pods:
+            self.update_pod(None, pod)
+            alloc = self._existing_allocation(pod)
+            if alloc is not None:
+                existing.append(alloc)
+        if existing:
+            self.scheduler_api.update_allocation(AllocationRequest(allocations=existing))
+        # 4. enable nodes
+        if infos:
+            self.scheduler_api.update_node(NodeRequest(nodes=[
+                NodeInfo(node_id=i.node_id, action=NodeAction.DRAIN_TO_SCHEDULABLE)
+                for i in infos
+            ]))
+        # 5. attach live handlers
+        self.add_scheduling_event_handlers()
+        self._initialized = True
+        logger.info("state initialization done: %d nodes, %d pods", len(nodes), len(pods))
+
+    def _existing_allocation(self, pod: Pod) -> Optional[Allocation]:
+        """reference getExistingAllocation (:1758-1787)."""
+        meta = get_task_metadata(pod, self.conf.generate_unique_app_ids)
+        if meta is None or not pod.is_assigned() or pod.is_terminated():
+            return None
+        return Allocation(
+            allocation_key=pod.uid,
+            application_id=meta.application_id,
+            node_id=pod.spec.node_name,
+            resource=get_pod_resource(pod),
+            placeholder=meta.placeholder,
+            task_group_name=meta.task_group_name,
+        )
+
+    # -------------------------------------------------- dispatcher handlers
+    def application_event_handler(self) -> Callable:
+        def handle(event):
+            if isinstance(event, AppEventRecord):
+                app = self.get_application(event.application_id)
+                if app is None:
+                    logger.warning("app event %s for unknown app %s",
+                                   event.event, event.application_id)
+                    return
+                app.handle_event(event.event, *event.args)
+
+        return handle
+
+    def task_event_handler(self) -> Callable:
+        def handle(event):
+            if isinstance(event, TaskEventRecord):
+                app = self.get_application(event.application_id)
+                if app is None:
+                    return
+                if event.event == app_mod.UPDATE_RESERVATION:
+                    app.handle_event(app_mod.UPDATE_RESERVATION)
+                    return
+                task = app.get_task(event.task_id)
+                if task is None:
+                    return
+                task.handle_event(event.event, *event.args)
+
+        return handle
+
+    # ------------------------------------------------------------ inspection
+    def state_dump(self) -> dict:
+        with self._lock:
+            return {
+                "cache": self.schedulers_cache.dao(),
+                "applications": {a.application_id: a.dao() for a in self._apps.values()},
+            }

@@ -99,6 +99,10 @@ class CoreScheduler(SchedulerAPI):
         # itself; here the cache is shared, so this overlay closes the window
         # where a freshly committed allocation would be double-counted as free.
         self._inflight: Dict[str, Allocation] = {}
+        # recovery: existing allocations can arrive before their app is
+        # submitted (the shim replays pods during InitializeState, app
+        # submission happens on the first pump tick) — park them here
+        self._pending_restores: Dict[str, List[Allocation]] = {}
         self._running = threading.Event()
         self._wake = threading.Condition()
         self._dirty = False
@@ -207,7 +211,9 @@ class CoreScheduler(SchedulerAPI):
         with self._lock:
             for add in request.new:
                 if add.application_id in self.partition.applications:
-                    continue  # duplicate submission is idempotent
+                    # idempotent: re-acknowledge so the shim FSM can progress
+                    resp.accepted.append(AcceptedApplication(add.application_id))
+                    continue
                 leaf = self.queues.resolve(add.queue_name)
                 if leaf is None:
                     resp.rejected.append(RejectedApplication(
@@ -227,6 +233,8 @@ class CoreScheduler(SchedulerAPI):
                 self.partition.applications[add.application_id] = app
                 leaf.app_ids.add(add.application_id)
                 resp.accepted.append(AcceptedApplication(add.application_id))
+                for alloc in self._pending_restores.pop(add.application_id, []):
+                    self._restore_allocation(alloc)
             for rem in request.remove:
                 self._remove_application(rem.application_id)
         if (resp.accepted or resp.rejected or resp.updated) and self.callback is not None:
@@ -234,6 +242,7 @@ class CoreScheduler(SchedulerAPI):
         self.trigger()
 
     def _remove_application(self, app_id: str) -> None:
+        self._pending_restores.pop(app_id, None)
         app = self.partition.applications.pop(app_id, None)
         if app is None:
             return
@@ -273,7 +282,8 @@ class CoreScheduler(SchedulerAPI):
         """Recovery path: an allocation that already exists in the cluster."""
         app = self.partition.applications.get(alloc.application_id)
         if app is None:
-            logger.warning("restore: unknown application %s", alloc.application_id)
+            # recovery race: park until the app submission arrives
+            self._pending_restores.setdefault(alloc.application_id, []).append(alloc)
             return
         if alloc.allocation_key in app.allocations:
             return
@@ -299,6 +309,12 @@ class CoreScheduler(SchedulerAPI):
             return None
         app = self.partition.applications.get(release.application_id)
         if app is None:
+            # the pod may have been parked for restore before its app arrived
+            parked = self._pending_restores.get(release.application_id)
+            if parked:
+                parked[:] = [a for a in parked if a.allocation_key != release.allocation_key]
+                if not parked:
+                    self._pending_restores.pop(release.application_id, None)
             return None
         app.pending_asks.pop(release.allocation_key, None)
         self._inflight.pop(release.allocation_key, None)

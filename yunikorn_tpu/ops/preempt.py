@@ -1,0 +1,57 @@
+"""Preemption predicates: ordered victim-subset search.
+
+Role-equivalent to PredicateManager.PreemptionPredicates (reference
+pkg/plugin/predicates/predicate_manager.go:137-188) with the startIndex
+contract of scheduler_callback.go:200-209: clone the node's state, remove
+victims[0:startIndex) unconditionally, then remove one victim at a time and
+return the first index at which the pod fits.
+
+This per-(pod,node) check is exact and host-side; the *batched* victim search
+across candidate nodes (used by the core's preemption planner) lives in
+core/preemption.py and calls this as its per-node kernel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from yunikorn_tpu.common.resource import Resource, get_pod_resource
+from yunikorn_tpu.common.si import (
+    PreemptionPredicatesArgs,
+    PreemptionPredicatesResponse,
+)
+from yunikorn_tpu.ops.host_predicates import pod_fits_node
+
+
+def preemption_victim_search(context, args: PreemptionPredicatesArgs) -> PreemptionPredicatesResponse:
+    cache = context.schedulers_cache
+    pod = cache.get_pod(args.allocation_key)
+    info = cache.get_node(args.node_id)
+    if pod is None or info is None:
+        return PreemptionPredicatesResponse(success=False, index=-1)
+
+    victims: List = []
+    for key in args.preempt_allocation_keys:
+        v = info.pods.get(key) or cache.get_pod(key)
+        if v is not None:
+            victims.append(v)
+
+    remaining = dict(info.pods)
+    free = info.available()
+    # removals up to startIndex are unconditional (the core already decided
+    # those victims are going away)
+    for v in victims[: args.start_index]:
+        if v.uid in remaining:
+            remaining.pop(v.uid)
+            free = free.add(get_pod_resource(v))
+    # remove one victim at a time, test after each removal; return the index
+    # of the removal that made the pod fit (reference returns i, never testing
+    # the zero-extra-removals case)
+    for i in range(args.start_index, len(victims)):
+        v = victims[i]
+        if v.uid in remaining:
+            remaining.pop(v.uid)
+            free = free.add(get_pod_resource(v))
+        err = pod_fits_node(pod, info.node, free, remaining.values())
+        if err is None:
+            return PreemptionPredicatesResponse(success=True, index=i)
+    return PreemptionPredicatesResponse(success=False, index=-1)

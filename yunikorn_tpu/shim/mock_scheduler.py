@@ -1,0 +1,136 @@
+"""MockScheduler: a full scheduler (real core + real shim) over a fake cluster.
+
+Role-equivalent to the reference's flagship test fake (pkg/shim/
+scheduler_mock_test.go:51-370): a *real* core started in-process wired to the
+mocked API provider, with assertion helpers that inspect both shim FSM state
+and core partition state (waitAndAssertTaskState :165, GetActiveNodeCountInCore
+:295). Integration tests and the throughput benchmark run full submit→bind
+cycles with zero Kubernetes. Lives in the package (not tests/) because
+bench.py builds on it, mirroring scheduler_perf_test.go's use.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from yunikorn_tpu.cache.context import Context
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.client.fake import BindStats, FakeCluster
+from yunikorn_tpu.common.objects import ConfigMap, Node, ObjectMeta, Pod
+from yunikorn_tpu.conf.schedulerconf import get_holder, reset_for_tests
+from yunikorn_tpu.core.scheduler import CoreScheduler
+from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
+from yunikorn_tpu.shim.scheduler import KubernetesShim
+
+
+class MockScheduler:
+    def __init__(self):
+        self.cluster: Optional[FakeCluster] = None
+        self.core: Optional[CoreScheduler] = None
+        self.shim: Optional[KubernetesShim] = None
+        self.context: Optional[Context] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def init(self, queues_yaml: str = "", interval: float = 0.05,
+             core_interval: float = 0.02, solver_policy: Optional[str] = None) -> None:
+        reset_for_tests()
+        holder = get_holder()
+        holder.update_config_maps(
+            [{"service.schedulingInterval": str(interval),
+              "queues.yaml": queues_yaml}],
+            initial=True,
+        )
+        dispatch_mod.reset_dispatcher()
+        self.cluster = FakeCluster()
+        cache = SchedulerCache()
+        self.core = CoreScheduler(cache, interval=core_interval)
+        self.context = Context(self.cluster, self.core, cache=cache)
+        self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
+
+    def start(self) -> None:
+        self.core.start()
+        self.shim.run()
+
+    def stop(self) -> None:
+        if self.shim is not None:
+            self.shim.stop()
+        if self.core is not None:
+            self.core.stop()
+
+    # --------------------------------------------------------------- actions
+    def add_node(self, node: Node) -> None:
+        self.cluster.add_node(node)
+
+    def add_nodes(self, nodes: List[Node]) -> None:
+        for n in nodes:
+            self.cluster.add_node(n)
+
+    def add_pod(self, pod: Pod) -> Pod:
+        return self.cluster.add_pod(pod)
+
+    def add_pods(self, pods: List[Pod]) -> None:
+        for p in pods:
+            self.cluster.add_pod(p)
+
+    def succeed_pod(self, pod: Pod) -> None:
+        self.cluster.succeed_pod(pod.uid)
+
+    def delete_pod(self, pod: Pod) -> None:
+        self.cluster.delete_pod(pod.uid)
+
+    def update_config(self, queues_yaml: str, namespace: str = "yunikorn") -> None:
+        self.cluster.add_configmap(ConfigMap(
+            metadata=ObjectMeta(name="yunikorn-configs", namespace=namespace),
+            data={"queues.yaml": queues_yaml},
+        ))
+
+    # ------------------------------------------------------------ assertions
+    def wait_for_task_state(self, app_id: str, task_id: str, expected: str,
+                            timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        last = "<no task>"
+        while time.time() < deadline:
+            app = self.context.get_application(app_id)
+            if app is not None:
+                task = app.get_task(task_id)
+                if task is not None:
+                    last = task.state
+                    if last == expected:
+                        return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"task {task_id} of {app_id}: expected state {expected}, last seen {last}")
+
+    def wait_for_app_state(self, app_id: str, expected: str, timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        last = "<no app>"
+        while time.time() < deadline:
+            app = self.context.get_application(app_id)
+            if app is not None:
+                last = app.state
+                if last == expected:
+                    return
+            time.sleep(0.02)
+        raise AssertionError(f"app {app_id}: expected state {expected}, last seen {last}")
+
+    def wait_for_bound_count(self, count: int, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.bind_stats().success_count >= count:
+                return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"expected {count} binds, got {self.bind_stats().success_count}")
+
+    def get_active_node_count_in_core(self) -> int:
+        return self.core.partition.active_node_count()
+
+    def get_pod_assignment(self, pod: Pod) -> str:
+        cur = self.cluster.get_pod(pod.uid)
+        return cur.spec.node_name if cur is not None else ""
+
+    def bind_stats(self) -> BindStats:
+        return self.cluster.get_client().bind_stats
+
+    def core_allocation_count(self) -> int:
+        return self.core.metrics["allocation_attempt_allocated"]
