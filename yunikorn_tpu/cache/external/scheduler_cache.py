@@ -69,6 +69,10 @@ class SchedulerCache:
         self.pvc_ref_counts: Dict[str, int] = {}  # "ns/claim" -> count
         # generation tracking for incremental snapshot encoding
         self._generation = 0
+        # bumped only when node allocatable capacity changes (add/remove/update
+        # of the node object itself, not pod churn) — cheap memo key for
+        # cluster-capacity reductions
+        self._capacity_version = 0
         self._dirty_nodes: Set[str] = set()
         self._listeners: List[Callable[[str], None]] = []
 
@@ -93,6 +97,7 @@ class SchedulerCache:
                         logger.info("adopted orphan pod %s onto node %s", pod.key(), node.name)
             else:
                 info.set_node(node)
+            self._capacity_version += 1
             self._mark_dirty(node.name)
             return adopted
 
@@ -108,6 +113,7 @@ class SchedulerCache:
                 self.orphaned_pods[key] = pod
                 self._update_pvc_refs(pod, add=False)
                 orphans.append(pod)
+            self._capacity_version += 1
             self._mark_dirty(node_name)
             return orphans
 
@@ -276,6 +282,10 @@ class SchedulerCache:
     def generation(self) -> int:
         with self._lock.reader():
             return self._generation
+
+    def capacity_version(self) -> int:
+        with self._lock.reader():
+            return self._capacity_version
 
     def take_dirty_nodes(self) -> Set[str]:
         """Return and clear the set of nodes whose aggregates changed."""

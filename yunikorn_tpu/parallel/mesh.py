@@ -1,0 +1,106 @@
+"""Multi-chip scaling: shard the solve over a device mesh.
+
+The reference scales by raising QPS against the K8s API and by the core's
+single-threaded cycle (SURVEY.md §2.5: no distributed backend exists or is
+needed there). The TPU-native scale-out story is different: the pods×nodes
+feasibility/scoring problem shards over the NODE dimension the way sequence
+parallelism shards sequence (SURVEY.md §5 "long-context" note):
+
+  mesh: 1-D ("nodes",) over all chips (ICI within a slice, DCN across)
+  node-side arrays  [M, ...]  → sharded along M   (PartitionSpec("nodes"))
+  pod-side arrays   [N, ...]  → replicated        (small: one row per pod)
+  group feasibility [G, M]    → sharded along M
+
+Under jit+GSPMD each chip evaluates predicates/fit/scoring for its node shard;
+the per-pod argmax over M becomes a sharded reduce (XLA inserts the ICI
+all-reduce); the water-fill and accept stages run on the replicated [N] data.
+Assignment extraction gathers one int32 per pod.
+
+This module provides the mesh construction + sharded wrapper around
+ops.assign.solve. It works on any device set — the test/dryrun path uses a
+virtual 8-device CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from yunikorn_tpu.ops import assign as assign_mod
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def _shardings(mesh: Mesh):
+    node_sharded = NamedSharding(mesh, P(NODE_AXIS))
+    node_sharded2 = NamedSharding(mesh, P(NODE_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    return node_sharded, node_sharded2, repl
+
+
+def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
+                  chunk: int = 512, policy: str = "binpacking",
+                  free_delta=None) -> assign_mod.SolveResult:
+    """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
+
+    M must be divisible by the mesh size (NodeArrays capacities are powers of
+    two, meshes are 2^k chips, so this holds by construction).
+    """
+    na = node_arrays
+    n_dev = mesh.devices.size
+    M = na.capacity
+    assert M % n_dev == 0, f"node capacity {M} not divisible by mesh size {n_dev}"
+    node_s, node_s2, repl = _shardings(mesh)
+
+    free_i = np.floor(na.free).astype(np.int32)
+    if free_delta is not None:
+        d = np.zeros_like(free_i)
+        rows = min(free_i.shape[0], free_delta.shape[0])
+        cols = min(free_i.shape[1], free_delta.shape[1])
+        d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
+        free_i = free_i - d
+    node_ok = na.valid & na.schedulable
+
+    put = jax.device_put
+    args = (
+        put(batch.req.astype(np.int32), repl),
+        put(batch.group_id, repl),
+        put(batch.rank, repl),
+        put(batch.valid, repl),
+        put(batch.g_term_req, repl),
+        put(batch.g_term_forb, repl),
+        put(batch.g_term_valid, repl),
+        put(batch.g_anyof, repl),
+        put(batch.g_anyof_valid, repl),
+        put(batch.g_tol, repl),
+        put(batch.g_ports, repl),
+        put(na.labels, node_s2),
+        put(na.taints_hard, node_s2),
+        put(na.ports, node_s2),
+        put(node_ok, node_s),
+        put(free_i, node_s2),
+        put(np.floor(na.capacity_arr).astype(np.int32), node_s2),
+    )
+    host_mask = batch.g_host_mask
+    if host_mask is not None:
+        hm = np.zeros((host_mask.shape[0], M), bool)
+        hm[:, : min(M, host_mask.shape[1])] = host_mask[:, :M]
+        mask_arg = put(hm, NamedSharding(mesh, P(None, NODE_AXIS)))
+    else:
+        mask_arg = None
+
+    with mesh:
+        assigned, free_after, rounds = assign_mod.solve(
+            *args, mask_arg, max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
+            policy=policy,
+        )
+    return assign_mod.SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

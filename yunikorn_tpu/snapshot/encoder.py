@@ -523,9 +523,17 @@ class SnapshotEncoder:
         Wt = self.vocabs.taints.num_words
         Wp = self.vocabs.ports.num_words
 
+        # requests dedup: large batches are dominated by identical shapes (a
+        # deployment's pods all ask the same), so quantize each distinct
+        # resource once and scatter
         req = np.zeros((N, R), np.float32)
+        row_cache: Dict[tuple, np.ndarray] = {}
         for i, ask in enumerate(asks):
-            row = self.quantize_request(ask.resource)
+            sig = tuple(sorted(ask.resource.resources.items()))
+            row = row_cache.get(sig)
+            if row is None:
+                row = self.quantize_request(ask.resource)
+                row_cache[sig] = row
             if row.shape[0] > R:
                 # vocab grew past the padded width: restart with the wider R
                 return self.build_batch(asks, ranks, queue_ids, min_batch)
