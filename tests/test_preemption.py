@@ -1,0 +1,140 @@
+"""Preemption tests: victim-subset search (startIndex contract), planner
+selection, and the end-to-end evict→reschedule flow (reference e2e suites:
+preemption / simple_preemptor / priority_scheduling).
+"""
+import time
+
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import ObjectMeta, PriorityClass, make_node, make_pod
+from yunikorn_tpu.common.si import PreemptionPredicatesArgs
+from yunikorn_tpu.core.preemption import plan_preemptions
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.ops.preempt import preemption_victim_search
+from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+
+
+def setup_node_with_victims():
+    cache = SchedulerCache()
+    cache.update_node(make_node("n1", cpu_milli=4000, memory=8 * 2**30))
+    victims = []
+    for i in range(4):
+        v = make_pod(f"victim-{i}", cpu_milli=1000, node_name="n1",
+                     phase="Running", priority=i)
+        cache.update_pod(v)
+        victims.append(v)
+    return cache, victims
+
+
+def test_victim_search_returns_first_fitting_index():
+    cache, victims = setup_node_with_victims()
+    # node full (4x1000m); pod needs 2000m → 2 victims must go
+    pod = make_pod("preemptor", cpu_milli=2000, priority=100)
+    cache.update_pod(pod)
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=[v.uid for v in victims], start_index=0))
+    assert resp.success and resp.index == 1  # removing victims[0..1] fits
+
+
+def test_victim_search_start_index_contract():
+    cache, victims = setup_node_with_victims()
+    pod = make_pod("preemptor", cpu_milli=3000, priority=100)
+    cache.update_pod(pod)
+    # startIndex=2: victims 0,1 removed unconditionally, then one at a time
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=[v.uid for v in victims], start_index=2))
+    assert resp.success and resp.index == 2
+
+
+def test_victim_search_no_fit():
+    cache, victims = setup_node_with_victims()
+    pod = make_pod("preemptor", cpu_milli=16000, priority=100)
+    cache.update_pod(pod)
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=[v.uid for v in victims], start_index=0))
+    assert not resp.success and resp.index == -1
+
+
+def test_planner_picks_cheapest_victims():
+    cache, victims = setup_node_with_victims()
+    pod = make_pod("preemptor", cpu_milli=1000, priority=100)
+    cache.update_pod(pod)
+    ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod),
+                        priority=100, pod=pod)
+    app_of_pod = {v.uid: "app-lo" for v in victims}
+    plans = plan_preemptions(cache, [ask], app_of_pod)
+    assert len(plans) == 1
+    assert plans[0].node_id == "n1"
+    # exactly one victim, the lowest priority one (priority 0)
+    assert [v.uid for v in plans[0].victims] == [victims[0].uid]
+
+
+def test_planner_respects_allow_preemption_annotation():
+    cache, victims = setup_node_with_victims()
+    # protect the two lowest-priority victims via PriorityClass opt-out
+    pc = PriorityClass(metadata=ObjectMeta(
+        name="protected", annotations={constants.ANNOTATION_ALLOW_PREEMPTION: "false"}))
+    cache.update_priority_class(pc)
+    for v in victims[:2]:
+        v.spec.priority_class_name = "protected"
+    pod = make_pod("preemptor", cpu_milli=1000, priority=100)
+    cache.update_pod(pod)
+    ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod), priority=100, pod=pod)
+    plans = plan_preemptions(cache, [ask], {v.uid: "app-lo" for v in victims})
+    assert len(plans) == 1
+    assert plans[0].victims[0].uid == victims[2].uid  # cheapest unprotected
+
+
+def test_planner_preemptor_never_policy():
+    cache, victims = setup_node_with_victims()
+    pod = make_pod("pacifist", cpu_milli=1000, priority=100)
+    pod.spec.preemption_policy = "Never"
+    cache.update_pod(pod)
+    ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod), priority=100, pod=pod)
+    plans = plan_preemptions(cache, [ask], {v.uid: "app-lo" for v in victims})
+    assert plans == []
+
+
+def test_planner_ignores_foreign_pods():
+    cache, victims = setup_node_with_victims()
+    pod = make_pod("preemptor", cpu_milli=1000, priority=100)
+    cache.update_pod(pod)
+    ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod), priority=100, pod=pod)
+    plans = plan_preemptions(cache, [ask], {})  # no yunikorn-managed victims
+    assert plans == []
+
+
+# ---------------------------------------------------------------------------
+# End-to-end
+# ---------------------------------------------------------------------------
+
+def test_preemption_e2e_evicts_and_reschedules():
+    ms = MockScheduler()
+    ms.init("")
+    ms.start()
+    try:
+        ms.add_node(make_node("n1", cpu_milli=2000, memory=4 * 2**30))
+        low = [ms.add_pod(make_pod(f"low-{i}", cpu_milli=1000, memory=2**27,
+                                   labels={"applicationId": "app-low"},
+                                   scheduler_name="yunikorn", priority=0))
+               for i in range(2)]
+        for p in low:
+            ms.wait_for_task_state("app-low", p.uid, task_mod.BOUND)
+        # node is full; high-priority pod arrives
+        high = ms.add_pod(make_pod("high", cpu_milli=1000, memory=2**27,
+                                   labels={"applicationId": "app-high"},
+                                   scheduler_name="yunikorn", priority=100))
+        # a low-priority pod gets evicted and the high pod binds
+        ms.wait_for_task_state("app-high", high.uid, task_mod.BOUND, timeout=20)
+        assert ms.get_pod_assignment(high) == "n1"
+        remaining_low = [p for p in low if ms.cluster.get_pod(p.uid) is not None]
+        assert len(remaining_low) == 1  # exactly one victim evicted
+    finally:
+        ms.stop()

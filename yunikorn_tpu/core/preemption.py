@@ -1,0 +1,173 @@
+"""Preemption planner: make room for high-priority asks by evicting victims.
+
+Role-equivalent to yunikorn-core's preemption logic, which the reference shim
+serves via the PreemptionPredicates upcall (reference pkg/cache/
+scheduler_callback.go:200-209 → Context.IsPodFitNodeViaPreemption
+context.go:718-746 → PredicateManager.PreemptionPredicates
+predicate_manager.go:137-188). The per-(ask,node) ordered-victim-subset check
+with the startIndex contract lives in ops/preempt.py; this module is the
+planner that decides WHICH asks preempt WHERE:
+
+  for each unplaced ask (priority order, bounded per cycle):
+    candidate nodes   = feasible nodes for the ask's constraint group
+    victims per node  = lower-priority, preemptable pods, ordered by
+                        (priority asc, newest first) — cheapest evictions first
+    chosen node       = feasible node minimizing (victim count, victim
+                        priority sum), validated through the exact
+                        victim-subset search
+    emit releases     = TerminationType.PREEMPTED_BY_SCHEDULER
+
+The shim reacts to the releases by deleting the victim pods (reference
+handleReleaseAppAllocationEvent); the freed capacity is observed through the
+informer path and the preempting ask wins it on the next solve cycle via its
+rank (priority sorts first).
+
+Victim-side opt-out: pods whose PriorityClass carries the
+yunikorn.apache.org/allow-preemption: "false" annotation are never selected
+(reference constants.AnnotationAllowPreemption). Preemptor-side opt-out: asks
+whose pod sets preemptionPolicy: Never do not trigger preemption.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import Pod
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import (
+    AllocationAsk,
+    AllocationRelease,
+    PreemptionPredicatesArgs,
+    TerminationType,
+)
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.ops.host_predicates import pod_fits_node
+from yunikorn_tpu.ops.preempt import preemption_victim_search
+
+logger = log("core.scheduler")
+
+MAX_PREEMPTING_ASKS_PER_CYCLE = 32
+MAX_CANDIDATE_NODES = 32
+MAX_VICTIMS_PER_NODE = 16
+
+
+@dataclasses.dataclass
+class PreemptionPlan:
+    ask: AllocationAsk
+    node_id: str
+    victims: List[Pod]
+
+    def releases(self, victim_app_ids: Dict[str, str]) -> List[AllocationRelease]:
+        return [
+            AllocationRelease(
+                application_id=victim_app_ids.get(v.uid, ""),
+                allocation_key=v.uid,
+                termination_type=TerminationType.PREEMPTED_BY_SCHEDULER,
+                message=f"preempted for {self.ask.allocation_key}",
+            )
+            for v in self.victims
+        ]
+
+
+def _pod_priority(pod: Optional[Pod]) -> int:
+    if pod is None or pod.spec.priority is None:
+        return 0
+    return pod.spec.priority
+
+
+def _is_preemptable(pod: Pod, pc_lookup) -> bool:
+    if pod.spec.priority_class_name:
+        pc = pc_lookup(pod.spec.priority_class_name)
+        if pc is not None:
+            if pc.metadata.annotations.get(constants.ANNOTATION_ALLOW_PREEMPTION) == constants.FALSE:
+                return False
+            if getattr(pc, "preemption_policy", "") == "Never":
+                # PriorityClass-level Never only blocks the preemptOR side;
+                # keep victims eligible (K8s semantics)
+                pass
+    return True
+
+
+def _may_preempt(ask: AllocationAsk) -> bool:
+    pod = ask.pod
+    if pod is not None and pod.spec.preemption_policy == "Never":
+        return False
+    return True
+
+
+def plan_preemptions(
+    cache,
+    unplaced_asks: List[AllocationAsk],
+    app_of_pod: Dict[str, str],
+) -> List[PreemptionPlan]:
+    """Compute preemption plans for unplaced asks.
+
+    `cache` is the shared external SchedulerCache (provides pods, nodes and
+    PriorityClass lookups); app_of_pod maps victim pod uid -> application id.
+    """
+    plans: List[PreemptionPlan] = []
+    already_victim: set = set()
+    candidates = sorted(unplaced_asks, key=lambda a: -(a.priority or 0))
+    for ask in candidates[:MAX_PREEMPTING_ASKS_PER_CYCLE]:
+        if (ask.priority or 0) <= 0 or not _may_preempt(ask) or ask.pod is None:
+            continue
+        plan = _plan_for_ask(cache, ask, already_victim, app_of_pod)
+        if plan is not None:
+            for v in plan.victims:
+                already_victim.add(v.uid)
+            plans.append(plan)
+    return plans
+
+
+def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
+                  app_of_pod: Dict[str, str]) -> Optional[PreemptionPlan]:
+    pod = ask.pod
+    best: Optional[Tuple[int, int, str, List[Pod]]] = None  # (count, prio_sum, node, victims)
+    pc_lookup = cache.get_priority_class
+
+    node_names = cache.node_names()
+    examined = 0
+    for name in node_names:
+        if examined >= MAX_CANDIDATE_NODES and best is not None:
+            break
+        info = cache.get_node(name)
+        if info is None:
+            continue
+        # quick feasibility screen ignoring capacity (host predicates)
+        err = pod_fits_node(pod, info.node, info.allocatable, info.pods.values())
+        if err is not None and err != "insufficient resources" and err != "host port conflict":
+            continue
+        examined += 1
+        # victims: lower priority, preemptable, not already claimed
+        victims = [
+            v for v in info.pods.values()
+            if _pod_priority(v) < (ask.priority or 0)
+            and v.uid not in already_victim
+            and v.uid in app_of_pod          # only yunikorn-managed allocations
+            and _is_preemptable(v, pc_lookup)
+        ]
+        if not victims:
+            continue
+        # cheapest evictions first: lowest priority, then youngest
+        victims.sort(key=lambda v: (_pod_priority(v), -v.metadata.creation_timestamp))
+        victims = victims[:MAX_VICTIMS_PER_NODE]
+        resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+            allocation_key=pod.uid,
+            node_id=name,
+            preempt_allocation_keys=[v.uid for v in victims],
+            start_index=0,
+        ))
+        if not resp.success:
+            continue
+        chosen = victims[: resp.index + 1]
+        prio_sum = sum(_pod_priority(v) for v in chosen)
+        key = (len(chosen), prio_sum)
+        if best is None or key < (best[0], best[1]):
+            best = (len(chosen), prio_sum, name, chosen)
+    if best is None:
+        return None
+    _, _, node_id, chosen = best
+    logger.info("preemption: ask %s evicts %d pods on node %s",
+                ask.allocation_key, len(chosen), node_id)
+    return PreemptionPlan(ask=ask, node_id=node_id, victims=chosen)

@@ -104,6 +104,9 @@ class CoreScheduler(SchedulerAPI):
         # submission happens on the first pump tick) — park them here
         self._pending_restores: Dict[str, List[Allocation]] = {}
         self._cap_cache: Optional[Tuple[int, Resource]] = None
+        # asks we already preempted for → timestamp; prevents stacking fresh
+        # victims every cycle while the previous evictions drain
+        self._preempted_for: Dict[str, float] = {}
         self._running = threading.Event()
         self._wake = threading.Condition()
         self._dirty = False
@@ -373,6 +376,7 @@ class CoreScheduler(SchedulerAPI):
             admitted, ranks, held = self._collect_and_gate()
             new_allocs: List[Allocation] = []
             skipped_keys: List[Tuple[str, str]] = []
+            unplaced_asks: List = []
             if admitted:
                 # overlay BEFORE sync: an assume landing in between then counts
                 # twice (once in the overlay, once in synced free) — strictly
@@ -392,6 +396,7 @@ class CoreScheduler(SchedulerAPI):
                     idx = int(assigned[i])
                     if idx < 0:
                         skipped_keys.append((ask.application_id, ask.allocation_key))
+                        unplaced_asks.append(ask)
                         continue
                     node_name = self.encoder.nodes.name_of(idx)
                     if node_name is None:
@@ -419,11 +424,41 @@ class CoreScheduler(SchedulerAPI):
             self.metrics["solve_count"] += 1
             self.metrics["solve_time_ms_total"] += int((time.time() - t0) * 1000)
 
+            # preemption: try to make room for unplaced high-priority asks
+            preempt_releases: List[AllocationRelease] = []
+            if self._preemption_enabled and unplaced_asks:
+                from yunikorn_tpu.core.preemption import plan_preemptions
+
+                now = time.time()
+                cooldown = 30.0
+                self._preempted_for = {
+                    k: ts for k, ts in self._preempted_for.items() if now - ts < cooldown
+                }
+                eligible = [a for a in unplaced_asks
+                            if a.allocation_key not in self._preempted_for]
+                app_of_pod = {
+                    key: app.application_id
+                    for app in self.partition.applications.values()
+                    for key in app.allocations
+                }
+                plans = plan_preemptions(self.cache, eligible, app_of_pod)
+                for plan in plans:
+                    self._preempted_for[plan.ask.allocation_key] = now
+                for plan in plans:
+                    for rel in plan.releases(app_of_pod):
+                        confirmed = self._release_allocation(rel)
+                        if confirmed is not None:
+                            preempt_releases.append(confirmed)
+                self.metrics["preempted_total"] = (
+                    self.metrics.get("preempted_total", 0) + len(preempt_releases))
+
         if self.callback is not None:
             if replaced.new or replaced.released:
                 self.callback.update_allocation(replaced)
             if new_allocs:
                 self.callback.update_allocation(AllocationResponse(new=new_allocs))
+            if preempt_releases:
+                self.callback.update_allocation(AllocationResponse(released=preempt_releases))
             for app_id, key in skipped_keys:
                 self.callback.update_container_scheduling_state(
                     UpdateContainerSchedulingStateRequest(

@@ -22,8 +22,8 @@ from yunikorn_tpu.common.si import (
 from yunikorn_tpu.ops.host_predicates import pod_fits_node
 
 
-def preemption_victim_search(context, args: PreemptionPredicatesArgs) -> PreemptionPredicatesResponse:
-    cache = context.schedulers_cache
+def preemption_victim_search(cache_or_context, args: PreemptionPredicatesArgs) -> PreemptionPredicatesResponse:
+    cache = getattr(cache_or_context, "schedulers_cache", cache_or_context)
     pod = cache.get_pod(args.allocation_key)
     info = cache.get_node(args.node_id)
     if pod is None or info is None:
