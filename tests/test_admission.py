@@ -1,0 +1,254 @@
+"""Admission controller tests (reference admission_controller_test.go, 2073
+lines — same scenarios: schedulerName patch, label injection, user-info
+auth, namespace filtering, immutability, workload templates, conf validation,
+PKI rotation; plus the live HTTP webhook).
+"""
+import json
+
+import pytest
+
+from yunikorn_tpu.admission.admission_controller import (
+    AdmissionController,
+    decode_patch,
+)
+from yunikorn_tpu.admission.caches import NamespaceCache, PriorityClassCache
+from yunikorn_tpu.admission.conf import AdmissionConf, parse_admission_conf
+from yunikorn_tpu.common import constants
+
+
+def make_review(pod=None, kind="Pod", operation="CREATE", namespace="default",
+                username="alice", groups=None, old=None, uid="uid-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": uid,
+            "kind": {"kind": kind},
+            "namespace": namespace,
+            "operation": operation,
+            "userInfo": {"username": username, "groups": groups or ["dev"]},
+            "object": pod or {},
+            "oldObject": old or {},
+        },
+    }
+
+
+def simple_pod(name="p1", labels=None, annotations=None, scheduler=""):
+    meta = {"name": name, "uid": f"uid-{name}"}
+    if labels is not None:
+        meta["labels"] = labels
+    if annotations is not None:
+        meta["annotations"] = annotations
+    spec = {}
+    if scheduler:
+        spec["schedulerName"] = scheduler
+    return {"metadata": meta, "spec": spec}
+
+
+@pytest.fixture
+def ac():
+    return AdmissionController(AdmissionConf())
+
+
+def patch_ops(result):
+    return {(p["op"], p["path"]) for p in decode_patch(result)}
+
+
+def test_scheduler_name_patched(ac):
+    result = ac.mutate(make_review(simple_pod()))
+    patch = decode_patch(result)
+    sn = [p for p in patch if p["path"] == "/spec/schedulerName"]
+    assert sn and sn[0]["value"] == "yunikorn"
+    assert result["response"]["allowed"]
+
+
+def test_app_id_and_queue_labels_added(ac):
+    result = ac.mutate(make_review(simple_pod()))
+    labels_patch = [p for p in decode_patch(result) if p["path"] == "/metadata/labels"]
+    assert labels_patch
+    labels = labels_patch[0]["value"]
+    assert labels[constants.LABEL_APPLICATION_ID].startswith("yunikorn-default-")
+    assert labels[constants.LABEL_QUEUE_NAME] == "root.default"
+
+
+def test_existing_app_id_kept(ac):
+    pod = simple_pod(labels={"applicationId": "my-app", "queue": "root.q"})
+    result = ac.mutate(make_review(pod))
+    labels_patch = [p for p in decode_patch(result) if p["path"] == "/metadata/labels"]
+    assert not labels_patch  # nothing to add
+
+
+def test_user_info_injected(ac):
+    result = ac.mutate(make_review(simple_pod(), username="alice", groups=["dev", "ops"]))
+    ann_patch = [p for p in decode_patch(result) if p["path"] == "/metadata/annotations"]
+    assert ann_patch
+    info = json.loads(ann_patch[0]["value"][constants.ANNOTATION_USER_INFO])
+    assert info["user"] == "alice" and info["groups"] == ["dev", "ops"]
+
+
+def test_system_user_trusted_no_injection(ac):
+    result = ac.mutate(make_review(
+        simple_pod(), username="system:serviceaccount:kube-system:deployment-controller"))
+    ann_patch = [p for p in decode_patch(result) if p["path"] == "/metadata/annotations"]
+    assert not ann_patch
+
+
+def test_bypass_auth_no_injection():
+    conf = parse_admission_conf({"admissionController.accessControl.bypassAuth": "true"})
+    ac = AdmissionController(conf)
+    result = ac.mutate(make_review(simple_pod()))
+    ann_patch = [p for p in decode_patch(result) if p["path"] == "/metadata/annotations"]
+    assert not ann_patch
+
+
+def test_bypass_namespace_not_processed(ac):
+    result = ac.mutate(make_review(simple_pod(), namespace="kube-system"))
+    # no schedulerName patch for bypassed namespaces
+    assert ("add", "/spec/schedulerName") not in patch_ops(result)
+
+
+def test_process_namespaces_regex():
+    conf = parse_admission_conf(
+        {"admissionController.filtering.processNamespaces": "^spark-,^batch$"})
+    ac = AdmissionController(conf)
+    assert ("add", "/spec/schedulerName") in patch_ops(
+        ac.mutate(make_review(simple_pod(), namespace="spark-jobs")))
+    assert ("add", "/spec/schedulerName") not in patch_ops(
+        ac.mutate(make_review(simple_pod(), namespace="other")))
+
+
+def test_namespace_annotation_overrides_regex():
+    ac = AdmissionController(AdmissionConf())
+    ac.namespaces.namespace_updated(
+        "opt-out", {constants.ANNOTATION_ENABLE_YUNIKORN: "false"})
+    assert ("add", "/spec/schedulerName") not in patch_ops(
+        ac.mutate(make_review(simple_pod(), namespace="opt-out")))
+    ac.namespaces.namespace_updated(
+        "kube-system", {constants.ANNOTATION_ENABLE_YUNIKORN: "true"})
+    assert ("add", "/spec/schedulerName") in patch_ops(
+        ac.mutate(make_review(simple_pod(), namespace="kube-system")))
+
+
+def test_yunikorn_own_pods_skipped(ac):
+    pod = simple_pod(labels={"app": "yunikorn"})
+    assert decode_patch(ac.mutate(make_review(pod))) == []
+
+
+def test_ignore_application_annotation(ac):
+    pod = simple_pod(annotations={constants.ANNOTATION_IGNORE_APPLICATION: "true"})
+    assert ("add", "/spec/schedulerName") not in patch_ops(ac.mutate(make_review(pod)))
+
+
+def test_user_info_immutable_on_update(ac):
+    old = simple_pod(annotations={constants.ANNOTATION_USER_INFO: '{"user":"a"}'})
+    new = simple_pod(annotations={constants.ANNOTATION_USER_INFO: '{"user":"b"}'})
+    result = ac.mutate(make_review(new, operation="UPDATE", old=old))
+    assert result["response"]["allowed"] is False
+    result = ac.mutate(make_review(old, operation="UPDATE", old=old))
+    assert result["response"]["allowed"] is True
+
+
+def test_preemption_annotation_from_priority_class(ac):
+    ac.priority_classes.priority_class_updated(
+        "no-preempt", {constants.ANNOTATION_ALLOW_PREEMPTION: "false"})
+    pod = simple_pod()
+    pod["spec"]["priorityClassName"] = "no-preempt"
+    result = ac.mutate(make_review(pod))
+    ann_patch = [p for p in decode_patch(result) if p["path"] == "/metadata/annotations"]
+    merged = {}
+    for p in ann_patch:
+        merged.update(p["value"])
+    assert merged.get(constants.ANNOTATION_ALLOW_PREEMPTION) == "false"
+
+
+def test_workload_template_injection(ac):
+    deployment = {
+        "metadata": {"name": "d1"},
+        "spec": {"template": {"metadata": {}, "spec": {}}},
+    }
+    result = ac.mutate(make_review(deployment, kind="Deployment", username="bob"))
+    patch = decode_patch(result)
+    assert patch and patch[0]["path"] == "/spec/template/metadata/annotations"
+    info = json.loads(patch[0]["value"][constants.ANNOTATION_USER_INFO])
+    assert info["user"] == "bob"
+
+
+def test_cronjob_template_path(ac):
+    cj = {
+        "metadata": {"name": "c1"},
+        "spec": {"jobTemplate": {"spec": {"template": {"metadata": {}, "spec": {}}}}},
+    }
+    result = ac.mutate(make_review(cj, kind="CronJob", username="bob"))
+    patch = decode_patch(result)
+    assert patch[0]["path"] == "/spec/jobTemplate/spec/template/metadata/annotations"
+
+
+def test_validate_conf():
+    calls = []
+
+    def validate(yaml_text):
+        calls.append(yaml_text)
+        return ("bad" not in yaml_text), "invalid queue config" if "bad" in yaml_text else ""
+
+    ac = AdmissionController(AdmissionConf(), validate_conf_fn=validate)
+    cm = {"metadata": {"name": "yunikorn-configs"}, "data": {"queues.yaml": "partitions: []"}}
+    result = ac.validate_conf(make_review(cm, kind="ConfigMap"))
+    assert result["response"]["allowed"]
+    cm_bad = {"metadata": {"name": "yunikorn-configs"}, "data": {"queues.yaml": "bad yaml"}}
+    result = ac.validate_conf(make_review(cm_bad, kind="ConfigMap"))
+    assert not result["response"]["allowed"]
+    # unrelated configmaps are always allowed, validator not called
+    n = len(calls)
+    other = {"metadata": {"name": "some-cm"}, "data": {}}
+    assert ac.validate_conf(make_review(other, kind="ConfigMap"))["response"]["allowed"]
+    assert len(calls) == n
+
+
+# ---------------------------------------------------------------------------
+# PKI + live webhook server
+# ---------------------------------------------------------------------------
+
+def test_pki_generation_and_rotation():
+    from yunikorn_tpu.admission.pki import CACollection, generate_server_cert
+
+    cas = CACollection()
+    server, bundle = cas.server_credentials(["localhost"])
+    assert b"BEGIN CERTIFICATE" in server.cert_pem
+    assert bundle.count(b"BEGIN CERTIFICATE") == 2
+    assert server.seconds_until_expiry() > 300 * 24 * 3600
+    assert cas.rotate_if_needed() is False  # fresh CAs, no rotation
+
+
+def test_webhook_server_http_roundtrip():
+    import urllib.request
+
+    from yunikorn_tpu.admission.webhook import WebhookServer
+
+    ac = AdmissionController(AdmissionConf())
+    server = WebhookServer(ac, port=0)
+    port = server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/mutate",
+            data=json.dumps(make_review(simple_pod())).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["response"]["allowed"]
+        assert body["response"]["patchType"] == "JSONPatch"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/health", timeout=5) as resp:
+            assert json.loads(resp.read())["status"] == "ok"
+    finally:
+        server.stop()
+
+
+def test_webhook_manager_manifests():
+    from yunikorn_tpu.admission.webhook import WebhookManager
+
+    mgr = WebhookManager(AdmissionConf())
+    m = mgr.mutating_webhook_config()
+    assert m["webhooks"][0]["clientConfig"]["caBundle"].count("BEGIN CERTIFICATE") == 2
+    v = mgr.validating_webhook_config()
+    assert v["webhooks"][0]["rules"][0]["resources"] == ["configmaps"]
+    assert mgr.wait_for_certificate_expiration_seconds() > 0

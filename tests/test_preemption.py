@@ -69,7 +69,7 @@ def test_planner_picks_cheapest_victims():
     ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod),
                         priority=100, pod=pod)
     app_of_pod = {v.uid: "app-lo" for v in victims}
-    plans = plan_preemptions(cache, [ask], app_of_pod)
+    plans, _ = plan_preemptions(cache, [ask], app_of_pod)
     assert len(plans) == 1
     assert plans[0].node_id == "n1"
     # exactly one victim, the lowest priority one (priority 0)
@@ -87,7 +87,7 @@ def test_planner_respects_allow_preemption_annotation():
     pod = make_pod("preemptor", cpu_milli=1000, priority=100)
     cache.update_pod(pod)
     ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod), priority=100, pod=pod)
-    plans = plan_preemptions(cache, [ask], {v.uid: "app-lo" for v in victims})
+    plans, _ = plan_preemptions(cache, [ask], {v.uid: "app-lo" for v in victims})
     assert len(plans) == 1
     assert plans[0].victims[0].uid == victims[2].uid  # cheapest unprotected
 
@@ -98,7 +98,7 @@ def test_planner_preemptor_never_policy():
     pod.spec.preemption_policy = "Never"
     cache.update_pod(pod)
     ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod), priority=100, pod=pod)
-    plans = plan_preemptions(cache, [ask], {v.uid: "app-lo" for v in victims})
+    plans, _ = plan_preemptions(cache, [ask], {v.uid: "app-lo" for v in victims})
     assert plans == []
 
 
@@ -107,7 +107,7 @@ def test_planner_ignores_foreign_pods():
     pod = make_pod("preemptor", cpu_milli=1000, priority=100)
     cache.update_pod(pod)
     ask = AllocationAsk(pod.uid, "app-hi", get_pod_resource(pod), priority=100, pod=pod)
-    plans = plan_preemptions(cache, [ask], {})  # no yunikorn-managed victims
+    plans, _ = plan_preemptions(cache, [ask], {})  # no yunikorn-managed victims
     assert plans == []
 
 

@@ -1,0 +1,91 @@
+"""REST API tests: the RClient-style surface over a live scheduler
+(reference helpers/yunikorn/rest_api_utils.go usage pattern).
+"""
+import json
+import urllib.request
+
+import pytest
+
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+from yunikorn_tpu.webapp.rest import RestServer
+
+
+@pytest.fixture
+def stack():
+    ms = MockScheduler()
+    ms.init("")
+    ms.start()
+    rest = RestServer(ms.core, ms.context, port=0)
+    port = rest.start()
+    yield ms, port
+    rest.stop()
+    ms.stop()
+
+
+def get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+def test_health_and_queues(stack):
+    ms, port = stack
+    assert get(port, "/ws/v1/health")["Healthy"] is True
+    queues = get(port, "/ws/v1/queues")
+    assert queues["queuename"] == "root"
+
+
+def test_apps_nodes_statedump(stack):
+    ms, port = stack
+    ms.add_node(make_node("node-1", cpu_milli=4000))
+    pod = ms.add_pod(make_pod("p1", cpu_milli=500, memory=2**27,
+                              labels={"applicationId": "rest-app"},
+                              scheduler_name="yunikorn"))
+    ms.wait_for_task_state("rest-app", pod.uid, task_mod.BOUND)
+    apps = get(port, "/ws/v1/apps")
+    assert apps["rest-app"]["state"] == "Running"
+    nodes = get(port, "/ws/v1/nodes")
+    assert nodes["node-1"]["schedulable"] is True
+    dump = get(port, "/ws/v1/fullstatedump")
+    assert "core" in dump and "shim" in dump
+    metrics = get(port, "/ws/v1/metrics")
+    assert metrics["allocation_attempt_allocated"] >= 1
+
+
+def test_validate_conf_endpoint(stack):
+    ms, port = stack
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/ws/v1/validate-conf",
+        data=b"partitions:\n  - name: default\n    queues:\n      - name: root",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        body = json.loads(resp.read())
+    assert body["allowed"] is True
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/ws/v1/validate-conf",
+        data=b"{{{bad yaml", method="POST")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        body = json.loads(resp.read())
+    assert body["allowed"] is False
+
+
+def test_webtest_proxy(stack):
+    ms, port = stack
+    import tempfile, os
+
+    from yunikorn_tpu.webapp.webtest import WebTestServer
+
+    with tempfile.TemporaryDirectory() as root:
+        with open(os.path.join(root, "index.html"), "w") as f:
+            f.write("<html>yunikorn</html>")
+        wt = WebTestServer(root, f"http://127.0.0.1:{port}", port=0)
+        wt_port = wt.start()
+        try:
+            with urllib.request.urlopen(f"http://127.0.0.1:{wt_port}/index.html", timeout=5) as resp:
+                assert b"yunikorn" in resp.read()
+            proxied = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{wt_port}/ws/v1/health", timeout=5).read())
+            assert proxied["Healthy"] is True
+        finally:
+            wt.stop()

@@ -1,0 +1,68 @@
+"""Informer-backed namespace and priority-class caches for admission.
+
+Role-equivalent to pkg/admission/namespace_cache.go:33-170 (tri-state
+enableYuniKorn / generateAppId namespace annotations) and
+priority_class_cache.go:34-120 (allow-preemption annotation).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from yunikorn_tpu.common import constants
+
+TRI_TRUE = 1
+TRI_FALSE = 0
+TRI_UNSET = -1
+
+
+def _tri(value: Optional[str]) -> int:
+    if value is None:
+        return TRI_UNSET
+    return TRI_TRUE if value.strip().lower() == "true" else TRI_FALSE
+
+
+class NamespaceCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flags: Dict[str, tuple] = {}  # ns -> (enableYuniKorn, generateAppId)
+
+    def namespace_updated(self, name: str, annotations: Dict[str, str]) -> None:
+        with self._lock:
+            self._flags[name] = (
+                _tri(annotations.get(constants.ANNOTATION_ENABLE_YUNIKORN)),
+                _tri(annotations.get(constants.ANNOTATION_GENERATE_APP_ID)),
+            )
+
+    def namespace_deleted(self, name: str) -> None:
+        with self._lock:
+            self._flags.pop(name, None)
+
+    def enable_yunikorn(self, ns: str) -> int:
+        with self._lock:
+            return self._flags.get(ns, (TRI_UNSET, TRI_UNSET))[0]
+
+    def generate_app_id(self, ns: str) -> int:
+        with self._lock:
+            return self._flags.get(ns, (TRI_UNSET, TRI_UNSET))[1]
+
+
+class PriorityClassCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._allow: Dict[str, bool] = {}
+
+    def priority_class_updated(self, name: str, annotations: Dict[str, str]) -> None:
+        with self._lock:
+            self._allow[name] = (
+                annotations.get(constants.ANNOTATION_ALLOW_PREEMPTION) != constants.FALSE
+            )
+
+    def priority_class_deleted(self, name: str) -> None:
+        with self._lock:
+            self._allow.pop(name, None)
+
+    def is_preemption_allowed(self, name: str) -> bool:
+        """Default True for unknown classes (reference behavior)."""
+        with self._lock:
+            return self._allow.get(name, True)

@@ -1,0 +1,172 @@
+"""Webhook HTTP server + webhook manager.
+
+Role-equivalent to the admission-controller binary's server
+(pkg/cmd/admissioncontroller/main.go:55-110: HTTPS on :9089 with /health,
+/mutate, /validate-conf; SIGUSR1 cert reload) and the WebhookManager's
+install/patch of the webhook configurations with the caBundle
+(webhook_manager.go:185-379). Serving is stdlib http.server; TLS uses the
+self-managed PKI when enabled (plain HTTP is the in-process test mode).
+"""
+from __future__ import annotations
+
+import json
+import ssl
+import tempfile
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from yunikorn_tpu.admission.admission_controller import AdmissionController
+from yunikorn_tpu.admission.pki import CACollection
+from yunikorn_tpu.log.logger import log
+
+logger = log("admission.webhook")
+
+MUTATE_PATH = "/mutate"
+VALIDATE_CONF_PATH = "/validate-conf"
+HEALTH_PATH = "/health"
+
+
+class WebhookServer:
+    def __init__(self, controller: AdmissionController, host: str = "127.0.0.1",
+                 port: int = 9089, use_tls: bool = False,
+                 cas: Optional[CACollection] = None):
+        self.controller = controller
+        self.host = host
+        self.port = port
+        self.use_tls = use_tls
+        self.cas = cas
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        controller = self.controller
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to our logger
+                logger.debug("webhook: " + fmt, *args)
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode() if not isinstance(payload, bytes) else payload
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == HEALTH_PATH:
+                    self._reply(200, {"status": "ok"})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError:
+                    self._reply(400, {"error": "invalid JSON"})
+                    return
+                if self.path == MUTATE_PATH:
+                    self._reply(200, controller.mutate(review))
+                elif self.path == VALIDATE_CONF_PATH:
+                    self._reply(200, controller.validate_conf(review))
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        if self.use_tls:
+            if self.cas is None:
+                self.cas = CACollection()
+            server_pair, _ = self.cas.server_credentials([self.host, "localhost"])
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            with tempfile.NamedTemporaryFile(suffix=".pem") as certf, \
+                    tempfile.NamedTemporaryFile(suffix=".pem") as keyf:
+                certf.write(server_pair.cert_pem)
+                certf.flush()
+                keyf.write(server_pair.key_pem)
+                keyf.flush()
+                ctx.load_cert_chain(certf.name, keyf.name)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket, server_side=True)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="admission-webhook", daemon=True)
+        self._thread.start()
+        logger.info("admission webhook serving on %s:%d (tls=%s)",
+                    self.host, self.port, self.use_tls)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class WebhookManager:
+    """Maintains the webhook registrations + caBundle (reference :57-799).
+
+    Against a real cluster this installs/patches Mutating/Validating
+    WebhookConfiguration objects; here it renders the manifests so an adapter
+    (or operator) can apply them, and owns CA rotation.
+    """
+
+    def __init__(self, conf, cas: Optional[CACollection] = None):
+        self.conf = conf
+        self.cas = cas or CACollection()
+
+    def mutating_webhook_config(self) -> dict:
+        return {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "yunikorn-admission-controller-cfg"},
+            "webhooks": [{
+                "name": "admission-webhook.yunikorn.validator",
+                "clientConfig": {
+                    "service": {"name": self.conf.am_service_name,
+                                "namespace": self.conf.namespace,
+                                "path": MUTATE_PATH},
+                    "caBundle": self.cas.ca_bundle().decode(),
+                },
+                "rules": [
+                    {"operations": ["CREATE", "UPDATE"], "apiGroups": [""],
+                     "apiVersions": ["v1"], "resources": ["pods"]},
+                    {"operations": ["CREATE", "UPDATE"],
+                     "apiGroups": ["apps", "batch"],
+                     "apiVersions": ["v1"],
+                     "resources": ["deployments", "daemonsets", "statefulsets",
+                                   "replicasets", "jobs", "cronjobs"]},
+                ],
+                "failurePolicy": "Fail",
+                "sideEffects": "None",
+                "admissionReviewVersions": ["v1"],
+            }],
+        }
+
+    def validating_webhook_config(self) -> dict:
+        return {
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "yunikorn-admission-controller-cfg"},
+            "webhooks": [{
+                "name": "admission-webhook.yunikorn.conf-validator",
+                "clientConfig": {
+                    "service": {"name": self.conf.am_service_name,
+                                "namespace": self.conf.namespace,
+                                "path": VALIDATE_CONF_PATH},
+                    "caBundle": self.cas.ca_bundle().decode(),
+                },
+                "rules": [{"operations": ["CREATE", "UPDATE"], "apiGroups": [""],
+                           "apiVersions": ["v1"], "resources": ["configmaps"]}],
+                "failurePolicy": "Ignore",
+                "sideEffects": "None",
+                "admissionReviewVersions": ["v1"],
+            }],
+        }
+
+    def wait_for_certificate_expiration_seconds(self) -> float:
+        """Time until the next CA rotation is due (reference :223-232)."""
+        return min(
+            p.seconds_until_expiry() - CACollection.ROTATE_BEFORE_SECONDS
+            for p in self.cas.pairs
+        )

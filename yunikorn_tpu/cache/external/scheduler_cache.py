@@ -121,6 +121,16 @@ class SchedulerCache:
         with self._lock.reader():
             return self.nodes_map.get(name)
 
+    def snapshot_node(self, name: str) -> Optional[NodeInfo]:
+        """Shallow-copied NodeInfo safe to iterate off-thread (the live pods
+        dict mutates under informer events)."""
+        with self._lock.reader():
+            info = self.nodes_map.get(name)
+            if info is None:
+                return None
+            return NodeInfo(node=info.node, pods=dict(info.pods),
+                            requested=info.requested, allocatable=info.allocatable)
+
     def node_names(self) -> List[str]:
         with self._lock.reader():
             return list(self.nodes_map)

@@ -1,0 +1,71 @@
+"""yunikorn-admission-controller binary.
+
+Role-equivalent to pkg/cmd/admissioncontroller/main.go:55-110: build the
+caches + webhook manager (cert handling + webhook registration manifests),
+serve HTTPS on :9089 with /health /mutate /validate-conf, reload certs on
+SIGUSR1, exit on SIGINT/SIGTERM.
+
+Usage:
+    python -m yunikorn_tpu.cmd.admission_controller [--port 9089] [--no-tls]
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from yunikorn_tpu.admission.admission_controller import AdmissionController
+from yunikorn_tpu.admission.caches import NamespaceCache, PriorityClassCache
+from yunikorn_tpu.admission.conf import AdmissionConfHolder
+from yunikorn_tpu.admission.pki import CACollection
+from yunikorn_tpu.admission.webhook import WebhookManager, WebhookServer
+from yunikorn_tpu.log.logger import log
+
+logger = log("admission")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="yunikorn-tpu admission controller")
+    parser.add_argument("--port", type=int, default=9089)
+    parser.add_argument("--host", type=str, default="0.0.0.0")
+    parser.add_argument("--no-tls", action="store_true")
+    args = parser.parse_args(argv)
+
+    holder = AdmissionConfHolder()
+    conf = holder.get()
+    cas = CACollection()
+    manager = WebhookManager(conf, cas)
+    controller = AdmissionController(
+        conf,
+        namespace_cache=NamespaceCache(),
+        pc_cache=PriorityClassCache(),
+    )
+    server = WebhookServer(controller, host=args.host, port=args.port,
+                           use_tls=not args.no_tls, cas=cas)
+    port = server.start()
+    logger.info("admission controller on :%d (tls=%s)", port, not args.no_tls)
+
+    stop = threading.Event()
+
+    def handle_term(signum, frame):
+        stop.set()
+
+    def handle_usr1(signum, frame):
+        # cert reload (reference main.go:99-110)
+        logger.info("SIGUSR1: rotating certificates")
+        cas.rotate_if_needed()
+        server.stop()
+        server.start()
+
+    signal.signal(signal.SIGINT, handle_term)
+    signal.signal(signal.SIGTERM, handle_term)
+    if hasattr(signal, "SIGUSR1"):
+        signal.signal(signal.SIGUSR1, handle_usr1)
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""yunikorn-scheduler binary.
+
+Role-equivalent to pkg/cmd/shim/main.go:38-70: bootstrap configmaps, start the
+core in-process, create + run the shim, expose the REST API, wait for
+SIGINT/SIGTERM. The cluster backend is selectable: the in-memory FakeCluster
+(default — also the kwok-style perf mode) or a real-K8s adapter when one is
+installed.
+
+Usage:
+    python -m yunikorn_tpu.cmd.scheduler [--nodes N] [--rest-port P]
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from yunikorn_tpu.cache.context import Context
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.client.fake import FakeCluster
+from yunikorn_tpu.client.synthetic import make_kwok_nodes
+from yunikorn_tpu.conf.schedulerconf import get_holder
+from yunikorn_tpu.core.scheduler import CoreScheduler
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.shim.scheduler import KubernetesShim
+from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
+from yunikorn_tpu.webapp.rest import RestServer
+
+logger = log("shim")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="yunikorn-tpu scheduler")
+    parser.add_argument("--nodes", type=int, default=0,
+                        help="pre-create N synthetic kwok-style nodes")
+    parser.add_argument("--rest-port", type=int, default=9080)
+    parser.add_argument("--queues-yaml", type=str, default="",
+                        help="path to a queues.yaml config file")
+    args = parser.parse_args(argv)
+
+    ensure_compilation_cache()
+
+    queues_yaml = ""
+    if args.queues_yaml:
+        with open(args.queues_yaml) as f:
+            queues_yaml = f.read()
+    holder = get_holder()
+    holder.update_config_maps([{"queues.yaml": queues_yaml}], initial=True)
+
+    cluster = FakeCluster()
+    if args.nodes:
+        for node in make_kwok_nodes(args.nodes):
+            cluster.add_node(node)
+
+    cache = SchedulerCache()
+    core = CoreScheduler(cache)
+    context = Context(cluster, core, cache=cache)
+    shim = KubernetesShim(cluster, core, context=context)
+    rest = RestServer(core, context, port=args.rest_port)
+
+    core.start()
+    shim.run()
+    port = rest.start()
+    logger.info("scheduler up; REST on :%d", port)
+
+    stop = threading.Event()
+
+    def handle_signal(signum, frame):
+        logger.info("signal %s received, shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_signal)
+    signal.signal(signal.SIGTERM, handle_signal)
+    stop.wait()
+    rest.stop()
+    shim.stop()
+    core.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

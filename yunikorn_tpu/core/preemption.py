@@ -100,45 +100,55 @@ def plan_preemptions(
     cache,
     unplaced_asks: List[AllocationAsk],
     app_of_pod: Dict[str, str],
-) -> List[PreemptionPlan]:
+    inflight_by_node: Optional[Dict[str, object]] = None,
+) -> Tuple[List[PreemptionPlan], List[str]]:
     """Compute preemption plans for unplaced asks.
 
     `cache` is the shared external SchedulerCache (provides pods, nodes and
-    PriorityClass lookups); app_of_pod maps victim pod uid -> application id.
+    PriorityClass lookups); app_of_pod maps victim pod uid -> application id;
+    inflight_by_node carries the core's committed-but-not-yet-assumed usage
+    per node (same overlay the solver applies), so victims are never evicted
+    for capacity this cycle's own allocations will consume.
+
+    Returns (plans, attempted_ask_keys) — attempted includes failed plans so
+    the caller can put them on cooldown too.
     """
     plans: List[PreemptionPlan] = []
+    attempted: List[str] = []
     already_victim: set = set()
     candidates = sorted(unplaced_asks, key=lambda a: -(a.priority or 0))
     for ask in candidates[:MAX_PREEMPTING_ASKS_PER_CYCLE]:
         if (ask.priority or 0) <= 0 or not _may_preempt(ask) or ask.pod is None:
             continue
-        plan = _plan_for_ask(cache, ask, already_victim, app_of_pod)
+        attempted.append(ask.allocation_key)
+        plan = _plan_for_ask(cache, ask, already_victim, app_of_pod,
+                             inflight_by_node or {})
         if plan is not None:
             for v in plan.victims:
                 already_victim.add(v.uid)
             plans.append(plan)
-    return plans
+    return plans, attempted
 
 
 def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
-                  app_of_pod: Dict[str, str]) -> Optional[PreemptionPlan]:
+                  app_of_pod: Dict[str, str],
+                  inflight_by_node: Dict[str, object]) -> Optional[PreemptionPlan]:
     pod = ask.pod
     best: Optional[Tuple[int, int, str, List[Pod]]] = None  # (count, prio_sum, node, victims)
     pc_lookup = cache.get_priority_class
 
     node_names = cache.node_names()
-    examined = 0
+    searched = 0
     for name in node_names:
-        if examined >= MAX_CANDIDATE_NODES and best is not None:
-            break
-        info = cache.get_node(name)
+        if searched >= MAX_CANDIDATE_NODES:
+            break  # hard budget on victim-subset searches per ask
+        info = cache.snapshot_node(name)
         if info is None:
             continue
         # quick feasibility screen ignoring capacity (host predicates)
         err = pod_fits_node(pod, info.node, info.allocatable, info.pods.values())
         if err is not None and err != "insufficient resources" and err != "host port conflict":
             continue
-        examined += 1
         # victims: lower priority, preemptable, not already claimed
         victims = [
             v for v in info.pods.values()
@@ -152,12 +162,13 @@ def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
         # cheapest evictions first: lowest priority, then youngest
         victims.sort(key=lambda v: (_pod_priority(v), -v.metadata.creation_timestamp))
         victims = victims[:MAX_VICTIMS_PER_NODE]
+        searched += 1
         resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
             allocation_key=pod.uid,
             node_id=name,
             preempt_allocation_keys=[v.uid for v in victims],
             start_index=0,
-        ))
+        ), extra_used=inflight_by_node.get(name))
         if not resp.success:
             continue
         chosen = victims[: resp.index + 1]

@@ -441,9 +441,18 @@ class CoreScheduler(SchedulerAPI):
                     for app in self.partition.applications.values()
                     for key in app.allocations
                 }
-                plans = plan_preemptions(self.cache, eligible, app_of_pod)
-                for plan in plans:
-                    self._preempted_for[plan.ask.allocation_key] = now
+                # the same overlay the solver used, grouped per node
+                inflight_by_node: Dict[str, Resource] = {}
+                for alloc in self._inflight.values():
+                    cur = inflight_by_node.get(alloc.node_id)
+                    inflight_by_node[alloc.node_id] = (
+                        alloc.resource if cur is None else cur.add(alloc.resource))
+                plans, attempted = plan_preemptions(
+                    self.cache, eligible, app_of_pod, inflight_by_node)
+                for key in attempted:
+                    # cooldown failed attempts too: an unplaceable ask must not
+                    # rescan the cluster every cycle
+                    self._preempted_for[key] = now
                 for plan in plans:
                     for rel in plan.releases(app_of_pod):
                         confirmed = self._release_allocation(rel)

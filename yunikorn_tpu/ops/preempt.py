@@ -22,10 +22,13 @@ from yunikorn_tpu.common.si import (
 from yunikorn_tpu.ops.host_predicates import pod_fits_node
 
 
-def preemption_victim_search(cache_or_context, args: PreemptionPredicatesArgs) -> PreemptionPredicatesResponse:
+def preemption_victim_search(cache_or_context, args: PreemptionPredicatesArgs,
+                             extra_used: Optional[Resource] = None) -> PreemptionPredicatesResponse:
+    """extra_used: additional committed-but-unobserved usage on the node (the
+    core's in-flight allocations), subtracted from the node's free capacity."""
     cache = getattr(cache_or_context, "schedulers_cache", cache_or_context)
     pod = cache.get_pod(args.allocation_key)
-    info = cache.get_node(args.node_id)
+    info = cache.snapshot_node(args.node_id)
     if pod is None or info is None:
         return PreemptionPredicatesResponse(success=False, index=-1)
 
@@ -37,6 +40,8 @@ def preemption_victim_search(cache_or_context, args: PreemptionPredicatesArgs) -
 
     remaining = dict(info.pods)
     free = info.available()
+    if extra_used is not None:
+        free = free.sub(extra_used)
     # removals up to startIndex are unconditional (the core already decided
     # those victims are going away)
     for v in victims[: args.start_index]:

@@ -1,0 +1,90 @@
+"""REST API: the scheduler's /ws/v1/* surface.
+
+The reference's REST endpoints live in yunikorn-core (the E2E harness drives
+them through `RClient`, reference test/e2e/framework/helpers/yunikorn/
+rest_api_utils.go: queues, apps, nodes, health, full state dump, validate-conf)
+and the shim contributes its cache DAO to the state dump (context.go:1348-1360).
+This server exposes the same paths over the in-process core + shim context.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from yunikorn_tpu.log.logger import log
+
+logger = log("core")
+
+
+class RestServer:
+    def __init__(self, core, context=None, host: str = "127.0.0.1", port: int = 9080):
+        self.core = core
+        self.context = context
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        core, context = self.core, self.context
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("rest: " + fmt, *args)
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                path = parsed.path.rstrip("/")
+                dao = core.get_partition_dao()
+                if path in ("/ws/v1/health", "/health"):
+                    self._reply(200, {"Healthy": True})
+                elif path in ("/ws/v1/queues", "/ws/v1/partition/default/queues"):
+                    self._reply(200, dao["queues"])
+                elif path in ("/ws/v1/apps", "/ws/v1/partition/default/applications"):
+                    self._reply(200, dao["partition"]["applications"])
+                elif path in ("/ws/v1/nodes", "/ws/v1/partition/default/nodes"):
+                    self._reply(200, dao["partition"]["nodes"])
+                elif path == "/ws/v1/metrics":
+                    self._reply(200, dao["metrics"])
+                elif path == "/ws/v1/fullstatedump":
+                    dump = {"core": dao}
+                    if context is not None:
+                        dump["shim"] = context.state_dump()
+                    self._reply(200, dump)
+                else:
+                    self._reply(404, {"error": f"unknown path {path}"})
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                if parsed.path.rstrip("/") == "/ws/v1/validate-conf":
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = self.rfile.read(length).decode()
+                    ok, message = core.validate_configuration(body)
+                    self._reply(200, {"allowed": ok, "reason": message})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="rest-api", daemon=True)
+        self._thread.start()
+        logger.info("REST API serving on %s:%d", self.host, self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
