@@ -60,8 +60,74 @@ class SolveResult:
         return self
 
 
+def _loc_round_stats(loc, cnt):
+    """Per-locality-group (min over valid domains, total) of current counts."""
+    _, _, dom_valid = loc[0], loc[1], loc[2]
+    big = jnp.int32(2**30)
+    minc = jnp.min(jnp.where(dom_valid, cnt, big), axis=1)             # [L]
+    total = jnp.sum(jnp.where(dom_valid, cnt, 0), axis=1)              # [L]
+    return minc, total
+
+
+def _loc_rules_mask(gid_rows, dom_cols, loc, cnt, minc, total, contrib_rows):
+    """Evaluate locality rules for pods (rows) × nodes (cols).
+
+    gid_rows: [C] group ids; dom_cols: None for all-nodes [C, M] evaluation or
+    [C] node ids for per-pod single-node checks; contrib_rows: [C, L] whether
+    each pod itself counts toward each locality group (K8s selfMatchNum — a
+    spread constraint whose selector does not match the pod itself adds 0).
+    Returns a bool mask of shape [C, M] or [C].
+    """
+    from yunikorn_tpu.snapshot.locality import (
+        KIND_AFFINITY,
+        KIND_ANTI_AFFINITY,
+        KIND_BLOCKED,
+        KIND_SPREAD,
+    )
+
+    loc_dom, _, _, _, g_refs, g_kind, g_skew, g_seed = loc
+    L, M = loc_dom.shape
+    D = cnt.shape[1]
+    S = g_refs.shape[1]
+    per_node = dom_cols is None
+    ok = None
+    for s in range(S):
+        l = g_refs[gid_rows, s]                                        # [C]
+        kind = g_kind[gid_rows, s]
+        skew = g_skew[gid_rows, s]
+        seed = g_seed[gid_rows, s]
+        lc = jnp.clip(l, 0, L - 1)
+        self_add = jnp.take_along_axis(contrib_rows, lc[:, None], axis=1)[:, 0]
+        self_add = self_add.astype(jnp.int32)                          # [C]
+        if per_node:
+            dom_row = loc_dom[lc]                                      # [C, M]
+        else:
+            dom_row = loc_dom[lc, dom_cols]                            # [C]
+        cnt_row = cnt[lc]                                              # [C, D]
+        dcl = jnp.clip(dom_row, 0, D - 1)
+        if per_node:
+            cnt_at = jnp.take_along_axis(cnt_row, dcl, axis=1)         # [C, M]
+            expand = lambda x: x[:, None]
+        else:
+            cnt_at = jnp.take_along_axis(cnt_row, dcl[:, None], axis=1)[:, 0]  # [C]
+            expand = lambda x: x
+        has_dom = dom_row >= 0
+        spread_ok = has_dom & (cnt_at + expand(self_add) - expand(minc[lc]) <= expand(skew))
+        aff_ok = has_dom & ((cnt_at > 0) | (expand(seed) & (expand(total[lc]) == 0)))
+        anti_ok = (~has_dom) | (cnt_at == 0)
+        rule_ok = jnp.where(expand(kind) == KIND_SPREAD, spread_ok,
+                   jnp.where(expand(kind) == KIND_AFFINITY, aff_ok,
+                    jnp.where(expand(kind) == KIND_ANTI_AFFINITY, anti_ok,
+                     jnp.where(expand(kind) == KIND_BLOCKED,
+                               jnp.zeros_like(anti_ok), True))))
+        rule_ok = jnp.where(expand(l >= 0) | (expand(kind) == KIND_BLOCKED), rule_ok, True)
+        ok = rule_ok if ok is None else (ok & rule_ok)
+    return ok
+
+
 def _best_nodes_chunked(req, group_id, group_feas, free, capacity, base_scores,
-                        chunk: int, policy: str):
+                        chunk: int, policy: str, loc=None, cnt=None,
+                        minc=None, total=None):
     """For every pod: (best node, any feasible?) without materializing [N, M]."""
     N, R = req.shape
     M = free.shape[0]
@@ -77,6 +143,9 @@ def _best_nodes_chunked(req, group_id, group_feas, free, capacity, base_scores,
         for r in range(R):
             margin = jnp.minimum(margin, free[:, r][None, :] - creq[:, r][:, None])
         ok = cfeas & (margin >= 0)
+        if loc is not None:
+            ccontrib = lax.dynamic_slice(loc[3], (start, 0), (chunk, loc[3].shape[1]))
+            ok &= _loc_rules_mask(cgid, None, loc, cnt, minc, total, ccontrib)
         scores = jnp.broadcast_to(base_scores[None, :], (chunk, M))
         if policy == "align":
             scores = scores + alignment_scores(creq, free, capacity)
@@ -141,6 +210,82 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free, base_sc
     return proposals
 
 
+def _loc_capped_flags(loc):
+    """Per locality group: is it referenced by a spread/anti (capped) slot,
+    and by an affinity slot (for seeding caps)? Computed once per solve."""
+    from yunikorn_tpu.snapshot.locality import (
+        KIND_AFFINITY,
+        KIND_ANTI_AFFINITY,
+        KIND_SPREAD,
+    )
+
+    loc_dom = loc[0]
+    g_refs, g_kind = loc[4], loc[5]
+    L = loc_dom.shape[0]
+    capped = []
+    aff = []
+    for l in range(L):
+        ref_l = g_refs == l
+        capped.append(jnp.any(ref_l & ((g_kind == KIND_SPREAD) | (g_kind == KIND_ANTI_AFFINITY))))
+        aff.append(jnp.any(ref_l & (g_kind == KIND_AFFINITY)))
+    return jnp.stack(capped), jnp.stack(aff)
+
+
+def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total, capped_l, aff_l):
+    """At most ONE accepted pod contributing to a capped locality group per
+    (group, domain) per round.
+
+    Contribution — not the pod's own constraint slots — is what changes the
+    counts, so the cap keys on contrib: a plain pod whose labels match another
+    pod's anti-affinity selector is capped alongside it (symmetry holds even
+    within one round). Affinity groups cap only while *seeding* (total==0),
+    and then per GROUP (one domain seeds per round) so a self-affinitized
+    group cannot split across domains.
+
+    Counts only update between rounds; without this cap several pods could
+    land in one domain in a single round and overshoot maxSkew or violate
+    anti-affinity. One-per-domain-per-round is exact for anti-affinity and
+    converges for spread.
+    """
+    loc_dom = loc[0]
+    L, _ = loc_dom.shape
+    N = accept_sorted.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    node_cl = jnp.clip(snode, 0, M - 1)
+    for l in range(L):
+        seeding = aff_l[l] & (total[l] == 0)
+        cap_now = capped_l[l] | seeding
+        dom_i = loc_dom[l, node_cl]                                    # [N]
+        active = cap_now & scontrib[:, l] & (dom_i >= 0) & (snode < M) & accept_sorted
+        # seeding caps per GROUP (key 0); spread/anti per domain
+        key = jnp.where(active, jnp.where(seeding, 0, dom_i), (M + 2) + idx)
+        order2 = jnp.argsort(key)                                      # stable
+        k2 = key[order2]
+        act2 = active[order2]
+        seg_start = jnp.concatenate([jnp.array([True]), k2[1:] != k2[:-1]])
+        c = jnp.cumsum(act2.astype(jnp.int32))
+        head = lax.cummax(jnp.where(seg_start, idx, 0))
+        base = jnp.where(head > 0, c[jnp.maximum(head - 1, 0)], 0)
+        within = c - base                                              # inclusive
+        keep2 = (~act2) | (within <= 1)
+        keep = jnp.zeros((N,), bool).at[order2].set(keep2)
+        accept_sorted = accept_sorted & keep
+    return accept_sorted
+
+
+def _loc_update_counts(cnt, loc, accepted, best, M):
+    """Scatter-add this round's placements into the domain counts."""
+    loc_dom, _, _, contrib, _, _, _, _ = loc
+    L = loc_dom.shape[0]
+    D = cnt.shape[1]
+    node_cl = jnp.clip(best, 0, M - 1)
+    for l in range(L):
+        dom_i = loc_dom[l, node_cl]                                    # [N]
+        add = accepted & contrib[:, l] & (dom_i >= 0) & (best >= 0) & (best < M)
+        cnt = cnt.at[l, jnp.clip(dom_i, 0, D - 1)].add(add.astype(jnp.int32))
+    return cnt
+
+
 def _segment_prefix_accept(snode, sreq, free_ext, M):
     """Accept the per-node-segment prefix of sorted requests that fits.
 
@@ -177,6 +322,8 @@ def solve(
     free,           # [M, R] int32
     capacity,       # [M, R] int32
     host_group_mask=None,   # [G, M] bool or None
+    loc=None,       # locality tuple: (dom [L,M], cnt0 [L,D], dom_valid [L,D],
+                    #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed)
     *,
     max_rounds: int = 16,
     chunk: int = 512,
@@ -195,34 +342,48 @@ def solve(
     if host_group_mask is not None:
         group_feas = group_feas & host_group_mask
 
+    has_loc = loc is not None
     free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
+    cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
+    if has_loc:
+        loc_capped_l, loc_aff_l = _loc_capped_flags(loc)
     init = (
         free_ext0,
         ~valid,                                     # "done" = assigned or invalid
         jnp.full((N,), -1, jnp.int32),              # assignment
         jnp.int32(0),                               # round counter
         jnp.int32(0),                               # consecutive no-progress rounds
+        cnt0,                                       # locality domain counts
     )
 
     def cond(state):
-        _, done, _, rnd, stalls = state
+        _, done, _, rnd, stalls, _ = state
         # water-fill and argmax rounds alternate; only give up after both stall
         return (stalls < 2) & (rnd < max_rounds) & ~jnp.all(done)
 
     def body(state):
-        free_ext, done, assigned, rnd, stalls = state
+        free_ext, done, assigned, rnd, stalls, cnt = state
         cur_free = free_ext[:M]
         base_scores = node_base_scores(cur_free, capacity, policy)
         active = ~done
+        if has_loc:
+            minc, total = _loc_round_stats(loc, cnt)
+        else:
+            minc = total = None
 
         proposals = _water_fill_proposals(req, group_id, rank, active, group_feas,
                                           cur_free, base_scores)
         prop_fits = jnp.all(free_ext[proposals] >= req, axis=1) & (proposals < M)
+        if has_loc:
+            # proposals must also satisfy the dynamic locality rules
+            prop_fits &= _loc_rules_mask(group_id, jnp.clip(proposals, 0, M - 1),
+                                         loc, cnt, minc, total, loc[3])
 
         def with_argmax(_):
             # exact per-pod argmax; guarantees ≥1 accept per contended node
             best, feasible = _best_nodes_chunked(
-                req, group_id, group_feas, cur_free, capacity, base_scores, chunk, policy
+                req, group_id, group_feas, cur_free, capacity, base_scores, chunk,
+                policy, loc, cnt, minc, total,
             )
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
@@ -239,18 +400,23 @@ def solve(
         snode = node_key[order]
         sreq = req[order]
         accept_sorted = _segment_prefix_accept(snode, sreq, free_ext, M)
+        if has_loc:
+            accept_sorted = _loc_accept_cap(accept_sorted, snode, loc[3][order],
+                                            loc, M, total, loc_capped_l, loc_aff_l)
         # commit accepted capacity
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
         free_ext = free_ext.at[snode].add(-delta)
         free_ext = free_ext.at[M].set(0)
         accepted = jnp.zeros((N,), bool).at[order].set(accept_sorted)
         assigned = jnp.where(accepted, best, assigned)
+        if has_loc:
+            cnt = _loc_update_counts(cnt, loc, accepted, best, M)
         done = done | accepted
         progress = jnp.any(accept_sorted)
         stalls = jnp.where(progress, 0, stalls + 1)
-        return free_ext, done, assigned, rnd + 1, stalls
+        return free_ext, done, assigned, rnd + 1, stalls, cnt
 
-    free_ext, done, assigned, rounds, _ = lax.while_loop(cond, body, init)
+    free_ext, done, assigned, rounds, _, _ = lax.while_loop(cond, body, init)
     return assigned, free_ext[:M], rounds
 
 
@@ -275,13 +441,19 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     cap_i = np.floor(na.capacity_arr).astype(np.int32)
     node_ok = na.valid & na.schedulable
     host_mask = batch.g_host_mask
-    kwargs = {}
     if host_mask is not None:
         # pad to node capacity
         if host_mask.shape[1] != na.capacity:
             hm = np.zeros((host_mask.shape[0], na.capacity), bool)
             hm[:, : host_mask.shape[1]] = host_mask[:, : na.capacity]
             host_mask = hm
+    loc = None
+    if batch.locality is not None:
+        lb = batch.locality
+        loc = tuple(jnp.asarray(a) for a in (
+            lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
+            lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed,
+        ))
     assigned, free_after, rounds = solve(
         jnp.asarray(batch.req.astype(np.int32)),
         jnp.asarray(batch.group_id),
@@ -301,6 +473,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         jnp.asarray(free_i),
         jnp.asarray(cap_i),
         jnp.asarray(host_mask) if host_mask is not None else None,
+        loc,
         max_rounds=max_rounds,
         chunk=chunk,
         policy=policy,

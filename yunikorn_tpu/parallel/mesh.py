@@ -98,9 +98,20 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     else:
         mask_arg = None
 
+    loc_arg = None
+    if batch.locality is not None:
+        lb = batch.locality
+        # locality tables ride replicated: tiny relative to the node arrays,
+        # and the per-round count updates are global reductions anyway
+        loc_arg = tuple(
+            put(a, repl) for a in (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
+                                   lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed)
+        )
+
     with mesh:
         assigned, free_after, rounds = assign_mod.solve(
-            *args, mask_arg, max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
+            *args, mask_arg, loc_arg,
+            max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
             policy=policy,
         )
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -94,6 +94,7 @@ class PodBatch:
     g_tol: np.ndarray               # [G, Wt]
     g_ports: np.ndarray             # [G, Wp]
     g_host_mask: Optional[np.ndarray]  # [G, M] bool or None
+    locality: Optional[object]         # snapshot.locality.LocalityBatch or None
     num_pods: int
     num_groups: int
 
@@ -321,7 +322,14 @@ class SnapshotEncoder:
                 if p.get("hostPort")
             )
         )
-        return (sel, tols, aff, ports)
+        # Placement-dependent constraints ride the signature too — but ONLY for
+        # pods that actually have them (or match an existing anti-affinity
+        # term): unconstrained pods keep the compact signature so group dedup
+        # stays effective (snapshot/locality.py owns the semantics).
+        from yunikorn_tpu.snapshot.locality import locality_signature
+
+        loc_sig = locality_signature(pod, self.cache)
+        return (sel, tols, aff, ports, loc_sig)
 
     def _encode_group(self, pod: Pod) -> GroupSpec:
         W = self.vocabs.labels.num_words
@@ -577,6 +585,11 @@ class SnapshotEncoder:
         valid = np.zeros((N,), bool)
         valid[:n] = True
 
+        from yunikorn_tpu.snapshot.locality import encode_locality
+
+        locality = encode_locality(asks, group_ids, len(group_specs),
+                                   self.nodes, self.cache, N, G)
+
         return PodBatch(
             ask_keys=[a.allocation_key for a in asks],
             req=req,
@@ -592,6 +605,7 @@ class SnapshotEncoder:
             g_tol=g_tol,
             g_ports=g_ports,
             g_host_mask=host_mask,
+            locality=locality,
             num_pods=n,
             num_groups=len(group_specs),
         )

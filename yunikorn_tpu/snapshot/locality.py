@@ -1,0 +1,396 @@
+"""Locality constraints: topology spread + pod (anti-)affinity encoding.
+
+Reference predicates: PodTopologySpread and InterPodAffinity plugins (both in
+the reference's reservation and allocation plugin sets,
+pkg/plugin/predicates/predicate_manager.go:302-392). These are the
+placement-dependent predicates — feasibility depends on where matching pods
+already sit — which makes them the hard case for a batched solve (SURVEY.md §7
+"hard parts"): pods placed earlier in the same batch change the counts later
+pods must respect.
+
+Encoding ("locality groups"):
+  L distinct (topologyKey, labelSelector, namespaces) tuples referenced by the
+  batch. For each:
+    dom   [M]  int32  domain index of every node for that topology key (-1 =
+                      node lacks the key)
+    cnt0  [D]  int32  matching-pod count per domain from *existing* cluster
+                      state (assigned pods in the shim cache)
+    valid [D]  bool   domains that exist
+  Per batch pod:
+    contrib [N, L] bool — placing this pod increments the domain count of L
+  Per constraint-group:
+    refs [G, S] int32 → locality group index (-1 unused slot)
+    kind [G, S] int32   1=spread(DoNotSchedule) 2=affinity 3=anti-affinity
+                        4=blocked (constraint could not be encoded — the
+                        group is held pending rather than mis-scheduled)
+    skew [G, S] int32   maxSkew for spread slots
+    seed [G, S] bool    affinity self-seeding (pod matches its own selector →
+                        may start the first domain, K8s semantics)
+
+Symmetric anti-affinity (K8s InterPodAffinity symmetry: an incoming pod may
+not land in a domain where an existing pod's *required anti-affinity term*
+matches it) is encoded with "holder" locality groups: contrib = pod holds the
+term, cnt0 = existing holders per domain; every group whose pods match the
+term's selector gets an ANTI slot referencing the holder group. Pod labels
+join the constraint-group signature exactly when locality is in play
+(locality_signature), so group-level slots are sound.
+
+The solver (ops/assign.py) carries cnt as loop state: every accepted pod
+scatter-adds into its domains, and the dynamic feasibility rules are
+re-evaluated each round. ScheduleAnyway (soft) spread is currently ignored
+(scoring hook later).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from yunikorn_tpu.common.objects import Pod
+from yunikorn_tpu.log.logger import log
+
+logger = log("shim.snapshot")
+
+MAX_LOCALITY_GROUPS = 8
+MAX_CONSTRAINT_SLOTS = 6
+
+KIND_NONE = 0
+KIND_SPREAD = 1
+KIND_AFFINITY = 2
+KIND_ANTI_AFFINITY = 3
+KIND_BLOCKED = 4
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def match_selector(selector: Optional[dict], labels: Dict[str, str]) -> bool:
+    """K8s LabelSelector semantics (matchLabels AND matchExpressions)."""
+    if selector is None:
+        return False
+    for k, v in (selector.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions") or []:
+        key, op = expr.get("key"), expr.get("operator")
+        values = expr.get("values") or []
+        val = labels.get(key)
+        if op == "In":
+            if val not in values:
+                return False
+        elif op == "NotIn":
+            if val in values:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False
+    return True
+
+
+def _selector_signature(selector: Optional[dict]) -> tuple:
+    if selector is None:
+        return ()
+    ml = tuple(sorted((selector.get("matchLabels") or {}).items()))
+    me = tuple(
+        (e.get("key"), e.get("operator"), tuple(e.get("values") or []))
+        for e in (selector.get("matchExpressions") or [])
+    )
+    return (ml, me)
+
+
+def _term_namespaces(term, pod: Pod) -> tuple:
+    return tuple(sorted(term.namespaces)) if term.namespaces else (pod.namespace,)
+
+
+@dataclasses.dataclass(frozen=True)
+class LocSpec:
+    """One locality tuple: where matching pods are counted."""
+
+    topo_key: str
+    selector_sig: tuple
+    namespaces: tuple
+    selector: Optional[dict] = dataclasses.field(compare=False, hash=False, default=None)
+
+    def counts_pod(self, pod: Pod) -> bool:
+        return pod.namespace in self.namespaces and match_selector(
+            self.selector, pod.metadata.labels)
+
+
+@dataclasses.dataclass(frozen=True)
+class AntiTermSpec(LocSpec):
+    """An anti-affinity term identity (for holder groups and symmetry)."""
+
+
+def _pod_constraints(pod: Pod) -> List[Tuple[int, LocSpec, int]]:
+    """Extract (kind, LocSpec, maxSkew) tuples from a pod's own spec."""
+    out: List[Tuple[int, LocSpec, int]] = []
+    for tsc in pod.spec.topology_spread_constraints:
+        if tsc.when_unsatisfiable != "DoNotSchedule":
+            continue  # soft constraints not filtered (scoring later)
+        out.append((KIND_SPREAD,
+                    LocSpec(tsc.topology_key, _selector_signature(tsc.label_selector),
+                            (pod.namespace,), tsc.label_selector),
+                    tsc.max_skew))
+    if pod.spec.affinity is not None:
+        for term in pod.spec.affinity.pod_affinity_required:
+            out.append((KIND_AFFINITY,
+                        LocSpec(term.topology_key or HOSTNAME_KEY,
+                                _selector_signature(term.label_selector),
+                                _term_namespaces(term, pod), term.label_selector),
+                        0))
+        for term in pod.spec.affinity.pod_anti_affinity_required:
+            out.append((KIND_ANTI_AFFINITY,
+                        LocSpec(term.topology_key or HOSTNAME_KEY,
+                                _selector_signature(term.label_selector),
+                                _term_namespaces(term, pod), term.label_selector),
+                        0))
+    return out
+
+
+def _pod_anti_terms(pod: Pod) -> List[AntiTermSpec]:
+    if pod.spec.affinity is None:
+        return []
+    return [
+        AntiTermSpec(term.topology_key or HOSTNAME_KEY,
+                     _selector_signature(term.label_selector),
+                     _term_namespaces(term, pod), term.label_selector)
+        for term in pod.spec.affinity.pod_anti_affinity_required
+    ]
+
+
+def all_anti_terms(cache) -> List[AntiTermSpec]:
+    """Anti-affinity terms held by any pod in the cache (memoized by generation).
+
+    Used for the symmetric check: incoming pods matching such a term must
+    avoid domains holding its pods. Includes pending pods so in-batch pairs
+    see each other.
+    """
+    gen = cache.generation()
+    memo = getattr(cache, "_anti_terms_memo", None)
+    if memo is not None and memo[0] == gen:
+        return memo[1]
+    seen: Dict[AntiTermSpec, None] = {}
+    for pod in list(cache.pods_map.values()):
+        for t in _pod_anti_terms(pod):
+            seen.setdefault(t)
+    out = list(seen)
+    cache._anti_terms_memo = (gen, out)
+    return out
+
+
+def locality_signature(pod: Pod, cache) -> tuple:
+    """The locality part of a pod's constraint-group signature.
+
+    Empty for pods untouched by locality (keeps group dedup compact). When the
+    pod has hard locality constraints OR matches an existing anti-affinity
+    term (symmetry), the signature includes the pod's full label set so
+    group-level locality slots are exact.
+    """
+    cons = _pod_constraints(pod)
+    matched_terms = tuple(
+        (t.topo_key, t.selector_sig, t.namespaces)
+        for t in all_anti_terms(cache)
+        if t.counts_pod(pod)
+    )
+    if not cons and not matched_terms:
+        return ()
+    cons_sig = tuple((kind, spec.topo_key, spec.selector_sig, spec.namespaces, skew)
+                     for kind, spec, skew in cons)
+    return (
+        tuple(sorted(pod.metadata.labels.items())),
+        pod.namespace,
+        cons_sig,
+        matched_terms,
+    )
+
+
+@dataclasses.dataclass
+class LocalityBatch:
+    """Dense arrays for the solver; None members mean 'no locality work'."""
+
+    dom: np.ndarray          # [L, M] int32
+    cnt0: np.ndarray         # [L, D] int32
+    dom_valid: np.ndarray    # [L, D] bool
+    contrib: np.ndarray      # [N, L] bool
+    g_refs: np.ndarray       # [G, S] int32
+    g_kind: np.ndarray       # [G, S] int32
+    g_skew: np.ndarray       # [G, S] int32
+    g_seed: np.ndarray       # [G, S] bool
+    num_groups: int
+
+
+class _LocAccum:
+    def __init__(self):
+        self.keys: Dict[tuple, int] = {}
+        self.specs: List[Tuple[LocSpec, bool]] = []  # (spec, is_holder_group)
+        self.overflow = False
+
+    def intern(self, spec: LocSpec, holder: bool) -> int:
+        sig = (spec.topo_key, spec.selector_sig, spec.namespaces, holder)
+        idx = self.keys.get(sig)
+        if idx is None:
+            if len(self.specs) >= MAX_LOCALITY_GROUPS:
+                self.overflow = True
+                return -2
+            idx = len(self.specs)
+            self.keys[sig] = idx
+            self.specs.append((spec, holder))
+        return idx
+
+
+def encode_locality(
+    asks: Sequence,
+    group_ids: Sequence[int],
+    num_groups: int,
+    node_arrays,
+    cache,
+    batch_n: int,
+    batch_g: int,
+) -> Optional[LocalityBatch]:
+    """Build the LocalityBatch for a solve, or None if nothing needs it.
+
+    Groups whose constraints cannot be encoded (slot or group overflow) are
+    marked KIND_BLOCKED — their pods stay pending instead of being
+    mis-scheduled or crashing the cycle.
+    """
+    accum = _LocAccum()
+    g_refs = np.full((batch_g, MAX_CONSTRAINT_SLOTS), -1, np.int32)
+    g_kind = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), np.int32)
+    g_skew = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), np.int32)
+    g_seed = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), bool)
+    seen_groups: set = set()
+    any_constraint = False
+    anti_terms = all_anti_terms(cache)
+
+    def block_group(gid: int, why: str) -> None:
+        logger.warning("locality constraints for group %d not encodable (%s); "
+                       "its pods stay pending", gid, why)
+        g_refs[gid, 0] = -1
+        g_kind[gid, 0] = KIND_BLOCKED
+
+    for ask, gid in zip(asks, group_ids):
+        if gid in seen_groups or ask.pod is None:
+            continue
+        seen_groups.add(gid)
+        pod = ask.pod
+        cons = _pod_constraints(pod)
+        # symmetry: anti terms (held by anyone) whose selector matches this pod
+        sym_slots = [t for t in anti_terms if t.counts_pod(pod)]
+        if not cons and not sym_slots:
+            continue
+        any_constraint = True
+        slots: List[Tuple[int, int, int, bool]] = []  # (l, kind, skew, seed)
+        ok = True
+        for kind, spec, skew in cons:
+            l_idx = accum.intern(spec, holder=False)
+            if l_idx < 0:
+                ok = False
+                break
+            seed = kind == KIND_AFFINITY and spec.counts_pod(pod)
+            slots.append((l_idx, kind, max(1, skew) if kind == KIND_SPREAD else 0, seed))
+        if ok:
+            own_terms = set(_pod_anti_terms(pod))
+            for t in sym_slots:
+                if t in own_terms and t.counts_pod(pod):
+                    continue  # self anti-affinity already enforced by the primary slot
+                l_idx = accum.intern(t, holder=True)
+                if l_idx < 0:
+                    ok = False
+                    break
+                slots.append((l_idx, KIND_ANTI_AFFINITY, 0, False))
+        if not ok or len(slots) > MAX_CONSTRAINT_SLOTS:
+            block_group(gid, "overflow")
+            continue
+        for s, (l, kind, skew, seed) in enumerate(slots):
+            g_refs[gid, s] = l
+            g_kind[gid, s] = kind
+            g_skew[gid, s] = skew
+            g_seed[gid, s] = seed
+    if not any_constraint:
+        return None
+
+    L_pad = MAX_LOCALITY_GROUPS
+    M = node_arrays.capacity
+
+    # domains per locality group
+    dom = np.full((L_pad, M), -1, np.int32)
+    domain_tables: List[Dict[str, int]] = [dict() for _ in range(L_pad)]
+    node_rows = [(idx, name) for idx, name in node_arrays._idx_to_name.items()]
+    infos = {name: cache.get_node(name) for _, name in node_rows}
+    for l, (spec, _holder) in enumerate(accum.specs):
+        table = domain_tables[l]
+        for idx, name in node_rows:
+            info = infos.get(name)
+            if info is None:
+                continue
+            val = info.node.metadata.labels.get(spec.topo_key)
+            if spec.topo_key == HOSTNAME_KEY and val is None:
+                val = name
+            if val is None:
+                continue
+            d = table.get(val)
+            if d is None:
+                d = len(table)
+                table[val] = d
+            dom[l, idx] = d
+
+    D = max(2, max((len(t) for t in domain_tables), default=2))
+    Dp = 1
+    while Dp < D:
+        Dp *= 2
+    cnt0 = np.zeros((L_pad, Dp), np.int32)
+    dom_valid = np.zeros((L_pad, Dp), bool)
+    for l, table in enumerate(domain_tables):
+        for d in table.values():
+            dom_valid[l, d] = True
+
+    # existing pods per domain (assigned pods in the cache)
+    node_idx_of = node_arrays._name_to_idx
+    specs = accum.specs
+    for pod in list(cache.pods_map.values()):
+        node_name = cache.assigned_pods.get(pod.uid)
+        if node_name is None:
+            continue
+        n_idx = node_idx_of.get(node_name)
+        if n_idx is None:
+            continue
+        pod_terms = None
+        for l, (spec, holder) in enumerate(specs):
+            d = dom[l, n_idx]
+            if d < 0:
+                continue
+            if holder:
+                if pod_terms is None:
+                    pod_terms = set(_pod_anti_terms(pod))
+                counts = AntiTermSpec(spec.topo_key, spec.selector_sig,
+                                      spec.namespaces, spec.selector) in pod_terms
+            else:
+                counts = spec.counts_pod(pod)
+            if counts:
+                cnt0[l, d] += 1
+
+    # batch-pod contributions
+    contrib = np.zeros((batch_n, L_pad), bool)
+    for i, ask in enumerate(asks):
+        if ask.pod is None:
+            continue
+        pod_terms = None
+        for l, (spec, holder) in enumerate(specs):
+            if holder:
+                if pod_terms is None:
+                    pod_terms = set(_pod_anti_terms(ask.pod))
+                contrib[i, l] = AntiTermSpec(spec.topo_key, spec.selector_sig,
+                                             spec.namespaces, spec.selector) in pod_terms
+            else:
+                contrib[i, l] = spec.counts_pod(ask.pod)
+
+    return LocalityBatch(
+        dom=dom, cnt0=cnt0, dom_valid=dom_valid, contrib=contrib,
+        g_refs=g_refs, g_kind=g_kind, g_skew=g_skew, g_seed=g_seed,
+        num_groups=len(accum.specs),
+    )
