@@ -309,7 +309,7 @@ def _segment_prefix_accept(snode, sreq, free_ext, M):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_rounds", "chunk", "policy"),
+    static_argnames=("max_rounds", "chunk", "policy", "use_pallas", "pallas_interpret"),
 )
 def solve(
     req,            # [N, R] int32
@@ -328,8 +328,16 @@ def solve(
     max_rounds: int = 16,
     chunk: int = 512,
     policy: str = "binpacking",
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
-    """One batched solve. Returns (assigned [N] int32, free_after, rounds)."""
+    """One batched solve. Returns (assigned [N] int32, free_after, rounds).
+
+    use_pallas routes the per-round best-node computation through the fused
+    Pallas kernel (ops/pallas_kernels.py). Only separable scoring policies are
+    fused and locality constraints fall back to the XLA path (they need the
+    dynamic per-round masks).
+    """
     N, R = req.shape
     M = free.shape[0]
     chunk = min(chunk, N)
@@ -381,10 +389,17 @@ def solve(
 
         def with_argmax(_):
             # exact per-pod argmax; guarantees ≥1 accept per contended node
-            best, feasible = _best_nodes_chunked(
-                req, group_id, group_feas, cur_free, capacity, base_scores, chunk,
-                policy, loc, cnt, minc, total,
-            )
+            if use_pallas and not has_loc and policy != "align":
+                from yunikorn_tpu.ops.pallas_kernels import pallas_best_nodes
+
+                best, feasible = pallas_best_nodes(
+                    req, group_id, group_feas, cur_free, base_scores,
+                    interpret=pallas_interpret)
+            else:
+                best, feasible = _best_nodes_chunked(
+                    req, group_id, group_feas, cur_free, capacity, base_scores, chunk,
+                    policy, loc, cnt, minc, total,
+                )
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
 
@@ -421,7 +436,8 @@ def solve(
 
 
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
-                free_delta=None, device=None) -> SolveResult:
+                free_delta=None, use_pallas=False, pallas_interpret=False,
+                device=None) -> SolveResult:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     free_delta: optional [capacity, R] float array subtracted from node free
@@ -477,5 +493,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         max_rounds=max_rounds,
         chunk=chunk,
         policy=policy,
+        use_pallas=use_pallas,
+        pallas_interpret=pallas_interpret,
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -1,0 +1,127 @@
+"""Pallas TPU kernel: fused fit + score + argmax for the solver's hot op.
+
+The per-round "best node per pod" computation (ops/assign._best_nodes_chunked)
+is the solver's FLOP center: for every active pod, compare its request against
+every node's free vector, mask with group feasibility, score, and arg-max over
+nodes. The XLA version materializes [chunk, M] score tiles between the mask
+and the argmax. This kernel keeps everything in VMEM:
+
+  grid = (pod_tiles, node_tiles)    node tiles innermost
+  per (p, n) tile:
+    fit[P, Mt]   = AND_r (free[n][:, r] >= req[p][:, r])     (VPU, unrolled R)
+    feas[P, Mt]  = onehot(gid[p]) @ group_feas[:, n-tile]    (MXU — the gather
+                   of a pod's feasibility row becomes a [P, G] x [G, Mt] matmul)
+    score[P, Mt] = base_scores[n-tile] masked by fit & feas
+    running packed max accumulates in VMEM scratch across node tiles and is
+    written out on the last node tile.
+
+Selection and identification share one int32 max: scores are quantized to
+1/128 steps (9 bits of range) and packed as  q * 2^21 + (M - column), so the
+maximum picks the best score and, on ties, the LOWEST node index — exactly
+jnp.argmax semantics — with all arithmetic exact in int32.
+
+Exposed through ops.assign.solve(..., use_pallas=True); the default stays the
+XLA path (property-tested identical). interpret=True runs the kernel on CPU.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+POD_TILE = 256
+NODE_TILE = 512
+SCORE_SCALE = 128.0          # score quantization step = 1/128
+INDEX_SPAN = 1 << 21         # room for node indices below the score bits
+PACKED_MIN = -(1 << 30)  # plain int: jnp constants cannot be captured by kernels
+
+
+def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, free_ref, scores_ref,
+                      out_ref, acc_ref):
+    """One (pod_tile, node_tile) step; node dimension is grid axis 1."""
+    n_idx = pl.program_id(1)
+    n_tiles = pl.num_programs(1)
+
+    req = req_ref[:]                      # [P, R] int32
+    free = free_ref[:]                    # [Mt, R] int32
+    P, R = req.shape
+    Mt = free.shape[0]
+
+    fit = jnp.ones((P, Mt), jnp.bool_)
+    for r in range(R):
+        fit &= free[:, r][None, :] >= req[:, r][:, None]
+
+    onehot = gid_onehot_ref[:]            # [P, G] f32
+    feas = feas_ref[:]                    # [G, Mt] f32 (0/1)
+    feas_rows = jax.lax.dot_general(
+        onehot, feas, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) > 0.5          # [P, Mt]
+
+    ok = fit & feas_rows
+    q = scores_ref[:]                     # [Mt] int32 quantized scores
+    col = jax.lax.broadcasted_iota(jnp.int32, (P, Mt), 1)
+    global_col = col + Mt * n_idx
+    total_m = Mt * n_tiles
+    packed = q[None, :] * INDEX_SPAN + (total_m - global_col)
+    packed = jnp.where(ok, packed, jnp.int32(PACKED_MIN))
+    tile_best = jnp.max(packed, axis=1)   # [P]
+
+    @pl.when(n_idx == 0)
+    def _init():
+        acc_ref[:] = tile_best
+
+    @pl.when(n_idx > 0)
+    def _acc():
+        acc_ref[:] = jnp.maximum(acc_ref[:], tile_best)
+
+    @pl.when(n_idx == n_tiles - 1)
+    def _finish():
+        best = acc_ref[:]
+        feasible = best > jnp.int32(PACKED_MIN)
+        # recover M - column from the packed low bits (floor-div is exact:
+        # the remainder term (total_m - col) is always in [1, INDEX_SPAN))
+        frac = best - (best // INDEX_SPAN) * INDEX_SPAN
+        out_ref[:, 0] = jnp.where(feasible, frac, 0)
+        out_ref[:, 1] = jnp.where(feasible, 1, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def pallas_best_nodes(req, group_id, group_feas, free, base_scores, interpret=False):
+    """Fused best-node computation. Shapes: req [N,R] i32, group_id [N] i32,
+    group_feas [G,M] bool, free [M,R] i32, base_scores [M] f32.
+
+    Returns (best [N] int32, feasible [N] bool). N and M are power-of-two
+    padded upstream, so the tile divisibility requirements hold.
+    """
+    N, R = req.shape
+    G, M = group_feas.shape
+    pt = min(POD_TILE, N)
+    nt = min(NODE_TILE, M)
+    assert N % pt == 0 and M % nt == 0
+
+    onehot = jax.nn.one_hot(group_id, G, dtype=jnp.float32)            # [N, G]
+    q_scores = jnp.round(base_scores * SCORE_SCALE).astype(jnp.int32)  # [M]
+    feas_f = group_feas.astype(jnp.float32)
+
+    out = pl.pallas_call(
+        _best_node_kernel,
+        grid=(N // pt, M // nt),
+        in_specs=[
+            pl.BlockSpec((pt, R), lambda p, n: (p, 0)),                # req
+            pl.BlockSpec((pt, G), lambda p, n: (p, 0)),                # onehot
+            pl.BlockSpec((G, nt), lambda p, n: (0, n)),                # feas
+            pl.BlockSpec((nt, R), lambda p, n: (n, 0)),                # free
+            pl.BlockSpec((nt,), lambda p, n: (n,)),                    # scores
+        ],
+        out_specs=pl.BlockSpec((pt, 2), lambda p, n: (p, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, 2), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((pt,), jnp.int32)],
+        interpret=interpret,
+    )(req, onehot, feas_f, free, q_scores)
+
+    feasible = out[:, 1] > 0
+    best = jnp.where(feasible, M - out[:, 0], 0).astype(jnp.int32)
+    return best, feasible
