@@ -1,0 +1,199 @@
+"""The five BASELINE.md benchmark configurations as functional tests (scaled
+down for CPU): the shapes the driver's kwok-perf-test analog measures.
+
+1. 100 nodes / 1k sleep pods, default queue
+2. flat queue, resource-fit only (scaled; the full 10k/50k runs in bench.py)
+3. Spark-on-K8s: executors under hierarchical queues + DRF fair-share
+4. gang: placement-group all-or-nothing (covered at full fidelity in
+   test_gang_e2e.py; here the Ray-job shape)
+5. multi-resource bin-pack: GPU+CPU+mem with node-affinity + taints
+"""
+import json
+
+import numpy as np
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.client.synthetic import (
+    make_kwok_nodes,
+    make_mixed_binpack_pods,
+    make_sleep_pods,
+)
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import Taint, Toleration, make_node, make_pod
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import (
+    AddApplicationRequest,
+    AllocationAsk,
+    AllocationRequest,
+    ApplicationRequest,
+    NodeAction,
+    NodeInfo,
+    NodeRequest,
+    RegisterResourceManagerRequest,
+    UserGroupInfo,
+)
+from yunikorn_tpu.core.scheduler import CoreScheduler
+
+from test_core import RecordingCallback
+
+SPARK_YAML = """
+partitions:
+  - name: default
+    nodesortpolicy: {type: binpacking}
+    queues:
+      - name: root
+        queues:
+          - name: spark
+            queues:
+              - name: team-a
+                resources:
+                  guaranteed: {vcore: 8}
+              - name: team-b
+                resources:
+                  guaranteed: {vcore: 8}
+"""
+
+
+def build_core(nodes, queues_yaml=""):
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues",
+                                       config=queues_yaml), cb)
+    infos = []
+    for n in nodes:
+        cache.update_node(n)
+        infos.append(NodeInfo(node_id=n.name, action=NodeAction.CREATE))
+    core.update_node(NodeRequest(nodes=infos))
+    return cache, cb, core
+
+
+def asks_for(core, pods, app_id):
+    return [AllocationAsk(p.uid, app_id, get_pod_resource(p), pod=p,
+                          priority=p.spec.priority or 0) for p in pods]
+
+
+def test_config1_sleep_pods_default_queue():
+    nodes = make_kwok_nodes(20)
+    cache, cb, core = build_core(nodes)
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="sleep-app", queue_name="root.default",
+        user=UserGroupInfo(user="perf"))]))
+    pods = make_sleep_pods(200, "sleep-app")
+    core.update_allocation(AllocationRequest(asks=asks_for(core, pods, "sleep-app")))
+    assert core.schedule_once() == 200
+    # all fit: 20 nodes × 110-pod cap ≥ 200 and cpu/memory ample
+    assert len(cb.allocations) == 200
+
+
+def test_config3_spark_executors_hierarchical_drf():
+    """5k executors scaled to 200; two teams under root.spark share fairly."""
+    nodes = make_kwok_nodes(10, cpu_milli=32000)
+    cache, cb, core = build_core(nodes, SPARK_YAML)
+    for team in ("team-a", "team-b"):
+        core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+            application_id=f"spark-{team}", queue_name=f"root.spark.{team}",
+            user=UserGroupInfo(user=team))]))
+    # driver + executors per app (spark shape: 1 driver, N executors)
+    all_asks = []
+    for team in ("team-a", "team-b"):
+        driver = make_pod(f"{team}-driver", cpu_milli=1000, memory=2**30)
+        execs = [make_pod(f"{team}-exec-{i}", cpu_milli=1000, memory=2**30)
+                 for i in range(100)]
+        all_asks.extend(asks_for(core, [driver] + execs, f"spark-{team}"))
+    core.update_allocation(AllocationRequest(asks=all_asks))
+    total = 0
+    for _ in range(6):
+        total += core.schedule_once()
+        if total >= 202:
+            break
+    assert total == 202
+    # fair share: both teams fully placed, usage equal
+    qa = core.queues.resolve("root.spark.team-a", create=False)
+    qb = core.queues.resolve("root.spark.team-b", create=False)
+    assert qa.allocated.get("cpu") == qb.allocated.get("cpu") == 101000
+
+
+def test_config4_ray_gang_shape():
+    """2k Ray jobs × 32 scaled to 8 jobs × 8: all-or-nothing via task groups.
+
+    Full placeholder lifecycle is covered in test_gang_e2e; this validates the
+    core-side placement-group shape at multiplicity.
+    """
+    nodes = make_kwok_nodes(16, cpu_milli=16000)
+    cache, cb, core = build_core(nodes)
+    for j in range(8):
+        core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+            application_id=f"ray-{j}", queue_name="root.default",
+            user=UserGroupInfo(user="ray"),
+            gang_scheduling_style="Hard")]))
+        ph_asks = [
+            AllocationAsk(f"ray-{j}-ph-{i}", f"ray-{j}",
+                          get_pod_resource(make_pod(f"ray-{j}-ph-{i}", cpu_milli=500,
+                                                    memory=2**28)),
+                          placeholder=True, task_group_name="workers",
+                          pod=make_pod(f"rayp-{j}-{i}", cpu_milli=500, memory=2**28))
+            for i in range(8)
+        ]
+        core.update_allocation(AllocationRequest(asks=ph_asks))
+    n = core.schedule_once()
+    assert n == 64  # every job's full gang reserved
+    # real workers replace placeholders in place
+    for j in range(8):
+        real = [AllocationAsk(f"ray-{j}-w-{i}", f"ray-{j}",
+                              get_pod_resource(make_pod(f"rayw-{j}-{i}", cpu_milli=500,
+                                                        memory=2**28)),
+                              task_group_name="workers",
+                              pod=make_pod(f"rayw-{j}-{i}", cpu_milli=500, memory=2**28))
+                for i in range(8)]
+        core.update_allocation(AllocationRequest(asks=real))
+    core.schedule_once()
+    replaced = [r for r in cb.releases
+                if r.termination_type.value == "PLACEHOLDER_REPLACED"]
+    assert len(replaced) == 64
+
+
+def test_config5_mixed_binpack_affinity_taints():
+    """GPU+CPU+mem pods with node affinity + taints (20k nodes scaled to 64)."""
+    gpu_taint = Taint(key="accelerator", value="gpu", effect="NoSchedule")
+    nodes = []
+    for i in range(32):
+        nodes.append(make_node(f"cpu-{i}", cpu_milli=32000, memory=64 * 2**30, pods=110))
+    for i in range(32):
+        nodes.append(make_node(
+            f"gpu-{i}", cpu_milli=32000, memory=64 * 2**30, pods=110,
+            labels={"accelerator": "gpu"}, taints=[gpu_taint],
+            extra_resources={"nvidia.com/gpu": 8}))
+    cache, cb, core = build_core(nodes)
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="mix", queue_name="root.default",
+        user=UserGroupInfo(user="ml"))]))
+    pods = make_mixed_binpack_pods(300, "mix", seed=7)
+    # GPU pods must target (and tolerate) the GPU pool
+    for p in pods:
+        if any("nvidia.com/gpu" in c.resources_requests for c in p.spec.containers):
+            p.spec.node_selector = {"accelerator": "gpu"}
+            p.spec.tolerations = [Toleration(key="accelerator", operator="Equal",
+                                             value="gpu", effect="NoSchedule")]
+    core.update_allocation(AllocationRequest(asks=asks_for(core, pods, "mix")))
+    total = 0
+    for _ in range(4):
+        total += core.schedule_once()
+    assert total == 300
+    # every GPU pod landed on a GPU node; no CPU pod on a tainted node
+    for alloc in cb.allocations:
+        pod = next(p for p in pods if p.uid == alloc.allocation_key)
+        is_gpu = any("nvidia.com/gpu" in c.resources_requests for c in pod.spec.containers)
+        if is_gpu:
+            assert alloc.node_id.startswith("gpu-")
+        else:
+            assert alloc.node_id.startswith("cpu-")
+    # exact GPU accounting: no node exceeds 8 GPUs
+    gpu_used = {}
+    for alloc in cb.allocations:
+        g = alloc.resource.get("nvidia.com/gpu")
+        if g:
+            gpu_used[alloc.node_id] = gpu_used.get(alloc.node_id, 0) + g
+    assert all(v <= 8 for v in gpu_used.values())

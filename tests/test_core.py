@@ -374,3 +374,76 @@ def test_state_dump():
     dump = json.loads(core.state_dump())
     assert "partition" in dump and "queues" in dump
     assert dump["queues"]["queuename"] == "root"
+
+
+# ---------------------------------------------------------------------------
+# User / group limits (reference user_group_limit e2e suite)
+# ---------------------------------------------------------------------------
+
+USER_LIMIT_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: limited
+            limits:
+              - users: [alice]
+                maxresources: {vcore: 2}
+                maxapplications: 2
+              - users: ["*"]
+                maxresources: {vcore: 4}
+          - name: grouplim
+            limits:
+              - groups: [devs]
+                maxresources: {vcore: 1}
+"""
+
+
+def test_user_resource_limit_enforced():
+    cache, cb, core = make_core(nodes=2, node_cpu=16000, queues_yaml=USER_LIMIT_YAML)
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="a1", queue_name="root.limited",
+                              user=UserGroupInfo(user="alice"))]))
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("a1", f"p{i}", cpu=1000, mem=2**20) for i in range(5)]))
+    n = core.schedule_once()
+    assert n == 2  # alice capped at 2 vcore
+    # another user in the same queue gets the wildcard limit (4 vcore)
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="b1", queue_name="root.limited",
+                              user=UserGroupInfo(user="bob"))]))
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("b1", f"q{i}", cpu=1000, mem=2**20) for i in range(6)]))
+    n = core.schedule_once()
+    assert n == 4
+
+
+def test_user_max_applications_enforced():
+    cache, cb, core = make_core(queues_yaml=USER_LIMIT_YAML)
+    for i in range(3):
+        core.update_application(ApplicationRequest(new=[
+            AddApplicationRequest(application_id=f"app-{i}", queue_name="root.limited",
+                                  user=UserGroupInfo(user="alice"))]))
+    assert cb.accepted_apps.count("app-0") == 1
+    assert cb.accepted_apps.count("app-1") == 1
+    rejected = [a for a, _ in cb.rejected_apps]
+    assert "app-2" in rejected  # maxapplications: 2
+
+
+def test_group_limit_enforced():
+    cache, cb, core = make_core(nodes=2, node_cpu=16000, queues_yaml=USER_LIMIT_YAML)
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="g1", queue_name="root.grouplim",
+                              user=UserGroupInfo(user="carol", groups=["devs"]))]))
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("g1", f"p{i}", cpu=500, mem=2**20) for i in range(4)]))
+    n = core.schedule_once()
+    assert n == 2  # devs group capped at 1 vcore
+    # release frees user budget
+    rel = AllocationRelease(application_id="g1",
+                            allocation_key=cb.allocations[0].allocation_key,
+                            termination_type=TerminationType.STOPPED_BY_RM)
+    core.update_allocation(AllocationRequest(releases=[rel]))
+    n = core.schedule_once()
+    assert n == 1

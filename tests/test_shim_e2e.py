@@ -231,3 +231,32 @@ def test_config_hot_reload_updates_quota():
         assert ok, "queue config did not hot-reload"
     finally:
         ms.stop()
+
+
+def test_in_place_pod_resize_updates_capacity(sched):
+    """pod_resource_scaling e2e analog: an in-place resize (KEP-1287) changes
+    the pod's effective request via container statuses; the cache re-accounts
+    the node and subsequent scheduling sees the new free capacity."""
+    sched.add_node(make_node("node-1", cpu_milli=4000))
+    p1 = sched.add_pod(yk_pod("resizable", cpu=1000))
+    sched.wait_for_task_state("app-1", p1.uid, task_mod.BOUND)
+    info = sched.context.schedulers_cache.get_node("node-1")
+    assert info.requested.get("cpu") == 1000
+    # resize up to 3000m: status-level allocated resources win over spec
+    resized = p1.deepcopy()
+    resized.status.container_statuses = [
+        {"name": "c0", "resources": {"requests": {"cpu": "3", "memory": str(2**28)}}}]
+    sched.cluster.update_pod(resized)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        info = sched.context.schedulers_cache.get_node("node-1")
+        if info.requested.get("cpu") == 3000:
+            break
+        time.sleep(0.05)
+    assert info.requested.get("cpu") == 3000
+    # only 1000m free now: a 2000m pod must not fit
+    p2 = sched.add_pod(yk_pod("big", cpu=2000))
+    time.sleep(0.4)
+    assert sched.get_pod_assignment(p2) == ""
+    p3 = sched.add_pod(yk_pod("small", cpu=900))
+    sched.wait_for_task_state("app-1", p3.uid, task_mod.BOUND)

@@ -60,6 +60,23 @@ def _parse_res_map(m: Optional[dict]) -> Optional[Resource]:
 
 
 @dataclasses.dataclass
+class LimitConfig:
+    """Per-user/group limit inside a queue (yunikorn-core `limits:` schema,
+    exercised by the reference's user_group_limit e2e suite)."""
+
+    users: List[str] = dataclasses.field(default_factory=list)
+    groups: List[str] = dataclasses.field(default_factory=list)
+    max_resources: Optional[Resource] = None
+    max_applications: int = 0
+
+    def applies_to(self, user: str, user_groups: List[str]) -> bool:
+        if "*" in self.users or user in self.users:
+            return True
+        return any(g in self.groups or "*" in self.groups for g in user_groups) \
+            if self.groups else False
+
+
+@dataclasses.dataclass
 class QueueConfig:
     name: str
     parent: bool = False
@@ -68,6 +85,7 @@ class QueueConfig:
     max_resource: Optional[Resource] = None
     max_applications: int = 0
     properties: Dict[str, str] = dataclasses.field(default_factory=dict)
+    limits: List[LimitConfig] = dataclasses.field(default_factory=list)
     children: List["QueueConfig"] = dataclasses.field(default_factory=list)
 
 
@@ -90,6 +108,14 @@ def parse_queues_yaml(text: str, partition: str = "default") -> Optional[QueueCo
 
 def _parse_queue_config(node: dict) -> QueueConfig:
     res = node.get("resources") or {}
+    limits = []
+    for lim in node.get("limits") or []:
+        limits.append(LimitConfig(
+            users=[str(u) for u in (lim.get("users") or [])],
+            groups=[str(g) for g in (lim.get("groups") or [])],
+            max_resources=_parse_res_map(lim.get("maxresources")),
+            max_applications=int(lim.get("maxapplications", 0) or 0),
+        ))
     return QueueConfig(
         name=node.get("name", ""),
         parent=bool(node.get("parent", False)) or bool(node.get("queues")),
@@ -98,6 +124,7 @@ def _parse_queue_config(node: dict) -> QueueConfig:
         max_resource=_parse_res_map(res.get("max")),
         max_applications=int(node.get("maxapplications", 0) or 0),
         properties={str(k): str(v) for k, v in (node.get("properties") or {}).items()},
+        limits=limits,
         children=[_parse_queue_config(c) for c in (node.get("queues") or [])],
     )
 
@@ -114,6 +141,9 @@ class Queue:
         self.allocated = Resource()
         self.pending = Resource()
         self.app_ids: set[str] = set()
+        # per-user accounting for LimitConfig enforcement
+        self.user_allocated: Dict[str, Resource] = {}
+        self.user_app_counts: Dict[str, int] = {}
         self.config = config or QueueConfig(name=name)
 
     # ------------------------------------------------------------------ shape
@@ -163,6 +193,51 @@ class Queue:
                 if not q.allocated.add(r).within_limit(q.config.max_resource):
                     return False
         return True
+
+    # ---------------------------------------------------------- user limits
+    def add_user_allocated(self, user: str, r: Resource) -> None:
+        for q in self.ancestors_and_self():
+            q.user_allocated[user] = q.user_allocated.get(user, Resource()).add(r)
+
+    def remove_user_allocated(self, user: str, r: Resource) -> None:
+        for q in self.ancestors_and_self():
+            cur = q.user_allocated.get(user)
+            if cur is not None:
+                q.user_allocated[user] = cur.sub(r)
+
+    def fits_user_limit(self, user: str, groups: List[str], r: Resource,
+                        extra: Optional[Resource] = None) -> bool:
+        """Would allocating r for this user stay within every applicable
+        per-user limit up the chain?"""
+        for q in self.ancestors_and_self():
+            for lim in q.config.limits:
+                if lim.max_resources is None or not lim.applies_to(user, groups):
+                    continue
+                used = q.user_allocated.get(user, Resource())
+                total = used.add(r) if extra is None else used.add(extra).add(r)
+                if not total.within_limit(lim.max_resources):
+                    return False
+        return True
+
+    def fits_user_app_limit(self, user: str, groups: List[str]) -> bool:
+        """Can this user run one more application in this queue chain?"""
+        for q in self.ancestors_and_self():
+            for lim in q.config.limits:
+                if lim.max_applications <= 0 or not lim.applies_to(user, groups):
+                    continue
+                if q.user_app_counts.get(user, 0) + 1 > lim.max_applications:
+                    return False
+        return True
+
+    def add_user_app(self, user: str) -> None:
+        for q in self.ancestors_and_self():
+            q.user_app_counts[user] = q.user_app_counts.get(user, 0) + 1
+
+    def remove_user_app(self, user: str) -> None:
+        for q in self.ancestors_and_self():
+            n = q.user_app_counts.get(user, 0)
+            if n > 0:
+                q.user_app_counts[user] = n - 1
 
     def dominant_share(self, cluster_capacity: Resource) -> float:
         """DRF dominant share: max over resources of allocated/denominator.

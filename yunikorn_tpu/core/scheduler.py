@@ -223,6 +223,12 @@ class CoreScheduler(SchedulerAPI):
                     resp.rejected.append(RejectedApplication(
                         add.application_id, f"failed to place application: queue {add.queue_name!r} not usable"))
                     continue
+                user_groups = list(add.user.groups)
+                if not leaf.fits_user_app_limit(add.user.user, user_groups):
+                    resp.rejected.append(RejectedApplication(
+                        add.application_id,
+                        f"user {add.user.user} exceeds maxApplications in {leaf.full_name}"))
+                    continue
                 app = CoreApplication(
                     application_id=add.application_id,
                     queue_name=leaf.full_name,
@@ -236,6 +242,7 @@ class CoreScheduler(SchedulerAPI):
                 )
                 self.partition.applications[add.application_id] = app
                 leaf.app_ids.add(add.application_id)
+                leaf.add_user_app(add.user.user)
                 resp.accepted.append(AcceptedApplication(add.application_id))
                 for alloc in self._pending_restores.pop(add.application_id, []):
                     self._restore_allocation(alloc)
@@ -253,8 +260,10 @@ class CoreScheduler(SchedulerAPI):
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.app_ids.discard(app_id)
+            leaf.remove_user_app(app.user.user)
             for alloc in app.allocations.values():
                 leaf.remove_allocated(alloc.resource)
+                leaf.remove_user_allocated(app.user.user, alloc.resource)
 
     def update_allocation(self, request: AllocationRequest) -> None:
         resp = AllocationResponse()
@@ -296,6 +305,8 @@ class CoreScheduler(SchedulerAPI):
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.add_allocated(alloc.resource)
+            if any(q.config.limits for q in leaf.ancestors_and_self()):
+                leaf.add_user_allocated(app.user.user, alloc.resource)
 
     def _track_foreign(self, alloc: Allocation) -> None:
         self.partition.foreign_allocations[alloc.allocation_key] = alloc
@@ -328,6 +339,8 @@ class CoreScheduler(SchedulerAPI):
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.remove_allocated(alloc.resource)
+            if any(q.config.limits for q in leaf.ancestors_and_self()):
+                leaf.remove_user_allocated(app.user.user, alloc.resource)
         return AllocationRelease(
             application_id=release.application_id,
             allocation_key=release.allocation_key,
@@ -392,6 +405,7 @@ class CoreScheduler(SchedulerAPI):
                 # commit with batched queue accounting: one ancestor walk per
                 # leaf, not per allocation (matters at 50k allocations/cycle)
                 leaf_totals: Dict[str, Resource] = {}
+                user_totals: Dict[Tuple[str, str], Resource] = {}
                 for i, ask in enumerate(admitted):
                     idx = int(assigned[i])
                     if idx < 0:
@@ -414,11 +428,18 @@ class CoreScheduler(SchedulerAPI):
                     app = self._commit_allocation(alloc, credit_queue=False)
                     t = leaf_totals.get(app.queue_name)
                     leaf_totals[app.queue_name] = alloc.resource if t is None else t.add(alloc.resource)
+                    uk = (app.queue_name, app.user.user)
+                    ut = user_totals.get(uk)
+                    user_totals[uk] = alloc.resource if ut is None else ut.add(alloc.resource)
                     new_allocs.append(alloc)
                 for qname, total in leaf_totals.items():
                     leaf = self.queues.resolve(qname, create=False)
                     if leaf is not None:
                         leaf.add_allocated(total)
+                        if any(q.config.limits for q in leaf.ancestors_and_self()):
+                            for (qn, user), ut in user_totals.items():
+                                if qn == qname:
+                                    leaf.add_user_allocated(user, ut)
             self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
             self.metrics["allocation_attempt_failed"] += len(skipped_keys)
             self.metrics["solve_count"] += 1
@@ -492,6 +513,8 @@ class CoreScheduler(SchedulerAPI):
             leaf = self.queues.resolve(app.queue_name, create=False)
             if leaf is not None:
                 leaf.add_allocated(alloc.resource)
+                if any(q.config.limits for q in leaf.ancestors_and_self()):
+                    leaf.add_user_allocated(app.user.user, alloc.resource)
         return app
 
     def _cluster_capacity(self) -> Resource:
@@ -570,10 +593,20 @@ class CoreScheduler(SchedulerAPI):
                 [q for q in leaf.ancestors_and_self() if q.config.max_resource is not None]
                 if leaf is not None else []
             )
+            has_limits = (leaf is not None
+                          and any(q.config.limits for q in leaf.ancestors_and_self()))
+            user_extra: Dict[str, Resource] = {}
             for app, ask in entries:
                 if quota_chain and not _fits_quota_with(quota_chain, cycle_extra, ask.resource):
                     held += 1
                     continue
+                if has_limits:
+                    u = app.user.user
+                    if not leaf.fits_user_limit(u, list(app.user.groups), ask.resource,
+                                                extra=user_extra.get(u)):
+                        held += 1
+                        continue
+                    user_extra[u] = user_extra.get(u, Resource()).add(ask.resource)
                 for q in quota_chain:
                     cycle_extra[q.full_name] = cycle_extra.get(q.full_name, Resource()).add(ask.resource)
                 admitted.append(ask)
