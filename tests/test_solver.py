@@ -265,3 +265,39 @@ def test_binpacking_prefers_packed_node():
     assert names_of(enc, res, batch)[p.uid] == "half"
     res = solve_batch(batch, enc.nodes, policy="spread")
     assert names_of(enc, res, batch)[p.uid] == "empty"
+
+
+def test_prefer_no_schedule_taint_scores_lower():
+    """PreferNoSchedule taints don't filter but push pods elsewhere; when only
+    the soft-tainted node remains feasible, pods still land there."""
+    soft = Taint(key="maint", value="soon", effect="PreferNoSchedule")
+    cache, enc = make_env([
+        make_node("soft-tainted", taints=[soft], cpu_milli=16000),
+        make_node("clean", cpu_milli=16000),
+    ])
+    pods = [make_pod(f"p{i}", cpu_milli=1000) for i in range(4)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert all(v == "clean" for v in got.values())
+    # saturate the clean node → overflow goes to the soft-tainted one
+    big = [make_pod(f"big{i}", cpu_milli=7000) for i in range(3)]
+    batch = enc.build_batch([ask_for(p) for p in big])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert sorted(v for v in got.values()) == ["clean", "clean", "soft-tainted"]
+
+
+def test_soft_taint_tolerated_no_penalty():
+    soft = Taint(key="maint", value="soon", effect="PreferNoSchedule")
+    cache, enc = make_env([
+        make_node("soft-tainted", taints=[soft], cpu_milli=4000),
+        make_node("clean", cpu_milli=16000),
+    ])
+    # binpacking prefers the fuller (smaller) node when tolerated
+    p = make_pod("tol", cpu_milli=1000)
+    p.spec.tolerations = [Toleration(key="maint", operator="Equal", value="soon",
+                                     effect="PreferNoSchedule")]
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p.uid] == "soft-tainted"

@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from yunikorn_tpu.models.policies import alignment_scores, node_base_scores
-from yunikorn_tpu.ops.predicates import group_feasibility
+from yunikorn_tpu.ops.predicates import group_feasibility, group_soft_penalty
 
 NEG_INF = jnp.float32(-3.0e38)
 
@@ -125,8 +125,8 @@ def _loc_rules_mask(gid_rows, dom_cols, loc, cnt, minc, total, contrib_rows):
     return ok
 
 
-def _best_nodes_chunked(req, group_id, group_feas, free, capacity, base_scores,
-                        chunk: int, policy: str, loc=None, cnt=None,
+def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
+                        base_scores, chunk: int, policy: str, loc=None, cnt=None,
                         minc=None, total=None):
     """For every pod: (best node, any feasible?) without materializing [N, M]."""
     N, R = req.shape
@@ -146,7 +146,7 @@ def _best_nodes_chunked(req, group_id, group_feas, free, capacity, base_scores,
         if loc is not None:
             ccontrib = lax.dynamic_slice(loc[3], (start, 0), (chunk, loc[3].shape[1]))
             ok &= _loc_rules_mask(cgid, None, loc, cnt, minc, total, ccontrib)
-        scores = jnp.broadcast_to(base_scores[None, :], (chunk, M))
+        scores = jnp.broadcast_to(base_scores[None, :], (chunk, M)) + group_soft[cgid]
         if policy == "align":
             scores = scores + alignment_scores(creq, free, capacity)
         scores = jnp.where(ok, scores, NEG_INF)
@@ -158,7 +158,8 @@ def _best_nodes_chunked(req, group_id, group_feas, free, capacity, base_scores,
     return best.reshape(N), feasible.reshape(N)
 
 
-def _water_fill_proposals(req, group_id, rank, active, group_feas, free, base_scores):
+def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
+                          base_scores, group_soft):
     """Capacity-aware proposals: the batched analog of "fill nodes in score order".
 
     Plain per-pod argmax herds every pod in a constraint group onto the same
@@ -184,7 +185,7 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free, base_sc
 
     def per_group(g):
         feas = group_feas[g]                                   # [M]
-        score = jnp.where(feas, base_scores, NEG_INF)
+        score = jnp.where(feas, base_scores + group_soft[g], NEG_INF)
         node_order = jnp.argsort(-score)                       # feasible first
         ofree = jnp.where(feas[node_order, None], free[node_order].astype(jnp.float32), 0.0)
         cumF = jnp.cumsum(ofree, axis=0)                       # [M, R]
@@ -318,7 +319,7 @@ def solve(
     valid,          # [N] bool
     g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
     g_tol, g_ports,                                   # group tensors
-    node_labels, node_taints, node_ports, node_ok,    # node symbol state
+    node_labels, node_taints, node_taints_soft, node_ports, node_ok,  # node symbol state
     free,           # [M, R] int32
     capacity,       # [M, R] int32
     host_group_mask=None,   # [G, M] bool or None
@@ -349,6 +350,8 @@ def solve(
     )
     if host_group_mask is not None:
         group_feas = group_feas & host_group_mask
+    # scoring half of TaintToleration: PreferNoSchedule taints penalize
+    group_soft = group_soft_penalty(g_tol, node_taints_soft)          # [G, M]
 
     has_loc = loc is not None
     free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
@@ -380,7 +383,7 @@ def solve(
             minc = total = None
 
         proposals = _water_fill_proposals(req, group_id, rank, active, group_feas,
-                                          cur_free, base_scores)
+                                          cur_free, base_scores, group_soft)
         prop_fits = jnp.all(free_ext[proposals] >= req, axis=1) & (proposals < M)
         if has_loc:
             # proposals must also satisfy the dynamic locality rules
@@ -397,8 +400,8 @@ def solve(
                     interpret=pallas_interpret)
             else:
                 best, feasible = _best_nodes_chunked(
-                    req, group_id, group_feas, cur_free, capacity, base_scores, chunk,
-                    policy, loc, cnt, minc, total,
+                    req, group_id, group_feas, group_soft, cur_free, capacity,
+                    base_scores, chunk, policy, loc, cnt, minc, total,
                 )
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
@@ -484,6 +487,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         jnp.asarray(batch.g_ports.view(np.uint32)),
         jnp.asarray(na.labels.view(np.uint32)),
         jnp.asarray(na.taints_hard.view(np.uint32)),
+        jnp.asarray(na.taints_soft.view(np.uint32)),
         jnp.asarray(na.ports.view(np.uint32)),
         jnp.asarray(node_ok),
         jnp.asarray(free_i),
@@ -493,7 +497,9 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         max_rounds=max_rounds,
         chunk=chunk,
         policy=policy,
-        use_pallas=use_pallas,
+        # the fused kernel scores from the base vector only; soft taints
+        # need the per-group penalty, so fall back to the XLA path then
+        use_pallas=use_pallas and not na.taints_soft.any(),
         pallas_interpret=pallas_interpret,
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

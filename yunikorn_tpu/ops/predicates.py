@@ -71,4 +71,24 @@ def group_feasibility(
     return sel_ok & ~taint_bad & ~port_bad & node_ok[None, :]
 
 
+def group_soft_penalty(
+    g_tol,             # [G, Wt] uint32
+    node_taints_soft,  # [M, Wt] uint32 (PreferNoSchedule taints)
+) -> jnp.ndarray:      # [G, M] float32
+    """Soft-taint penalty: the scoring half of the TaintToleration plugin.
+
+    PreferNoSchedule taints never filter (reference: only NoSchedule/NoExecute
+    are hard); nodes carrying untolerated soft taints score lower. The penalty
+    is the popcount of untolerated soft-taint bits, scaled small so packing
+    dominates and soft taints break ties.
+    """
+    G, Wt = g_tol.shape
+    M = node_taints_soft.shape[0]
+    count = jnp.zeros((G, M), jnp.int32)
+    for w in range(Wt):
+        bad = node_taints_soft[:, w][None, :] & ~g_tol[:, w][:, None]   # [G, M]
+        count += jax.lax.population_count(bad).astype(jnp.int32)
+    return -0.05 * count.astype(jnp.float32)
+
+
 group_feasibility_jit = jax.jit(group_feasibility)

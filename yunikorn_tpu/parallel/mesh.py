@@ -85,6 +85,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         put(batch.g_ports, repl),
         put(na.labels, node_s2),
         put(na.taints_hard, node_s2),
+        put(na.taints_soft, node_s2),
         put(na.ports, node_s2),
         put(node_ok, node_s),
         put(free_i, node_s2),
