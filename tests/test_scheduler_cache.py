@@ -116,8 +116,13 @@ def test_dirty_node_tracking():
     pod = make_pod("p1", cpu_milli=100, node_name="n2", phase="Running")
     cache.update_pod(pod)
     assert cache.generation() > g0
-    assert cache.take_dirty_nodes() == {"n2"}
-    assert cache.take_dirty_nodes() == set()
+    dirty, objects = cache.take_dirty_nodes()
+    assert dirty == {"n2"}
+    assert objects == set()  # pod churn: free-only refresh suffices
+    cache.update_node(make_node("n2"))
+    dirty, objects = cache.take_dirty_nodes()
+    assert objects == {"n2"}  # node object changed: full re-encode
+    assert cache.take_dirty_nodes() == (set(), set())
 
 
 # ---------------------------------------------------------------------------

@@ -292,12 +292,21 @@ def test_soft_taint_tolerated_no_penalty():
     soft = Taint(key="maint", value="soon", effect="PreferNoSchedule")
     cache, enc = make_env([
         make_node("soft-tainted", taints=[soft], cpu_milli=4000),
-        make_node("clean", cpu_milli=16000),
+        make_node("clean", cpu_milli=4000),
     ])
-    # binpacking prefers the fuller (smaller) node when tolerated
-    p = make_pod("tol", cpu_milli=1000)
+    # make the tainted node clearly fuller so binpacking prefers it iff the
+    # taint is tolerated (no penalty)
+    occ = make_pod("occ", cpu_milli=3000, node_name="soft-tainted", phase="Running")
+    cache.update_pod(occ)
+    enc.sync_nodes()
+    p = make_pod("tol", cpu_milli=500)
     p.spec.tolerations = [Toleration(key="maint", operator="Equal", value="soon",
                                      effect="PreferNoSchedule")]
     batch = enc.build_batch([ask_for(p)])
     res = solve_batch(batch, enc.nodes)
     assert names_of(enc, res, batch)[p.uid] == "soft-tainted"
+    # the same pod without the toleration avoids the tainted node
+    p2 = make_pod("intol", cpu_milli=500)
+    batch = enc.build_batch([ask_for(p2)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p2.uid] == "clean"

@@ -15,7 +15,7 @@ Two framework-specific additions:
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from yunikorn_tpu.common.objects import Node, Pod
 from yunikorn_tpu.common.resource import Resource, get_node_resource, get_pod_resource
@@ -73,7 +73,15 @@ class SchedulerCache:
         # of the node object itself, not pod churn) — cheap memo key for
         # cluster-capacity reductions
         self._capacity_version = 0
+        # pod churn only moves a node's FREE capacity; node-object changes
+        # (labels/taints/allocatable) need a full row re-encode. Tracked
+        # separately so the encoder can take the cheap path for the common case.
         self._dirty_nodes: Set[str] = set()
+        self._dirty_node_objects: Set[str] = set()
+        # bumped only when a pod carrying required anti-affinity terms enters
+        # or leaves the cache — keys the symmetric-anti-affinity term memo so
+        # per-pod group signatures stay cached for ordinary workloads
+        self._anti_version = 0
         self._listeners: List[Callable[[str], None]] = []
 
     # ------------------------------------------------------------------ nodes
@@ -99,6 +107,7 @@ class SchedulerCache:
                 info.set_node(node)
             self._capacity_version += 1
             self._mark_dirty(node.name)
+            self._dirty_node_objects.add(node.name)
             return adopted
 
     def remove_node(self, node_name: str) -> List[Pod]:
@@ -115,6 +124,7 @@ class SchedulerCache:
                 orphans.append(pod)
             self._capacity_version += 1
             self._mark_dirty(node_name)
+            self._dirty_node_objects.add(node_name)
             return orphans
 
     def get_node(self, name: str) -> Optional[NodeInfo]:
@@ -149,9 +159,16 @@ class SchedulerCache:
         with self._lock:
             return self._update_pod_locked(pod)
 
+    @staticmethod
+    def _has_anti_terms(pod: Optional[Pod]) -> bool:
+        return bool(pod is not None and pod.spec.affinity is not None
+                    and pod.spec.affinity.pod_anti_affinity_required)
+
     def _update_pod_locked(self, pod: Pod) -> bool:
         key = pod.uid
         result = True
+        if self._has_anti_terms(pod) or self._has_anti_terms(self.pods_map.get(key)):
+            self._anti_version += 1
         cur = self.pods_map.get(key)
         if cur is not None:
             self.pods_map.pop(key, None)
@@ -196,6 +213,8 @@ class SchedulerCache:
     def remove_pod(self, pod: Pod) -> None:
         with self._lock:
             key = pod.uid
+            if self._has_anti_terms(pod) or self._has_anti_terms(self.pods_map.get(key)):
+                self._anti_version += 1
             node_name = self.assigned_pods.pop(key, None)
             cur = self.pods_map.pop(key, None)
             if node_name is not None and cur is not None:
@@ -297,12 +316,22 @@ class SchedulerCache:
         with self._lock.reader():
             return self._capacity_version
 
-    def take_dirty_nodes(self) -> Set[str]:
-        """Return and clear the set of nodes whose aggregates changed."""
+    def anti_version(self) -> int:
+        with self._lock.reader():
+            return self._anti_version
+
+    def take_dirty_nodes(self) -> Tuple[Set[str], Set[str]]:
+        """Return and clear (all dirty nodes, subset whose node OBJECT changed).
+
+        Nodes only in the first set need just a free-capacity row refresh;
+        nodes in the second need a full symbol re-encode.
+        """
         with self._lock:
             dirty = self._dirty_nodes
+            objects = self._dirty_node_objects
             self._dirty_nodes = set()
-            return dirty
+            self._dirty_node_objects = set()
+            return dirty, objects
 
     # ---------------------------------------------------------------- snapshot
     def snapshot_nodes(self) -> List[NodeInfo]:

@@ -77,6 +77,7 @@ class AllocationAsk:
     originator: bool = False
     preferred_node: str = ""
     pod: Optional[object] = None         # opaque to the core's policy, used by predicates
+    seq: int = 0                         # core-assigned FIFO sequence
 
 
 @dataclasses.dataclass

@@ -275,7 +275,7 @@ class CoreScheduler(SchedulerAPI):
                         ask.application_id, ask.allocation_key, "application not running"))
                     continue
                 self._ask_seq += 1
-                ask.tags.setdefault("__seq__", str(self._ask_seq))
+                ask.seq = self._ask_seq
                 app.pending_asks[ask.allocation_key] = ask
             for alloc in request.allocations:
                 if alloc.foreign:
@@ -586,7 +586,7 @@ class CoreScheduler(SchedulerAPI):
             entries.sort(key=lambda e: (
                 -(e[1].priority or 0),
                 e[0].submit_time,
-                int(e[1].tags.get("__seq__", "0")),
+                e[1].seq,
             ))
             # queues with no max anywhere in their chain skip the walk entirely
             quota_chain = (

@@ -88,7 +88,7 @@ def group_soft_penalty(
     for w in range(Wt):
         bad = node_taints_soft[:, w][None, :] & ~g_tol[:, w][:, None]   # [G, M]
         count += jax.lax.population_count(bad).astype(jnp.int32)
-    return -0.05 * count.astype(jnp.float32)
+    return -0.25 * count.astype(jnp.float32)
 
 
 group_feasibility_jit = jax.jit(group_feasibility)

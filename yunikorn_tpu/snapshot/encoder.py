@@ -237,6 +237,31 @@ class NodeArrays:
         self.version += 1
         return idx
 
+    def update_free_row(self, name: str, info: NodeInfo) -> None:
+        """Cheap path: refresh only the free-capacity row (pod churn)."""
+        idx = self._name_to_idx.get(name)
+        if idx is None:
+            return
+        rv = self.vocabs.resources
+        avail = info.available().resources
+        slots = [(rv.slot(n), v / rv.scale(n)) for n, v in avail.items()]
+        self._maybe_grow()
+        self.free[idx] = 0.0
+        for slot, val in slots:
+            self.free[idx, slot] = val
+        # host ports may change with pod churn too
+        port_bits = []
+        for pod in info.pods.values():
+            for c in pod.spec.containers:
+                for p in c.ports:
+                    hp = p.get("hostPort")
+                    if hp:
+                        port_bits.append(self.vocabs.ports.bit(port_bit(p.get("protocol", "TCP"), hp)))
+        self.ports[idx] = 0
+        for b in port_bits:
+            _set_bit(self.ports[idx], b)
+        self.version += 1
+
     def remove_node(self, name: str) -> None:
         idx = self._name_to_idx.pop(name, None)
         if idx is None:
@@ -272,23 +297,31 @@ class SnapshotEncoder:
 
     # ------------------------------------------------------------------ nodes
     def sync_nodes(self, full: bool = False) -> None:
-        """Re-encode dirty (or all) nodes from the scheduler cache."""
+        """Re-encode dirty (or all) nodes from the scheduler cache.
+
+        Pod churn only changes a node's free capacity, so those nodes take a
+        cheap O(R) free-row refresh; only nodes whose node OBJECT changed
+        (labels/taints/allocatable/new) pay the full symbol re-encode.
+        """
         if full:
             names = set(self.cache.node_names())
             # also drop rows for nodes no longer in the cache
             for name in list(self.nodes._name_to_idx):
                 if name not in names:
                     self.nodes.remove_node(name)
-            dirty = names
+            dirty, objects = names, names
         else:
-            dirty = self.cache.take_dirty_nodes()
+            dirty, objects = self.cache.take_dirty_nodes()
         for name in dirty:
             info = self.cache.get_node(name)
             if info is None:
                 self.nodes.remove_node(name)
-            else:
+                continue
+            if name in objects or self.nodes.index_of(name) is None:
                 sched = self._unschedulable_overrides.get(name, True)
                 self.nodes.encode_node(info, schedulable=sched)
+            else:
+                self.nodes.update_free_row(name, info)
         # taint vocab may have grown; bump group invalidation version
         self._taint_version = self.vocabs.taints.used_bits()
 
@@ -299,6 +332,22 @@ class SnapshotEncoder:
 
     # ------------------------------------------------------------------- pods
     def _group_signature(self, pod: Pod) -> tuple:
+        # signatures are pure functions of the pod spec + the anti-affinity
+        # term set; cache per pod, invalidated when the term set regenerates
+        from yunikorn_tpu.snapshot.locality import all_anti_terms
+
+        terms = all_anti_terms(self.cache)
+        cached = getattr(pod, "_yk_sig_cache", None)
+        if cached is not None and cached[0] is terms:
+            return cached[1]
+        sig = self._compute_group_signature(pod)
+        try:
+            pod._yk_sig_cache = (terms, sig)
+        except AttributeError:
+            pass
+        return sig
+
+    def _compute_group_signature(self, pod: Pod) -> tuple:
         sel = tuple(sorted(pod.spec.node_selector.items()))
         tols = tuple(
             (t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations

@@ -170,7 +170,7 @@ def all_anti_terms(cache) -> List[AntiTermSpec]:
     avoid domains holding its pods. Includes pending pods so in-batch pairs
     see each other.
     """
-    gen = cache.generation()
+    gen = cache.anti_version()
     memo = getattr(cache, "_anti_terms_memo", None)
     if memo is not None and memo[0] == gen:
         return memo[1]
