@@ -323,3 +323,23 @@ def test_constants_wire_compat():
     assert constants.CANONICAL_LABEL_APP_ID == "yunikorn.apache.org/app-id"
     assert constants.SCHEDULER_NAME == "yunikorn"
     assert constants.PLACEHOLDER_CONTAINER_IMAGE.startswith("registry.k8s.io/pause")
+
+
+def test_deadlock_detection_fires(monkeypatch):
+    """The reference enables go-deadlock for unit tests (Makefile:586-589);
+    our locking raises DeadlockError past the timeout when enabled."""
+    from yunikorn_tpu.locking import locking
+
+    monkeypatch.setattr(locking, "DETECTION_ENABLED", True)
+    monkeypatch.setattr(locking, "TIMEOUT_SECONDS", 0.2)
+    m = locking.Mutex()
+    m.acquire()
+    with pytest.raises(locking.DeadlockError):
+        m.acquire()  # same-thread re-acquire deadlocks
+    m.release()
+
+    rw = locking.RWMutex()
+    rw.acquire()
+    with pytest.raises(locking.DeadlockError):
+        rw.r_acquire()
+    rw.release()

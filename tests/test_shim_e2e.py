@@ -260,3 +260,65 @@ def test_in_place_pod_resize_updates_capacity(sched):
     assert sched.get_pod_assignment(p2) == ""
     p3 = sched.add_pod(yk_pod("small", cpu=900))
     sched.wait_for_task_state("app-1", p3.uid, task_mod.BOUND)
+
+
+def test_restart_with_changed_config():
+    """restart_changed_config e2e analog: the scheduler restarts against the
+    same cluster with a DIFFERENT queues.yaml; recovered state must respect
+    the new configuration."""
+    ms = MockScheduler()
+    ms.init(QUEUES_YAML)
+    ms.start()
+    ms.add_node(make_node("node-1", cpu_milli=16000))
+    pods = [ms.add_pod(yk_pod(f"pod-{i}", cpu=1000)) for i in range(2)]
+    for p in pods:
+        ms.wait_for_task_state("app-1", p.uid, task_mod.BOUND)
+    cluster = ms.cluster  # the "cluster" survives the scheduler restart
+    ms.shim.stop()
+    ms.core.stop()
+
+    # restart with root.default now capped at 3 vcore
+    new_yaml = QUEUES_YAML.replace(
+        "          - name: default\n",
+        "          - name: default\n            resources:\n              max: {vcore: 3}\n",
+    )
+    from yunikorn_tpu.cache.context import Context
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.conf.schedulerconf import get_holder, reset_for_tests
+    from yunikorn_tpu.core.scheduler import CoreScheduler
+    from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
+    from yunikorn_tpu.shim.scheduler import KubernetesShim
+
+    reset_for_tests()
+    get_holder().update_config_maps(
+        [{"service.schedulingInterval": "0.05", "queues.yaml": new_yaml}], initial=True)
+    dispatch_mod.reset_dispatcher()
+    cache2 = SchedulerCache()
+    core2 = CoreScheduler(cache2, interval=0.02)
+    ctx2 = Context(cluster, core2, cache=cache2)
+    shim2 = KubernetesShim(cluster, core2, context=ctx2)
+    core2.start()
+    shim2.run()
+    try:
+        # recovered: both pods Bound again without rebinding; 2000m accounted
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            app = ctx2.get_application("app-1")
+            if app is not None and all(
+                    (t := app.get_task(p.uid)) is not None and t.state == task_mod.BOUND
+                    for p in pods):
+                break
+            time.sleep(0.05)
+        leaf = core2.queues.resolve("root.default", create=False)
+        assert leaf.allocated.get("cpu") == 2000
+        assert leaf.config.max_resource.get("cpu") == 3000  # new config applied
+        # new quota enforced on top of recovered usage: only 1 more vcore fits
+        extra = [cluster.add_pod(yk_pod(f"extra-{i}", cpu=1000)) for i in range(3)]
+        deadline = time.time() + 5
+        while time.time() < deadline and leaf.allocated.get("cpu") < 3000:
+            time.sleep(0.05)
+        time.sleep(0.3)
+        assert leaf.allocated.get("cpu") == 3000  # capped by the NEW max
+    finally:
+        shim2.stop()
+        core2.stop()
