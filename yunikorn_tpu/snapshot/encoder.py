@@ -331,12 +331,15 @@ class SnapshotEncoder:
         self.nodes.set_schedulable(name, schedulable)
 
     # ------------------------------------------------------------------- pods
-    def _group_signature(self, pod: Pod) -> tuple:
+    def _group_signature(self, pod: Pod, terms=None) -> tuple:
         # signatures are pure functions of the pod spec + the anti-affinity
-        # term set; cache per pod, invalidated when the term set regenerates
-        from yunikorn_tpu.snapshot.locality import all_anti_terms
+        # term set; cache per pod, invalidated when the term set regenerates.
+        # Callers in a loop pass `terms` (one lock acquisition per batch, not
+        # one per pod).
+        if terms is None:
+            from yunikorn_tpu.snapshot.locality import all_anti_terms
 
-        terms = all_anti_terms(self.cache)
+            terms = all_anti_terms(self.cache)
         cached = getattr(pod, "_yk_sig_cache", None)
         if cached is not None and cached[0] is terms:
             return cached[1]
@@ -543,6 +546,9 @@ class SnapshotEncoder:
         R = rv.num_slots
 
         # group dedup
+        from yunikorn_tpu.snapshot.locality import all_anti_terms
+
+        anti_terms = all_anti_terms(self.cache)
         group_specs: List[GroupSpec] = []
         group_ids: List[int] = []
         sig_to_gid: Dict[tuple, int] = {}
@@ -551,7 +557,7 @@ class SnapshotEncoder:
             if pod is None:
                 sig: tuple = ("<none>",)
             else:
-                sig = self._group_signature(pod)
+                sig = self._group_signature(pod, anti_terms)
             gid = sig_to_gid.get(sig)
             if gid is not None:
                 # re-encode if the taint vocab grew since this group was cached
