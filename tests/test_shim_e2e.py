@@ -322,3 +322,54 @@ def test_restart_with_changed_config():
     finally:
         shim2.stop()
         core2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Volumes (persistent_volume e2e analog)
+# ---------------------------------------------------------------------------
+
+def test_pod_with_pvc_binds_volume_then_pod(sched):
+    from yunikorn_tpu.common.objects import ObjectMeta, PersistentVolumeClaim, Volume
+
+    sched.add_node(make_node("node-1"))
+    sched.cluster.add_pvc(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="claim-1", namespace="default"),
+        storage_class="standard"))
+    pod = yk_pod("with-vol")
+    pod.spec.volumes = [Volume(name="data", pvc_claim_name="claim-1")]
+    sched.add_pod(pod)
+    sched.wait_for_task_state("app-1", pod.uid, task_mod.BOUND)
+    pvc = sched.cluster.get_pvc("default", "claim-1")
+    assert pvc.bound and pvc.volume_name  # volume bound before the pod bind
+
+
+def test_pod_with_missing_pvc_fails(sched):
+    from yunikorn_tpu.common.objects import Volume
+
+    sched.add_node(make_node("node-1"))
+    pod = yk_pod("no-claim")
+    pod.spec.volumes = [Volume(name="data", pvc_claim_name="ghost-claim")]
+    sched.add_pod(pod)
+    sched.wait_for_task_state("app-1", pod.uid, task_mod.FAILED)
+
+
+def test_node_volume_attach_limit(sched):
+    """NodeVolumeLimits analog: pods consume attach slots; a node with a low
+    published limit rejects overflow."""
+    from yunikorn_tpu.common.objects import ObjectMeta, PersistentVolumeClaim, Volume
+
+    node = make_node("vol-node", cpu_milli=16000)
+    node.status.allocatable["attachable-volumes-csi"] = 2
+    sched.add_node(node)
+    for i in range(3):
+        sched.cluster.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=f"c{i}", namespace="default")))
+    pods = []
+    for i in range(3):
+        p = yk_pod(f"vp-{i}", cpu=100)
+        p.spec.volumes = [Volume(name="d", pvc_claim_name=f"c{i}")]
+        pods.append(sched.add_pod(p))
+    sched.wait_for_bound_count(2)
+    time.sleep(0.4)
+    bound = [p for p in pods if sched.get_pod_assignment(p)]
+    assert len(bound) == 2  # attach limit 2 caps the third

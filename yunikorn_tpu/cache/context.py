@@ -58,21 +58,51 @@ logger = log("shim.context")
 
 
 class VolumeBinder:
-    """Volume binding seam (reference volumebinding.NewVolumeBinder).
+    """Volume binding seam (reference volumebinding.NewVolumeBinder with the
+    10-minute bind timeout, apifactory.go:92-165; FindPodVolumes/
+    AssumePodVolumes semantics in context.go:747-827).
 
-    The in-repo implementation treats volumes as bound when their PVCs are
-    bound in the cluster store; a real-K8s adapter replaces this with the
-    scheduler-framework volume binder.
+    The in-repo implementation binds against the FakeCluster PVC store; a
+    real-K8s adapter replaces this with the scheduler-framework volume binder.
     """
 
-    def __init__(self, api_provider: APIProvider):
+    def __init__(self, api_provider: APIProvider, bind_timeout: float = 600.0):
         self.api = api_provider
+        self.bind_timeout = bind_timeout
 
     def all_bound(self, pod: Pod) -> bool:
-        return all(not v.pvc_claim_name for v in pod.spec.volumes)
+        if not any(v.pvc_claim_name for v in pod.spec.volumes):
+            return True
+        get_pvc = getattr(self.api, "get_pvc", None)
+        if get_pvc is None:
+            return True
+        return all(
+            (pvc := get_pvc(pod.namespace, v.pvc_claim_name)) is not None and pvc.bound
+            for v in pod.spec.volumes if v.pvc_claim_name
+        )
 
     def bind_pod_volumes(self, pod: Pod) -> None:
-        return  # in-memory cluster: nothing to bind
+        """Bind all of the pod's unbound PVCs (AssumePodVolumes + bind)."""
+        bind_pvc = getattr(self.api, "bind_pvc", None)
+        get_pvc = getattr(self.api, "get_pvc", None)
+        if bind_pvc is None or get_pvc is None:
+            return
+        import time as _time
+
+        deadline = _time.time() + self.bind_timeout
+        for v in pod.spec.volumes:
+            if not v.pvc_claim_name:
+                continue
+            while _time.time() < deadline:
+                pvc = get_pvc(pod.namespace, v.pvc_claim_name)
+                if pvc is not None:
+                    if not pvc.bound:
+                        bind_pvc(pod.namespace, v.pvc_claim_name)
+                    break
+                _time.sleep(0.05)
+            else:
+                raise TimeoutError(
+                    f"volume bind timeout for pvc {v.pvc_claim_name}")
 
 
 class Context:
@@ -113,6 +143,9 @@ class Context:
             add_fn=self.add_priority_class,
             update_fn=lambda old, new: self.add_priority_class(new),
             delete_fn=self.delete_priority_class))
+        self.api_provider.add_event_handler(InformerType.PVC, ResourceEventHandlers(
+            add_fn=self._on_pvc, update_fn=lambda old, new: self._on_pvc(new),
+            delete_fn=self._on_pvc_deleted))
 
     # ----------------------------------------------------------------- nodes
     def add_node(self, node: Node) -> None:
@@ -294,8 +327,23 @@ class Context:
         if not self.schedulers_cache.are_pod_volumes_all_bound(pod.uid):
             self.volume_binder.bind_pod_volumes(pod)
 
+    def _on_pvc(self, pvc) -> None:
+        with self._lock:
+            self._pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+
+    def _on_pvc_deleted(self, pvc) -> None:
+        with self._lock:
+            pvc.deleted = True
+            self._pvcs.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+
     def get_pvc(self, namespace: str, name: str):
-        return self._pvcs.get(f"{namespace}/{name}")
+        with self._lock:
+            pvc = self._pvcs.get(f"{namespace}/{name}")
+        if pvc is not None:
+            return pvc
+        # fall through to the cluster store (informer may not have synced yet)
+        get = getattr(self.api_provider, "get_pvc", None)
+        return get(namespace, name) if get is not None else None
 
     # ------------------------------------------------------ priority classes
     def add_priority_class(self, pc: PriorityClass) -> None:

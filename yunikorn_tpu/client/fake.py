@@ -25,6 +25,7 @@ from yunikorn_tpu.client.interfaces import (
 from yunikorn_tpu.common.objects import (
     ConfigMap,
     Node,
+    PersistentVolumeClaim,
     Pod,
     PodCondition,
     PriorityClass,
@@ -111,6 +112,7 @@ class FakeCluster(APIProvider):
         self._nodes: Dict[str, Node] = {}
         self._configmaps: Dict[str, ConfigMap] = {}
         self._priority_classes: Dict[str, PriorityClass] = {}
+        self._pvcs: Dict[str, PersistentVolumeClaim] = {}
         self._handlers: Dict[InformerType, List[ResourceEventHandlers]] = {}
         self._client = FakeKubeClient(self)
         self._started = False
@@ -241,6 +243,30 @@ class FakeCluster(APIProvider):
         with self._lock:
             return self._configmaps.get(f"{namespace}/{name}")
 
+    def add_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        with self._lock:
+            self._pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+        self._fire(InformerType.PVC, "add", pvc)
+
+    def get_pvc(self, namespace: str, name: str) -> Optional[PersistentVolumeClaim]:
+        with self._lock:
+            return self._pvcs.get(f"{namespace}/{name}")
+
+    def delete_pvc(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pvc = self._pvcs.pop(f"{namespace}/{name}", None)
+        if pvc is not None:
+            self._fire(InformerType.PVC, "delete", pvc)
+
+    def bind_pvc(self, namespace: str, name: str, volume_name: str = "") -> None:
+        with self._lock:
+            pvc = self._pvcs.get(f"{namespace}/{name}")
+            if pvc is None:
+                raise KeyError(f"pvc {namespace}/{name} not found")
+            pvc.bound = True
+            pvc.volume_name = volume_name or f"pv-{name}"
+        self._fire(InformerType.PVC, "update", pvc, pvc)
+
     def add_priority_class(self, pc: PriorityClass) -> None:
         with self._lock:
             self._priority_classes[pc.name] = pc
@@ -262,6 +288,8 @@ class FakeCluster(APIProvider):
             return list(self._configmaps.values())
         if informer == InformerType.PRIORITY_CLASS:
             return list(self._priority_classes.values())
+        if informer == InformerType.PVC:
+            return list(self._pvcs.values())
         return []
 
     def _fire(self, informer: InformerType, kind: str, obj, old=None) -> None:

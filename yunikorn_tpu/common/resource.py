@@ -24,6 +24,11 @@ MEMORY = "memory"
 PODS = "pods"
 EPHEMERAL_STORAGE = "ephemeral-storage"
 HUGEPAGES_PREFIX = "hugepages-"
+# Volume attach limits ride the resource-fit machinery: pods consume one unit
+# per PVC volume, nodes default to 64 attachable (the NodeVolumeLimits / CSI
+# limits predicate of the reference's allocation plugin set).
+VOLUME_ATTACH = "attachable-volumes-csi"
+DEFAULT_NODE_VOLUME_LIMIT = 64
 
 _QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+)([EPTGMKezypnum]i?|)$")
 
@@ -179,6 +184,9 @@ def get_pod_resource(pod) -> Resource:
         container resources win over spec (in-place resize).
     """
     total = Resource({PODS: 1})
+    n_vols = sum(1 for v in pod.spec.volumes if v.pvc_claim_name)
+    if n_vols:
+        total = total.add(Resource({VOLUME_ATTACH: n_vols}))
     for c in pod.spec.containers:
         req = _container_request(pod, c)
         total = total.add(req)
@@ -211,8 +219,15 @@ def _container_request(pod, container) -> Resource:
 
 
 def get_node_resource(allocatable: Mapping[str, object]) -> Resource:
-    """Node allocatable → Resource (reference resource.go:188-197)."""
-    return Resource.from_requests(allocatable)
+    """Node allocatable → Resource (reference resource.go:188-197).
+
+    Injects the default CSI attach limit when the node does not publish one,
+    so volume-consuming pods are bounded per node.
+    """
+    out = Resource.from_requests(allocatable)
+    if VOLUME_ATTACH not in out.resources:
+        out.resources[VOLUME_ATTACH] = DEFAULT_NODE_VOLUME_LIMIT
+    return out
 
 
 def equals(a: Optional[Resource], b: Optional[Resource]) -> bool:
