@@ -447,3 +447,53 @@ def test_group_limit_enforced():
     core.update_allocation(AllocationRequest(releases=[rel]))
     n = core.schedule_once()
     assert n == 1
+
+
+def test_group_limit_is_aggregate_across_members():
+    """A groups: limit caps the GROUP's total, not each member (ugm tracker
+    semantics) — two devs may not jointly exceed the 1-vcore group cap."""
+    cache, cb, core = make_core(nodes=2, node_cpu=16000, queues_yaml=USER_LIMIT_YAML)
+    for u in ("carol", "dave"):
+        core.update_application(ApplicationRequest(new=[
+            AddApplicationRequest(application_id=f"g-{u}", queue_name="root.grouplim",
+                                  user=UserGroupInfo(user=u, groups=["devs"]))]))
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("g-carol", f"c{i}", cpu=500, mem=2**20) for i in range(3)]
+             + [ask_of("g-dave", f"d{i}", cpu=500, mem=2**20) for i in range(3)]))
+    n = core.schedule_once()
+    assert n == 2  # 1 vcore total for the devs group, not per user
+    leaf = core.queues.resolve("root.grouplim", create=False)
+    assert leaf.group_allocated["devs"].get("cpu") == 1000
+
+
+def test_parent_queue_limit_enforced_across_cycles():
+    """Limits on an intermediate parent must count committed usage (not just
+    in-cycle overlays) — placements in later cycles respect earlier ones."""
+    yaml_text = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: spark
+            limits:
+              - users: ["*"]
+                maxresources: {vcore: 4}
+            queues:
+              - name: team-a
+              - name: team-b
+"""
+    cache, cb, core = make_core(nodes=2, node_cpu=16000, queues_yaml=yaml_text)
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="a", queue_name="root.spark.team-a",
+                              user=UserGroupInfo(user="eve"))]))
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="b", queue_name="root.spark.team-b",
+                              user=UserGroupInfo(user="eve"))]))
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("a", f"a{i}", cpu=1000, mem=2**20) for i in range(3)]))
+    assert core.schedule_once() == 3
+    # second cycle, other leaf under the same limited parent: only 1 more fits
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("b", f"b{i}", cpu=1000, mem=2**20) for i in range(3)]))
+    assert core.schedule_once() == 1

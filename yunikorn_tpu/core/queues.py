@@ -141,9 +141,13 @@ class Queue:
         self.allocated = Resource()
         self.pending = Resource()
         self.app_ids: set[str] = set()
-        # per-user accounting for LimitConfig enforcement
+        # per-user AND per-group accounting for LimitConfig enforcement
+        # (group limits cap the group's AGGREGATE usage, like yunikorn-core's
+        # ugm group tracker — not each member individually)
         self.user_allocated: Dict[str, Resource] = {}
         self.user_app_counts: Dict[str, int] = {}
+        self.group_allocated: Dict[str, Resource] = {}
+        self.group_app_counts: Dict[str, int] = {}
         self.config = config or QueueConfig(name=name)
 
     # ------------------------------------------------------------------ shape
@@ -194,50 +198,97 @@ class Queue:
                     return False
         return True
 
-    # ---------------------------------------------------------- user limits
-    def add_user_allocated(self, user: str, r: Resource) -> None:
+    # ---------------------------------------------------- user/group limits
+    def add_user_allocated(self, user: str, r: Resource, groups: List[str] = ()) -> None:
         for q in self.ancestors_and_self():
             q.user_allocated[user] = q.user_allocated.get(user, Resource()).add(r)
+            for g in groups:
+                q.group_allocated[g] = q.group_allocated.get(g, Resource()).add(r)
 
-    def remove_user_allocated(self, user: str, r: Resource) -> None:
+    def remove_user_allocated(self, user: str, r: Resource, groups: List[str] = ()) -> None:
         for q in self.ancestors_and_self():
             cur = q.user_allocated.get(user)
             if cur is not None:
                 q.user_allocated[user] = cur.sub(r)
+            for g in groups:
+                cur = q.group_allocated.get(g)
+                if cur is not None:
+                    q.group_allocated[g] = cur.sub(r)
 
     def fits_user_limit(self, user: str, groups: List[str], r: Resource,
-                        extra: Optional[Resource] = None) -> bool:
-        """Would allocating r for this user stay within every applicable
-        per-user limit up the chain?"""
+                        cycle_extra: Optional[Dict[str, Resource]] = None) -> bool:
+        """Would allocating r stay within every applicable limit up the chain?
+
+        User-list limits check the user's own usage; group-list limits check
+        the GROUP's aggregate usage. cycle_extra carries this cycle's not-yet-
+        committed admissions keyed by "<queue>|u|<user>" / "<queue>|g|<group>".
+        """
+        ce = cycle_extra or {}
         for q in self.ancestors_and_self():
             for lim in q.config.limits:
-                if lim.max_resources is None or not lim.applies_to(user, groups):
+                if lim.max_resources is None:
                     continue
-                used = q.user_allocated.get(user, Resource())
-                total = used.add(r) if extra is None else used.add(extra).add(r)
-                if not total.within_limit(lim.max_resources):
-                    return False
+                if "*" in lim.users or user in lim.users:
+                    used = q.user_allocated.get(user, Resource())
+                    extra = ce.get(f"{q.full_name}|u|{user}")
+                    total = used.add(r) if extra is None else used.add(extra).add(r)
+                    if not total.within_limit(lim.max_resources):
+                        return False
+                for g in groups:
+                    if g in lim.groups or "*" in lim.groups:
+                        used = q.group_allocated.get(g, Resource())
+                        extra = ce.get(f"{q.full_name}|g|{g}")
+                        total = used.add(r) if extra is None else used.add(extra).add(r)
+                        if not total.within_limit(lim.max_resources):
+                            return False
         return True
+
+    def record_cycle_admission(self, user: str, groups: List[str], r: Resource,
+                               cycle_extra: Dict[str, Resource]) -> None:
+        """Fold an in-cycle admission into cycle_extra for every limited
+        ancestor (so the cap holds across sibling leaves within one cycle)."""
+        for q in self.ancestors_and_self():
+            if not q.config.limits:
+                continue
+            key = f"{q.full_name}|u|{user}"
+            cycle_extra[key] = cycle_extra.get(key, Resource()).add(r)
+            for g in groups:
+                key = f"{q.full_name}|g|{g}"
+                cycle_extra[key] = cycle_extra.get(key, Resource()).add(r)
 
     def fits_user_app_limit(self, user: str, groups: List[str]) -> bool:
         """Can this user run one more application in this queue chain?"""
         for q in self.ancestors_and_self():
             for lim in q.config.limits:
-                if lim.max_applications <= 0 or not lim.applies_to(user, groups):
+                if lim.max_applications <= 0:
                     continue
-                if q.user_app_counts.get(user, 0) + 1 > lim.max_applications:
-                    return False
+                if "*" in lim.users or user in lim.users:
+                    if q.user_app_counts.get(user, 0) + 1 > lim.max_applications:
+                        return False
+                for g in groups:
+                    if g in lim.groups or "*" in lim.groups:
+                        if q.group_app_counts.get(g, 0) + 1 > lim.max_applications:
+                            return False
         return True
 
-    def add_user_app(self, user: str) -> None:
+    def add_user_app(self, user: str, groups: List[str] = ()) -> None:
         for q in self.ancestors_and_self():
             q.user_app_counts[user] = q.user_app_counts.get(user, 0) + 1
+            for g in groups:
+                q.group_app_counts[g] = q.group_app_counts.get(g, 0) + 1
 
-    def remove_user_app(self, user: str) -> None:
+    def remove_user_app(self, user: str, groups: List[str] = ()) -> None:
         for q in self.ancestors_and_self():
             n = q.user_app_counts.get(user, 0)
             if n > 0:
                 q.user_app_counts[user] = n - 1
+            for g in groups:
+                n = q.group_app_counts.get(g, 0)
+                if n > 0:
+                    q.group_app_counts[g] = n - 1
+
+    def has_limits_in_chain(self) -> bool:
+        return any(q.config.limits for q in self.ancestors_and_self())
 
     def dominant_share(self, cluster_capacity: Resource) -> float:
         """DRF dominant share: max over resources of allocated/denominator.
@@ -328,6 +379,16 @@ class QueueTree:
                 # app submitted to a parent queue: reject (reference behavior)
                 return None
             return q
+
+    def any_limits(self) -> bool:
+        """Does ANY queue in the tree configure limits (incl. parents)?"""
+        with self._lock:
+            def walk(q: Queue) -> bool:
+                if q.config.limits:
+                    return True
+                return any(walk(c) for c in q.children.values())
+
+            return walk(self.root)
 
     def leaves(self) -> List[Queue]:
         with self._lock:

@@ -224,7 +224,7 @@ class CoreScheduler(SchedulerAPI):
                         add.application_id, f"failed to place application: queue {add.queue_name!r} not usable"))
                     continue
                 user_groups = list(add.user.groups)
-                if not leaf.fits_user_app_limit(add.user.user, user_groups):
+                if self.queues.any_limits() and not leaf.fits_user_app_limit(add.user.user, user_groups):
                     resp.rejected.append(RejectedApplication(
                         add.application_id,
                         f"user {add.user.user} exceeds maxApplications in {leaf.full_name}"))
@@ -242,7 +242,7 @@ class CoreScheduler(SchedulerAPI):
                 )
                 self.partition.applications[add.application_id] = app
                 leaf.app_ids.add(add.application_id)
-                leaf.add_user_app(add.user.user)
+                leaf.add_user_app(add.user.user, list(add.user.groups))
                 resp.accepted.append(AcceptedApplication(add.application_id))
                 for alloc in self._pending_restores.pop(add.application_id, []):
                     self._restore_allocation(alloc)
@@ -260,10 +260,11 @@ class CoreScheduler(SchedulerAPI):
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.app_ids.discard(app_id)
-            leaf.remove_user_app(app.user.user)
+            leaf.remove_user_app(app.user.user, list(app.user.groups))
             for alloc in app.allocations.values():
                 leaf.remove_allocated(alloc.resource)
-                leaf.remove_user_allocated(app.user.user, alloc.resource)
+                leaf.remove_user_allocated(app.user.user, alloc.resource,
+                                           list(app.user.groups))
 
     def update_allocation(self, request: AllocationRequest) -> None:
         resp = AllocationResponse()
@@ -305,8 +306,9 @@ class CoreScheduler(SchedulerAPI):
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.add_allocated(alloc.resource)
-            if any(q.config.limits for q in leaf.ancestors_and_self()):
-                leaf.add_user_allocated(app.user.user, alloc.resource)
+            if leaf.has_limits_in_chain():
+                leaf.add_user_allocated(app.user.user, alloc.resource,
+                                        list(app.user.groups))
 
     def _track_foreign(self, alloc: Allocation) -> None:
         self.partition.foreign_allocations[alloc.allocation_key] = alloc
@@ -339,8 +341,9 @@ class CoreScheduler(SchedulerAPI):
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.remove_allocated(alloc.resource)
-            if any(q.config.limits for q in leaf.ancestors_and_self()):
-                leaf.remove_user_allocated(app.user.user, alloc.resource)
+            if leaf.has_limits_in_chain():
+                leaf.remove_user_allocated(app.user.user, alloc.resource,
+                                           list(app.user.groups))
         return AllocationRelease(
             application_id=release.application_id,
             allocation_key=release.allocation_key,
@@ -407,10 +410,9 @@ class CoreScheduler(SchedulerAPI):
                 # plain dict-of-int accumulators: Resource.add per alloc
                 # costs a dict copy each — at 50k allocs that is measurable
                 leaf_totals: Dict[str, Dict[str, int]] = {}
-                user_totals: Dict[Tuple[str, str], Dict[str, int]] = {}
-                limits_exist = any(
-                    q.config.limits for q in self.queues.leaves()
-                ) or any(q.config.limits for q in self.queues.root.ancestors_and_self())
+                # qname -> (user, groups-tuple) -> accumulator
+                user_totals: Dict[str, Dict[Tuple[str, tuple], Dict[str, int]]] = {}
+                limits_exist = self.queues.any_limits()
                 for i, ask in enumerate(admitted):
                     idx = int(assigned[i])
                     if idx < 0:
@@ -435,7 +437,8 @@ class CoreScheduler(SchedulerAPI):
                     for rk, rv in alloc.resource.resources.items():
                         acc[rk] = acc.get(rk, 0) + rv
                     if limits_exist:
-                        uacc = user_totals.setdefault((app.queue_name, app.user.user), {})
+                        uacc = user_totals.setdefault(app.queue_name, {}).setdefault(
+                            (app.user.user, tuple(app.user.groups)), {})
                         for rk, rv in alloc.resource.resources.items():
                             uacc[rk] = uacc.get(rk, 0) + rv
                     new_allocs.append(alloc)
@@ -443,10 +446,9 @@ class CoreScheduler(SchedulerAPI):
                     leaf = self.queues.resolve(qname, create=False)
                     if leaf is not None:
                         leaf.add_allocated(Resource(total))
-                        if limits_exist and any(q.config.limits for q in leaf.ancestors_and_self()):
-                            for (qn, user), ut in user_totals.items():
-                                if qn == qname:
-                                    leaf.add_user_allocated(user, Resource(ut))
+                        if limits_exist and leaf.has_limits_in_chain():
+                            for (user, groups), ut in user_totals.get(qname, {}).items():
+                                leaf.add_user_allocated(user, Resource(ut), list(groups))
             self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
             self.metrics["allocation_attempt_failed"] += len(skipped_keys)
             self.metrics["solve_count"] += 1
@@ -520,8 +522,9 @@ class CoreScheduler(SchedulerAPI):
             leaf = self.queues.resolve(app.queue_name, create=False)
             if leaf is not None:
                 leaf.add_allocated(alloc.resource)
-                if any(q.config.limits for q in leaf.ancestors_and_self()):
-                    leaf.add_user_allocated(app.user.user, alloc.resource)
+                if leaf.has_limits_in_chain():
+                    leaf.add_user_allocated(app.user.user, alloc.resource,
+                                            list(app.user.groups))
         return app
 
     def _cluster_capacity(self) -> Resource:
@@ -587,6 +590,10 @@ class CoreScheduler(SchedulerAPI):
         # in-cycle admissions accumulate per queue NODE (keyed by full name) so
         # sibling leaves cannot jointly blow through a shared parent's max
         cycle_extra: Dict[str, Resource] = {}
+        # user/group-limit overlay shared across ALL leaves this cycle (keys
+        # "<queue>|u|<user>" / "<queue>|g|<group>"), so sibling leaves under a
+        # limited parent are jointly capped
+        limit_cycle_extra: Dict[str, Resource] = {}
         for share, qname in queue_shares:
             leaf = self.queues.resolve(qname, create=False)
             entries = by_queue[qname]
@@ -600,20 +607,19 @@ class CoreScheduler(SchedulerAPI):
                 [q for q in leaf.ancestors_and_self() if q.config.max_resource is not None]
                 if leaf is not None else []
             )
-            has_limits = (leaf is not None
-                          and any(q.config.limits for q in leaf.ancestors_and_self()))
-            user_extra: Dict[str, Resource] = {}
+            has_limits = leaf is not None and leaf.has_limits_in_chain()
             for app, ask in entries:
                 if quota_chain and not _fits_quota_with(quota_chain, cycle_extra, ask.resource):
                     held += 1
                     continue
                 if has_limits:
-                    u = app.user.user
-                    if not leaf.fits_user_limit(u, list(app.user.groups), ask.resource,
-                                                extra=user_extra.get(u)):
+                    groups = list(app.user.groups)
+                    if not leaf.fits_user_limit(app.user.user, groups, ask.resource,
+                                                cycle_extra=limit_cycle_extra):
                         held += 1
                         continue
-                    user_extra[u] = user_extra.get(u, Resource()).add(ask.resource)
+                    leaf.record_cycle_admission(app.user.user, groups, ask.resource,
+                                                limit_cycle_extra)
                 for q in quota_chain:
                     cycle_extra[q.full_name] = cycle_extra.get(q.full_name, Resource()).add(ask.resource)
                 admitted.append(ask)

@@ -499,7 +499,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         policy=policy,
         # the fused kernel scores from the base vector only; soft taints
         # need the per-group penalty, so fall back to the XLA path then
-        use_pallas=use_pallas and not na.taints_soft.any(),
+        use_pallas=use_pallas and not na.has_soft_taints(),
         pallas_interpret=pallas_interpret,
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -125,6 +125,9 @@ class NodeArrays:
         self.ports = np.zeros((m, self._Wp), np.uint32)
         self.schedulable = np.zeros((m,), bool)
         self.valid = np.zeros((m,), bool)
+        # live nodes carrying PreferNoSchedule taints (gates the fused Pallas
+        # kernel without scanning the padded arrays per solve)
+        self._soft_taint_rows: set = getattr(self, "_soft_taint_rows", set())
 
     def ensure_padding(self) -> None:
         """Repad arrays after external vocab growth (e.g. during group encode)."""
@@ -229,6 +232,10 @@ class NodeArrays:
         self.taints_soft[idx] = 0
         for b in soft_bits:
             _set_bit(self.taints_soft[idx], b)
+        if soft_bits:
+            self._soft_taint_rows.add(idx)
+        else:
+            self._soft_taint_rows.discard(idx)
         self.ports[idx] = 0
         for b in port_bits:
             _set_bit(self.ports[idx], b)
@@ -245,11 +252,8 @@ class NodeArrays:
         rv = self.vocabs.resources
         avail = info.available().resources
         slots = [(rv.slot(n), v / rv.scale(n)) for n, v in avail.items()]
-        self._maybe_grow()
-        self.free[idx] = 0.0
-        for slot, val in slots:
-            self.free[idx, slot] = val
-        # host ports may change with pod churn too
+        # intern ALL symbols before _maybe_grow so a vocab word-boundary
+        # crossing repads the arrays before any bit is written
         port_bits = []
         for pod in info.pods.values():
             for c in pod.spec.containers:
@@ -257,6 +261,10 @@ class NodeArrays:
                     hp = p.get("hostPort")
                     if hp:
                         port_bits.append(self.vocabs.ports.bit(port_bit(p.get("protocol", "TCP"), hp)))
+        self._maybe_grow()
+        self.free[idx] = 0.0
+        for slot, val in slots:
+            self.free[idx, slot] = val
         self.ports[idx] = 0
         for b in port_bits:
             _set_bit(self.ports[idx], b)
@@ -270,6 +278,12 @@ class NodeArrays:
         self.valid[idx] = False
         self.schedulable[idx] = False
         self.free[idx] = 0.0
+        # clear symbol rows so freed slots never leak stale taints/labels
+        self.labels[idx] = 0
+        self.taints_hard[idx] = 0
+        self.taints_soft[idx] = 0
+        self.ports[idx] = 0
+        self._soft_taint_rows.discard(idx)
         self._free_rows.append(idx)
         self.version += 1
 
@@ -282,6 +296,9 @@ class NodeArrays:
     @property
     def num_nodes(self) -> int:
         return len(self._name_to_idx)
+
+    def has_soft_taints(self) -> bool:
+        return bool(self._soft_taint_rows)
 
 
 class SnapshotEncoder:
