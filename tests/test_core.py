@@ -497,3 +497,58 @@ partitions:
     core.update_allocation(AllocationRequest(
         asks=[ask_of("b", f"b{i}", cpu=1000, mem=2**20) for i in range(3)]))
     assert core.schedule_once() == 1
+
+
+def test_submit_acl_enforced():
+    yaml_text = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: open
+            submitacl: "*"
+          - name: secure
+            submitacl: "alice bleague"
+"""
+    cache, cb, core = make_core(queues_yaml=yaml_text)
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="ok1", queue_name="root.open",
+                              user=UserGroupInfo(user="anyone"))]))
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="ok2", queue_name="root.secure",
+                              user=UserGroupInfo(user="alice"))]))
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="denied", queue_name="root.secure",
+                              user=UserGroupInfo(user="bob", groups=["cleague"]))]))
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="grp", queue_name="root.secure",
+                              user=UserGroupInfo(user="carl", groups=["bleague", "league"]))]))
+    assert "ok1" in cb.accepted_apps
+    assert "ok2" in cb.accepted_apps
+    assert "grp" in cb.accepted_apps       # group membership grants
+    rejected = [a for a, _ in cb.rejected_apps]
+    assert "denied" in rejected            # wrong user, wrong groups
+
+
+def test_required_node_ask_bypasses_solver():
+    """DaemonSet semantics: an ask pinned via preferred_node allocates on
+    exactly that node (or stays pending when it cannot fit)."""
+    cache, cb, core = make_core(nodes=3, node_cpu=4000)
+    add_app(core, "ds-app")
+    pinned = ask_of("ds-app", "ds-pod", cpu=1000, mem=2**20)
+    pinned.preferred_node = "node-2"
+    core.update_allocation(AllocationRequest(asks=[pinned]))
+    core.schedule_once()
+    allocs = {a.allocation_key: a.node_id for a in cb.allocations}
+    assert allocs["ds-pod"] == "node-2"
+    # pinned to a full node: stays pending
+    filler = [ask_of("ds-app", f"f{i}", cpu=1000, mem=2**20) for i in range(12)]
+    core.update_allocation(AllocationRequest(asks=filler))
+    core.schedule_once()
+    stuck = ask_of("ds-app", "stuck", cpu=4000, mem=2**20)
+    stuck.preferred_node = "node-0"
+    core.update_allocation(AllocationRequest(asks=[stuck]))
+    core.schedule_once()
+    assert "stuck" not in {a.allocation_key for a in cb.allocations}
+    assert "stuck" in core.partition.get_application("ds-app").pending_asks

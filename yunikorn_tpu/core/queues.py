@@ -290,6 +290,28 @@ class Queue:
     def has_limits_in_chain(self) -> bool:
         return any(q.config.limits for q in self.ancestors_and_self())
 
+    # ------------------------------------------------------------------- ACLs
+    def submit_allowed(self, user: str, groups: List[str]) -> bool:
+        """submitacl semantics: "*" grants everyone; otherwise the value is
+        "user1,user2 group1,group2" (space-separated user list then group
+        list). ACLs are checked up the hierarchy — access granted by ANY
+        ancestor suffices. Chains that define no ACL at all allow submission
+        (dynamic-queue compatibility)."""
+        any_defined = False
+        for q in self.ancestors_and_self():
+            acl = q.config.submit_acl
+            if acl == "":
+                continue
+            any_defined = True
+            if acl.strip() == "*":
+                return True
+            parts = acl.split(" ")
+            users = [u for u in parts[0].split(",") if u] if parts else []
+            acl_groups = [g for g in parts[1].split(",") if g] if len(parts) > 1 else []
+            if user in users or any(g in acl_groups for g in groups):
+                return True
+        return not any_defined
+
     def dominant_share(self, cluster_capacity: Resource) -> float:
         """DRF dominant share: max over resources of allocated/denominator.
 

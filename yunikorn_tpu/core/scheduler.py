@@ -224,6 +224,11 @@ class CoreScheduler(SchedulerAPI):
                         add.application_id, f"failed to place application: queue {add.queue_name!r} not usable"))
                     continue
                 user_groups = list(add.user.groups)
+                if not leaf.submit_allowed(add.user.user, user_groups):
+                    resp.rejected.append(RejectedApplication(
+                        add.application_id,
+                        f"user {add.user.user} is not allowed to submit to {leaf.full_name}"))
+                    continue
                 if self.queues.any_limits() and not leaf.fits_user_app_limit(add.user.user, user_groups):
                     resp.rejected.append(RejectedApplication(
                         add.application_id,
@@ -389,6 +394,7 @@ class CoreScheduler(SchedulerAPI):
         with self._lock:
             self._check_placeholder_timeouts()
             replaced = self._replace_placeholders()
+            pinned = self._allocate_required_node_asks()
             admitted, ranks, held = self._collect_and_gate()
             new_allocs: List[Allocation] = []
             skipped_keys: List[Tuple[str, str]] = []
@@ -492,6 +498,8 @@ class CoreScheduler(SchedulerAPI):
                     self.metrics.get("preempted_total", 0) + len(preempt_releases))
 
         if self.callback is not None:
+            if pinned:
+                self.callback.update_allocation(AllocationResponse(new=pinned))
             if replaced.new or replaced.released:
                 self.callback.update_allocation(replaced)
             if new_allocs:
@@ -508,6 +516,40 @@ class CoreScheduler(SchedulerAPI):
                     )
                 )
         return len(new_allocs)
+
+    def _allocate_required_node_asks(self) -> List[Allocation]:
+        """DaemonSet-style asks pinned to one node (ask.preferred_node, the
+        SI RequiredNode semantics) bypass the batched solve: verify the pin
+        with the exact host predicates and allocate directly, like the core's
+        required-node path."""
+        from yunikorn_tpu.ops.host_predicates import pod_fits_node
+
+        out: List[Allocation] = []
+        for app in self.partition.applications.values():
+            if app.state not in (APP_ACCEPTED, APP_RUNNING, APP_RESUMING):
+                continue
+            for key, ask in list(app.pending_asks.items()):
+                if not ask.preferred_node or ask.pod is None:
+                    continue
+                info = self.cache.snapshot_node(ask.preferred_node)
+                if info is None:
+                    continue
+                overlay = Resource()
+                for infl in self._inflight.values():
+                    if infl.node_id == ask.preferred_node:
+                        overlay = overlay.add(infl.resource)
+                err = pod_fits_node(ask.pod, info.node,
+                                    info.available().sub(overlay), info.pods.values())
+                if err is not None:
+                    continue  # stays pending (preemption may free it later)
+                alloc = Allocation(
+                    allocation_key=key, application_id=app.application_id,
+                    node_id=ask.preferred_node, resource=ask.resource,
+                    priority=ask.priority, placeholder=ask.placeholder,
+                    task_group_name=ask.task_group_name, tags=dict(ask.tags))
+                self._commit_allocation(alloc)
+                out.append(alloc)
+        return out
 
     def _commit_allocation(self, alloc: Allocation, credit_queue: bool = True) -> CoreApplication:
         """Record one allocation. credit_queue=False lets the batched solve
