@@ -310,3 +310,25 @@ def test_soft_taint_tolerated_no_penalty():
     batch = enc.build_batch([ask_for(p2)])
     res = solve_batch(batch, enc.nodes)
     assert names_of(enc, res, batch)[p2.uid] == "clean"
+
+
+def test_preferred_node_affinity_scoring():
+    cache, enc = make_env([
+        make_node("ssd-node", labels={"disk": "ssd"}),
+        make_node("hdd-node", labels={"disk": "hdd"}),
+    ])
+    p = make_pod("wants-ssd", cpu_milli=100)
+    p.spec.affinity = Affinity(node_preferred_terms=[
+        (100, NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("disk", "In", ["ssd"])]))])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p.uid] == "ssd-node"
+    # NotIn preference pushes away
+    p2 = make_pod("avoids-hdd", cpu_milli=100)
+    p2.spec.affinity = Affinity(node_preferred_terms=[
+        (100, NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("disk", "NotIn", ["hdd"])]))])
+    batch = enc.build_batch([ask_for(p2)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p2.uid] == "ssd-node"

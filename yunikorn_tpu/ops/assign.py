@@ -44,7 +44,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from yunikorn_tpu.models.policies import alignment_scores, node_base_scores
-from yunikorn_tpu.ops.predicates import group_feasibility, group_soft_penalty
+from yunikorn_tpu.ops.predicates import group_feasibility, group_preferred_bonus, group_soft_penalty
 
 NEG_INF = jnp.float32(-3.0e38)
 
@@ -319,6 +319,7 @@ def solve(
     valid,          # [N] bool
     g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
     g_tol, g_ports,                                   # group tensors
+    g_pref_req, g_pref_forb, g_pref_weight,           # preferred-affinity scoring
     node_labels, node_taints, node_taints_soft, node_ports, node_ok,  # node symbol state
     free,           # [M, R] int32
     capacity,       # [M, R] int32
@@ -350,8 +351,10 @@ def solve(
     )
     if host_group_mask is not None:
         group_feas = group_feas & host_group_mask
-    # scoring half of TaintToleration: PreferNoSchedule taints penalize
-    group_soft = group_soft_penalty(g_tol, node_taints_soft)          # [G, M]
+    # scoring halves: PreferNoSchedule taints penalize, preferred node
+    # affinity terms reward — one [G, M] adjustment shared by the round paths
+    group_soft = group_soft_penalty(g_tol, node_taints_soft) + group_preferred_bonus(
+        g_pref_req, g_pref_forb, g_pref_weight, node_labels)          # [G, M]
 
     has_loc = loc is not None
     free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
@@ -485,6 +488,9 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         jnp.asarray(batch.g_anyof_valid),
         jnp.asarray(batch.g_tol.view(np.uint32)),
         jnp.asarray(batch.g_ports.view(np.uint32)),
+        jnp.asarray(batch.g_pref_req.view(np.uint32)),
+        jnp.asarray(batch.g_pref_forb.view(np.uint32)),
+        jnp.asarray(batch.g_pref_weight),
         jnp.asarray(na.labels.view(np.uint32)),
         jnp.asarray(na.taints_hard.view(np.uint32)),
         jnp.asarray(na.taints_soft.view(np.uint32)),
@@ -497,9 +503,11 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         max_rounds=max_rounds,
         chunk=chunk,
         policy=policy,
-        # the fused kernel scores from the base vector only; soft taints
-        # need the per-group penalty, so fall back to the XLA path then
-        use_pallas=use_pallas and not na.has_soft_taints(),
+        # the fused kernel scores from the base vector only; soft taints and
+        # preferred-affinity bonuses need the per-group adjustment, so fall
+        # back to the XLA path when either is present
+        use_pallas=(use_pallas and not na.has_soft_taints()
+                    and not batch.g_pref_weight.any()),
         pallas_interpret=pallas_interpret,
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

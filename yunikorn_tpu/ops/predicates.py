@@ -71,6 +71,28 @@ def group_feasibility(
     return sel_ok & ~taint_bad & ~port_bad & node_ok[None, :]
 
 
+def group_preferred_bonus(
+    g_pref_req,    # [G, P, W] uint32
+    g_pref_forb,   # [G, P, W] uint32
+    g_pref_weight, # [G, P] float32
+    node_labels,   # [M, W] uint32
+) -> jnp.ndarray:  # [G, M] float32
+    """preferredDuringSchedulingIgnoredDuringExecution scoring: each satisfied
+    weighted term adds weight/100 * 0.25 to the node's score for that group
+    (kube-scheduler normalizes weights to [0,100])."""
+    G, P, W = g_pref_req.shape
+    M = node_labels.shape[0]
+    bonus = jnp.zeros((G, M), jnp.float32)
+    for t in range(P):
+        ok = jnp.ones((G, M), bool)
+        for w in range(W):
+            nl = node_labels[:, w][None, :]
+            ok &= (g_pref_req[:, t, w][:, None] & ~nl) == 0
+            ok &= (g_pref_forb[:, t, w][:, None] & nl) == 0
+        bonus += jnp.where(ok, g_pref_weight[:, t][:, None] / 100.0 * 0.25, 0.0)
+    return bonus
+
+
 def group_soft_penalty(
     g_tol,             # [G, Wt] uint32
     node_taints_soft,  # [M, Wt] uint32 (PreferNoSchedule taints)

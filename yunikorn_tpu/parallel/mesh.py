@@ -83,6 +83,9 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         put(batch.g_anyof_valid, repl),
         put(batch.g_tol, repl),
         put(batch.g_ports, repl),
+        put(batch.g_pref_req, repl),
+        put(batch.g_pref_forb, repl),
+        put(batch.g_pref_weight, repl),
         put(na.labels, node_s2),
         put(na.taints_hard, node_s2),
         put(na.taints_soft, node_s2),

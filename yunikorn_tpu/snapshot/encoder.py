@@ -50,6 +50,7 @@ logger = log("shim.snapshot")
 
 MAX_TERMS = 8        # OR-terms per group (nodeSelector + affinity terms)
 MAX_ANYOF = 8        # multi-value In expressions per term
+MAX_PREF_TERMS = 4   # preferredDuringScheduling terms per group (scoring)
 
 
 from yunikorn_tpu.snapshot.vocab import _next_pow2 as _bucket
@@ -73,6 +74,9 @@ class GroupSpec:
     needs_host_eval: bool
     host_exprs: List[Tuple[str, str, str]]  # (key, op, value) Gt/Lt expressions
     taint_vocab_version: int
+    pref_req: Optional[np.ndarray] = None    # [P, W] u32 preferred-term bits
+    pref_forb: Optional[np.ndarray] = None   # [P, W] u32
+    pref_weight: Optional[np.ndarray] = None # [P] f32 (0 = unused slot)
 
 
 @dataclasses.dataclass
@@ -93,6 +97,9 @@ class PodBatch:
     g_anyof_valid: np.ndarray       # [G, T, E]
     g_tol: np.ndarray               # [G, Wt]
     g_ports: np.ndarray             # [G, Wp]
+    g_pref_req: np.ndarray          # [G, P, W] preferred-affinity bits
+    g_pref_forb: np.ndarray         # [G, P, W]
+    g_pref_weight: np.ndarray       # [G, P] f32
     g_host_mask: Optional[np.ndarray]  # [G, M] bool or None
     locality: Optional[object]         # snapshot.locality.LocalityBatch or None
     num_pods: int
@@ -369,6 +376,10 @@ class SnapshotEncoder:
 
     def _compute_group_signature(self, pod: Pod) -> tuple:
         sel = tuple(sorted(pod.spec.node_selector.items()))
+        pref = tuple(
+            (w, tuple((x.key, x.operator, tuple(x.values)) for x in t.match_expressions))
+            for w, t in (pod.spec.affinity.node_preferred_terms if pod.spec.affinity else [])
+        )
         tols = tuple(
             (t.key, t.operator, t.value, t.effect) for t in pod.spec.tolerations
         )
@@ -398,7 +409,7 @@ class SnapshotEncoder:
         from yunikorn_tpu.snapshot.locality import locality_signature
 
         loc_sig = locality_signature(pod, self.cache)
-        return (sel, tols, aff, ports, loc_sig)
+        return (sel, tols, aff, ports, pref, loc_sig)
 
     def _encode_group(self, pod: Pod) -> GroupSpec:
         W = self.vocabs.labels.num_words
@@ -469,6 +480,28 @@ class SnapshotEncoder:
                     else:
                         logger.warning("unsupported matchFields operator %s", e.operator)
 
+        # --- preferred node affinity (scoring): weighted single terms ---
+        pref_req = np.zeros((MAX_PREF_TERMS, W), np.uint32)
+        pref_forb = np.zeros((MAX_PREF_TERMS, W), np.uint32)
+        pref_weight = np.zeros((MAX_PREF_TERMS,), np.float32)
+        preferred = (pod.spec.affinity.node_preferred_terms
+                     if pod.spec.affinity else [])
+        for pi, (weight, pterm) in enumerate(preferred[:MAX_PREF_TERMS]):
+            pref_weight[pi] = float(weight)
+            for pe in pterm.match_expressions:
+                if pe.operator == "In" and len(pe.values) == 1:
+                    _set_bit(pref_req[pi], lv.bit(label_bit(pe.key, pe.values[0])))
+                elif pe.operator == "In":
+                    # any-of in a soft term approximated by the first value
+                    _set_bit(pref_req[pi], lv.bit(label_bit(pe.key, pe.values[0])))
+                elif pe.operator == "NotIn":
+                    for v in pe.values:
+                        _set_bit(pref_forb[pi], lv.bit(label_bit(pe.key, v)))
+                elif pe.operator == "Exists":
+                    _set_bit(pref_req[pi], lv.bit(label_key_bit(pe.key)))
+                elif pe.operator == "DoesNotExist":
+                    _set_bit(pref_forb[pi], lv.bit(label_key_bit(pe.key)))
+
         # --- tolerations (expand Exists against the current taint vocab) ---
         tol = np.zeros((Wt,), np.uint32)
         for t in pod.spec.tolerations:
@@ -513,6 +546,9 @@ class SnapshotEncoder:
             needs_host_eval=bool(host_exprs),
             host_exprs=host_exprs,
             taint_vocab_version=self.vocabs.taints.used_bits(),
+            pref_req=pref_req,
+            pref_forb=pref_forb,
+            pref_weight=pref_weight,
         )
 
     def _host_eval_mask(self, spec: GroupSpec) -> np.ndarray:
@@ -626,6 +662,9 @@ class SnapshotEncoder:
         g_anyof_valid = np.zeros((G, MAX_TERMS, MAX_ANYOF), bool)
         g_tol = np.zeros((G, Wt), np.uint32)
         g_ports = np.zeros((G, Wp), np.uint32)
+        g_pref_req = np.zeros((G, MAX_PREF_TERMS, W), np.uint32)
+        g_pref_forb = np.zeros((G, MAX_PREF_TERMS, W), np.uint32)
+        g_pref_weight = np.zeros((G, MAX_PREF_TERMS), np.float32)
         host_mask: Optional[np.ndarray] = None
         for gi, spec in enumerate(group_specs):
             T, Wg = spec.term_req.shape
@@ -636,6 +675,10 @@ class SnapshotEncoder:
             g_anyof_valid[gi, :T] = spec.anyof_valid
             g_tol[gi, : spec.tolerations.shape[0]] = spec.tolerations
             g_ports[gi, : spec.ports.shape[0]] = spec.ports
+            if spec.pref_req is not None:
+                g_pref_req[gi, :, : spec.pref_req.shape[1]] = spec.pref_req
+                g_pref_forb[gi, :, : spec.pref_forb.shape[1]] = spec.pref_forb
+                g_pref_weight[gi] = spec.pref_weight
             if spec.needs_host_eval:
                 if host_mask is None:
                     host_mask = np.ones((G, self.nodes.capacity), bool)
@@ -676,6 +719,9 @@ class SnapshotEncoder:
             g_anyof_valid=g_anyof_valid,
             g_tol=g_tol,
             g_ports=g_ports,
+            g_pref_req=g_pref_req,
+            g_pref_forb=g_pref_forb,
+            g_pref_weight=g_pref_weight,
             g_host_mask=host_mask,
             locality=locality,
             num_pods=n,
@@ -710,6 +756,9 @@ class SnapshotEncoder:
             needs_host_eval=False,
             host_exprs=[],
             taint_vocab_version=self.vocabs.taints.used_bits(),
+            pref_req=np.zeros((MAX_PREF_TERMS, W), np.uint32),
+            pref_forb=np.zeros((MAX_PREF_TERMS, W), np.uint32),
+            pref_weight=np.zeros((MAX_PREF_TERMS,), np.float32),
         )
         spec.term_valid[0] = True
         return spec
