@@ -552,3 +552,30 @@ def test_required_node_ask_bypasses_solver():
     core.schedule_once()
     assert "stuck" not in {a.allocation_key for a in cb.allocations}
     assert "stuck" in core.partition.get_application("ds-app").pending_asks
+
+
+def test_priority_offset_and_fence():
+    yaml_text = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: boosted
+            properties: {"priority.offset": "100"}
+          - name: fenced
+            properties: {"priority.offset": "5", "priority.policy": "fence"}
+"""
+    cache, cb, core = make_core(nodes=1, node_cpu=1000, queues_yaml=yaml_text)
+    boosted = core.queues.resolve("root.boosted", create=False)
+    fenced = core.queues.resolve("root.fenced", create=False)
+    assert boosted.priority_adjustment() == 100
+    assert fenced.priority_adjustment() == 5  # fence stops above itself
+    # within the boosted queue, adjusted priority orders asks the same way
+    add_app(core, "b-app", "root.boosted")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("b-app", "low", cpu=1000, priority=0),
+        ask_of("b-app", "high", cpu=1000, priority=50),
+    ]))
+    core.schedule_once()
+    assert [a.allocation_key for a in cb.allocations] == ["high"]

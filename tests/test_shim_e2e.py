@@ -373,3 +373,21 @@ def test_node_volume_attach_limit(sched):
     time.sleep(0.4)
     bound = [p for p in pods if sched.get_pod_assignment(p)]
     assert len(bound) == 2  # attach limit 2 caps the third
+
+
+def test_app_completes_when_all_tasks_done(sched):
+    """Core completes idle Running apps (Completing→Completed) and the shim
+    garbage-collects them (reference app lifecycle end)."""
+    sched.core._completing_timeout = 0.3
+    sched.add_node(make_node("node-1", cpu_milli=4000))
+    p = sched.add_pod(yk_pod("one-shot", app_id="done-app"))
+    sched.wait_for_task_state("done-app", p.uid, task_mod.BOUND)
+    sched.succeed_pod(p)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        app = sched.context.get_application("done-app")
+        if app is None:  # completed AND garbage-collected
+            break
+        time.sleep(0.05)
+    assert sched.context.get_application("done-app") is None
+    assert sched.core.partition.get_application("done-app") is None

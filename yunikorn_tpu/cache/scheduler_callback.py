@@ -97,6 +97,9 @@ class AsyncRMCallback(ResourceManagerCallback):
             elif upd.state == "Failing":
                 dispatch_mod.dispatch(AppEventRecord(
                     upd.application_id, app_mod.FAIL_APPLICATION, (upd.message,)))
+            elif upd.state == "Completed" and app.state == app_mod.RUNNING:
+                dispatch_mod.dispatch(AppEventRecord(
+                    upd.application_id, app_mod.COMPLETE_APPLICATION))
 
     # ------------------------------------------------------------------ nodes
     def update_node(self, response: NodeResponse) -> None:

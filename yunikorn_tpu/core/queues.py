@@ -290,6 +290,21 @@ class Queue:
     def has_limits_in_chain(self) -> bool:
         return any(q.config.limits for q in self.ancestors_and_self())
 
+    def priority_adjustment(self) -> int:
+        """Queue priority offsets summed up the chain; a queue with
+        priority.policy: fence stops propagation of offsets ABOVE it
+        (yunikorn-core priority fence semantics)."""
+        total = 0
+        for q in self.ancestors_and_self():
+            props = q.config.properties
+            try:
+                total += int(props.get("priority.offset", "0") or 0)
+            except ValueError:
+                pass
+            if props.get("priority.policy", "").lower() == "fence":
+                break
+        return total
+
     # ------------------------------------------------------------------- ACLs
     def submit_allowed(self, user: str, groups: List[str]) -> bool:
         """submitacl semantics: "*" grants everyone; otherwise the value is

@@ -75,6 +75,7 @@ from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
 logger = log("core.scheduler")
 
 DEFAULT_PLACEHOLDER_TIMEOUT = 15 * 60.0  # core default when the app sets none
+COMPLETING_TIMEOUT = 30.0  # Running app with nothing left → Completed after this
 
 
 class CoreScheduler(SchedulerAPI):
@@ -107,6 +108,8 @@ class CoreScheduler(SchedulerAPI):
         # asks we already preempted for → timestamp; prevents stacking fresh
         # victims every cycle while the previous evictions drain
         self._preempted_for: Dict[str, float] = {}
+        self._completing_since: Dict[str, float] = {}
+        self._completing_timeout = COMPLETING_TIMEOUT
         self._running = threading.Event()
         self._wake = threading.Condition()
         self._dirty = False
@@ -259,6 +262,7 @@ class CoreScheduler(SchedulerAPI):
 
     def _remove_application(self, app_id: str) -> None:
         self._pending_restores.pop(app_id, None)
+        self._completing_since.pop(app_id, None)
         app = self.partition.applications.pop(app_id, None)
         if app is None:
             return
@@ -392,6 +396,7 @@ class CoreScheduler(SchedulerAPI):
         """One full scheduling cycle. Returns the number of new allocations."""
         t0 = time.time()
         with self._lock:
+            self._check_app_completion()
             self._check_placeholder_timeouts()
             replaced = self._replace_placeholders()
             pinned = self._allocate_required_node_asks()
@@ -639,8 +644,9 @@ class CoreScheduler(SchedulerAPI):
         for share, qname in queue_shares:
             leaf = self.queues.resolve(qname, create=False)
             entries = by_queue[qname]
+            prio_adj = leaf.priority_adjustment() if leaf is not None else 0
             entries.sort(key=lambda e: (
-                -(e[1].priority or 0),
+                -((e[1].priority or 0) + prio_adj),
                 e[0].submit_time,
                 e[1].seq,
             ))
@@ -715,6 +721,32 @@ class CoreScheduler(SchedulerAPI):
                 self._commit_allocation(alloc)
                 resp.new.append(alloc)
         return resp
+
+    def _check_app_completion(self) -> None:
+        """Running apps with no allocations and no pending asks complete after
+        a grace period (yunikorn-core Completing→Completed transition); the
+        shim is notified through an application status update."""
+        now = time.time()
+        updates: List[UpdatedApplication] = []
+        for app in self.partition.applications.values():
+            if app.state not in (APP_RUNNING, APP_COMPLETING):
+                continue
+            if app.allocations or app.pending_asks:
+                self._completing_since.pop(app.application_id, None)
+                if app.state == APP_COMPLETING:
+                    app.state = APP_RUNNING
+                continue
+            since = self._completing_since.setdefault(app.application_id, now)
+            if app.state == APP_RUNNING:
+                app.state = APP_COMPLETING
+            if now - since >= self._completing_timeout:
+                app.state = APP_COMPLETED
+                self._completing_since.pop(app.application_id, None)
+                updates.append(UpdatedApplication(
+                    application_id=app.application_id, state="Completed",
+                    message="application completed"))
+        if updates and self.callback is not None:
+            self.callback.update_application(ApplicationResponse(updated=updates))
 
     def _check_placeholder_timeouts(self) -> None:
         """Placeholder timeout → release placeholders + app Resuming/Failing."""

@@ -97,8 +97,9 @@ class KubernetesShim:
                              app_mod.RESERVING, app_mod.RESUMING):
                 app.schedule()
                 outstanding += 1
-            elif app.state == app_mod.FAILED and app.are_all_tasks_terminated():
-                # garbage-collect failed apps once every task terminated
+            elif app.state in (app_mod.FAILED, app_mod.COMPLETED) \
+                    and app.are_all_tasks_terminated():
+                # garbage-collect terminal apps once every task terminated
                 self.context.remove_application(app.application_id)
         self.outstanding_apps_logged = outstanding
 
