@@ -579,3 +579,48 @@ partitions:
     ]))
     core.schedule_once()
     assert [a.allocation_key for a in cb.allocations] == ["high"]
+
+
+def test_priority_offset_boosts_across_queues():
+    """The offset must matter ACROSS queues: a boosted queue's asks win
+    scarce capacity over a plain queue's equal-priority asks."""
+    yaml_text = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: boosted
+            properties: {"priority.offset": "100"}
+          - name: normal
+"""
+    cache, cb, core = make_core(nodes=1, node_cpu=1000, queues_yaml=yaml_text)
+    add_app(core, "n-app", "root.normal")
+    add_app(core, "b-app", "root.boosted")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("n-app", "n0", cpu=1000, priority=0),
+        ask_of("b-app", "b0", cpu=1000, priority=0),
+    ]))
+    core.schedule_once()
+    assert [a.allocation_key for a in cb.allocations] == ["b0"]
+
+
+def test_resuming_app_completes():
+    """A Soft-gang app that resumed (placeholders timed out) and finished its
+    real work must complete, not leak (review regression)."""
+    cache, cb, core = make_core()
+    core._completing_timeout = 0.05
+    add_app(core, "res-app", gang_scheduling_style="Soft", execution_timeout_seconds=0.05)
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("res-app", "ph-0", cpu=1000, placeholder=True, task_group_name="tg")]))
+    core.schedule_once()
+    time.sleep(0.15)
+    core.schedule_once()  # timeout fires → Resuming, placeholders released
+    app = core.partition.get_application("res-app")
+    assert app.state == "Resuming"
+    time.sleep(0.1)
+    core.schedule_once()  # nothing left → Completing → Completed
+    time.sleep(0.1)
+    core.schedule_once()
+    completed = [u for u in cb.updated_apps if u.state == "Completed"]
+    assert completed and completed[0].application_id == "res-app"

@@ -97,9 +97,16 @@ class AsyncRMCallback(ResourceManagerCallback):
             elif upd.state == "Failing":
                 dispatch_mod.dispatch(AppEventRecord(
                     upd.application_id, app_mod.FAIL_APPLICATION, (upd.message,)))
-            elif upd.state == "Completed" and app.state == app_mod.RUNNING:
-                dispatch_mod.dispatch(AppEventRecord(
-                    upd.application_id, app_mod.COMPLETE_APPLICATION))
+            elif upd.state == "Completed":
+                # the core's Completed notice is one-shot; drive the shim FSM
+                # to Running first when needed so the completion always lands
+                if app.state in (app_mod.ACCEPTED, app_mod.RESERVING, app_mod.RESUMING):
+                    dispatch_mod.dispatch(AppEventRecord(
+                        upd.application_id, app_mod.RUN_APPLICATION))
+                if app.state in (app_mod.RUNNING, app_mod.ACCEPTED,
+                                 app_mod.RESERVING, app_mod.RESUMING):
+                    dispatch_mod.dispatch(AppEventRecord(
+                        upd.application_id, app_mod.COMPLETE_APPLICATION))
 
     # ------------------------------------------------------------------ nodes
     def update_node(self, response: NodeResponse) -> None:

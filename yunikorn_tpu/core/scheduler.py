@@ -563,7 +563,7 @@ class CoreScheduler(SchedulerAPI):
         app.allocations[alloc.allocation_key] = alloc
         app.pending_asks.pop(alloc.allocation_key, None)
         self._inflight[alloc.allocation_key] = alloc
-        if app.state == APP_ACCEPTED:
+        if app.state in (APP_ACCEPTED, APP_RESUMING):
             app.state = APP_RUNNING
         if credit_queue:
             leaf = self.queues.resolve(app.queue_name, create=False)
@@ -626,10 +626,15 @@ class CoreScheduler(SchedulerAPI):
                 by_queue.setdefault(app.queue_name, []).append((app, ask))
 
         queue_shares = []
+        adj_of: Dict[str, int] = {}
         for qname in by_queue:
             leaf = self.queues.resolve(qname, create=False)
             share = leaf.dominant_share(cluster_cap) if leaf else 0.0
-            queue_shares.append((share, qname))
+            adj = leaf.priority_adjustment() if leaf else 0
+            adj_of[qname] = adj
+            best_prio = max(((e[1].priority or 0) + adj) for e in by_queue[qname])
+            # cross-queue: highest adjusted priority first, then fair share
+            queue_shares.append((-best_prio, share, qname))
         queue_shares.sort()
 
         admitted: List[object] = []
@@ -641,10 +646,10 @@ class CoreScheduler(SchedulerAPI):
         # "<queue>|u|<user>" / "<queue>|g|<group>"), so sibling leaves under a
         # limited parent are jointly capped
         limit_cycle_extra: Dict[str, Resource] = {}
-        for share, qname in queue_shares:
+        for _neg_prio, share, qname in queue_shares:
             leaf = self.queues.resolve(qname, create=False)
             entries = by_queue[qname]
-            prio_adj = leaf.priority_adjustment() if leaf is not None else 0
+            prio_adj = adj_of.get(qname, 0)
             entries.sort(key=lambda e: (
                 -((e[1].priority or 0) + prio_adj),
                 e[0].submit_time,
@@ -729,7 +734,7 @@ class CoreScheduler(SchedulerAPI):
         now = time.time()
         updates: List[UpdatedApplication] = []
         for app in self.partition.applications.values():
-            if app.state not in (APP_RUNNING, APP_COMPLETING):
+            if app.state not in (APP_RUNNING, APP_COMPLETING, APP_RESUMING):
                 continue
             if app.allocations or app.pending_asks:
                 self._completing_since.pop(app.application_id, None)

@@ -486,6 +486,9 @@ class SnapshotEncoder:
         pref_weight = np.zeros((MAX_PREF_TERMS,), np.float32)
         preferred = (pod.spec.affinity.node_preferred_terms
                      if pod.spec.affinity else [])
+        if len(preferred) > MAX_PREF_TERMS:
+            logger.warning("pod %s has %d preferred affinity terms; scoring only "
+                           "the first %d", pod.key(), len(preferred), MAX_PREF_TERMS)
         for pi, (weight, pterm) in enumerate(preferred[:MAX_PREF_TERMS]):
             pref_weight[pi] = float(weight)
             for pe in pterm.match_expressions:
