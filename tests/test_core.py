@@ -624,3 +624,47 @@ def test_resuming_app_completes():
     core.schedule_once()
     completed = [u for u in cb.updated_apps if u.state == "Completed"]
     assert completed and completed[0].application_id == "res-app"
+
+
+def test_placement_rules_and_namespace_quota():
+    import json as _json
+
+    from yunikorn_tpu.common import constants as C
+
+    cache, cb, core = make_core(nodes=2, node_cpu=16000)
+    # no queue provided; namespace tag + parent-queue tag place the app
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(
+            application_id="placed", queue_name="",
+            user=UserGroupInfo(user="u"),
+            tags={C.APP_TAG_NAMESPACE: "team1",
+                  C.APP_TAG_NAMESPACE_PARENT_QUEUE: "eng",
+                  C.NAMESPACE_QUOTA: _json.dumps({"cpu": "2", "memory": "4Gi"}),
+                  C.NAMESPACE_MAX_APPS: "1"})]))
+    assert "placed" in cb.accepted_apps
+    app = core.partition.get_application("placed")
+    assert app.queue_name == "root.eng.team1"
+    leaf = core.queues.resolve("root.eng.team1", create=False)
+    assert leaf.config.max_resource.get("cpu") == 2000
+    # namespace quota enforced on allocations
+    core.update_allocation(AllocationRequest(
+        asks=[ask_of("placed", f"p{i}", cpu=1000, mem=2**20) for i in range(4)]))
+    assert core.schedule_once() == 2
+    # namespace.maxApps: second app in the same queue rejected
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(
+            application_id="too-many", queue_name="",
+            user=UserGroupInfo(user="u"),
+            tags={C.APP_TAG_NAMESPACE: "team1",
+                  C.APP_TAG_NAMESPACE_PARENT_QUEUE: "eng"})]))
+    rejected = [a for a, _ in cb.rejected_apps]
+    assert "too-many" in rejected
+
+
+def test_default_namespace_placement():
+    cache, cb, core = make_core()
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="ns-app", queue_name="",
+                              user=UserGroupInfo(user="u"),
+                              tags={"namespace": "batch"})]))
+    assert core.partition.get_application("ns-app").queue_name == "root.batch"

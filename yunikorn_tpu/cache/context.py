@@ -118,6 +118,7 @@ class Context:
         self.volume_binder = VolumeBinder(api_provider)
         self._apps: Dict[str, Application] = {}
         self._pvcs: Dict[str, object] = {}
+        self._namespaces: Dict[str, Dict[str, str]] = {}
         # foreign pods already reported to the core: uid -> (node, resource)
         self._foreign_sent: Dict[str, tuple] = {}
         self._lock = threading.RLock()
@@ -146,6 +147,10 @@ class Context:
         self.api_provider.add_event_handler(InformerType.PVC, ResourceEventHandlers(
             add_fn=self._on_pvc, update_fn=lambda old, new: self._on_pvc(new),
             delete_fn=self._on_pvc_deleted))
+        self.api_provider.add_event_handler(InformerType.NAMESPACE, ResourceEventHandlers(
+            add_fn=self._on_namespace,
+            update_fn=lambda old, new: self._on_namespace(new),
+            delete_fn=self._on_namespace_deleted))
 
     # ----------------------------------------------------------------- nodes
     def add_node(self, node: Node) -> None:
@@ -272,6 +277,15 @@ class Context:
         app_meta = get_app_metadata(pod, self.conf.generate_unique_app_ids)
         if app_meta is None:
             return
+        ns_anns = self.namespace_annotations(pod.namespace)
+        if ns_anns:
+            for key in (constants.NAMESPACE_QUOTA, constants.NAMESPACE_GUARANTEED,
+                        constants.NAMESPACE_MAX_APPS):
+                if key in ns_anns:
+                    app_meta.tags[key] = ns_anns[key]
+            parent = ns_anns.get(constants.ANNOTATION_PARENT_QUEUE)
+            if parent and constants.APP_TAG_NAMESPACE_PARENT_QUEUE not in app_meta.tags:
+                app_meta.tags[constants.APP_TAG_NAMESPACE_PARENT_QUEUE] = parent
         with self._lock:
             app = self._apps.get(app_meta.application_id)
             if app is None:
@@ -326,6 +340,26 @@ class Context:
     def bind_pod_volumes(self, pod: Pod) -> None:
         if not self.schedulers_cache.are_pod_volumes_all_bound(pod.uid):
             self.volume_binder.bind_pod_volumes(pod)
+
+    def _on_namespace(self, ns) -> None:
+        with self._lock:
+            self._namespaces[ns.metadata.name] = dict(ns.metadata.annotations)
+
+    def _on_namespace_deleted(self, ns) -> None:
+        with self._lock:
+            self._namespaces.pop(ns.metadata.name, None)
+
+    def namespace_annotations(self, name: str) -> Dict[str, str]:
+        with self._lock:
+            anns = self._namespaces.get(name)
+        if anns is not None:
+            return anns
+        get = getattr(self.api_provider, "get_namespace", None)
+        if get is not None:
+            ns = get(name)
+            if ns is not None:
+                return dict(ns.metadata.annotations)
+        return {}
 
     def _on_pvc(self, pvc) -> None:
         with self._lock:

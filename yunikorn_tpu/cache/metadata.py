@@ -180,7 +180,7 @@ def get_app_metadata(pod: Pod, generate_unique: bool = False) -> Optional[Applic
         tags[constants.APP_TAG_NAMESPACE_PARENT_QUEUE] = parent_queue
     return ApplicationMetadata(
         application_id=get_application_id(pod, generate_unique),
-        queue_name=get_queue_name(pod) or f"{constants.ROOT_QUEUE}.{pod.namespace}",
+        queue_name=get_queue_name(pod),  # empty → the core's placement rules decide
         user=get_user_groups(pod),
         tags=tags,
         task_groups=parse_task_groups(pod),

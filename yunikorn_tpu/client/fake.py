@@ -24,6 +24,7 @@ from yunikorn_tpu.client.interfaces import (
 )
 from yunikorn_tpu.common.objects import (
     ConfigMap,
+    Namespace,
     Node,
     PersistentVolumeClaim,
     Pod,
@@ -113,6 +114,7 @@ class FakeCluster(APIProvider):
         self._configmaps: Dict[str, ConfigMap] = {}
         self._priority_classes: Dict[str, PriorityClass] = {}
         self._pvcs: Dict[str, PersistentVolumeClaim] = {}
+        self._namespaces: Dict[str, Namespace] = {}
         self._handlers: Dict[InformerType, List[ResourceEventHandlers]] = {}
         self._client = FakeKubeClient(self)
         self._started = False
@@ -243,6 +245,15 @@ class FakeCluster(APIProvider):
         with self._lock:
             return self._configmaps.get(f"{namespace}/{name}")
 
+    def add_namespace(self, ns: Namespace) -> None:
+        with self._lock:
+            self._namespaces[ns.metadata.name] = ns
+        self._fire(InformerType.NAMESPACE, "add", ns)
+
+    def get_namespace(self, name: str) -> Optional[Namespace]:
+        with self._lock:
+            return self._namespaces.get(name)
+
     def add_pvc(self, pvc: PersistentVolumeClaim) -> None:
         with self._lock:
             self._pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
@@ -290,6 +301,8 @@ class FakeCluster(APIProvider):
             return list(self._priority_classes.values())
         if informer == InformerType.PVC:
             return list(self._pvcs.values())
+        if informer == InformerType.NAMESPACE:
+            return list(self._namespaces.values())
         return []
 
     def _fire(self, informer: InformerType, kind: str, obj, old=None) -> None:

@@ -1,0 +1,82 @@
+"""Placement rules: resolve an application's leaf queue.
+
+Role-equivalent to yunikorn-core's placement-rule chain (the reference shim
+feeds it queue names plus namespace tags — context.go:922-1023 adds namespace
+quota/parent-queue tags; utils.go:102-118 resolves provided queue names). The
+default chain matches the reference deployment's behavior:
+
+  1. provided      — the queue the workload named (labels/annotations)
+  2. tag namespace — root.<namespace>, optionally nested under the namespace's
+                     parent-queue annotation (yunikorn.apache.org/parentqueue)
+
+Namespace quota/guaranteed annotations (yunikorn.apache.org/namespace.quota /
+.guaranteed, JSON resource maps) become the dynamic namespace queue's limits,
+exactly the reference's namespace-quota mechanism.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.common.si import AddApplicationRequest
+from yunikorn_tpu.log.logger import log
+
+logger = log("core.scheduler")
+
+
+def place_application(add: AddApplicationRequest) -> str:
+    """Return the full queue name for an application (may not exist yet)."""
+    if add.queue_name:
+        return add.queue_name
+    namespace = add.tags.get(constants.APP_TAG_NAMESPACE, constants.DEFAULT_APP_NAMESPACE)
+    parent = add.tags.get(constants.APP_TAG_NAMESPACE_PARENT_QUEUE, "")
+    if parent:
+        if not parent.startswith(constants.ROOT_QUEUE):
+            parent = f"{constants.ROOT_QUEUE}.{parent}"
+        return f"{parent}.{namespace}"
+    return f"{constants.ROOT_QUEUE}.{namespace}"
+
+
+def _parse_quota_json(raw: str) -> Optional[Resource]:
+    try:
+        data = json.loads(raw)
+    except json.JSONDecodeError:
+        logger.warning("invalid namespace quota annotation: %r", raw)
+        return None
+    out = {}
+    for k, v in data.items():
+        from yunikorn_tpu.common.resource import parse_quantity
+
+        if k in ("cpu", "vcore"):
+            out["cpu"] = parse_quantity(v, as_milli=True)
+        else:
+            out[k] = parse_quantity(v)
+    return Resource(out)
+
+
+def apply_namespace_quota(leaf, add: AddApplicationRequest) -> None:
+    """Namespace quota annotations → dynamic queue limits (reference
+    context.go:922-1023 / constants NamespaceQuota, NamespaceGuaranteed,
+    NamespaceMaxApps). Only dynamic (placement-created) queues are adjusted —
+    statically configured queues keep their yaml limits.
+    """
+    if not leaf.dynamic:
+        return
+    quota = add.tags.get(constants.NAMESPACE_QUOTA)
+    if quota:
+        r = _parse_quota_json(quota)
+        if r is not None:
+            leaf.config.max_resource = r
+    guaranteed = add.tags.get(constants.NAMESPACE_GUARANTEED)
+    if guaranteed:
+        r = _parse_quota_json(guaranteed)
+        if r is not None:
+            leaf.config.guaranteed = r
+    max_apps = add.tags.get(constants.NAMESPACE_MAX_APPS)
+    if max_apps:
+        try:
+            leaf.config.max_applications = int(max_apps)
+        except ValueError:
+            logger.warning("invalid namespace.maxApps annotation: %r", max_apps)

@@ -406,10 +406,14 @@ class QueueTree:
                 if child is None:
                     if not create:
                         return None
-                    if q.is_leaf and q is not self.root:
+                    if q.is_leaf and q is not self.root and not q.dynamic:
+                        # static leaves stay leaves; dynamic intermediates may
+                        # grow children (placement creates whole chains)
                         logger.warning("cannot create %s under leaf queue %s", part, q.full_name)
                         return None
                     child = Queue(part, q, dynamic=True)
+                    if i < len(parts) - 1:
+                        child.config.parent = True  # dynamic intermediate
                     q.children[part] = child
                 q = child
             if not q.is_leaf:

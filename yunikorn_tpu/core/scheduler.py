@@ -221,10 +221,19 @@ class CoreScheduler(SchedulerAPI):
                     # idempotent: re-acknowledge so the shim FSM can progress
                     resp.accepted.append(AcceptedApplication(add.application_id))
                     continue
-                leaf = self.queues.resolve(add.queue_name)
+                from yunikorn_tpu.core.placement import apply_namespace_quota, place_application
+
+                placed_name = place_application(add)
+                leaf = self.queues.resolve(placed_name)
                 if leaf is None:
                     resp.rejected.append(RejectedApplication(
-                        add.application_id, f"failed to place application: queue {add.queue_name!r} not usable"))
+                        add.application_id, f"failed to place application: queue {placed_name!r} not usable"))
+                    continue
+                apply_namespace_quota(leaf, add)
+                if any(q.config.max_applications and len(q.app_ids) >= q.config.max_applications
+                       for q in leaf.ancestors_and_self()):
+                    resp.rejected.append(RejectedApplication(
+                        add.application_id, f"queue {leaf.full_name} is at maxApplications"))
                     continue
                 user_groups = list(add.user.groups)
                 if not leaf.submit_allowed(add.user.user, user_groups):
