@@ -21,9 +21,10 @@ from typing import Optional
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.resource import Resource
 from yunikorn_tpu.common.si import AddApplicationRequest
+from yunikorn_tpu.core.queues import _parse_res_map
 from yunikorn_tpu.log.logger import log
 
-logger = log("core.scheduler")
+logger = log("core.placement")
 
 
 def place_application(add: AddApplicationRequest) -> str:
@@ -40,20 +41,17 @@ def place_application(add: AddApplicationRequest) -> str:
 
 
 def _parse_quota_json(raw: str) -> Optional[Resource]:
+    """JSON resource map → Resource via the same parser queues.yaml uses;
+    malformed annotations are ignored with a warning, never raised (this runs
+    inside the core's submission path)."""
     try:
         data = json.loads(raw)
-    except json.JSONDecodeError:
-        logger.warning("invalid namespace quota annotation: %r", raw)
+        if not isinstance(data, dict):
+            raise ValueError("not an object")
+        return _parse_res_map(data)
+    except (json.JSONDecodeError, ValueError, TypeError) as e:
+        logger.warning("invalid namespace quota annotation %r: %s", raw, e)
         return None
-    out = {}
-    for k, v in data.items():
-        from yunikorn_tpu.common.resource import parse_quantity
-
-        if k in ("cpu", "vcore"):
-            out["cpu"] = parse_quantity(v, as_milli=True)
-        else:
-            out[k] = parse_quantity(v)
-    return Resource(out)
 
 
 def apply_namespace_quota(leaf, add: AddApplicationRequest) -> None:

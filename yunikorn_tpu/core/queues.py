@@ -287,6 +287,14 @@ class Queue:
                 if n > 0:
                     q.group_app_counts[g] = n - 1
 
+    def subtree_app_count(self) -> int:
+        """Applications in this queue's subtree (parents enforce
+        maxApplications over all descendants)."""
+        total = len(self.app_ids)
+        for child in self.children.values():
+            total += child.subtree_app_count()
+        return total
+
     def has_limits_in_chain(self) -> bool:
         return any(q.config.limits for q in self.ancestors_and_self())
 
@@ -406,9 +414,11 @@ class QueueTree:
                 if child is None:
                     if not create:
                         return None
-                    if q.is_leaf and q is not self.root and not q.dynamic:
-                        # static leaves stay leaves; dynamic intermediates may
-                        # grow children (placement creates whole chains)
+                    if q.is_leaf and q is not self.root and (
+                            not q.dynamic or q.app_ids or not q.allocated.is_zero()):
+                        # static leaves stay leaves; an EMPTY dynamic leaf may
+                        # become an intermediate (placement creates chains),
+                        # but never one already hosting apps/allocations
                         logger.warning("cannot create %s under leaf queue %s", part, q.full_name)
                         return None
                     child = Queue(part, q, dynamic=True)

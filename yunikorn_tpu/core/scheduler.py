@@ -230,7 +230,7 @@ class CoreScheduler(SchedulerAPI):
                         add.application_id, f"failed to place application: queue {placed_name!r} not usable"))
                     continue
                 apply_namespace_quota(leaf, add)
-                if any(q.config.max_applications and len(q.app_ids) >= q.config.max_applications
+                if any(q.config.max_applications and q.subtree_app_count() >= q.config.max_applications
                        for q in leaf.ancestors_and_self()):
                     resp.rejected.append(RejectedApplication(
                         add.application_id, f"queue {leaf.full_name} is at maxApplications"))

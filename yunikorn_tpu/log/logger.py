@@ -25,6 +25,7 @@ HANDLES = [
     "core",
     "core.config",
     "core.scheduler",
+    "core.placement",
     "core.queue",
     "deprecation",
     "dispatcher",
