@@ -1,0 +1,32 @@
+# Developer entry points (reference Makefile analog).
+
+.PHONY: test bench lint run-scheduler run-admission dryrun clean
+
+test:
+	python -m pytest tests/ -q
+
+test-deadlock:  ## unit tests with deadlock detection enabled (reference: make test)
+	DEADLOCK_DETECTION_ENABLED=true DEADLOCK_TIMEOUT_SECONDS=30 \
+		python -m pytest tests/ -q
+
+bench:  ## end-to-end throughput on the north-star config (real TPU)
+	python bench.py
+
+bench-small:  ## CPU-friendly smoke of the bench harness
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu YK_BENCH_NODES=500 YK_BENCH_PODS=2000 \
+		python bench.py
+
+run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
+	python -m yunikorn_tpu.cmd.scheduler --nodes 100
+
+run-admission:  ## admission webhook with TLS on :9089
+	python -m yunikorn_tpu.cmd.admission_controller
+
+dryrun:  ## multi-chip sharding check on a virtual 8-device CPU mesh
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+		import __graft_entry__ as g; fn, a = g.entry(); fn(*a); g.dryrun_multichip(8)"
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
