@@ -274,8 +274,8 @@ def test_restart_with_changed_config():
     for p in pods:
         ms.wait_for_task_state("app-1", p.uid, task_mod.BOUND)
     cluster = ms.cluster  # the "cluster" survives the scheduler restart
-    ms.shim.stop()
     ms.core.stop()
+    ms.shim.stop()
 
     # restart with root.default now capped at 3 vcore
     new_yaml = QUEUES_YAML.replace(

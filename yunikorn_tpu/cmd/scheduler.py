@@ -74,8 +74,8 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, handle_signal)
     stop.wait()
     rest.stop()
+    core.stop()   # before the shim: no callbacks into a stopped dispatcher
     shim.stop()
-    core.stop()
     return 0
 
 

@@ -52,10 +52,12 @@ class MockScheduler:
         self.shim.run()
 
     def stop(self) -> None:
-        if self.shim is not None:
-            self.shim.stop()
+        # core first: its solve thread must not fire callbacks into a stopped
+        # dispatcher
         if self.core is not None:
             self.core.stop()
+        if self.shim is not None:
+            self.shim.stop()
 
     # --------------------------------------------------------------- actions
     def add_node(self, node: Node) -> None:
