@@ -430,3 +430,19 @@ def test_recovery_at_scale():
         assert elapsed < 30
     finally:
         ms.stop()
+
+
+def test_core_events_published_on_pods(sched):
+    """Core allocation events surface as pod events through PublishEvents."""
+    from yunikorn_tpu.common.events import get_recorder
+
+    sched.add_node(make_node("node-1", cpu_milli=4000))
+    p = sched.add_pod(yk_pod("evented"))
+    sched.wait_for_task_state("app-1", p.uid, task_mod.BOUND)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        evs = get_recorder().events(object_key=p.key(), reason="Allocated")
+        if evs:
+            break
+        time.sleep(0.05)
+    assert evs and "node-1" in evs[0].message

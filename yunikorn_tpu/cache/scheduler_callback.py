@@ -168,8 +168,23 @@ class AsyncRMCallback(ResourceManagerCallback):
 
     # ------------------------------------------------------------------ misc
     def send_event(self, events: List[EventRecord]) -> None:
+        """Publish core events onto cluster objects (reference PublishEvents,
+        context.go:1157-1200: request events attach to the pod, node events
+        are filtered to add/decommission reasons :1362-1372)."""
+        from yunikorn_tpu.common.si import EventRecordType
+
         for ev in events:
-            get_recorder().eventf(ev.type.value, ev.object_id, "Normal", ev.reason, ev.message)
+            if ev.type == EventRecordType.REQUEST:
+                pod = self.context.schedulers_cache.get_pod(ev.object_id)
+                key = pod.key() if pod is not None else ev.object_id
+                get_recorder().eventf("Pod", key, "Normal", ev.reason, ev.message)
+            elif ev.type == EventRecordType.NODE:
+                if ev.reason not in ("NodeAdded", "NodeRemoved", "Decommission"):
+                    continue  # reference filters node events to lifecycle ones
+                get_recorder().eventf("Node", ev.object_id, "Normal", ev.reason, ev.message)
+            else:
+                get_recorder().eventf(ev.type.value, ev.object_id, "Normal",
+                                      ev.reason, ev.message)
 
     def update_container_scheduling_state(
         self, request: UpdateContainerSchedulingStateRequest

@@ -512,6 +512,18 @@ class CoreScheduler(SchedulerAPI):
                     self.metrics.get("preempted_total", 0) + len(preempt_releases))
 
         if self.callback is not None:
+            # core event stream → shim PublishEvents (reference forwards core
+            # events onto pods/nodes as K8s events, context.go:1157-1200)
+            from yunikorn_tpu.common.si import EventRecord, EventRecordType
+
+            events = [
+                EventRecord(type=EventRecordType.REQUEST, object_id=a.allocation_key,
+                            reference_id=a.node_id, reason="Allocated",
+                            message=f"allocated on node {a.node_id}")
+                for a in new_allocs[:200]  # bounded per cycle
+            ]
+            if events:
+                self.callback.send_event(events)
             if pinned:
                 self.callback.update_allocation(AllocationResponse(new=pinned))
             if replaced.new or replaced.released:
