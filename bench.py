@@ -24,7 +24,52 @@ N_PODS = int(os.environ.get("YK_BENCH_PODS", 50_000))
 TARGET_PODS_PER_S = 50_000.0  # north star: 50k pods in 1s
 
 
+def _init_backend_or_die() -> str:
+    """Initialize the JAX backend up front; fail fast + loud if it can't.
+
+    Round-1 failure mode (BENCH_r01.json): the axon TPU relay raised
+    UNAVAILABLE and the bench died with a raw traceback. The relay can also
+    *block* for a long time while a previous client's claim drains — in that
+    case we keep waiting (killing a waiting TPU client wedges the relay
+    further) but emit heartbeats to stderr so the run is diagnosable.
+    """
+    import threading
+
+    t0 = time.time()
+    done = threading.Event()
+
+    def heartbeat():
+        while not done.wait(30):
+            print(f"# bench: still waiting for JAX backend "
+                  f"({time.time() - t0:.0f}s; TPU relay claim may be queued)",
+                  file=sys.stderr, flush=True)
+
+    hb = threading.Thread(target=heartbeat, daemon=True)
+    hb.start()
+    try:
+        import jax
+        devs = jax.devices()
+    except Exception as e:  # backend unavailable: one diagnostic JSON line
+        done.set()
+        print(json.dumps({
+            "metric": "backend-unavailable",
+            "value": 0.0,
+            "unit": "pods/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:400],
+            "init_secs": round(time.time() - t0, 1),
+        }))
+        sys.exit(1)
+    done.set()
+    platform = devs[0].platform
+    print(f"# bench: backend up in {time.time() - t0:.1f}s: "
+          f"{len(devs)}x {platform} ({devs[0]})", file=sys.stderr, flush=True)
+    return platform
+
+
 def main() -> int:
+    platform = _init_backend_or_die()
+
     from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
 
     ensure_compilation_cache()
@@ -130,7 +175,7 @@ def main() -> int:
 
     pods_per_s = n_warm / dt_warm if dt_warm > 0 else 0.0
     result = {
-        "metric": f"pods-scheduled/sec (e2e core cycle: quota+rank+encode+TPU solve+commit; {N_NODES} nodes, {N_PODS} pods, 5 queues)",
+        "metric": f"pods-scheduled/sec (e2e core cycle: quota+rank+encode+{platform} solve+commit; {N_NODES} nodes, {N_PODS} pods, 5 queues)",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_s / TARGET_PODS_PER_S, 3),

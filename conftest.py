@@ -4,16 +4,14 @@ The environment ships an axon TPU plugin that registers at interpreter start
 (sitecustomize) and forces jax_platforms="axon,cpu" via jax.config — overriding
 the JAX_PLATFORMS env var. Tests must be hermetic (and must not dial the TPU
 relay), so this conftest re-forces the config to cpu before any backend is
-initialized. Bench (bench.py) and the graft entry run outside pytest and keep
-the real TPU.
+initialized. Only bench.py keeps the real backend; the graft entry also forces
+the virtual-CPU platform (its job is validating the multi-chip sharding).
 """
 import os
+import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax  # noqa: E402  (already imported by sitecustomize; cheap)
+from yunikorn_tpu.utils.jaxtools import force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)

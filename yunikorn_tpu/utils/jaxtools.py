@@ -8,8 +8,35 @@ core scheduler, bench.py and the graft entry before the first solve.
 from __future__ import annotations
 
 import os
+import re
 
 _initialized = False
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Force JAX onto a virtual n-device CPU platform, beating the axon plugin.
+
+    The environment's axon TPU plugin registers at interpreter start and sets
+    jax_platforms via jax.config, which overrides the JAX_PLATFORMS env var —
+    so both the env var *and* the config key must be (re)forced before the
+    backend initializes. If XLA_FLAGS already pins a different
+    host-platform device count, it is rewritten, not kept.
+
+    Shared by the root conftest.py, __graft_entry__.dryrun_multichip and any
+    CPU-only script; must run before the first backend use.
+    """
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
+    else:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def ensure_compilation_cache(path: str | None = None) -> None:
