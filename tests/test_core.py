@@ -668,3 +668,100 @@ def test_default_namespace_placement():
                               user=UserGroupInfo(user="u"),
                               tags={"namespace": "batch"})]))
     assert core.partition.get_application("ns-app").queue_name == "root.batch"
+
+
+# ---------------------------------------------------------------------------
+# Round-2 advisor regressions
+# ---------------------------------------------------------------------------
+
+def test_placeholder_replacement_requires_fit():
+    """A real ask larger than its placeholder (plus the node's free) must NOT
+    replace it — yunikorn-core's tryPlaceholderAllocate only replaces when the
+    real allocation fits; anything else silently overcommits the node."""
+    cache, cb, core = make_core(nodes=1, node_cpu=4000)
+    add_app(core, "app-g", gang_scheduling_style="Soft")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-g", "ph-0", cpu=1000, placeholder=True, task_group_name="tg-1")]))
+    core.schedule_once()
+    assert len(cb.allocations) == 1
+    # fill the node: free is now 0
+    add_app(core, "filler")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("filler", "f0", cpu=3000, mem=2**20)]))
+    core.schedule_once()
+    # real ask needs 2000 > placeholder 1000 + free 0 → must stay pending
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-g", "real-0", cpu=2000, task_group_name="tg-1")]))
+    core.schedule_once()
+    assert "real-0" not in {a.allocation_key for a in cb.allocations}
+    assert not any(r.termination_type == TerminationType.PLACEHOLDER_REPLACED
+                   for r in cb.releases)
+    app = core.partition.get_application("app-g")
+    assert "real-0" in app.pending_asks
+    assert "ph-0" in app.allocations  # placeholder kept
+    total = sum(a.resource.get("cpu") for app2 in
+                core.partition.applications.values()
+                for a in app2.allocations.values())
+    assert total <= 4000  # no oversubscription
+
+
+def test_placeholder_replacement_fits_with_node_free():
+    """A real ask larger than the placeholder alone but within placeholder +
+    node free is still a valid replacement."""
+    cache, cb, core = make_core(nodes=1, node_cpu=4000)
+    add_app(core, "app-g", gang_scheduling_style="Soft")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-g", "ph-0", cpu=1000, placeholder=True, task_group_name="tg-1")]))
+    core.schedule_once()
+    add_app(core, "filler")
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("filler", "f0", cpu=1000, mem=2**20)]))
+    core.schedule_once()
+    # free = 2000; real 2000 ≤ ph 1000 + free 2000 → replace
+    core.update_allocation(AllocationRequest(asks=[
+        ask_of("app-g", "real-0", cpu=2000, task_group_name="tg-1")]))
+    core.schedule_once()
+    assert "real-0" in {a.allocation_key for a in cb.allocations}
+    assert any(r.termination_type == TerminationType.PLACEHOLDER_REPLACED
+               for r in cb.releases)
+
+
+def test_required_node_ask_respects_queue_quota():
+    """Pinned (RequiredNode/DaemonSet) asks are still subject to queue
+    headroom — yunikorn-core gates required-node asks on headroom too."""
+    cache, cb, core = make_core(nodes=2, node_cpu=16000)
+    add_app(core, "ds-app", "root.limited")  # max 2 vcore
+    pinned = ask_of("ds-app", "big-ds", cpu=3000, mem=2**20)
+    pinned.preferred_node = "node-0"
+    core.update_allocation(AllocationRequest(asks=[pinned]))
+    core.schedule_once()
+    assert "big-ds" not in {a.allocation_key for a in cb.allocations}
+    assert "big-ds" in core.partition.get_application("ds-app").pending_asks
+    # within quota: allocates on the pinned node
+    ok = ask_of("ds-app", "small-ds", cpu=1000, mem=2**20)
+    ok.preferred_node = "node-0"
+    core.update_allocation(AllocationRequest(asks=[ok]))
+    core.schedule_once()
+    allocs = {a.allocation_key: a.node_id for a in cb.allocations}
+    assert allocs.get("small-ds") == "node-0"
+
+
+def test_foreign_allocation_update_does_not_double_count():
+    """Re-sending a foreign allocation (resource change or node move) must
+    replace the tracked entry, not accumulate occupied forever."""
+    cache, cb, core = make_core(nodes=2)
+    f = Allocation(allocation_key="f0", application_id="", node_id="node-0",
+                   resource=ResourceBuilder().cpu(3000).build(), foreign=True)
+    core.update_allocation(AllocationRequest(allocations=[f]))
+    assert core.partition.nodes["node-0"].occupied.get("cpu") == 3000
+    # resource shrink in place
+    f2 = Allocation(allocation_key="f0", application_id="", node_id="node-0",
+                    resource=ResourceBuilder().cpu(2000).build(), foreign=True)
+    core.update_allocation(AllocationRequest(allocations=[f2]))
+    assert core.partition.nodes["node-0"].occupied.get("cpu") == 2000
+    # move to the other node
+    f3 = Allocation(allocation_key="f0", application_id="", node_id="node-1",
+                    resource=ResourceBuilder().cpu(2000).build(), foreign=True)
+    core.update_allocation(AllocationRequest(allocations=[f3]))
+    assert core.partition.nodes["node-0"].occupied.get("cpu") == 0
+    assert core.partition.nodes["node-1"].occupied.get("cpu") == 2000

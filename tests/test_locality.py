@@ -252,18 +252,158 @@ def test_spread_self_match_num():
     assert assignments(enc, res, batch)[p.uid] == "a0"
 
 
-def test_locality_group_overflow_blocks_not_crashes():
+def test_locality_group_overflow_host_fallback_schedules_all():
     cache, enc = make_env([make_node(f"n{i}", labels={"zone": f"z{i}"}) for i in range(4)])
     pods = []
-    # 10 distinct spread selectors -> overflow past MAX_LOCALITY_GROUPS
+    # 10 distinct spread selectors -> overflow past MAX_LOCALITY_GROUPS;
+    # the overflowed groups take the exact host-evaluation path instead of
+    # being blocked (round-1 behavior: held pending forever)
     for i in range(10):
         p = spread_pod(f"w{i}", labels={"uniq": f"v{i}"})
         p.spec.topology_spread_constraints[0].label_selector = {
             "matchLabels": {"uniq": f"v{i}"}}
         pods.append(p)
     batch = enc.build_batch([ask_for(p) for p in pods])  # must not raise
+    assert batch.locality is not None and batch.locality.fallback
     res = solve_batch(batch, enc.nodes)
     got = assignments(enc, res, batch)
     placed = sum(1 for v in got.values() if v is not None)
-    # the encodable groups scheduled; overflow groups held pending
-    assert 0 < placed < 10
+    # every selector is unique → each group has one pod, no constraint binds
+    assert placed == 10
+
+
+# ---------------------------------------------------------------------------
+# Overflow → host-fallback path (round-2: groups used to be blocked forever)
+# ---------------------------------------------------------------------------
+
+def overflow_anti_pod(name, n_terms=7, labels=None):
+    """A pod with more required anti-affinity terms than MAX_CONSTRAINT_SLOTS
+    (6) — not encodable in the locality tensors, must take the host path."""
+    p = make_pod(name, cpu_milli=100, memory=2**20, labels=labels or {})
+    p.spec.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(label_selector={"matchLabels": {f"x{i}": "t"}},
+                        topology_key="kubernetes.io/hostname")
+        for i in range(n_terms)
+    ])
+    return p
+
+
+def test_overflow_constraints_fall_back_to_host_eval():
+    cache, enc = make_env([make_node(f"n{i}", cpu_milli=8000) for i in range(3)])
+    # existing pod on n0 matches term 3 of the overflow pod
+    existing = make_pod("existing", cpu_milli=100, node_name="n0",
+                        phase="Running", labels={"x3": "t"})
+    cache.update_pod(existing)
+    enc.sync_nodes()
+    p = overflow_anti_pod("big")
+    batch = enc.build_batch([ask_for(p)])
+    assert batch.locality is not None and batch.locality.fallback
+    res = solve_batch(batch, enc.nodes)
+    got = assignments(enc, res, batch)
+    # scheduled (not starved), and NOT on the node its 4th term forbids
+    assert got[p.uid] is not None
+    assert got[p.uid] != "n0"
+
+
+def test_overflow_group_serialized_one_pod_per_solve():
+    """Two pods of one overflowed group that anti-affine each other: only one
+    may land per solve (static host mask can't see intra-batch placements);
+    the second schedules next cycle once the first is visible in the cache."""
+    cache, enc = make_env([make_node(f"n{i}", cpu_milli=8000) for i in range(3)])
+    pods = [overflow_anti_pod(f"s{i}", labels={"x0": "t"}) for i in range(2)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes)
+    got = assignments(enc, res, batch)
+    placed = {k: v for k, v in got.items() if v is not None}
+    assert len(placed) == 1
+    # bind the first, re-encode, second must land on a DIFFERENT node
+    first_key, first_node = next(iter(placed.items()))
+    first_pod = next(p for p in pods if p.uid == first_key)
+    first_pod.spec.node_name = first_node
+    first_pod.status.phase = "Running"
+    cache.update_pod(first_pod)
+    enc.sync_nodes()
+    second = next(p for p in pods if p.uid != first_key)
+    batch2 = enc.build_batch([ask_for(second)])
+    res2 = solve_batch(batch2, enc.nodes)
+    got2 = assignments(enc, res2, batch2)
+    assert got2[second.uid] is not None
+    assert got2[second.uid] != first_node
+
+
+def test_overflow_spread_host_semantics():
+    """Host fallback also enforces DoNotSchedule spread exactly: with skew 1
+    and 2 pods already in zone a, the next must go to zone b."""
+    nodes = [make_node("a0", labels={"zone": "a"}),
+             make_node("b0", labels={"zone": "b"})]
+    cache, enc = make_env(nodes)
+    for i in range(2):
+        ex = make_pod(f"e{i}", cpu_milli=100, node_name="a0", phase="Running",
+                      labels={"app": "web"})
+        cache.update_pod(ex)
+    enc.sync_nodes()
+    p = spread_pod("w0")  # zone spread, maxSkew 1, selector app=web
+    # add 6 anti terms to force overflow alongside the spread constraint
+    p.spec.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(label_selector={"matchLabels": {f"y{i}": "t"}},
+                        topology_key="kubernetes.io/hostname")
+        for i in range(6)
+    ])
+    batch = enc.build_batch([ask_for(p)])
+    assert batch.locality is not None and batch.locality.fallback
+    res = solve_batch(batch, enc.nodes)
+    got = assignments(enc, res, batch)
+    assert got[p.uid] == "b0"  # 2 in a, 0 in b, skew 1 → must balance
+
+
+def test_group_cache_is_bounded():
+    cache, enc = make_env([make_node("n0")])
+    enc._group_cache_max = 4
+    pods = [make_pod(f"p{i}", cpu_milli=100, memory=2**20,
+                     node_selector={"shard": f"s{i}"}) for i in range(10)]
+    for p in pods:
+        enc.build_batch([ask_for(p)])
+    assert len(enc._group_cache) <= 4
+
+
+def test_symmetry_holder_labels_not_matching_own_term():
+    """An existing pod E HOLDS an anti-affinity term t whose selector matches
+    incoming pod N, but E's own labels do NOT match t. N also carries t.
+    Symmetry must still keep N off E's node — the primary slot (which counts
+    pods MATCHING t) cannot stand in for the holder check."""
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    term = PodAffinityTerm(label_selector={"matchLabels": {"app": "web"}},
+                           topology_key="kubernetes.io/hostname")
+    existing = make_pod("holder", cpu_milli=100, node_name="n0",
+                        phase="Running", labels={"app": "db"})
+    existing.spec.affinity = Affinity(pod_anti_affinity_required=[term])
+    cache.update_pod(existing)
+    enc.sync_nodes()
+    incoming = make_pod("web-pod", cpu_milli=100, memory=2**20,
+                        labels={"app": "web"})
+    incoming.spec.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(label_selector={"matchLabels": {"app": "web"}},
+                        topology_key="kubernetes.io/hostname")])
+    batch = enc.build_batch([ask_for(incoming)])
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[incoming.uid] == "n1"
+
+
+def test_symmetry_holder_not_matching_own_term_host_fallback():
+    """Same scenario through the overflow host-evaluation path."""
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    term = PodAffinityTerm(label_selector={"matchLabels": {"app": "web"}},
+                           topology_key="kubernetes.io/hostname")
+    existing = make_pod("holder", cpu_milli=100, node_name="n0",
+                        phase="Running", labels={"app": "db"})
+    existing.spec.affinity = Affinity(pod_anti_affinity_required=[term])
+    cache.update_pod(existing)
+    enc.sync_nodes()
+    incoming = overflow_anti_pod("web-pod", labels={"app": "web"})
+    incoming.spec.affinity.pod_anti_affinity_required.append(
+        PodAffinityTerm(label_selector={"matchLabels": {"app": "web"}},
+                        topology_key="kubernetes.io/hostname"))
+    batch = enc.build_batch([ask_for(incoming)])
+    assert batch.locality is not None and batch.locality.fallback
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[incoming.uid] == "n1"

@@ -329,6 +329,14 @@ class CoreScheduler(SchedulerAPI):
                                         list(app.user.groups))
 
     def _track_foreign(self, alloc: Allocation) -> None:
+        # The shim re-sends a foreign allocation whenever (node, resource)
+        # changes; un-count the tracked predecessor or occupied drifts up on
+        # every update/move.
+        prev = self.partition.foreign_allocations.get(alloc.allocation_key)
+        if prev is not None:
+            old_node = self.partition.nodes.get(prev.node_id)
+            if old_node is not None:
+                old_node.occupied = old_node.occupied.sub(prev.resource)
         self.partition.foreign_allocations[alloc.allocation_key] = alloc
         node = self.partition.nodes.get(alloc.node_id)
         if node is not None:
@@ -568,6 +576,16 @@ class CoreScheduler(SchedulerAPI):
                                     info.available().sub(overlay), info.pods.values())
                 if err is not None:
                     continue  # stays pending (preemption may free it later)
+                # Pinned asks are still subject to queue headroom and
+                # user/group limits (yunikorn-core gates required-node asks
+                # on headroom too); hold them pending when exhausted.
+                leaf = self.queues.resolve(app.queue_name, create=False)
+                if leaf is not None:
+                    if not leaf.fits_quota(ask.resource):
+                        continue
+                    if leaf.has_limits_in_chain() and not leaf.fits_user_limit(
+                            app.user.user, list(app.user.groups), ask.resource):
+                        continue
                 alloc = Allocation(
                     allocation_key=key, application_id=app.application_id,
                     node_id=ask.preferred_node, resource=ask.resource,
@@ -716,11 +734,38 @@ class CoreScheduler(SchedulerAPI):
             for key, ask in list(app.pending_asks.items()):
                 if ask.placeholder or not ask.task_group_name:
                     continue
-                ph = next(
-                    (a for a in app.allocations.values()
-                     if a.placeholder and a.task_group_name == ask.task_group_name),
-                    None,
-                )
+                # Only replace when the real ask actually fits: within the
+                # placeholder's own resource, or within the node's free plus
+                # what the release returns (yunikorn-core tryPlaceholderAllocate
+                # never lands a larger-than-placeholder pod without a fit
+                # check). Otherwise skip — the ask goes through the batched
+                # solve like any other.
+                ph = None
+                for cand in app.allocations.values():
+                    if not cand.placeholder or cand.task_group_name != ask.task_group_name:
+                        continue
+                    if ask.resource.fits_in(cand.resource):
+                        ph = cand
+                        break
+                    info = self.cache.snapshot_node(cand.node_id)
+                    if info is None:
+                        continue
+                    # free after the release = cache-visible available, minus
+                    # committed-but-not-yet-assumed allocations on the node
+                    # (the placeholder itself excluded), plus the placeholder's
+                    # resource when the cache already counts it as used
+                    overlay = Resource()
+                    for infl in self._inflight.values():
+                        if (infl.node_id == cand.node_id
+                                and infl.allocation_key != cand.allocation_key
+                                and self.cache.get_pod_node_name(infl.allocation_key) is None):
+                            overlay = overlay.add(infl.resource)
+                    free_after = info.available().sub(overlay)
+                    if self.cache.get_pod_node_name(cand.allocation_key) is not None:
+                        free_after = free_after.add(cand.resource)
+                    if ask.resource.fits_in(free_after):
+                        ph = cand
+                        break
                 if ph is None:
                     continue
                 # release placeholder

@@ -81,7 +81,6 @@ def _loc_rules_mask(gid_rows, dom_cols, loc, cnt, minc, total, contrib_rows):
     from yunikorn_tpu.snapshot.locality import (
         KIND_AFFINITY,
         KIND_ANTI_AFFINITY,
-        KIND_BLOCKED,
         KIND_SPREAD,
     )
 
@@ -117,10 +116,8 @@ def _loc_rules_mask(gid_rows, dom_cols, loc, cnt, minc, total, contrib_rows):
         anti_ok = (~has_dom) | (cnt_at == 0)
         rule_ok = jnp.where(expand(kind) == KIND_SPREAD, spread_ok,
                    jnp.where(expand(kind) == KIND_AFFINITY, aff_ok,
-                    jnp.where(expand(kind) == KIND_ANTI_AFFINITY, anti_ok,
-                     jnp.where(expand(kind) == KIND_BLOCKED,
-                               jnp.zeros_like(anti_ok), True))))
-        rule_ok = jnp.where(expand(l >= 0) | (expand(kind) == KIND_BLOCKED), rule_ok, True)
+                    jnp.where(expand(kind) == KIND_ANTI_AFFINITY, anti_ok, True)))
+        rule_ok = jnp.where(expand(l >= 0), rule_ok, True)
         ok = rule_ok if ok is None else (ok & rule_ok)
     return ok
 

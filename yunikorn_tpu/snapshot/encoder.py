@@ -315,7 +315,12 @@ class SnapshotEncoder:
         self.cache = cache
         self.vocabs = vocabs or Vocabs()
         self.nodes = NodeArrays(self.vocabs)
-        self._group_cache: Dict[tuple, Tuple[int, GroupSpec]] = {}
+        # LRU-bounded: locality signatures fold pod labels in, so label churn
+        # on long-running clusters would otherwise grow this without bound
+        from collections import OrderedDict
+
+        self._group_cache: "OrderedDict[tuple, Tuple[int, GroupSpec]]" = OrderedDict()
+        self._group_cache_max = 8192
         self._unschedulable_overrides: Dict[str, bool] = {}
         self._taint_version = 0
 
@@ -628,9 +633,13 @@ class SnapshotEncoder:
                     cached = self._group_cache.get(sig)
                     if cached is not None and cached[1].taint_vocab_version == self.vocabs.taints.used_bits():
                         spec = cached[1]
+                        self._group_cache.move_to_end(sig)
                     else:
                         spec = self._encode_group(pod)
                         self._group_cache[sig] = (0, spec)
+                        self._group_cache.move_to_end(sig)
+                        while len(self._group_cache) > self._group_cache_max:
+                            self._group_cache.popitem(last=False)
                 group_specs.append(spec)
             group_ids.append(gid)
 
@@ -707,6 +716,24 @@ class SnapshotEncoder:
 
         locality = encode_locality(asks, group_ids, len(group_specs),
                                    self.nodes, self.cache, N, G)
+
+        if locality is not None and locality.fallback:
+            # Overflowed locality groups: exact host mask + one pod per solve
+            # (the mask is static w.r.t. this batch, so a second pod of the
+            # same group could otherwise violate intra-batch interactions).
+            if host_mask is None:
+                host_mask = np.ones((G, self.nodes.capacity), bool)
+            for gid, fb in locality.fallback.items():
+                host_mask[gid] &= fb[: self.nodes.capacity]
+            first_seen: set = set()
+            for i in range(n):
+                gid = group_ids[i]
+                if gid not in locality.fallback:
+                    continue
+                if gid in first_seen:
+                    valid[i] = False  # retried next cycle with fresh counts
+                else:
+                    first_seen.add(gid)
 
         return PodBatch(
             ask_keys=[a.allocation_key for a in asks],

@@ -21,8 +21,9 @@ Encoding ("locality groups"):
   Per constraint-group:
     refs [G, S] int32 → locality group index (-1 unused slot)
     kind [G, S] int32   1=spread(DoNotSchedule) 2=affinity 3=anti-affinity
-                        4=blocked (constraint could not be encoded — the
-                        group is held pending rather than mis-scheduled)
+                        (groups whose constraints overflow the encoding take
+                        the exact host-evaluation fallback instead — see
+                        host_locality_mask)
     skew [G, S] int32   maxSkew for spread slots
     seed [G, S] bool    affinity self-seeding (pod matches its own selector →
                         may start the first domain, K8s semantics)
@@ -59,8 +60,6 @@ KIND_NONE = 0
 KIND_SPREAD = 1
 KIND_AFFINITY = 2
 KIND_ANTI_AFFINITY = 3
-KIND_BLOCKED = 4
-
 HOSTNAME_KEY = "kubernetes.io/hostname"
 
 
@@ -222,6 +221,11 @@ class LocalityBatch:
     g_skew: np.ndarray       # [G, S] int32
     g_seed: np.ndarray       # [G, S] bool
     num_groups: int
+    # groups whose constraints overflow the tensor encoding, evaluated exactly
+    # on the host instead: gid -> [M] feasibility mask against existing
+    # cluster state. The encoder serializes these groups (one pod per solve)
+    # so intra-batch interactions cannot violate the constraints.
+    fallback: Optional[Dict[int, np.ndarray]] = None
 
 
 class _LocAccum:
@@ -243,6 +247,100 @@ class _LocAccum:
         return idx
 
 
+def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
+    """Exact per-pod evaluation of locality constraints on the host.
+
+    Fallback for constraint groups that overflow the tensor encoding
+    (> MAX_LOCALITY_GROUPS distinct tuples or > MAX_CONSTRAINT_SLOTS slots):
+    the same rules the in-solve _loc_rules_mask applies, evaluated in Python
+    against *existing* cluster state — the reference's per-(pod,node) behavior
+    (InterPodAffinity / PodTopologySpread filters). Callers must serialize
+    such groups (at most one pod per solve) so intra-batch placements cannot
+    violate the constraints; each cycle re-evaluates with fresh counts.
+    """
+    M = node_arrays.capacity
+    ok = np.zeros(M, bool)
+    rows = list(node_arrays._idx_to_name.items())
+    for idx, _name in rows:
+        ok[idx] = True
+
+    placed: List[Tuple[Pod, int]] = []
+    for p in cache.pods_map.values():
+        node_name = cache.assigned_pods.get(p.uid)
+        if node_name is None:
+            continue
+        n_idx = node_arrays._name_to_idx.get(node_name)
+        if n_idx is not None:
+            placed.append((p, n_idx))
+
+    def domain_values(topo_key: str) -> Dict[int, Optional[str]]:
+        vals: Dict[int, Optional[str]] = {}
+        for idx, name in rows:
+            info = cache.get_node(name)
+            if info is None:
+                continue
+            v = info.node.metadata.labels.get(topo_key)
+            if topo_key == HOSTNAME_KEY and v is None:
+                v = name
+            vals[idx] = v
+        return vals
+
+    dom_cache: Dict[str, Dict[int, Optional[str]]] = {}
+
+    def cached_domain_values(topo_key: str) -> Dict[int, Optional[str]]:
+        vals = dom_cache.get(topo_key)
+        if vals is None:
+            vals = dom_cache[topo_key] = domain_values(topo_key)
+        return vals
+
+    for kind, spec, skew in _pod_constraints(pod):
+        vals = cached_domain_values(spec.topo_key)
+        counts: Dict[str, int] = {}
+        for p, n_idx in placed:
+            v = vals.get(n_idx)
+            if v is not None and spec.counts_pod(p):
+                counts[v] = counts.get(v, 0) + 1
+        valid_domains = {v for v in vals.values() if v is not None}
+        minc = min((counts.get(v, 0) for v in valid_domains), default=0)
+        total = sum(counts.get(v, 0) for v in valid_domains)
+        self_add = 1 if (kind == KIND_SPREAD and spec.counts_pod(pod)) else 0
+        seed = kind == KIND_AFFINITY and spec.counts_pod(pod)
+        eff_skew = max(1, skew) if kind == KIND_SPREAD else 0
+        for idx, _name in rows:
+            v = vals.get(idx)
+            has_dom = v is not None
+            cnt_at = counts.get(v, 0) if has_dom else 0
+            if kind == KIND_SPREAD:
+                good = has_dom and (cnt_at + self_add - minc <= eff_skew)
+            elif kind == KIND_AFFINITY:
+                good = has_dom and (cnt_at > 0 or (seed and total == 0))
+            else:  # KIND_ANTI_AFFINITY
+                good = (not has_dom) or cnt_at == 0
+            if not good:
+                ok[idx] = False
+
+    # symmetry: existing pods' required anti-affinity terms that match this
+    # pod block their holders' domains (holding ≠ matching: the primary anti
+    # constraints above cannot stand in for this check)
+    sym_terms = [t for t in all_anti_terms(cache) if t.counts_pod(pod)]
+    if sym_terms:
+        placed_terms = [(n_idx, set(_pod_anti_terms(p))) for p, n_idx in placed]
+        for t in sym_terms:
+            vals = cached_domain_values(t.topo_key)
+            holder_domains: set = set()
+            for n_idx, terms in placed_terms:
+                v = vals.get(n_idx)
+                if v is not None and t in terms:
+                    holder_domains.add(v)
+            if not holder_domains:
+                continue
+            for idx, _name in rows:
+                v = vals.get(idx)
+                if v is not None and v in holder_domains:
+                    ok[idx] = False
+    return ok
+
+
 def encode_locality(
     asks: Sequence,
     group_ids: Sequence[int],
@@ -254,9 +352,10 @@ def encode_locality(
 ) -> Optional[LocalityBatch]:
     """Build the LocalityBatch for a solve, or None if nothing needs it.
 
-    Groups whose constraints cannot be encoded (slot or group overflow) are
-    marked KIND_BLOCKED — their pods stay pending instead of being
-    mis-scheduled or crashing the cycle.
+    Groups whose constraints cannot be encoded (slot or group overflow) get
+    an exact host-evaluated feasibility mask in .fallback instead — the
+    encoder serializes them to one pod per solve so they schedule correctly
+    rather than starving.
     """
     accum = _LocAccum()
     g_refs = np.full((batch_g, MAX_CONSTRAINT_SLOTS), -1, np.int32)
@@ -267,11 +366,16 @@ def encode_locality(
     any_constraint = False
     anti_terms = all_anti_terms(cache)
 
-    def block_group(gid: int, why: str) -> None:
-        logger.warning("locality constraints for group %d not encodable (%s); "
-                       "its pods stay pending", gid, why)
-        g_refs[gid, 0] = -1
-        g_kind[gid, 0] = KIND_BLOCKED
+    fallback: Dict[int, np.ndarray] = {}
+
+    def fall_back(gid: int, pod: Pod, why: str) -> None:
+        # Constraints that overflow the tensor encoding are evaluated exactly
+        # on the host instead of blocking the group (pods would starve with
+        # no feedback); the encoder serializes the group to one pod per solve.
+        logger.info("locality constraints for group %d overflow the tensor "
+                    "encoding (%s); falling back to host evaluation "
+                    "(serialized to one pod per cycle)", gid, why)
+        fallback[gid] = host_locality_mask(pod, cache, node_arrays)
 
     for ask, gid in zip(asks, group_ids):
         if gid in seen_groups or ask.pod is None:
@@ -294,17 +398,18 @@ def encode_locality(
             seed = kind == KIND_AFFINITY and spec.counts_pod(pod)
             slots.append((l_idx, kind, max(1, skew) if kind == KIND_SPREAD else 0, seed))
         if ok:
-            own_terms = set(_pod_anti_terms(pod))
             for t in sym_slots:
-                if t in own_terms and t.counts_pod(pod):
-                    continue  # self anti-affinity already enforced by the primary slot
+                # NOTE: even when the pod holds t itself, the primary slot is
+                # not enough — it blocks domains with pods MATCHING t's
+                # selector, while symmetry must block domains with pods
+                # HOLDING t (a holder's own labels need not match its term).
                 l_idx = accum.intern(t, holder=True)
                 if l_idx < 0:
                     ok = False
                     break
                 slots.append((l_idx, KIND_ANTI_AFFINITY, 0, False))
         if not ok or len(slots) > MAX_CONSTRAINT_SLOTS:
-            block_group(gid, "overflow")
+            fall_back(gid, pod, "group or slot overflow")
             continue
         for s, (l, kind, skew, seed) in enumerate(slots):
             g_refs[gid, s] = l
@@ -393,4 +498,5 @@ def encode_locality(
         dom=dom, cnt0=cnt0, dom_valid=dom_valid, contrib=contrib,
         g_refs=g_refs, g_kind=g_kind, g_skew=g_skew, g_seed=g_seed,
         num_groups=len(accum.specs),
+        fallback=fallback or None,
     )
