@@ -332,3 +332,90 @@ def test_preferred_node_affinity_scoring():
     batch = enc.build_batch([ask_for(p2)])
     res = solve_batch(batch, enc.nodes)
     assert names_of(enc, res, batch)[p2.uid] == "ssd-node"
+
+
+# ---------------------------------------------------------------------------
+# Round-2: exact handling of constraints the tensors can't hold
+# (reference never approximates a predicate, predicate_manager.go:202-250)
+# ---------------------------------------------------------------------------
+
+def test_nine_or_terms_exact():
+    """More OR-terms than MAX_TERMS (8): the 9th term must still be honored
+    exactly via the host path (round-1 truncated it silently)."""
+    nodes = [make_node(f"n{i}", labels={"shard": f"s{i}"}) for i in range(10)]
+    cache, enc = make_env(nodes)
+    p = make_pod("picky", cpu_milli=100, memory=2**20)
+    # 9 OR terms, each matching exactly one shard; only shards s8 and s0 exist
+    # with capacity... use terms s1..s9 but only node n9 carries shard s9 and
+    # nodes n1..n8 are made unschedulable to force the 9th term to decide
+    p.spec.affinity = Affinity(node_required_terms=[
+        NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("shard", "In", [f"s{i}"])])
+        for i in range(1, 10)
+    ])
+    for i in range(1, 9):
+        nodes[i].spec.unschedulable = True
+        cache.update_node(nodes[i])
+    enc.sync_nodes(full=True)
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    # n0 (shard s0) matches NO term; n9 (shard s9) matches term 9 → must pick n9
+    assert got[p.uid] == "n9"
+
+
+def test_gt_expr_inside_multi_term_or_is_not_anded():
+    """A Gt expression in term A must not be ANDed over term B's matches:
+    a node satisfying only B stays feasible (round-1 host_exprs bug)."""
+    cache, enc = make_env([
+        make_node("small-ssd", labels={"disk": "ssd", "mem-gb": "8"}),
+    ])
+    p = make_pod("either", cpu_milli=100, memory=2**20)
+    p.spec.affinity = Affinity(node_required_terms=[
+        # term A: mem-gb > 100 (small-ssd fails this)
+        NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("mem-gb", "Gt", ["100"])]),
+        # term B: disk ssd (small-ssd satisfies this)
+        NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("disk", "In", ["ssd"])]),
+    ])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p.uid] == "small-ssd"
+
+
+def test_multi_value_preferred_in_scores_all_values():
+    """preferred In [a, b]: a zone-b node must receive the bonus too
+    (round-1 approximated by the first value only)."""
+    cache, enc = make_env([
+        make_node("nb", labels={"zone": "b"}),
+        make_node("nc", labels={"zone": "c"}),
+    ])
+    p = make_pod("prefers", cpu_milli=100, memory=2**20)
+    p.spec.affinity = Affinity(node_preferred_terms=[
+        (100, NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("zone", "In", ["a", "b"])])),
+    ])
+    batch = enc.build_batch([ask_for(p)])
+    assert batch.g_host_soft is not None
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    # zone-b matches the preference; zone-c does not → must pick nb
+    assert names_of(enc, res, batch)[p.uid] == "nb"
+
+
+def test_preferred_term_overflow_host_scored():
+    """A 5th preferred term (> MAX_PREF_TERMS=4) still contributes score."""
+    cache, enc = make_env([
+        make_node("plain"),
+        make_node("gold", labels={"tier": "gold"}),
+    ])
+    p = make_pod("wants-gold", cpu_milli=100, memory=2**20)
+    terms = [(1, NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement(f"never{i}", "In", [f"x{i}"])])) for i in range(4)]
+    terms.append((100, NodeSelectorTerm(match_expressions=[
+        NodeSelectorRequirement("tier", "In", ["gold"])])))
+    p.spec.affinity = Affinity(node_preferred_terms=terms)
+    batch = enc.build_batch([ask_for(p)])
+    assert batch.g_host_soft is not None
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    assert names_of(enc, res, batch)[p.uid] == "gold"

@@ -321,6 +321,7 @@ def solve(
     free,           # [M, R] int32
     capacity,       # [M, R] int32
     host_group_mask=None,   # [G, M] bool or None
+    host_group_soft=None,   # [G, M] float32 or None (host-scored soft terms)
     loc=None,       # locality tuple: (dom [L,M], cnt0 [L,D], dom_valid [L,D],
                     #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed)
     *,
@@ -352,6 +353,10 @@ def solve(
     # affinity terms reward — one [G, M] adjustment shared by the round paths
     group_soft = group_soft_penalty(g_tol, node_taints_soft) + group_preferred_bonus(
         g_pref_req, g_pref_forb, g_pref_weight, node_labels)          # [G, M]
+    if host_group_soft is not None:
+        # preferred terms the tensor encoding can't express exactly
+        # (multi-value In, slot overflow) — scored on the host, same scale
+        group_soft = group_soft + host_group_soft
 
     has_loc = loc is not None
     free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
@@ -438,6 +443,19 @@ def solve(
     return assigned, free_ext[:M], rounds
 
 
+def pad2d(arr, width, fill):
+    """Pad or clamp the second dim of a [G, m] host array to `width` — the
+    node capacity may have grown (or a sharded view may be narrower) since
+    the batch was encoded."""
+    import numpy as np
+
+    if arr.shape[1] == width:
+        return arr
+    out = np.full((arr.shape[0], width), fill, arr.dtype)
+    out[:, : min(arr.shape[1], width)] = arr[:, :width]
+    return out
+
+
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
                 device=None) -> SolveResult:
@@ -461,11 +479,10 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     node_ok = na.valid & na.schedulable
     host_mask = batch.g_host_mask
     if host_mask is not None:
-        # pad to node capacity
-        if host_mask.shape[1] != na.capacity:
-            hm = np.zeros((host_mask.shape[0], na.capacity), bool)
-            hm[:, : host_mask.shape[1]] = host_mask[:, : na.capacity]
-            host_mask = hm
+        host_mask = pad2d(host_mask, na.capacity, False)
+    host_soft = getattr(batch, "g_host_soft", None)
+    if host_soft is not None:
+        host_soft = pad2d(host_soft, na.capacity, np.float32(0.0))
     loc = None
     if batch.locality is not None:
         lb = batch.locality
@@ -496,6 +513,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         jnp.asarray(free_i),
         jnp.asarray(cap_i),
         jnp.asarray(host_mask) if host_mask is not None else None,
+        jnp.asarray(host_soft) if host_soft is not None else None,
         loc,
         max_rounds=max_rounds,
         chunk=chunk,
@@ -504,7 +522,8 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         # preferred-affinity bonuses need the per-group adjustment, so fall
         # back to the XLA path when either is present
         use_pallas=(use_pallas and not na.has_soft_taints()
-                    and not batch.g_pref_weight.any()),
+                    and not batch.g_pref_weight.any()
+                    and getattr(batch, "g_host_soft", None) is None),
         pallas_interpret=pallas_interpret,
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

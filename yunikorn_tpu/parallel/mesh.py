@@ -94,13 +94,13 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         put(free_i, node_s2),
         put(np.floor(na.capacity_arr).astype(np.int32), node_s2),
     )
+    group_node_s = NamedSharding(mesh, P(None, NODE_AXIS))
     host_mask = batch.g_host_mask
-    if host_mask is not None:
-        hm = np.zeros((host_mask.shape[0], M), bool)
-        hm[:, : min(M, host_mask.shape[1])] = host_mask[:, :M]
-        mask_arg = put(hm, NamedSharding(mesh, P(None, NODE_AXIS)))
-    else:
-        mask_arg = None
+    mask_arg = (put(assign_mod.pad2d(host_mask, M, False), group_node_s)
+                if host_mask is not None else None)
+    host_soft = getattr(batch, "g_host_soft", None)
+    soft_arg = (put(assign_mod.pad2d(host_soft, M, np.float32(0.0)), group_node_s)
+                if host_soft is not None else None)
 
     loc_arg = None
     if batch.locality is not None:
@@ -114,7 +114,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
 
     with mesh:
         assigned, free_after, rounds = assign_mod.solve(
-            *args, mask_arg, loc_arg,
+            *args, mask_arg, soft_arg, loc_arg,
             max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
             policy=policy,
         )

@@ -60,6 +60,72 @@ def _set_bit(arr: np.ndarray, bit: int) -> None:
     arr[bit // 32] |= np.uint32(1 << (bit % 32))
 
 
+def _term_needs_host(term) -> bool:
+    """Would tensor-encoding this node-affinity term require per-expression
+    host fallback (only sound when the term stands alone)?"""
+    multi_in = 0
+    for e in term.match_expressions:
+        if e.operator == "In" and len(e.values) > 1:
+            multi_in += 1
+            if multi_in > MAX_ANYOF:
+                return True
+        elif e.operator in ("Gt", "Lt"):
+            return True
+    for e in term.match_fields:
+        if e.key == "metadata.name" and e.operator == "In" and len(e.values) > 1:
+            return True
+    return False
+
+
+def _node_matches_term(term, labels: Dict[str, str], node_name: str) -> bool:
+    """Full K8s NodeSelectorTerm semantics for one node (host path).
+
+    Mirrors the in-tree NodeAffinity filter: all matchExpressions and
+    matchFields must hold; NotIn/DoesNotExist match when the key is absent."""
+    for e in term.match_expressions:
+        v = labels.get(e.key)
+        if v is None and e.key == "kubernetes.io/hostname":
+            v = node_name
+        op = e.operator
+        if op == "In":
+            if v is None or v not in e.values:
+                return False
+        elif op == "NotIn":
+            if v is not None and v in e.values:
+                return False
+        elif op == "Exists":
+            if v is None:
+                return False
+        elif op == "DoesNotExist":
+            if v is not None:
+                return False
+        elif op in ("Gt", "Lt"):
+            try:
+                iv, tv = int(v), int(e.values[0])
+            except (TypeError, ValueError, IndexError):
+                return False
+            if op == "Gt" and not iv > tv:
+                return False
+            if op == "Lt" and not iv < tv:
+                return False
+        else:
+            return False  # unknown operator: never matches (K8s errors out)
+    for e in term.match_fields:
+        if e.key != "metadata.name":
+            return False
+        if e.operator == "In":
+            if node_name not in e.values:
+                return False
+        elif e.operator == "NotIn":
+            if node_name in e.values:
+                return False
+        else:
+            return False
+    return True
+
+
+
+
 @dataclasses.dataclass
 class GroupSpec:
     """Decoded constraint signature for one group."""
@@ -77,6 +143,13 @@ class GroupSpec:
     pref_req: Optional[np.ndarray] = None    # [P, W] u32 preferred-term bits
     pref_forb: Optional[np.ndarray] = None   # [P, W] u32
     pref_weight: Optional[np.ndarray] = None # [P] f32 (0 = unused slot)
+    # full required node-affinity term list, host-evaluated with exact OR
+    # semantics when the tensor encoding can't express it (> MAX_TERMS terms,
+    # or per-expression fallback needed inside a multi-term OR — ANDing a
+    # per-expression host mask would wrongly constrain the other terms)
+    host_affinity_terms: Optional[list] = None
+    # preferred terms host-scored exactly (multi-value In / slot overflow)
+    host_pref_terms: Optional[list] = None   # [(weight, term)]
 
 
 @dataclasses.dataclass
@@ -101,6 +174,7 @@ class PodBatch:
     g_pref_forb: np.ndarray         # [G, P, W]
     g_pref_weight: np.ndarray       # [G, P] f32
     g_host_mask: Optional[np.ndarray]  # [G, M] bool or None
+    g_host_soft: Optional[np.ndarray]  # [G, M] f32 host-scored soft terms or None
     locality: Optional[object]         # snapshot.locality.LocalityBatch or None
     num_pods: int
     num_groups: int
@@ -382,7 +456,9 @@ class SnapshotEncoder:
     def _compute_group_signature(self, pod: Pod) -> tuple:
         sel = tuple(sorted(pod.spec.node_selector.items()))
         pref = tuple(
-            (w, tuple((x.key, x.operator, tuple(x.values)) for x in t.match_expressions))
+            (w,
+             tuple((x.key, x.operator, tuple(x.values)) for x in t.match_expressions),
+             tuple((x.key, x.operator, tuple(x.values)) for x in t.match_fields))
             for w, t in (pod.spec.affinity.node_preferred_terms if pod.spec.affinity else [])
         )
         tols = tuple(
@@ -432,18 +508,28 @@ class SnapshotEncoder:
         )
         n_terms = max(1, len(affinity_terms))
         host_exprs: List[Tuple[str, str, str]] = []
+        host_affinity_terms: Optional[list] = None
         term_req = np.zeros((MAX_TERMS, W), np.uint32)
         term_forb = np.zeros((MAX_TERMS, W), np.uint32)
         term_valid = np.zeros((MAX_TERMS,), bool)
         anyof = np.zeros((MAX_TERMS, MAX_ANYOF, W), np.uint32)
         anyof_valid = np.zeros((MAX_TERMS, MAX_ANYOF), bool)
-        if n_terms > MAX_TERMS:
-            logger.warning("pod %s has %d affinity terms; truncating to %d", pod.key(), n_terms, MAX_TERMS)
-            n_terms = MAX_TERMS
+        # OR-of-terms the tensors can't hold exactly is host-evaluated in
+        # full: per-expression host fallback (Gt/Lt, anyof overflow,
+        # matchFields multi-In) composes by AND, which is only sound inside a
+        # single term; with >1 term (or >MAX_TERMS terms) the whole affinity
+        # moves to the host path (reference never approximates a predicate,
+        # predicate_manager.go:202-250).
+        if affinity_terms and (
+            n_terms > MAX_TERMS
+            or (n_terms > 1 and any(_term_needs_host(t) for t in affinity_terms))
+        ):
+            host_affinity_terms = list(affinity_terms)
+            n_terms = 1  # tensor side only enforces the node selector
         for t in range(n_terms):
             term_valid[t] = True
             term_req[t] = base_req
-            if t < len(affinity_terms):
+            if host_affinity_terms is None and t < len(affinity_terms):
                 e_idx = 0
                 for e in affinity_terms[t].match_expressions:
                     if e.operator == "In":
@@ -486,21 +572,34 @@ class SnapshotEncoder:
                         logger.warning("unsupported matchFields operator %s", e.operator)
 
         # --- preferred node affinity (scoring): weighted single terms ---
+        # Terms the bitset rows can express exactly (single-value In, NotIn,
+        # Exists, DoesNotExist; no matchFields) go to the tensors; anything
+        # else — multi-value In, Gt/Lt, matchFields, slot overflow — is
+        # host-scored exactly instead of approximated.
         pref_req = np.zeros((MAX_PREF_TERMS, W), np.uint32)
         pref_forb = np.zeros((MAX_PREF_TERMS, W), np.uint32)
         pref_weight = np.zeros((MAX_PREF_TERMS,), np.float32)
         preferred = (pod.spec.affinity.node_preferred_terms
                      if pod.spec.affinity else [])
-        if len(preferred) > MAX_PREF_TERMS:
-            logger.warning("pod %s has %d preferred affinity terms; scoring only "
-                           "the first %d", pod.key(), len(preferred), MAX_PREF_TERMS)
-        for pi, (weight, pterm) in enumerate(preferred[:MAX_PREF_TERMS]):
+        host_pref_terms: list = []
+
+        def _pref_exact(pterm) -> bool:
+            if pterm.match_fields:
+                return False
+            return all(
+                (pe.operator == "In" and len(pe.values) == 1)
+                or pe.operator in ("NotIn", "Exists", "DoesNotExist")
+                for pe in pterm.match_expressions
+            )
+
+        pi = 0
+        for weight, pterm in preferred:
+            if pi >= MAX_PREF_TERMS or not _pref_exact(pterm):
+                host_pref_terms.append((float(weight), pterm))
+                continue
             pref_weight[pi] = float(weight)
             for pe in pterm.match_expressions:
-                if pe.operator == "In" and len(pe.values) == 1:
-                    _set_bit(pref_req[pi], lv.bit(label_bit(pe.key, pe.values[0])))
-                elif pe.operator == "In":
-                    # any-of in a soft term approximated by the first value
+                if pe.operator == "In":
                     _set_bit(pref_req[pi], lv.bit(label_bit(pe.key, pe.values[0])))
                 elif pe.operator == "NotIn":
                     for v in pe.values:
@@ -509,6 +608,7 @@ class SnapshotEncoder:
                     _set_bit(pref_req[pi], lv.bit(label_key_bit(pe.key)))
                 elif pe.operator == "DoesNotExist":
                     _set_bit(pref_forb[pi], lv.bit(label_key_bit(pe.key)))
+            pi += 1
 
         # --- tolerations (expand Exists against the current taint vocab) ---
         tol = np.zeros((Wt,), np.uint32)
@@ -551,15 +651,23 @@ class SnapshotEncoder:
             anyof_valid=anyof_valid,
             tolerations=tol,
             ports=ports,
-            needs_host_eval=bool(host_exprs),
+            needs_host_eval=bool(host_exprs) or host_affinity_terms is not None,
             host_exprs=host_exprs,
             taint_vocab_version=self.vocabs.taints.used_bits(),
             pref_req=pref_req,
             pref_forb=pref_forb,
             pref_weight=pref_weight,
+            host_affinity_terms=host_affinity_terms,
+            host_pref_terms=host_pref_terms or None,
         )
 
-    def _host_eval_mask(self, spec: GroupSpec) -> np.ndarray:
+    def _host_rows(self):
+        """[(node idx, NodeInfo)] — one cache read per node, shared by the
+        host-evaluation passes within one build_batch."""
+        return [(idx, self.cache.get_node(name))
+                for idx, name in list(self.nodes._idx_to_name.items())]
+
+    def _host_eval_mask(self, spec: GroupSpec, rows=None) -> np.ndarray:
         """Evaluate non-tensorizable expressions for every node.
 
         Single pass over the node table per call (one cache read per node, not
@@ -567,8 +675,8 @@ class SnapshotEncoder:
         """
         M = self.nodes.capacity
         mask = np.ones((M,), bool)
-        rows = [(idx, self.cache.get_node(name))
-                for idx, name in list(self.nodes._idx_to_name.items())]
+        if rows is None:
+            rows = self._host_rows()
         for key, op, raw in spec.host_exprs:
             in_values = set(raw.split(",")) if op == "In" else None
             for idx, info in rows:
@@ -591,7 +699,36 @@ class SnapshotEncoder:
                         mask[idx] = False
                         continue
                     mask[idx] &= (ival > target) if op == "Gt" else (ival < target)
+        if spec.host_affinity_terms is not None:
+            # OR-of-terms node affinity, exact K8s semantics
+            for idx, info in rows:
+                if info is None:
+                    continue
+                labels = info.node.metadata.labels
+                name = info.node.name
+                mask[idx] &= any(
+                    _node_matches_term(t, labels, name)
+                    for t in spec.host_affinity_terms
+                )
         return mask
+
+    def _host_pref_scores(self, spec: GroupSpec, rows=None) -> np.ndarray:
+        """[M] score adjustment from host-evaluated preferred terms (same
+        scale as ops.predicates.group_preferred_bonus: weight/100 * 0.25)."""
+        M = self.nodes.capacity
+        scores = np.zeros((M,), np.float32)
+        if rows is None:
+            rows = self._host_rows()
+        for idx, info in rows:
+            if info is None:
+                continue
+            labels = info.node.metadata.labels
+            s = 0.0
+            for weight, pterm in spec.host_pref_terms:
+                if _node_matches_term(pterm, labels, info.node.name):
+                    s += weight / 100.0 * 0.25
+            scores[idx] = s
+        return scores
 
     def build_batch(
         self,
@@ -678,6 +815,8 @@ class SnapshotEncoder:
         g_pref_forb = np.zeros((G, MAX_PREF_TERMS, W), np.uint32)
         g_pref_weight = np.zeros((G, MAX_PREF_TERMS), np.float32)
         host_mask: Optional[np.ndarray] = None
+        host_soft: Optional[np.ndarray] = None
+        host_rows = None
         for gi, spec in enumerate(group_specs):
             T, Wg = spec.term_req.shape
             g_term_req[gi, :T, :Wg] = spec.term_req
@@ -691,10 +830,17 @@ class SnapshotEncoder:
                 g_pref_req[gi, :, : spec.pref_req.shape[1]] = spec.pref_req
                 g_pref_forb[gi, :, : spec.pref_forb.shape[1]] = spec.pref_forb
                 g_pref_weight[gi] = spec.pref_weight
+            if spec.needs_host_eval or spec.host_pref_terms:
+                if host_rows is None:
+                    host_rows = self._host_rows()
             if spec.needs_host_eval:
                 if host_mask is None:
                     host_mask = np.ones((G, self.nodes.capacity), bool)
-                host_mask[gi] = self._host_eval_mask(spec)
+                host_mask[gi] = self._host_eval_mask(spec, host_rows)
+            if spec.host_pref_terms:
+                if host_soft is None:
+                    host_soft = np.zeros((G, self.nodes.capacity), np.float32)
+                host_soft[gi] = self._host_pref_scores(spec, host_rows)
 
         rank_arr = np.zeros((N,), np.float32)
         if ranks is not None:
@@ -753,6 +899,7 @@ class SnapshotEncoder:
             g_pref_forb=g_pref_forb,
             g_pref_weight=g_pref_weight,
             g_host_mask=host_mask,
+            g_host_soft=host_soft,
             locality=locality,
             num_pods=n,
             num_groups=len(group_specs),
