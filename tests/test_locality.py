@@ -407,3 +407,126 @@ def test_symmetry_holder_not_matching_own_term_host_fallback():
     assert batch.locality is not None and batch.locality.fallback
     res = solve_batch(batch, enc.nodes)
     assert assignments(enc, res, batch)[incoming.uid] == "n1"
+
+
+# ---------------------------------------------------------------------------
+# Soft locality: ScheduleAnyway spread + preferred pod (anti-)affinity scoring
+# ---------------------------------------------------------------------------
+
+def soft_spread_pod(name, key="zone", labels=None):
+    labels = labels or {"app": "web"}
+    p = make_pod(name, cpu_milli=100, memory=2**20, labels=labels)
+    p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1, topology_key=key, when_unsatisfiable="ScheduleAnyway",
+        label_selector={"matchLabels": dict(labels)})]
+    return p
+
+
+def test_schedule_anyway_prefers_balance():
+    nodes = [make_node("a0", labels={"zone": "a"}),
+             make_node("b0", labels={"zone": "b"})]
+    cache, enc = make_env(nodes)
+    for i in range(2):
+        ex = make_pod(f"e{i}", cpu_milli=100, node_name="a0", phase="Running",
+                      labels={"app": "web"})
+        cache.update_pod(ex)
+    enc.sync_nodes()
+    p = soft_spread_pod("w0")
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    # 2 in zone a, 0 in b → prefers b (but would not require it)
+    assert assignments(enc, res, batch)[p.uid] == "b0"
+
+
+def test_schedule_anyway_does_not_require():
+    """Unlike DoNotSchedule, ScheduleAnyway must place the pod even when the
+    preferred domain is infeasible."""
+    nodes = [make_node("a0", labels={"zone": "a"}),
+             make_node("b0", cpu_milli=100, labels={"zone": "b"})]  # tiny node
+    cache, enc = make_env(nodes)
+    for i in range(2):
+        ex = make_pod(f"e{i}", cpu_milli=100, node_name="a0", phase="Running",
+                      labels={"app": "web"})
+        cache.update_pod(ex)
+    enc.sync_nodes()
+    p = soft_spread_pod("w0")
+    p.spec.containers[0].resources_requests["cpu"] = "2000m"  # b0 can't fit
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    # zone b is preferred but infeasible → still schedules (in zone a)
+    assert assignments(enc, res, batch)[p.uid] == "a0"
+
+
+def test_schedule_anyway_balances_within_batch():
+    nodes = [make_node("a0", cpu_milli=8000, labels={"zone": "a"}),
+             make_node("b0", cpu_milli=8000, labels={"zone": "b"})]
+    cache, enc = make_env(nodes)
+    pods = [soft_spread_pod(f"w{i}") for i in range(4)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    got = assignments(enc, res, batch)
+    assert all(v is not None for v in got.values())
+    per_zone = {"a0": 0, "b0": 0}
+    for v in got.values():
+        per_zone[v] += 1
+    # dynamic counts steer the batch toward balance
+    assert per_zone["a0"] == 2 and per_zone["b0"] == 2
+
+
+def test_preferred_pod_affinity_colocates():
+    cache, enc = make_env([
+        make_node("n0", labels={"zone": "a"}),
+        make_node("n1", labels={"zone": "b"}),
+    ])
+    db = make_pod("db", cpu_milli=100, node_name="n1", phase="Running",
+                  labels={"app": "db"})
+    cache.update_pod(db)
+    enc.sync_nodes()
+    p = make_pod("web", cpu_milli=100, memory=2**20, labels={"app": "web"})
+    p.spec.affinity = Affinity(pod_affinity_preferred=[
+        (100, PodAffinityTerm(label_selector={"matchLabels": {"app": "db"}},
+                              topology_key="zone"))])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    assert assignments(enc, res, batch)[p.uid] == "n1"
+
+
+def test_preferred_anti_affinity_avoids():
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    noisy = make_pod("noisy", cpu_milli=100, node_name="n0", phase="Running",
+                     labels={"app": "noisy"})
+    cache.update_pod(noisy)
+    enc.sync_nodes()
+    p = make_pod("quiet", cpu_milli=100, memory=2**20)
+    p.spec.affinity = Affinity(pod_anti_affinity_preferred=[
+        (100, PodAffinityTerm(label_selector={"matchLabels": {"app": "noisy"}},
+                              topology_key="kubernetes.io/hostname"))])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    assert assignments(enc, res, batch)[p.uid] == "n1"
+
+
+def test_soft_spill_static_host_scoring():
+    """Soft preferences that spill the slot budget (hard slots full) are
+    statically host-scored into g_host_soft instead of dropped."""
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    db = make_pod("db", cpu_milli=100, node_name="n1", phase="Running",
+                  labels={"app": "db"})
+    cache.update_pod(db)
+    enc.sync_nodes()
+    p = make_pod("busy", cpu_milli=100, memory=2**20)
+    # 6 hard anti terms fill MAX_CONSTRAINT_SLOTS; the preference must spill
+    p.spec.affinity = Affinity(
+        pod_anti_affinity_required=[
+            PodAffinityTerm(label_selector={"matchLabels": {f"z{i}": "t"}},
+                            topology_key="kubernetes.io/hostname")
+            for i in range(6)],
+        pod_affinity_preferred=[
+            (100, PodAffinityTerm(label_selector={"matchLabels": {"app": "db"}},
+                                  topology_key="kubernetes.io/hostname"))],
+    )
+    batch = enc.build_batch([ask_for(p)])
+    assert batch.locality is not None and batch.locality.soft_static
+    assert batch.g_host_soft is not None
+    res = solve_batch(batch, enc.nodes, policy="spread")
+    assert assignments(enc, res, batch)[p.uid] == "n1"

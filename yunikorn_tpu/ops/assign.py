@@ -84,7 +84,8 @@ def _loc_rules_mask(gid_rows, dom_cols, loc, cnt, minc, total, contrib_rows):
         KIND_SPREAD,
     )
 
-    loc_dom, _, _, _, g_refs, g_kind, g_skew, g_seed = loc
+    loc_dom = loc[0]
+    g_refs, g_kind, g_skew, g_seed = loc[4], loc[5], loc[6], loc[7]
     L, M = loc_dom.shape
     D = cnt.shape[1]
     S = g_refs.shape[1]
@@ -122,9 +123,55 @@ def _loc_rules_mask(gid_rows, dom_cols, loc, cnt, minc, total, contrib_rows):
     return ok
 
 
+def _loc_soft_scores(gid_rows, dom_cols, loc, cnt, minc, contrib_rows):
+    """Score adjustments from soft locality slots for pods (rows) × nodes.
+
+    Same row/col conventions as _loc_rules_mask. Soft spread penalizes
+    imbalance above the current minimum domain; soft (anti-)affinity adds the
+    slot's pre-scaled weight per matching pod in the domain. Hard slots carry
+    weight 0 and contribute nothing.
+    """
+    from yunikorn_tpu.snapshot.locality import KIND_SOFT_SPREAD
+
+    loc_dom = loc[0]
+    g_refs, g_kind, g_weight = loc[4], loc[5], loc[8]
+    L, M = loc_dom.shape
+    D = cnt.shape[1]
+    S = g_refs.shape[1]
+    per_node = dom_cols is None
+    out = None
+    for s in range(S):
+        l = g_refs[gid_rows, s]                                        # [C]
+        kind = g_kind[gid_rows, s]
+        w = g_weight[gid_rows, s]
+        lc = jnp.clip(l, 0, L - 1)
+        self_add = jnp.take_along_axis(contrib_rows, lc[:, None], axis=1)[:, 0]
+        self_add = self_add.astype(jnp.int32)
+        if per_node:
+            dom_row = loc_dom[lc]                                      # [C, M]
+        else:
+            dom_row = loc_dom[lc, dom_cols]                            # [C]
+        cnt_row = cnt[lc]                                              # [C, D]
+        dcl = jnp.clip(dom_row, 0, D - 1)
+        if per_node:
+            cnt_at = jnp.take_along_axis(cnt_row, dcl, axis=1)         # [C, M]
+            expand = lambda x: x[:, None]
+        else:
+            cnt_at = jnp.take_along_axis(cnt_row, dcl[:, None], axis=1)[:, 0]
+            expand = lambda x: x
+        has_dom = dom_row >= 0
+        spread_pen = jnp.maximum(
+            cnt_at + expand(self_add) - expand(minc[lc]), 0).astype(jnp.float32)
+        val = jnp.where(expand(kind) == KIND_SOFT_SPREAD, spread_pen,
+                        cnt_at.astype(jnp.float32))
+        adj = jnp.where(has_dom & expand(l >= 0), expand(w) * val, 0.0)
+        out = adj if out is None else out + adj
+    return out
+
+
 def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
                         base_scores, chunk: int, policy: str, loc=None, cnt=None,
-                        minc=None, total=None):
+                        minc=None, total=None, has_loc_soft=True):
     """For every pod: (best node, any feasible?) without materializing [N, M]."""
     N, R = req.shape
     M = free.shape[0]
@@ -140,10 +187,12 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
         for r in range(R):
             margin = jnp.minimum(margin, free[:, r][None, :] - creq[:, r][:, None])
         ok = cfeas & (margin >= 0)
+        scores = jnp.broadcast_to(base_scores[None, :], (chunk, M)) + group_soft[cgid]
         if loc is not None:
             ccontrib = lax.dynamic_slice(loc[3], (start, 0), (chunk, loc[3].shape[1]))
             ok &= _loc_rules_mask(cgid, None, loc, cnt, minc, total, ccontrib)
-        scores = jnp.broadcast_to(base_scores[None, :], (chunk, M)) + group_soft[cgid]
+            if has_loc_soft:
+                scores = scores + _loc_soft_scores(cgid, None, loc, cnt, minc, ccontrib)
         if policy == "align":
             scores = scores + alignment_scores(creq, free, capacity)
         scores = jnp.where(ok, scores, NEG_INF)
@@ -156,7 +205,8 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
 
 
 def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
-                          base_scores, group_soft):
+                          base_scores, group_soft, loc=None, cnt=None,
+                          minc=None, group_contrib=None):
     """Capacity-aware proposals: the batched analog of "fill nodes in score order".
 
     Plain per-pod argmax herds every pod in a constraint group onto the same
@@ -182,7 +232,12 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
 
     def per_group(g):
         feas = group_feas[g]                                   # [M]
-        score = jnp.where(feas, base_scores + group_soft[g], NEG_INF)
+        score = base_scores + group_soft[g]
+        if loc is not None:
+            score = score + _loc_soft_scores(
+                jnp.reshape(g, (1,)), None, loc, cnt, minc,
+                group_contrib[jnp.reshape(g, (1,))])[0]
+        score = jnp.where(feas, score, NEG_INF)
         node_order = jnp.argsort(-score)                       # feasible first
         ofree = jnp.where(feas[node_order, None], free[node_order].astype(jnp.float32), 0.0)
         cumF = jnp.cumsum(ofree, axis=0)                       # [M, R]
@@ -210,10 +265,12 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
 
 def _loc_capped_flags(loc):
     """Per locality group: is it referenced by a spread/anti (capped) slot,
-    and by an affinity slot (for seeding caps)? Computed once per solve."""
+    by an affinity slot (for seeding caps), or by a ScheduleAnyway spread
+    slot (for the balance allowance)? Computed once per solve."""
     from yunikorn_tpu.snapshot.locality import (
         KIND_AFFINITY,
         KIND_ANTI_AFFINITY,
+        KIND_SOFT_SPREAD,
         KIND_SPREAD,
     )
 
@@ -222,16 +279,21 @@ def _loc_capped_flags(loc):
     L = loc_dom.shape[0]
     capped = []
     aff = []
+    soft_spread = []
     for l in range(L):
         ref_l = g_refs == l
         capped.append(jnp.any(ref_l & ((g_kind == KIND_SPREAD) | (g_kind == KIND_ANTI_AFFINITY))))
         aff.append(jnp.any(ref_l & (g_kind == KIND_AFFINITY)))
-    return jnp.stack(capped), jnp.stack(aff)
+        soft_spread.append(jnp.any(ref_l & (g_kind == KIND_SOFT_SPREAD)))
+    return jnp.stack(capped), jnp.stack(aff), jnp.stack(soft_spread)
 
 
-def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total, capped_l, aff_l):
-    """At most ONE accepted pod contributing to a capped locality group per
-    (group, domain) per round.
+def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total,
+                    capped_l, aff_l, allowance_l):
+    """Cap accepted pods contributing to a locality group per (group, domain)
+    per round: 1 for hard spread/anti groups, `allowance_l` (≈ remaining /
+    domains) for ScheduleAnyway spread groups so a batch balances without
+    throttling throughput.
 
     Contribution — not the pod's own constraint slots — is what changes the
     counts, so the cap keys on contrib: a plain pod whose labels match another
@@ -252,7 +314,8 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total, capped_l, aff
     node_cl = jnp.clip(snode, 0, M - 1)
     for l in range(L):
         seeding = aff_l[l] & (total[l] == 0)
-        cap_now = capped_l[l] | seeding
+        cap_now = (allowance_l[l] < N) | seeding
+        limit = jnp.where(capped_l[l] | seeding, 1, allowance_l[l])
         dom_i = loc_dom[l, node_cl]                                    # [N]
         active = cap_now & scontrib[:, l] & (dom_i >= 0) & (snode < M) & accept_sorted
         # seeding caps per GROUP (key 0); spread/anti per domain
@@ -265,7 +328,7 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total, capped_l, aff
         head = lax.cummax(jnp.where(seg_start, idx, 0))
         base = jnp.where(head > 0, c[jnp.maximum(head - 1, 0)], 0)
         within = c - base                                              # inclusive
-        keep2 = (~act2) | (within <= 1)
+        keep2 = (~act2) | (within <= limit)
         keep = jnp.zeros((N,), bool).at[order2].set(keep2)
         accept_sorted = accept_sorted & keep
     return accept_sorted
@@ -273,7 +336,7 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total, capped_l, aff
 
 def _loc_update_counts(cnt, loc, accepted, best, M):
     """Scatter-add this round's placements into the domain counts."""
-    loc_dom, _, _, contrib, _, _, _, _ = loc
+    loc_dom, contrib = loc[0], loc[3]
     L = loc_dom.shape[0]
     D = cnt.shape[1]
     node_cl = jnp.clip(best, 0, M - 1)
@@ -307,7 +370,8 @@ def _segment_prefix_accept(snode, sreq, free_ext, M):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_rounds", "chunk", "policy", "use_pallas", "pallas_interpret"),
+    static_argnames=("max_rounds", "chunk", "policy", "use_pallas",
+                     "pallas_interpret", "has_loc_soft"),
 )
 def solve(
     req,            # [N, R] int32
@@ -323,15 +387,21 @@ def solve(
     host_group_mask=None,   # [G, M] bool or None
     host_group_soft=None,   # [G, M] float32 or None (host-scored soft terms)
     loc=None,       # locality tuple: (dom [L,M], cnt0 [L,D], dom_valid [L,D],
-                    #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed)
+                    #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed,
+                    #  g_weight [G,S] f32 — soft-slot score weights)
     *,
     max_rounds: int = 16,
     chunk: int = 512,
     policy: str = "binpacking",
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    has_loc_soft: bool = True,
 ):
     """One batched solve. Returns (assigned [N] int32, free_after, rounds).
+
+    has_loc_soft=False (static) skips the soft-locality scoring pass for
+    batches whose locality slots are all hard (the common case) — the pass
+    provably sums to zero when every g_weight is 0.
 
     use_pallas routes the per-round best-node computation through the fused
     Pallas kernel (ops/pallas_kernels.py). Only separable scoring policies are
@@ -362,7 +432,20 @@ def solve(
     free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
     cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
     if has_loc:
-        loc_capped_l, loc_aff_l = _loc_capped_flags(loc)
+        loc_capped_l, loc_aff_l, loc_softspread_l = _loc_capped_flags(loc)
+        # per-group contribution flags (all pods in a group share them — the
+        # signature folds labels in whenever locality applies): lets the
+        # water-fill score soft locality per group
+        if has_loc_soft:
+            G = group_feas.shape[0]
+            L = loc[0].shape[0]
+            group_contrib = (jnp.zeros((G, L), jnp.int32)
+                             .at[group_id].max(loc[3].astype(jnp.int32))
+                             .astype(bool))
+        else:
+            group_contrib = None
+    else:
+        group_contrib = None
     init = (
         free_ext0,
         ~valid,                                     # "done" = assigned or invalid
@@ -388,7 +471,9 @@ def solve(
             minc = total = None
 
         proposals = _water_fill_proposals(req, group_id, rank, active, group_feas,
-                                          cur_free, base_scores, group_soft)
+                                          cur_free, base_scores, group_soft,
+                                          loc if has_loc_soft else None,
+                                          cnt, minc, group_contrib)
         prop_fits = jnp.all(free_ext[proposals] >= req, axis=1) & (proposals < M)
         if has_loc:
             # proposals must also satisfy the dynamic locality rules
@@ -407,6 +492,7 @@ def solve(
                 best, feasible = _best_nodes_chunked(
                     req, group_id, group_feas, group_soft, cur_free, capacity,
                     base_scores, chunk, policy, loc, cnt, minc, total,
+                    has_loc_soft,
                 )
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
@@ -424,8 +510,17 @@ def solve(
         sreq = req[order]
         accept_sorted = _segment_prefix_accept(snode, sreq, free_ext, M)
         if has_loc:
+            # soft-spread groups get a per-domain allowance of ceil(remaining
+            # pods / domains): the batch balances across domains within a
+            # round at full throughput, then re-scores with fresh counts
+            remaining = jnp.sum((active[:, None] & loc[3]).astype(jnp.int32), axis=0)
+            n_dom = jnp.maximum(jnp.sum(loc[2].astype(jnp.int32), axis=1), 1)
+            soft_allow = jnp.maximum((remaining + n_dom - 1) // n_dom, 1)
+            allowance_l = jnp.where(loc_capped_l, 1,
+                                    jnp.where(loc_softspread_l, soft_allow, N))
             accept_sorted = _loc_accept_cap(accept_sorted, snode, loc[3][order],
-                                            loc, M, total, loc_capped_l, loc_aff_l)
+                                            loc, M, total, loc_capped_l,
+                                            loc_aff_l, allowance_l)
         # commit accepted capacity
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
         free_ext = free_ext.at[snode].add(-delta)
@@ -488,7 +583,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         lb = batch.locality
         loc = tuple(jnp.asarray(a) for a in (
             lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
-            lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed,
+            lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight,
         ))
     assigned, free_after, rounds = solve(
         jnp.asarray(batch.req.astype(np.int32)),
@@ -525,5 +620,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
                     and not batch.g_pref_weight.any()
                     and getattr(batch, "g_host_soft", None) is None),
         pallas_interpret=pallas_interpret,
+        has_loc_soft=(batch.locality is not None
+                      and bool(np.any(batch.locality.g_weight))),
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

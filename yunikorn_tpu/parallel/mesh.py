@@ -109,7 +109,8 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         # and the per-round count updates are global reductions anyway
         loc_arg = tuple(
             put(a, repl) for a in (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
-                                   lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed)
+                                   lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed,
+                                   lb.g_weight)
         )
 
     with mesh:
@@ -117,5 +118,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
             *args, mask_arg, soft_arg, loc_arg,
             max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
             policy=policy,
+            has_loc_soft=(batch.locality is not None
+                          and bool(np.any(batch.locality.g_weight))),
         )
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -863,6 +863,15 @@ class SnapshotEncoder:
         locality = encode_locality(asks, group_ids, len(group_specs),
                                    self.nodes, self.cache, N, G)
 
+        if locality is not None and locality.soft_static:
+            # soft constraints that spilled the slot budget: statically scored
+            # on the host, folded into the same channel as host-scored
+            # preferred node affinity
+            if host_soft is None:
+                host_soft = np.zeros((G, self.nodes.capacity), np.float32)
+            for gid, s in locality.soft_static.items():
+                host_soft[gid] += s[: self.nodes.capacity]
+
         if locality is not None and locality.fallback:
             # Overflowed locality groups: exact host mask + one pod per solve
             # (the mask is static w.r.t. this batch, so a second pod of the

@@ -38,8 +38,9 @@ join the constraint-group signature exactly when locality is in play
 
 The solver (ops/assign.py) carries cnt as loop state: every accepted pod
 scatter-adds into its domains, and the dynamic feasibility rules are
-re-evaluated each round. ScheduleAnyway (soft) spread is currently ignored
-(scoring hook later).
+re-evaluated each round. Soft constraints (ScheduleAnyway spread, preferred
+pod (anti-)affinity) ride the same counts as weighted score adjustments
+(_loc_soft_scores) — prefer, never require.
 """
 from __future__ import annotations
 
@@ -60,7 +61,21 @@ KIND_NONE = 0
 KIND_SPREAD = 1
 KIND_AFFINITY = 2
 KIND_ANTI_AFFINITY = 3
+# soft (scoring-only) kinds: ScheduleAnyway spread and
+# preferredDuringScheduling pod (anti-)affinity — evaluated from the same
+# per-round domain counts as the hard rules, but adjust scores instead of
+# feasibility (reference: PodTopologySpread / InterPodAffinity Score plugins,
+# predicate_manager.go:302-392 allocation plugin list)
+KIND_SOFT_SPREAD = 4
+KIND_SOFT_AFFINITY = 5
+KIND_SOFT_ANTI = 6
 HOSTNAME_KEY = "kubernetes.io/hostname"
+
+# score scale: a 100-weight preferred term contributes 0.25 (matches
+# ops.predicates.group_preferred_bonus); soft spread penalizes 0.1 per count
+# of imbalance above the minimum domain
+SOFT_WEIGHT_SCALE = 0.25 / 100.0
+SOFT_SPREAD_PENALTY = -0.1
 
 
 def match_selector(selector: Optional[dict], labels: Dict[str, str]) -> bool:
@@ -151,6 +166,33 @@ def _pod_constraints(pod: Pod) -> List[Tuple[int, LocSpec, int]]:
     return out
 
 
+def _pod_soft_constraints(pod: Pod) -> List[Tuple[int, LocSpec, float]]:
+    """(kind, LocSpec, scaled score weight) for the scoring-only constraints:
+    ScheduleAnyway topology spread + preferred pod (anti-)affinity."""
+    out: List[Tuple[int, LocSpec, float]] = []
+    for tsc in pod.spec.topology_spread_constraints:
+        if tsc.when_unsatisfiable != "ScheduleAnyway":
+            continue
+        out.append((KIND_SOFT_SPREAD,
+                    LocSpec(tsc.topology_key, _selector_signature(tsc.label_selector),
+                            (pod.namespace,), tsc.label_selector),
+                    SOFT_SPREAD_PENALTY))
+    if pod.spec.affinity is not None:
+        for weight, term in pod.spec.affinity.pod_affinity_preferred:
+            out.append((KIND_SOFT_AFFINITY,
+                        LocSpec(term.topology_key or HOSTNAME_KEY,
+                                _selector_signature(term.label_selector),
+                                _term_namespaces(term, pod), term.label_selector),
+                        float(weight) * SOFT_WEIGHT_SCALE))
+        for weight, term in pod.spec.affinity.pod_anti_affinity_preferred:
+            out.append((KIND_SOFT_ANTI,
+                        LocSpec(term.topology_key or HOSTNAME_KEY,
+                                _selector_signature(term.label_selector),
+                                _term_namespaces(term, pod), term.label_selector),
+                        -float(weight) * SOFT_WEIGHT_SCALE))
+    return out
+
+
 def _pod_anti_terms(pod: Pod) -> List[AntiTermSpec]:
     if pod.spec.affinity is None:
         return []
@@ -191,19 +233,23 @@ def locality_signature(pod: Pod, cache) -> tuple:
     group-level locality slots are exact.
     """
     cons = _pod_constraints(pod)
+    soft = _pod_soft_constraints(pod)
     matched_terms = tuple(
         (t.topo_key, t.selector_sig, t.namespaces)
         for t in all_anti_terms(cache)
         if t.counts_pod(pod)
     )
-    if not cons and not matched_terms:
+    if not cons and not soft and not matched_terms:
         return ()
     cons_sig = tuple((kind, spec.topo_key, spec.selector_sig, spec.namespaces, skew)
                      for kind, spec, skew in cons)
+    soft_sig = tuple((kind, spec.topo_key, spec.selector_sig, spec.namespaces, w)
+                     for kind, spec, w in soft)
     return (
         tuple(sorted(pod.metadata.labels.items())),
         pod.namespace,
         cons_sig,
+        soft_sig,
         matched_terms,
     )
 
@@ -220,12 +266,17 @@ class LocalityBatch:
     g_kind: np.ndarray       # [G, S] int32
     g_skew: np.ndarray       # [G, S] int32
     g_seed: np.ndarray       # [G, S] bool
+    g_weight: np.ndarray     # [G, S] f32 scaled score weight (soft slots)
     num_groups: int
     # groups whose constraints overflow the tensor encoding, evaluated exactly
     # on the host instead: gid -> [M] feasibility mask against existing
     # cluster state. The encoder serializes these groups (one pod per solve)
     # so intra-batch interactions cannot violate the constraints.
     fallback: Optional[Dict[int, np.ndarray]] = None
+    # soft-constraint score adjustments that spilled out of the slot budget:
+    # gid -> [M] float32, statically scored against existing state; the
+    # encoder folds these into the batch's g_host_soft channel
+    soft_static: Optional[Dict[int, np.ndarray]] = None
 
 
 class _LocAccum:
@@ -247,6 +298,39 @@ class _LocAccum:
         return idx
 
 
+def _host_eval_env(cache, node_arrays):
+    """Shared scaffolding for the host evaluation paths: node rows, placed
+    (pod, node-idx) pairs, and a memoized per-topo-key domain-value map."""
+    rows = list(node_arrays._idx_to_name.items())
+    placed: List[Tuple[Pod, int]] = []
+    for p in cache.pods_map.values():
+        node_name = cache.assigned_pods.get(p.uid)
+        if node_name is None:
+            continue
+        n_idx = node_arrays._name_to_idx.get(node_name)
+        if n_idx is not None:
+            placed.append((p, n_idx))
+    dom_cache: Dict[str, Dict[int, Optional[str]]] = {}
+
+    def vals_of(topo_key: str) -> Dict[int, Optional[str]]:
+        vals = dom_cache.get(topo_key)
+        if vals is not None:
+            return vals
+        vals = {}
+        for idx, name in rows:
+            info = cache.get_node(name)
+            if info is None:
+                continue
+            v = info.node.metadata.labels.get(topo_key)
+            if topo_key == HOSTNAME_KEY and v is None:
+                v = name
+            vals[idx] = v
+        dom_cache[topo_key] = vals
+        return vals
+
+    return rows, placed, vals_of
+
+
 def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
     """Exact per-pod evaluation of locality constraints on the host.
 
@@ -260,41 +344,12 @@ def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
     """
     M = node_arrays.capacity
     ok = np.zeros(M, bool)
-    rows = list(node_arrays._idx_to_name.items())
+    rows, placed, vals_of = _host_eval_env(cache, node_arrays)
     for idx, _name in rows:
         ok[idx] = True
 
-    placed: List[Tuple[Pod, int]] = []
-    for p in cache.pods_map.values():
-        node_name = cache.assigned_pods.get(p.uid)
-        if node_name is None:
-            continue
-        n_idx = node_arrays._name_to_idx.get(node_name)
-        if n_idx is not None:
-            placed.append((p, n_idx))
-
-    def domain_values(topo_key: str) -> Dict[int, Optional[str]]:
-        vals: Dict[int, Optional[str]] = {}
-        for idx, name in rows:
-            info = cache.get_node(name)
-            if info is None:
-                continue
-            v = info.node.metadata.labels.get(topo_key)
-            if topo_key == HOSTNAME_KEY and v is None:
-                v = name
-            vals[idx] = v
-        return vals
-
-    dom_cache: Dict[str, Dict[int, Optional[str]]] = {}
-
-    def cached_domain_values(topo_key: str) -> Dict[int, Optional[str]]:
-        vals = dom_cache.get(topo_key)
-        if vals is None:
-            vals = dom_cache[topo_key] = domain_values(topo_key)
-        return vals
-
     for kind, spec, skew in _pod_constraints(pod):
-        vals = cached_domain_values(spec.topo_key)
+        vals = vals_of(spec.topo_key)
         counts: Dict[str, int] = {}
         for p, n_idx in placed:
             v = vals.get(n_idx)
@@ -326,7 +381,7 @@ def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
     if sym_terms:
         placed_terms = [(n_idx, set(_pod_anti_terms(p))) for p, n_idx in placed]
         for t in sym_terms:
-            vals = cached_domain_values(t.topo_key)
+            vals = vals_of(t.topo_key)
             holder_domains: set = set()
             for n_idx, terms in placed_terms:
                 v = vals.get(n_idx)
@@ -339,6 +394,40 @@ def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
                 if v is not None and v in holder_domains:
                     ok[idx] = False
     return ok
+
+
+def host_locality_soft_scores(pod: Pod, soft_cons, cache, node_arrays) -> np.ndarray:
+    """[M] float32 score adjustment for soft constraints scored on the host.
+
+    Used when soft slots spill the tensor budget: same rules as the in-solve
+    _loc_soft_scores but against *existing* cluster state only (exact for
+    scoring the first pod; later pods re-score each cycle as the cache fills).
+    Weights arrive pre-scaled (_pod_soft_constraints).
+    """
+    M = node_arrays.capacity
+    scores = np.zeros((M,), np.float32)
+    rows, placed, vals_of = _host_eval_env(cache, node_arrays)
+
+    for kind, spec, weight in soft_cons:
+        vals = vals_of(spec.topo_key)
+        counts: Dict[str, int] = {}
+        for p, n_idx in placed:
+            v = vals.get(n_idx)
+            if v is not None and spec.counts_pod(p):
+                counts[v] = counts.get(v, 0) + 1
+        valid_domains = {v for v in vals.values() if v is not None}
+        minc = min((counts.get(v, 0) for v in valid_domains), default=0)
+        self_add = 1 if (kind == KIND_SOFT_SPREAD and spec.counts_pod(pod)) else 0
+        for idx, _name in rows:
+            v = vals.get(idx)
+            if v is None:
+                continue
+            cnt_at = counts.get(v, 0)
+            if kind == KIND_SOFT_SPREAD:
+                scores[idx] += weight * max(cnt_at + self_add - minc, 0)
+            else:  # SOFT_AFFINITY (+w) / SOFT_ANTI (-w): per matching pod
+                scores[idx] += weight * cnt_at
+    return scores
 
 
 def encode_locality(
@@ -362,6 +451,8 @@ def encode_locality(
     g_kind = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), np.int32)
     g_skew = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), np.int32)
     g_seed = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), bool)
+    g_weight = np.zeros((batch_g, MAX_CONSTRAINT_SLOTS), np.float32)
+    soft_static: Dict[int, np.ndarray] = {}
     seen_groups: set = set()
     any_constraint = False
     anti_terms = all_anti_terms(cache)
@@ -383,12 +474,14 @@ def encode_locality(
         seen_groups.add(gid)
         pod = ask.pod
         cons = _pod_constraints(pod)
+        soft_cons = _pod_soft_constraints(pod)
         # symmetry: anti terms (held by anyone) whose selector matches this pod
         sym_slots = [t for t in anti_terms if t.counts_pod(pod)]
-        if not cons and not sym_slots:
+        if not cons and not soft_cons and not sym_slots:
             continue
         any_constraint = True
-        slots: List[Tuple[int, int, int, bool]] = []  # (l, kind, skew, seed)
+        # (l, kind, skew, seed, weight); hard slots carry weight 0
+        slots: List[Tuple[int, int, int, bool, float]] = []
         ok = True
         for kind, spec, skew in cons:
             l_idx = accum.intern(spec, holder=False)
@@ -396,7 +489,8 @@ def encode_locality(
                 ok = False
                 break
             seed = kind == KIND_AFFINITY and spec.counts_pod(pod)
-            slots.append((l_idx, kind, max(1, skew) if kind == KIND_SPREAD else 0, seed))
+            slots.append((l_idx, kind, max(1, skew) if kind == KIND_SPREAD else 0,
+                          seed, 0.0))
         if ok:
             for t in sym_slots:
                 # NOTE: even when the pod holds t itself, the primary slot is
@@ -407,15 +501,35 @@ def encode_locality(
                 if l_idx < 0:
                     ok = False
                     break
-                slots.append((l_idx, KIND_ANTI_AFFINITY, 0, False))
+                slots.append((l_idx, KIND_ANTI_AFFINITY, 0, False, 0.0))
         if not ok or len(slots) > MAX_CONSTRAINT_SLOTS:
             fall_back(gid, pod, "group or slot overflow")
+            if soft_cons:
+                soft_static[gid] = host_locality_soft_scores(
+                    pod, soft_cons, cache, node_arrays)
             continue
-        for s, (l, kind, skew, seed) in enumerate(slots):
+        # soft (scoring) slots fill whatever budget remains; ones that don't
+        # fit are scored statically against existing state instead (approximate
+        # only w.r.t. this batch's own placements — scoring, not feasibility)
+        soft_spill: List[Tuple[int, LocSpec, float]] = []
+        for kind, spec, weight in soft_cons:
+            if len(slots) >= MAX_CONSTRAINT_SLOTS:
+                soft_spill.append((kind, spec, weight))
+                continue
+            l_idx = accum.intern(spec, holder=False)
+            if l_idx < 0:
+                soft_spill.append((kind, spec, weight))
+                continue
+            slots.append((l_idx, kind, 0, False, weight))
+        if soft_spill:
+            soft_static[gid] = host_locality_soft_scores(
+                pod, soft_spill, cache, node_arrays)
+        for s, (l, kind, skew, seed, weight) in enumerate(slots):
             g_refs[gid, s] = l
             g_kind[gid, s] = kind
             g_skew[gid, s] = skew
             g_seed[gid, s] = seed
+            g_weight[gid, s] = weight
     if not any_constraint:
         return None
 
@@ -497,6 +611,8 @@ def encode_locality(
     return LocalityBatch(
         dom=dom, cnt0=cnt0, dom_valid=dom_valid, contrib=contrib,
         g_refs=g_refs, g_kind=g_kind, g_skew=g_skew, g_seed=g_seed,
+        g_weight=g_weight,
         num_groups=len(accum.specs),
         fallback=fallback or None,
+        soft_static=soft_static or None,
     )
