@@ -343,3 +343,82 @@ def test_deadlock_detection_fires(monkeypatch):
     with pytest.raises(locking.DeadlockError):
         rw.r_acquire()
     rw.release()
+
+
+def test_dispatcher_overflow_preserves_fifo_and_limit():
+    """Round-2: overflow rides ONE retry worker (not a thread per event) and
+    keeps FIFO order among overflowed events; past the async limit dispatch
+    raises (reference dispatcher.go:73,176-180 semantics)."""
+    d = Dispatcher(capacity=1)
+    d._async_limit = 5  # shrink for the test
+    got = []
+    release = threading.Event()
+
+    def slow_handler(e):
+        release.wait(5)
+        got.append(e.application_id)
+
+    entered = threading.Event()
+
+    def gate_handler(e):
+        entered.set()
+        slow_handler(e)
+
+    d.register_event_handler("app", EventType.APPLICATION, gate_handler)
+    d.start()
+    try:
+        # park the consumer inside the handler first so the queue slot is
+        # deterministically occupied by the next dispatch
+        d.dispatch(AppEventRecord("app-0", "Submit"))
+        assert entered.wait(5)
+        threads_before = threading.active_count()
+        # 1 slot in queue + 5 overflow = 6 more accepted; the 7th must raise
+        for i in range(1, 7):
+            d.dispatch(AppEventRecord(f"app-{i}", "Submit"))
+        from yunikorn_tpu.dispatcher.dispatcher import DispatchError
+
+        with pytest.raises(DispatchError):
+            d.dispatch(AppEventRecord("app-too-many", "Submit"))
+        # no thread-per-event explosion (round-1 spawned one per overflow)
+        assert threading.active_count() - threads_before <= 1
+        release.set()
+        deadline = time.time() + 10
+        while len(got) < 7 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(got) == 7  # app-0 (parked) + app-1 (queued) + 5 overflowed
+        # the overflowed events (2..6) must arrive in dispatch order
+        overflowed = got[2:]
+        assert overflowed == sorted(overflowed, key=lambda s: int(s.split("-")[1]))
+    finally:
+        d.stop()
+
+
+def test_rmutex_reentrant_and_detects():
+    from yunikorn_tpu.locking import locking as lk
+
+    m = lk.RMutex()
+    with m:
+        with m:  # reentrant acquire must not deadlock
+            pass
+
+    # detection: a second thread times out on a held Mutex
+    old_enabled, old_timeout = lk.DETECTION_ENABLED, lk.TIMEOUT_SECONDS
+    lk.DETECTION_ENABLED, lk.TIMEOUT_SECONDS = True, 0.2
+    try:
+        m2 = lk.Mutex()
+        m2.acquire()
+        errs = []
+
+        def try_acquire():
+            try:
+                m2.acquire()
+            except lk.DeadlockError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=try_acquire)
+        t.start()
+        t.join(5)
+        assert errs, "expected DeadlockError on contended Mutex"
+        m2.release()
+    finally:
+        lk.DETECTION_ENABLED, lk.TIMEOUT_SECONDS = old_enabled, old_timeout

@@ -6,9 +6,9 @@ priority_class_cache.go:34-120 (allow-preemption annotation).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common import constants
 
 TRI_TRUE = 1
@@ -24,7 +24,7 @@ def _tri(value: Optional[str]) -> int:
 
 class NamespaceCache:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._flags: Dict[str, tuple] = {}  # ns -> (enableYuniKorn, generateAppId)
 
     def namespace_updated(self, name: str, annotations: Dict[str, str]) -> None:
@@ -49,7 +49,7 @@ class NamespaceCache:
 
 class PriorityClassCache:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._allow: Dict[str, bool] = {}
 
     def priority_class_updated(self, name: str, annotations: Dict[str, str]) -> None:

@@ -8,9 +8,9 @@ from __future__ import annotations
 
 import dataclasses
 import re
-import threading
 from typing import Dict, List, Optional, Pattern
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.log.logger import log
 
 logger = log("admission.conf")
@@ -127,7 +127,7 @@ def parse_admission_conf(flat: Dict[str, str], namespace: str = "yunikorn") -> A
 
 class AdmissionConfHolder:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._conf = AdmissionConf()
 
     def get(self) -> AdmissionConf:

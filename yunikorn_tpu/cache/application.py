@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.events import AppEventRecord, get_recorder
 from yunikorn_tpu.common.si import (
@@ -87,7 +88,7 @@ class Application:
         self.submit_time = time.time()
         self.placeholder_asks_sent = False
         self.origin_task_id: Optional[str] = None
-        self._lock = threading.RLock()
+        self._lock = locking.RMutex()
         self.fsm = FSM(NEW, _TRANSITIONS, {
             "enter_state": self._log_transition,
             "after_" + SUBMIT_APPLICATION: lambda e: self._handle_submit(),

@@ -16,9 +16,9 @@ the serialization point the TPU design removes (SURVEY.md L2 note).
 """
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.cache import application as app_mod
 from yunikorn_tpu.cache import task as task_mod
 from yunikorn_tpu.cache.application import Application
@@ -121,7 +121,7 @@ class Context:
         self._namespaces: Dict[str, Dict[str, str]] = {}
         # foreign pods already reported to the core: uid -> (node, resource)
         self._foreign_sent: Dict[str, tuple] = {}
-        self._lock = threading.RLock()
+        self._lock = locking.RMutex()
         self._initialized = False
 
     # convenience alias matching the reference naming

@@ -11,6 +11,7 @@ import threading
 import time
 from typing import Dict
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common.events import AppEventRecord, get_recorder
 from yunikorn_tpu.common.objects import Pod
 from yunikorn_tpu.cache.placeholder import gen_placeholder_name, new_placeholder
@@ -25,7 +26,7 @@ class PlaceholderManager:
     def __init__(self, api_provider):
         self.api_provider = api_provider
         self._orphans: Dict[str, Pod] = {}
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._running = threading.Event()
         self._thread = None
 

@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Optional
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.events import AppEventRecord, TaskEventRecord, get_recorder
 from yunikorn_tpu.common.objects import Pod, PodCondition
@@ -95,7 +96,7 @@ class Task:
         self.created_time = pod.metadata.creation_timestamp
         self.scheduling_state = TaskSchedulingState.PENDING
         self.terminated_reason = ""
-        self._lock = threading.RLock()
+        self._lock = locking.RMutex()
         self.fsm = FSM(NEW, _TRANSITIONS, {
             "enter_state": self._log_transition,
             "enter_" + PENDING: lambda e: self._post_pending(),

@@ -12,10 +12,10 @@ records BindStats (first/last bind time + count) for throughput measurement
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, List, Optional
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.client.interfaces import (
     APIProvider,
     InformerType,
@@ -60,7 +60,7 @@ class FakeKubeClient(KubeClient):
         self.bind_fn = None      # test hook: override bind behavior
         self.create_fn = None
         self.delete_fn = None
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
 
     def bind(self, pod: Pod, node_name: str) -> None:
         try:
@@ -108,7 +108,7 @@ class FakeCluster(APIProvider):
     """In-memory cluster: object store + synchronous informer fan-out."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locking.RMutex()
         self._pods: Dict[str, Pod] = {}
         self._nodes: Dict[str, Node] = {}
         self._configmaps: Dict[str, ConfigMap] = {}

@@ -7,10 +7,10 @@ ApplicationEvent / TaskEvent / SchedulerNodeEvent interfaces) and recorder.go:27
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Any, List, Optional, Tuple
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.log.logger import log
 
 logger = log("shim.utils")
@@ -122,7 +122,7 @@ class EventRecorder:
     """
 
     def __init__(self, capacity: int = 100000):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._events: List[RecordedEvent] = []
         self._capacity = capacity
 
@@ -152,7 +152,7 @@ class EventRecorder:
             self._events.clear()
 
 
-_recorder_lock = threading.Lock()
+_recorder_lock = locking.Mutex()
 _recorder: Optional[EventRecorder] = None
 
 

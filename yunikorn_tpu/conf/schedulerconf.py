@@ -13,9 +13,9 @@ from __future__ import annotations
 import base64
 import dataclasses
 import gzip
-import threading
 from typing import Dict, List, Optional, Tuple
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.log.logger import log, update_logging_config
 
@@ -260,7 +260,7 @@ class ConfHolder:
     """Atomic config holder with hot-reload semantics (reference confHolder)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._conf = SchedulerConf()
         self._queues_config: str = ""
         self._extra: Dict[str, str] = {}

@@ -33,11 +33,11 @@ core's convention.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Dict, List, Optional
 
 import yaml
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.resource import Resource, parse_quantity
 from yunikorn_tpu.log.logger import log
@@ -361,7 +361,7 @@ class QueueTree:
     """The live hierarchy + placement: resolve app queue names to leaves."""
 
     def __init__(self, config: Optional[QueueConfig] = None):
-        self._lock = threading.RLock()
+        self._lock = locking.RMutex()
         self.root = Queue(ROOT, None, config)
         if config is not None:
             self._build(self.root, config)

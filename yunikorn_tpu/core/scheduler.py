@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 
 import yaml
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.resource import Resource
@@ -83,7 +84,7 @@ class CoreScheduler(SchedulerAPI):
 
     def __init__(self, cache: SchedulerCache, interval: float = 0.1,
                  solver_policy: Optional[str] = None):
-        self._lock = threading.RLock()
+        self._lock = locking.RMutex()
         self.cache = cache
         self.encoder = SnapshotEncoder(cache)
         self.partition = Partition()

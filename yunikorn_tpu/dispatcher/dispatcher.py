@@ -4,8 +4,13 @@ Role-equivalent to pkg/dispatcher/dispatcher.go: a singleton with typed handlers
 for Application / Task / Node / Scheduler events (:40-46), a large buffered channel
 (capacity = conf EventChannelCapacity, default 1,048,576), non-blocking enqueue with
 an async-retry fallback (retry every 3s up to DispatchTimeout, :157-201), a hard
-failure when the number of in-flight async retries exceeds max(10000, cap/10)
+failure when the number of queued async retries exceeds max(10000, cap/10)
 (:73,176-180), and a single consumer thread that routes by event type (:220-242).
+
+Where the reference spawns one goroutine per overflow event (cheap in Go),
+here overflow events queue onto ONE retry worker — 10k Python threads would
+kill the process, and a single worker additionally preserves FIFO order among
+the overflowed events.
 
 The single consumer is the concurrency linchpin: events for any one object are
 processed serially, so the FSMs never race. The TPU solver runs outside this
@@ -14,11 +19,14 @@ core callbacks do.
 """
 from __future__ import annotations
 
+import collections
 import enum
 import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+from yunikorn_tpu.locking import locking
 
 from yunikorn_tpu.common.events import (
     ApplicationEvent,
@@ -48,12 +56,15 @@ class Dispatcher:
     def __init__(self, capacity: int = 1024 * 1024, dispatch_timeout: float = 300.0):
         self._queue: "queue.Queue[Optional[SchedulingEvent]]" = queue.Queue(maxsize=capacity)
         self._handlers: Dict[EventType, List[Callable[[SchedulingEvent], None]]] = {}
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._running = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._dispatch_timeout = dispatch_timeout
         self._async_limit = max(10000, capacity // 10)
-        self._inflight_async = 0
+        # overflow events wait here for the single retry worker (FIFO)
+        self._overflow: Deque[Tuple[SchedulingEvent, float]] = collections.deque()
+        self._overflow_cond = threading.Condition()
+        self._retry_thread: Optional[threading.Thread] = None
         self._drained = threading.Event()
         self._drained.set()
 
@@ -70,36 +81,42 @@ class Dispatcher:
 
     # -- dispatch -----------------------------------------------------------
     def dispatch(self, event: SchedulingEvent) -> None:
-        """Non-blocking enqueue; falls back to an async retry thread when full."""
+        """Non-blocking enqueue; overflow queues onto the single retry worker."""
         if not self._running.is_set():
             raise DispatchError("dispatcher is not running")
         self._drained.clear()
         try:
             self._queue.put_nowait(event)
         except queue.Full:
-            with self._lock:
-                if self._inflight_async >= self._async_limit:
+            with self._overflow_cond:
+                if len(self._overflow) >= self._async_limit:
                     raise DispatchError(
                         f"dispatcher exceeded async-dispatch limit {self._async_limit}"
                     )
-                self._inflight_async += 1
-            t = threading.Thread(target=self._async_retry, args=(event,), daemon=True)
-            t.start()
+                self._overflow.append((event, time.time() + self._dispatch_timeout))
+                self._overflow_cond.notify()
 
-    def _async_retry(self, event: SchedulingEvent) -> None:
-        deadline = time.time() + self._dispatch_timeout
-        try:
-            while self._running.is_set():
-                try:
-                    self._queue.put(event, timeout=ASYNC_RETRY_INTERVAL)
+    def _retry_loop(self) -> None:
+        """Single worker: drains the overflow deque into the main queue in
+        FIFO order, dropping events whose dispatch timeout passed."""
+        while self._running.is_set():
+            with self._overflow_cond:
+                while not self._overflow and self._running.is_set():
+                    self._overflow_cond.wait(timeout=ASYNC_RETRY_INTERVAL)
+                if not self._running.is_set():
                     return
-                except queue.Full:
-                    if time.time() > deadline:
-                        logger.error("dispatch timeout for event %s", event)
-                        return
-        finally:
-            with self._lock:
-                self._inflight_async -= 1
+                event, deadline = self._overflow[0]
+            try:
+                self._queue.put(event, timeout=ASYNC_RETRY_INTERVAL)
+                self._drained.clear()  # in flight again (consumer re-sets on idle)
+                with self._overflow_cond:
+                    # single popper: only this worker ever removes entries
+                    self._overflow.popleft()
+            except queue.Full:
+                if time.time() > deadline:
+                    logger.error("dispatch timeout for event %s", event)
+                    with self._overflow_cond:
+                        self._overflow.popleft()
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -108,20 +125,39 @@ class Dispatcher:
         self._running.set()
         self._thread = threading.Thread(target=self._run, name="dispatcher", daemon=True)
         self._thread.start()
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, name="dispatcher-retry", daemon=True)
+        self._retry_thread.start()
 
     def stop(self) -> None:
         """Stop the consumer after draining what is already queued."""
         if not self._running.is_set():
             return
         self._running.clear()
+        with self._overflow_cond:
+            self._overflow_cond.notify_all()  # wake the retry worker to exit
         self._queue.put(None)  # wake the consumer
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        if self._retry_thread is not None:
+            self._retry_thread.join(timeout=10)
+            self._retry_thread = None
 
     def drain(self, timeout: float = 30.0) -> bool:
-        """Block until the queue is empty and the consumer is idle (test helper)."""
-        return self._drained.wait(timeout=timeout)
+        """Block until the overflow deque and queue are empty and the consumer
+        is idle (test helper)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._overflow_cond:
+                overflow_empty = not self._overflow
+            if overflow_empty and self._drained.wait(timeout=0.05):
+                with self._overflow_cond:
+                    if not self._overflow:  # nothing slipped in meanwhile
+                        return True
+            else:
+                time.sleep(0.01)
+        return False
 
     def _run(self) -> None:
         while True:
@@ -170,7 +206,7 @@ class Dispatcher:
 # ---------------------------------------------------------------------------
 
 _instance: Optional[Dispatcher] = None
-_instance_lock = threading.Lock()
+_instance_lock = locking.Mutex()
 
 
 def get_dispatcher() -> Dispatcher:

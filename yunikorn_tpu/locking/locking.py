@@ -72,6 +72,23 @@ class Mutex:
         return False
 
 
+class RMutex(Mutex):
+    """Reentrant mutex (threading.RLock analog) with optional detection.
+
+    The reference wraps every mutex in the codebase (locking.go:38-44);
+    components whose call graphs re-enter their own lock use this variant.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._holder: Optional[str] = None
+
+    def release(self) -> None:
+        # unlike Mutex, keep _holder: under nesting the outer frames still
+        # hold the lock; the name is diagnostic only either way
+        self._lock.release()
+
+
 class RWMutex:
     """Reader-writer lock (writer-preferring) with optional deadlock detection.
 

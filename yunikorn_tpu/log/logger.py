@@ -10,8 +10,9 @@ from __future__ import annotations
 
 import logging
 import sys
-import threading
 from typing import Dict, Optional
+
+from yunikorn_tpu.locking import locking
 
 _ROOT_NAME = "yunikorn"
 
@@ -71,7 +72,7 @@ _LEVELS = {
     "5": logging.CRITICAL,
 }
 
-_lock = threading.Lock()
+_lock = locking.Mutex()
 _configured = False
 _current_config: Dict[str, str] = {}
 

@@ -46,7 +46,10 @@ from jax import lax
 from yunikorn_tpu.models.policies import alignment_scores, node_base_scores
 from yunikorn_tpu.ops.predicates import group_feasibility, group_preferred_bonus, group_soft_penalty
 
-NEG_INF = jnp.float32(-3.0e38)
+# plain Python float (weak-typed, promotes to f32 inside jit): a module-level
+# jnp constant would initialize the JAX backend at import — the scheduler
+# binary must not dial the TPU before it means to
+NEG_INF = -3.0e38
 
 
 @dataclasses.dataclass

@@ -18,9 +18,9 @@ per dimension over a cluster's life).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Tuple
 
+from yunikorn_tpu.locking import locking
 from yunikorn_tpu.common.resource import CPU, EPHEMERAL_STORAGE, MEMORY, PODS
 
 WORD_BITS = 32
@@ -44,7 +44,7 @@ class ResourceVocab:
     ]
 
     def __init__(self, min_slots: int = 8):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._slots: Dict[str, int] = {}
         self._scales: Dict[str, int] = {}
         self._min_slots = min_slots
@@ -88,7 +88,7 @@ class BitVocab:
     """Interned symbols → bit positions; exposes word count W (padded)."""
 
     def __init__(self, min_words: int = 4):
-        self._lock = threading.Lock()
+        self._lock = locking.Mutex()
         self._bits: Dict[object, int] = {}
         self._min_words = min_words
 
