@@ -765,3 +765,199 @@ def test_foreign_allocation_update_does_not_double_count():
     core.update_allocation(AllocationRequest(allocations=[f3]))
     assert core.partition.nodes["node-0"].occupied.get("cpu") == 0
     assert core.partition.nodes["node-1"].occupied.get("cpu") == 2000
+
+
+# ---------------------------------------------------------------------------
+# Placement rules + multi-partition (round-2)
+# ---------------------------------------------------------------------------
+
+PLACEMENT_YAML = """
+partitions:
+  - name: default
+    placementrules:
+      - name: user
+        filter:
+          type: allow
+          users: [admin]
+      - name: group
+        parent:
+          name: fixed
+          value: root.teams
+        filter:
+          type: allow
+          groups: [devs]
+      - name: tag
+        value: namespace
+    queues:
+      - name: root
+        queues:
+          - name: default
+"""
+
+
+def _add_app_user(core, app_id, user, groups=(), queue="", tags=None):
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id=app_id, queue_name=queue,
+                              user=UserGroupInfo(user=user, groups=list(groups)),
+                              tags=dict(tags or {}))]))
+
+
+def test_placement_rule_user_routes_to_user_queue():
+    cache, cb, core = make_core(queues_yaml=PLACEMENT_YAML)
+    _add_app_user(core, "app-a", "admin")
+    assert core.partition.get_application("app-a").queue_name == "root.admin"
+
+
+def test_placement_rule_chain_fallthrough_and_filters():
+    cache, cb, core = make_core(queues_yaml=PLACEMENT_YAML)
+    # not admin → user rule filtered out; in devs → group rule with parent
+    _add_app_user(core, "app-b", "bob", groups=["devs"])
+    assert core.partition.get_application("app-b").queue_name == "root.teams.devs"
+    # neither → tag rule places by namespace
+    _add_app_user(core, "app-c", "carol", tags={"namespace": "batch"})
+    assert core.partition.get_application("app-c").queue_name == "root.batch"
+    # no rule matches at all → rejected
+    _add_app_user(core, "app-d", "dave")
+    assert core.partition.get_application("app-d") is None
+    assert any(a == "app-d" for a, _ in cb.rejected_apps)
+
+
+def test_placement_rule_sanitizes_dotted_user():
+    yaml_text = """
+partitions:
+  - name: default
+    placementrules:
+      - name: user
+    queues:
+      - name: root
+"""
+    cache, cb, core = make_core(queues_yaml=yaml_text)
+    _add_app_user(core, "app-e", "jane.doe")
+    assert core.partition.get_application("app-e").queue_name == "root.jane_dot_doe"
+
+
+MULTI_PARTITION_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: default
+  - name: gpu
+    nodesortpolicy:
+      type: fair
+    queues:
+      - name: root
+        queues:
+          - name: default
+          - name: capped
+            resources:
+              max: {vcore: 1}
+"""
+
+
+def test_second_partition_schedules_independently():
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="rm-1", policy_group="queues", config=MULTI_PARTITION_YAML), cb)
+    infos = []
+    for i in range(2):
+        n = make_node(f"cpu-{i}", cpu_milli=8000)
+        cache.update_node(n)
+        infos.append(NodeInfo(node_id=n.name, action=NodeAction.CREATE))
+    for i in range(2):
+        n = make_node(f"gpu-{i}", cpu_milli=8000)
+        cache.update_node(n)
+        infos.append(NodeInfo(node_id=n.name, action=NodeAction.CREATE,
+                              attributes={"si/node-partition": "gpu"}))
+    core.update_node(NodeRequest(nodes=infos))
+    assert set(core.partitions) == {"default", "gpu"}
+    assert set(core.partitions["gpu"].nodes) == {"gpu-0", "gpu-1"}
+
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="cpu-app", queue_name="root.default",
+                              user=UserGroupInfo(user="u")),
+        AddApplicationRequest(application_id="gpu-app", queue_name="root.default",
+                              partition="gpu", user=UserGroupInfo(user="u")),
+    ]))
+    asks = [ask_of("cpu-app", f"c{i}") for i in range(4)]
+    asks += [ask_of("gpu-app", f"g{i}") for i in range(4)]
+    core.update_allocation(AllocationRequest(asks=asks))
+    core.schedule_once()
+    by_key = {a.allocation_key: a.node_id for a in cb.allocations}
+    assert len(by_key) == 8
+    for i in range(4):
+        assert by_key[f"c{i}"].startswith("cpu-")
+        assert by_key[f"g{i}"].startswith("gpu-")
+
+
+def test_partition_quota_independent():
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="rm-1", policy_group="queues", config=MULTI_PARTITION_YAML), cb)
+    n = make_node("gpu-0", cpu_milli=16000)
+    cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[NodeInfo(
+        node_id="gpu-0", action=NodeAction.CREATE,
+        attributes={"si/node-partition": "gpu"})]))
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="capped-app", queue_name="root.capped",
+                              partition="gpu", user=UserGroupInfo(user="u"))]))
+    asks = [ask_of("capped-app", f"p{i}", cpu=1000) for i in range(3)]
+    core.update_allocation(AllocationRequest(asks=asks))
+    n_alloc = core.schedule_once()
+    assert n_alloc == 1  # gpu partition's root.capped max 1 vcore
+
+
+def test_partition_removed_from_config_drains():
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="rm-1", policy_group="queues", config=MULTI_PARTITION_YAML), cb)
+    n = make_node("gpu-0", cpu_milli=8000)
+    cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[NodeInfo(
+        node_id="gpu-0", action=NodeAction.CREATE,
+        attributes={"si/node-partition": "gpu"})]))
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="g-app", queue_name="root.default",
+                              partition="gpu", user=UserGroupInfo(user="u"))]))
+    assert core.partitions["gpu"].get_application("g-app") is not None
+    # reload config WITHOUT the gpu partition → drains (nodes still present)
+    single = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues: [{name: default}]
+"""
+    core.update_configuration(single, {})
+    assert core.partitions["gpu"].draining
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="late-app", queue_name="root.default",
+                              partition="gpu", user=UserGroupInfo(user="u"))]))
+    assert any(a == "late-app" for a, _ in cb.rejected_apps)
+    # no new scheduling in the drained partition
+    core.update_allocation(AllocationRequest(asks=[ask_of("g-app", "g0")]))
+    assert core.schedule_once() == 0
+
+
+def test_duplicate_node_across_partitions_rejected():
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="rm-1", policy_group="queues", config=MULTI_PARTITION_YAML), cb)
+    n = make_node("n0", cpu_milli=8000)
+    cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[NodeInfo(node_id="n0", action=NodeAction.CREATE)]))
+    core.update_node(NodeRequest(nodes=[NodeInfo(
+        node_id="n0", action=NodeAction.CREATE,
+        attributes={"si/node-partition": "gpu"})]))
+    assert "n0" in core.partitions["default"].nodes
+    assert "n0" not in core.partitions["gpu"].nodes

@@ -80,6 +80,9 @@ class Partition:
         self.applications: Dict[str, CoreApplication] = {}
         self.nodes: Dict[str, CoreNode] = {}
         self.foreign_allocations: Dict[str, Allocation] = {}  # key -> allocation
+        # set when a config reload drops this partition: existing work drains,
+        # no new apps and no new scheduling cycles
+        self.draining = False
 
     def active_node_count(self) -> int:
         return sum(1 for n in self.nodes.values() if n.schedulable)

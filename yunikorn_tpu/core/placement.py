@@ -3,11 +3,18 @@
 Role-equivalent to yunikorn-core's placement-rule chain (the reference shim
 feeds it queue names plus namespace tags — context.go:922-1023 adds namespace
 quota/parent-queue tags; utils.go:102-118 resolves provided queue names). The
-default chain matches the reference deployment's behavior:
+default chain (no `placementrules:` configured) matches the reference
+deployment's behavior:
 
   1. provided      — the queue the workload named (labels/annotations)
   2. tag namespace — root.<namespace>, optionally nested under the namespace's
                      parent-queue annotation (yunikorn.apache.org/parentqueue)
+
+With `placementrules:` in queues.yaml, the configured chain runs instead
+(yunikorn-core placement semantics): rules `provided`, `user`, `group`,
+`tag` (value = tag key, e.g. namespace), `fixed` (value = queue), each with
+an optional allow/deny user/group `filter`, a `create` flag, and an optional
+nested `parent` rule whose result prefixes the child queue.
 
 Namespace quota/guaranteed annotations (yunikorn.apache.org/namespace.quota /
 .guaranteed, JSON resource maps) become the dynamic namespace queue's limits,
@@ -15,8 +22,10 @@ exactly the reference's namespace-quota mechanism.
 """
 from __future__ import annotations
 
+import dataclasses
 import json
-from typing import Optional
+import re
+from typing import List, Optional
 
 from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.resource import Resource
@@ -25,6 +34,147 @@ from yunikorn_tpu.core.queues import _parse_res_map
 from yunikorn_tpu.log.logger import log
 
 logger = log("core.placement")
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9_-]+$")
+
+
+def _sanitize_queue_part(name: str) -> str:
+    """Queue-name-safe form of a user/group name (dots are hierarchy)."""
+    return name.replace(".", "_dot_")
+
+
+@dataclasses.dataclass
+class RuleFilter:
+    """allow/deny filter on the submitting user (yunikorn-core filter
+    semantics: plain entries match exactly; a single non-plain entry is a
+    regex matched against the whole name)."""
+
+    type: str = ""                       # "allow" (default) or "deny"
+    users: List[str] = dataclasses.field(default_factory=list)
+    groups: List[str] = dataclasses.field(default_factory=list)
+
+    def _list_matches(self, entries: List[str], names: List[str]) -> bool:
+        if not entries:
+            return False
+        if len(entries) == 1 and not _NAME_RE.match(entries[0]):
+            try:
+                rx = re.compile(entries[0])
+            except re.error:
+                return False
+            return any(rx.fullmatch(n) for n in names)
+        return any(e in names for e in entries)
+
+    def allows(self, user: str, groups: List[str]) -> bool:
+        if not self.users and not self.groups:
+            return True  # empty filter matches everyone
+        matched = (self._list_matches(self.users, [user])
+                   or self._list_matches(self.groups, list(groups)))
+        return not matched if self.type == "deny" else matched
+
+
+@dataclasses.dataclass
+class PlacementRule:
+    name: str                            # provided | user | group | tag | fixed
+    create: bool = True
+    value: str = ""                      # tag key (tag) / queue name (fixed)
+    filter: Optional[RuleFilter] = None
+    parent: Optional["PlacementRule"] = None
+
+
+def parse_placement_rules(part_doc: dict) -> List[PlacementRule]:
+    """Parse a partition document's `placementrules:` list (may be empty)."""
+
+    def one(doc: dict) -> Optional[PlacementRule]:
+        name = str(doc.get("name", "")).lower()
+        if name not in ("provided", "user", "group", "tag", "fixed"):
+            logger.warning("unknown placement rule %r ignored", name)
+            return None
+        filt = None
+        fd = doc.get("filter") or {}
+        if fd:
+            filt = RuleFilter(
+                type=str(fd.get("type", "")).lower(),
+                users=[str(u) for u in (fd.get("users") or [])],
+                groups=[str(g) for g in (fd.get("groups") or [])],
+            )
+        parent = None
+        if doc.get("parent"):
+            parent = one(doc["parent"])
+        return PlacementRule(name=name, create=bool(doc.get("create", True)),
+                             value=str(doc.get("value", "")),
+                             filter=filt, parent=parent)
+
+    out = []
+    for doc in part_doc.get("placementrules") or []:
+        rule = one(doc)
+        if rule is not None:
+            out.append(rule)
+    return out
+
+
+class PlacementEngine:
+    """Run the configured rule chain; first rule yielding a queue wins
+    (yunikorn-core placement manager semantics)."""
+
+    def __init__(self, rules: List[PlacementRule]):
+        self.rules = rules
+
+    def _rule_queue(self, rule: PlacementRule, add: AddApplicationRequest) -> Optional[str]:
+        user = add.user.user
+        groups = list(add.user.groups)
+        if rule.filter is not None and not rule.filter.allows(user, groups):
+            return None
+        if rule.name == "provided":
+            leaf = add.queue_name
+            if not leaf:
+                return None
+        elif rule.name == "user":
+            if not user:
+                return None
+            leaf = _sanitize_queue_part(user)
+        elif rule.name == "group":
+            if not groups:
+                return None
+            leaf = _sanitize_queue_part(groups[0])
+        elif rule.name == "tag":
+            if not rule.value:
+                return None
+            tag = add.tags.get(rule.value)
+            if not tag and rule.value == "namespace":
+                tag = add.tags.get(constants.APP_TAG_NAMESPACE)
+            if not tag:
+                return None
+            leaf = _sanitize_queue_part(tag)
+        elif rule.name == "fixed":
+            if not rule.value:
+                return None
+            leaf = rule.value
+        else:
+            return None
+
+        if rule.parent is not None:
+            parent_q = self._rule_queue(rule.parent, add)
+            if parent_q is None:
+                return None
+            # a fully-qualified leaf (provided/fixed) cannot be re-parented
+            if "." in leaf or leaf == constants.ROOT_QUEUE:
+                return None
+            return f"{parent_q}.{leaf}"
+        if leaf.startswith(constants.ROOT_QUEUE + ".") or leaf == constants.ROOT_QUEUE:
+            return leaf
+        return f"{constants.ROOT_QUEUE}.{leaf}"
+
+    def place(self, add: AddApplicationRequest, queues):
+        """Return the first rule-resolved leaf Queue usable in `queues` (a
+        QueueTree), or None; honors each rule's create flag."""
+        for rule in self.rules:
+            name = self._rule_queue(rule, add)
+            if name is None:
+                continue
+            leaf = queues.resolve(name, create=rule.create)
+            if leaf is not None and leaf.is_leaf:
+                return leaf
+        return None
 
 
 def place_application(add: AddApplicationRequest) -> str:

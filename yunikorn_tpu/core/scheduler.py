@@ -87,8 +87,18 @@ class CoreScheduler(SchedulerAPI):
         self._lock = locking.RMutex()
         self.cache = cache
         self.encoder = SnapshotEncoder(cache)
+        # Multi-partition: self.partition / self.queues are the ACTIVE
+        # pointers (set per request/cycle under the core lock); the dicts hold
+        # every partition the config or node attributes named. The single
+        # "default" partition is the common case and pays no overhead.
         self.partition = Partition()
         self.queues = QueueTree()
+        self.partitions: Dict[str, Partition] = {"default": self.partition}
+        self.queue_trees: Dict[str, QueueTree] = {"default": self.queues}
+        self.placements: Dict[str, object] = {}      # name -> PlacementEngine
+        self._partition_policy: Dict[str, str] = {}
+        self._app_partition: Dict[str, str] = {}
+        self._config_partitions: set = {"default"}
         self.callback: Optional[ResourceManagerCallback] = None
         self.rm_id = ""
         self._policy = solver_policy or "binpacking"
@@ -105,7 +115,8 @@ class CoreScheduler(SchedulerAPI):
         # submitted (the shim replays pods during InitializeState, app
         # submission happens on the first pump tick) — park them here
         self._pending_restores: Dict[str, List[Allocation]] = {}
-        self._cap_cache: Optional[Tuple[int, Resource]] = None
+        # per-partition (capacity_version, total) memo
+        self._cap_cache: Dict[str, Tuple[int, Resource]] = {}
         # asks we already preempted for → timestamp; prevents stacking fresh
         # victims every cycle while the previous evictions drain
         self._preempted_for: Dict[str, float] = {}
@@ -138,24 +149,70 @@ class CoreScheduler(SchedulerAPI):
             self._load_config(config)
         self.trigger()
 
+    def _use_partition(self, name: str) -> None:
+        """Point self.partition / self.queues at `name`, creating the
+        partition lazily (nodes may carry a partition attribute the config
+        never declared; yunikorn-core auto-registers)."""
+        name = name or "default"
+        part = self.partitions.get(name)
+        if part is None:
+            part = self.partitions[name] = Partition(name)
+            self.queue_trees[name] = QueueTree()
+        self.partition = part
+        self.queues = self.queue_trees[name]
+
     def _load_config(self, config_text: str) -> None:
-        cfg = parse_queues_yaml(config_text or "")
-        self.queues.reload(cfg)
-        if config_text and not self._policy_forced:
+        from yunikorn_tpu.core.placement import PlacementEngine, parse_placement_rules
+
+        doc = {}
+        if config_text:
             try:
                 doc = yaml.safe_load(config_text) or {}
-                for part in doc.get("partitions", []):
-                    if part.get("name", "default") == self.partition.name:
-                        nsp = (part.get("nodesortpolicy") or {}).get("type", "")
-                        if nsp == "binpacking":
-                            self._policy = "binpacking"
-                        elif nsp in ("fair", "fairness"):
-                            self._policy = "spread"
-                        pre = part.get("preemption") or {}
-                        if "enabled" in pre:
-                            self._preemption_enabled = bool(pre["enabled"])
             except yaml.YAMLError:
                 logger.warning("invalid queues.yaml ignored")
+                doc = {}
+        part_names = [p.get("name", "default") for p in doc.get("partitions", [])] or ["default"]
+        for pname in part_names:
+            cfg = parse_queues_yaml(config_text or "", partition=pname)
+            if pname not in self.partitions:
+                self.partitions[pname] = Partition(pname)
+                self.queue_trees[pname] = QueueTree()
+            self.partitions[pname].draining = False  # re-added after removal
+            self.queue_trees[pname].reload(cfg)
+        # partitions the PREVIOUS config declared but the new one dropped:
+        # delete when empty, otherwise drain (no new apps, no scheduling) —
+        # lazily node-created partitions are untouched
+        for stale in self._config_partitions - set(part_names) - {"default"}:
+            part = self.partitions.get(stale)
+            if part is None:
+                continue
+            if not part.nodes and not part.applications:
+                self.partitions.pop(stale, None)
+                self.queue_trees.pop(stale, None)
+            else:
+                part.draining = True
+                logger.warning("partition %s removed from config; draining", stale)
+            self.placements.pop(stale, None)
+            self._partition_policy.pop(stale, None)
+        self._config_partitions = set(part_names)
+        for part in doc.get("partitions", []):
+            pname = part.get("name", "default")
+            rules = parse_placement_rules(part)
+            if rules:
+                self.placements[pname] = PlacementEngine(rules)
+            else:
+                self.placements.pop(pname, None)
+            nsp = (part.get("nodesortpolicy") or {}).get("type", "")
+            if nsp == "binpacking":
+                self._partition_policy[pname] = "binpacking"
+            elif nsp in ("fair", "fairness"):
+                self._partition_policy[pname] = "spread"
+            if pname == "default" and not self._policy_forced:
+                self._policy = self._partition_policy.get(pname, self._policy)
+                pre = part.get("preemption") or {}
+                if "enabled" in pre:
+                    self._preemption_enabled = bool(pre["enabled"])
+        self._use_partition("default")
 
     def validate_configuration(self, config_text: str) -> Tuple[bool, str]:
         """/ws/v1/validate-conf analog (used by the admission controller)."""
@@ -173,7 +230,18 @@ class CoreScheduler(SchedulerAPI):
             for info in request.nodes:
                 nid = info.node_id
                 if info.action in (NodeAction.CREATE, NodeAction.CREATE_DRAIN):
-                    if nid in self.partition.nodes:
+                    # SI node-partition attribute routes the node (reference
+                    # si.AttributeKeys; one node belongs to one partition)
+                    self._use_partition(
+                        info.attributes.get("si/node-partition")
+                        or info.attributes.get("partition") or "default")
+                else:
+                    self._use_partition(self._node_partition_of(nid))
+                if info.action in (NodeAction.CREATE, NodeAction.CREATE_DRAIN):
+                    # a node belongs to exactly ONE partition; a re-register
+                    # under a different partition attribute must not register
+                    # it twice (both solves would place onto it)
+                    if any(nid in p.nodes for p in self.partitions.values()):
                         resp.rejected.append(RejectedNode(nid, "node already registered"))
                         continue
                     node = CoreNode(
@@ -218,14 +286,33 @@ class CoreScheduler(SchedulerAPI):
         resp = ApplicationResponse()
         with self._lock:
             for add in request.new:
+                pname = add.partition or "default"
+                part = self.partitions.get(pname)
+                if part is None or getattr(part, "draining", False):
+                    # unlike nodes, apps never create partitions: yunikorn-core
+                    # rejects submissions to a partition the config (or node
+                    # set) does not know
+                    resp.rejected.append(RejectedApplication(
+                        add.application_id, f"unknown or removed partition {pname!r}"))
+                    continue
+                self._use_partition(pname)
                 if add.application_id in self.partition.applications:
                     # idempotent: re-acknowledge so the shim FSM can progress
                     resp.accepted.append(AcceptedApplication(add.application_id))
                     continue
                 from yunikorn_tpu.core.placement import apply_namespace_quota, place_application
 
-                placed_name = place_application(add)
-                leaf = self.queues.resolve(placed_name)
+                engine = self.placements.get(self.partition.name)
+                if engine is not None:
+                    leaf = engine.place(add, self.queues)
+                    if leaf is None:
+                        resp.rejected.append(RejectedApplication(
+                            add.application_id, "application rejected by placement rules"))
+                        continue
+                    placed_name = leaf.full_name
+                else:
+                    placed_name = place_application(add)
+                    leaf = self.queues.resolve(placed_name)
                 if leaf is None:
                     resp.rejected.append(RejectedApplication(
                         add.application_id, f"failed to place application: queue {placed_name!r} not usable"))
@@ -259,12 +346,14 @@ class CoreScheduler(SchedulerAPI):
                     placeholder_timeout=add.execution_timeout_seconds,
                 )
                 self.partition.applications[add.application_id] = app
+                self._app_partition[add.application_id] = self.partition.name
                 leaf.app_ids.add(add.application_id)
                 leaf.add_user_app(add.user.user, list(add.user.groups))
                 resp.accepted.append(AcceptedApplication(add.application_id))
                 for alloc in self._pending_restores.pop(add.application_id, []):
                     self._restore_allocation(alloc)
             for rem in request.remove:
+                self._use_partition(self._app_partition.get(rem.application_id, "default"))
                 self._remove_application(rem.application_id)
         if (resp.accepted or resp.rejected or resp.updated) and self.callback is not None:
             self.callback.update_application(resp)
@@ -273,6 +362,7 @@ class CoreScheduler(SchedulerAPI):
     def _remove_application(self, app_id: str) -> None:
         self._pending_restores.pop(app_id, None)
         self._completing_since.pop(app_id, None)
+        self._app_partition.pop(app_id, None)
         app = self.partition.applications.pop(app_id, None)
         if app is None:
             return
@@ -289,6 +379,7 @@ class CoreScheduler(SchedulerAPI):
         resp = AllocationResponse()
         with self._lock:
             for ask in request.asks:
+                self._use_partition(self._app_partition.get(ask.application_id, "default"))
                 app = self.partition.applications.get(ask.application_id)
                 if app is None or app.state in (APP_REJECTED, APP_COMPLETED):
                     resp.rejected.append(RejectedAllocationAsk(
@@ -299,10 +390,13 @@ class CoreScheduler(SchedulerAPI):
                 app.pending_asks[ask.allocation_key] = ask
             for alloc in request.allocations:
                 if alloc.foreign:
+                    self._use_partition(self._node_partition_of(alloc.node_id))
                     self._track_foreign(alloc)
                 else:
+                    self._use_partition(self._app_partition.get(alloc.application_id, "default"))
                     self._restore_allocation(alloc)
             for release in request.releases:
+                self._use_partition(self._app_partition.get(release.application_id, "default"))
                 rel = self._release_allocation(release)
                 if rel is not None:
                     resp.released.append(rel)
@@ -343,14 +437,23 @@ class CoreScheduler(SchedulerAPI):
         if node is not None:
             node.occupied = node.occupied.add(alloc.resource)
 
+    def _node_partition_of(self, node_id: str) -> str:
+        if node_id in self.partition.nodes:
+            return self.partition.name
+        for pname, part in self.partitions.items():
+            if node_id in part.nodes:
+                return pname
+        return "default"
+
     def _release_allocation(self, release: AllocationRelease) -> Optional[AllocationRelease]:
-        # foreign release
-        foreign = self.partition.foreign_allocations.pop(release.allocation_key, None)
-        if foreign is not None:
-            node = self.partition.nodes.get(foreign.node_id)
-            if node is not None:
-                node.occupied = node.occupied.sub(foreign.resource)
-            return None
+        # foreign release (carries no app id; search the partitions)
+        for part in self.partitions.values():
+            foreign = part.foreign_allocations.pop(release.allocation_key, None)
+            if foreign is not None:
+                node = part.nodes.get(foreign.node_id)
+                if node is not None:
+                    node.occupied = node.occupied.sub(foreign.resource)
+                return None
         app = self.partition.applications.get(release.application_id)
         if app is None:
             # the pod may have been parked for restore before its app arrived
@@ -411,146 +514,188 @@ class CoreScheduler(SchedulerAPI):
                 logger.exception("scheduling cycle failed")
 
     def schedule_once(self) -> int:
-        """One full scheduling cycle. Returns the number of new allocations."""
-        t0 = time.time()
+        """One full scheduling cycle over every partition."""
+        total = 0
+        payloads = []
         with self._lock:
-            self._check_app_completion()
-            self._check_placeholder_timeouts()
-            replaced = self._replace_placeholders()
-            pinned = self._allocate_required_node_asks()
-            admitted, ranks, held = self._collect_and_gate()
-            new_allocs: List[Allocation] = []
-            skipped_keys: List[Tuple[str, str]] = []
-            unplaced_asks: List = []
-            if admitted:
-                # overlay BEFORE sync: an assume landing in between then counts
-                # twice (once in the overlay, once in synced free) — strictly
-                # conservative, never over-committing
-                overlay = self._inflight_overlay()
-                self.encoder.sync_nodes()
-                batch = self.encoder.build_batch(admitted, ranks=ranks)
-                result = solve_batch(batch, self.encoder.nodes, policy=self._policy,
-                                     free_delta=overlay)
-                import numpy as np
+            multi = len(self.partitions) > 1
+            for pname in list(self.partitions):
+                if getattr(self.partitions[pname], "draining", False):
+                    continue  # removed from config; no new scheduling
+                self._use_partition(pname)
+                n, payload = self._schedule_partition(restrict_nodes=multi)
+                total += n
+                payloads.append(payload)
+        for payload in payloads:
+            self._publish_cycle(payload)
+        return total
 
-                assigned = np.asarray(result.assigned)[: batch.num_pods]
-                # commit with batched queue accounting: one ancestor walk per
-                # leaf, not per allocation (matters at 50k allocations/cycle)
-                # plain dict-of-int accumulators: Resource.add per alloc
-                # costs a dict copy each — at 50k allocs that is measurable
-                leaf_totals: Dict[str, Dict[str, int]] = {}
-                # qname -> (user, groups-tuple) -> accumulator
-                user_totals: Dict[str, Dict[Tuple[str, tuple], Dict[str, int]]] = {}
-                limits_exist = self.queues.any_limits()
-                for i, ask in enumerate(admitted):
-                    idx = int(assigned[i])
-                    if idx < 0:
-                        skipped_keys.append((ask.application_id, ask.allocation_key))
-                        unplaced_asks.append(ask)
-                        continue
-                    node_name = self.encoder.nodes.name_of(idx)
-                    if node_name is None:
-                        continue
-                    alloc = Allocation(
-                        allocation_key=ask.allocation_key,
-                        application_id=ask.application_id,
-                        node_id=node_name,
-                        resource=ask.resource,
-                        priority=ask.priority,
-                        placeholder=ask.placeholder,
-                        task_group_name=ask.task_group_name,
-                        tags=dict(ask.tags),
-                    )
-                    app = self._commit_allocation(alloc, credit_queue=False)
-                    acc = leaf_totals.setdefault(app.queue_name, {})
-                    for rk, rv in alloc.resource.resources.items():
-                        acc[rk] = acc.get(rk, 0) + rv
-                    if limits_exist:
-                        uacc = user_totals.setdefault(app.queue_name, {}).setdefault(
-                            (app.user.user, tuple(app.user.groups)), {})
-                        for rk, rv in alloc.resource.resources.items():
-                            uacc[rk] = uacc.get(rk, 0) + rv
-                    new_allocs.append(alloc)
-                for qname, total in leaf_totals.items():
-                    leaf = self.queues.resolve(qname, create=False)
-                    if leaf is not None:
-                        leaf.add_allocated(Resource(total))
-                        if limits_exist and leaf.has_limits_in_chain():
-                            for (user, groups), ut in user_totals.get(qname, {}).items():
-                                leaf.add_user_allocated(user, Resource(ut), list(groups))
-            self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
-            self.metrics["allocation_attempt_failed"] += len(skipped_keys)
-            self.metrics["solve_count"] += 1
-            self.metrics["solve_time_ms_total"] += int((time.time() - t0) * 1000)
+    def _partition_node_mask(self):
+        """[capacity] bool mask restricting the solve to this partition's
+        nodes (multi-partition only; the encoder holds the whole cache)."""
+        import numpy as np
 
-            # preemption: try to make room for unplaced high-priority asks
-            preempt_releases: List[AllocationRelease] = []
-            if self._preemption_enabled and unplaced_asks:
-                from yunikorn_tpu.core.preemption import plan_preemptions
+        mask = np.zeros((self.encoder.nodes.capacity,), bool)
+        for nid in self.partition.nodes:
+            idx = self.encoder.nodes._name_to_idx.get(nid)
+            if idx is not None:
+                mask[idx] = True
+        return mask
 
-                now = time.time()
-                cooldown = 30.0
-                self._preempted_for = {
-                    k: ts for k, ts in self._preempted_for.items() if now - ts < cooldown
-                }
-                eligible = [a for a in unplaced_asks
-                            if a.allocation_key not in self._preempted_for]
-                app_of_pod = {
-                    key: app.application_id
-                    for app in self.partition.applications.values()
-                    for key in app.allocations
-                }
-                # the same overlay the solver used, grouped per node
-                inflight_by_node: Dict[str, Resource] = {}
-                for alloc in self._inflight.values():
-                    cur = inflight_by_node.get(alloc.node_id)
-                    inflight_by_node[alloc.node_id] = (
-                        alloc.resource if cur is None else cur.add(alloc.resource))
-                plans, attempted = plan_preemptions(
-                    self.cache, eligible, app_of_pod, inflight_by_node)
-                for key in attempted:
-                    # cooldown failed attempts too: an unplaceable ask must not
-                    # rescan the cluster every cycle
-                    self._preempted_for[key] = now
-                for plan in plans:
-                    for rel in plan.releases(app_of_pod):
-                        confirmed = self._release_allocation(rel)
-                        if confirmed is not None:
-                            preempt_releases.append(confirmed)
-                self.metrics["preempted_total"] = (
-                    self.metrics.get("preempted_total", 0) + len(preempt_releases))
+    def _schedule_partition(self, restrict_nodes: bool = False) -> int:
+        """One scheduling cycle for the ACTIVE partition (core lock held)."""
+        t0 = time.time()
+        self._check_app_completion()
+        self._check_placeholder_timeouts()
+        replaced = self._replace_placeholders()
+        pinned = self._allocate_required_node_asks()
+        admitted, ranks, held = self._collect_and_gate()
+        new_allocs: List[Allocation] = []
+        skipped_keys: List[Tuple[str, str]] = []
+        unplaced_asks: List = []
+        if admitted:
+            # overlay BEFORE sync: an assume landing in between then counts
+            # twice (once in the overlay, once in synced free) — strictly
+            # conservative, never over-committing
+            overlay = self._inflight_overlay()
+            self.encoder.sync_nodes()
+            # mask AFTER the sync: the encoder assigns node rows lazily
+            node_mask = self._partition_node_mask() if restrict_nodes else None
+            batch = self.encoder.build_batch(admitted, ranks=ranks)
+            policy = (self._policy if self._policy_forced or
+                      self.partition.name == "default"
+                      else self._partition_policy.get(self.partition.name, self._policy))
+            result = solve_batch(batch, self.encoder.nodes, policy=policy,
+                                 free_delta=overlay, node_mask=node_mask)
+            import numpy as np
 
-        if self.callback is not None:
-            # core event stream → shim PublishEvents (reference forwards core
-            # events onto pods/nodes as K8s events, context.go:1157-1200)
-            from yunikorn_tpu.common.si import EventRecord, EventRecordType
-
-            events = [
-                EventRecord(type=EventRecordType.REQUEST, object_id=a.allocation_key,
-                            reference_id=a.node_id, reason="Allocated",
-                            message=f"allocated on node {a.node_id}")
-                for a in new_allocs[:200]  # bounded per cycle
-            ]
-            if events:
-                self.callback.send_event(events)
-            if pinned:
-                self.callback.update_allocation(AllocationResponse(new=pinned))
-            if replaced.new or replaced.released:
-                self.callback.update_allocation(replaced)
-            if new_allocs:
-                self.callback.update_allocation(AllocationResponse(new=new_allocs))
-            if preempt_releases:
-                self.callback.update_allocation(AllocationResponse(released=preempt_releases))
-            for app_id, key in skipped_keys:
-                self.callback.update_container_scheduling_state(
-                    UpdateContainerSchedulingStateRequest(
-                        application_id=app_id,
-                        allocation_key=key,
-                        state=ContainerSchedulingState.SKIPPED,
-                        reason="insufficient cluster resources or no feasible node",
-                    )
+            assigned = np.asarray(result.assigned)[: batch.num_pods]
+            # commit with batched queue accounting: one ancestor walk per
+            # leaf, not per allocation (matters at 50k allocations/cycle)
+            # plain dict-of-int accumulators: Resource.add per alloc
+            # costs a dict copy each — at 50k allocs that is measurable
+            leaf_totals: Dict[str, Dict[str, int]] = {}
+            # qname -> (user, groups-tuple) -> accumulator
+            user_totals: Dict[str, Dict[Tuple[str, tuple], Dict[str, int]]] = {}
+            limits_exist = self.queues.any_limits()
+            for i, ask in enumerate(admitted):
+                idx = int(assigned[i])
+                if idx < 0:
+                    skipped_keys.append((ask.application_id, ask.allocation_key))
+                    unplaced_asks.append(ask)
+                    continue
+                node_name = self.encoder.nodes.name_of(idx)
+                if node_name is None:
+                    continue
+                alloc = Allocation(
+                    allocation_key=ask.allocation_key,
+                    application_id=ask.application_id,
+                    node_id=node_name,
+                    resource=ask.resource,
+                    priority=ask.priority,
+                    placeholder=ask.placeholder,
+                    task_group_name=ask.task_group_name,
+                    tags=dict(ask.tags),
                 )
-        return len(new_allocs)
+                app = self._commit_allocation(alloc, credit_queue=False)
+                acc = leaf_totals.setdefault(app.queue_name, {})
+                for rk, rv in alloc.resource.resources.items():
+                    acc[rk] = acc.get(rk, 0) + rv
+                if limits_exist:
+                    uacc = user_totals.setdefault(app.queue_name, {}).setdefault(
+                        (app.user.user, tuple(app.user.groups)), {})
+                    for rk, rv in alloc.resource.resources.items():
+                        uacc[rk] = uacc.get(rk, 0) + rv
+                new_allocs.append(alloc)
+            for qname, total in leaf_totals.items():
+                leaf = self.queues.resolve(qname, create=False)
+                if leaf is not None:
+                    leaf.add_allocated(Resource(total))
+                    if limits_exist and leaf.has_limits_in_chain():
+                        for (user, groups), ut in user_totals.get(qname, {}).items():
+                            leaf.add_user_allocated(user, Resource(ut), list(groups))
+        self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
+        self.metrics["allocation_attempt_failed"] += len(skipped_keys)
+        self.metrics["solve_count"] += 1
+        self.metrics["solve_time_ms_total"] += int((time.time() - t0) * 1000)
+
+        # preemption: try to make room for unplaced high-priority asks
+        preempt_releases: List[AllocationRelease] = []
+        if self._preemption_enabled and unplaced_asks:
+            from yunikorn_tpu.core.preemption import plan_preemptions
+
+            now = time.time()
+            cooldown = 30.0
+            self._preempted_for = {
+                k: ts for k, ts in self._preempted_for.items() if now - ts < cooldown
+            }
+            eligible = [a for a in unplaced_asks
+                        if a.allocation_key not in self._preempted_for]
+            app_of_pod = {
+                key: app.application_id
+                for app in self.partition.applications.values()
+                for key in app.allocations
+            }
+            # the same overlay the solver used, grouped per node
+            inflight_by_node: Dict[str, Resource] = {}
+            for alloc in self._inflight.values():
+                cur = inflight_by_node.get(alloc.node_id)
+                inflight_by_node[alloc.node_id] = (
+                    alloc.resource if cur is None else cur.add(alloc.resource))
+            plans, attempted = plan_preemptions(
+                self.cache, eligible, app_of_pod, inflight_by_node)
+            for key in attempted:
+                # cooldown failed attempts too: an unplaceable ask must not
+                # rescan the cluster every cycle
+                self._preempted_for[key] = now
+            for plan in plans:
+                for rel in plan.releases(app_of_pod):
+                    confirmed = self._release_allocation(rel)
+                    if confirmed is not None:
+                        preempt_releases.append(confirmed)
+            self.metrics["preempted_total"] = (
+                self.metrics.get("preempted_total", 0) + len(preempt_releases))
+
+        # the publish payload is delivered by schedule_once AFTER the core
+        # lock is released (callbacks may re-enter the core from other
+        # threads; publishing under the lock risks stalls and deadlocks)
+        return len(new_allocs), (pinned, replaced, new_allocs,
+                                 preempt_releases, skipped_keys)
+
+    def _publish_cycle(self, payload) -> None:
+        """Deliver one partition cycle's RM-callback traffic (lock NOT held)."""
+        pinned, replaced, new_allocs, preempt_releases, skipped_keys = payload
+        if self.callback is None:
+            return
+        # core event stream → shim PublishEvents (reference forwards core
+        # events onto pods/nodes as K8s events, context.go:1157-1200)
+        from yunikorn_tpu.common.si import EventRecord, EventRecordType
+
+        events = [
+            EventRecord(type=EventRecordType.REQUEST, object_id=a.allocation_key,
+                        reference_id=a.node_id, reason="Allocated",
+                        message=f"allocated on node {a.node_id}")
+            for a in new_allocs[:200]  # bounded per cycle
+        ]
+        if events:
+            self.callback.send_event(events)
+        if pinned:
+            self.callback.update_allocation(AllocationResponse(new=pinned))
+        if replaced.new or replaced.released:
+            self.callback.update_allocation(replaced)
+        if new_allocs:
+            self.callback.update_allocation(AllocationResponse(new=new_allocs))
+        if preempt_releases:
+            self.callback.update_allocation(AllocationResponse(released=preempt_releases))
+        for app_id, key in skipped_keys:
+            self.callback.update_container_scheduling_state(
+                UpdateContainerSchedulingStateRequest(
+                    application_id=app_id,
+                    allocation_key=key,
+                    state=ContainerSchedulingState.SKIPPED,
+                    reason="insufficient cluster resources or no feasible node",
+                )
+            )
 
     def _allocate_required_node_asks(self) -> List[Allocation]:
         """DaemonSet-style asks pinned to one node (ask.preferred_node, the
@@ -615,19 +760,22 @@ class CoreScheduler(SchedulerAPI):
         return app
 
     def _cluster_capacity(self) -> Resource:
-        """Total allocatable, memoized by the cache's capacity version (bumped
-        only on node add/remove/update, not pod churn — 10k nodes would
-        otherwise cost a Python reduce per cycle)."""
+        """Total allocatable of the ACTIVE partition, memoized by the cache's
+        capacity version (bumped only on node add/remove/update, not pod
+        churn — 10k nodes would otherwise cost a Python reduce per cycle)."""
         gen = self.cache.capacity_version()
-        cached = self._cap_cache
+        cached = self._cap_cache.get(self.partition.name)
         if cached is not None and cached[0] == gen:
             return cached[1]
+        multi = len(self.partitions) > 1
         total: Dict[str, int] = {}
         for info in self.cache.snapshot_nodes():
+            if multi and info.node.name not in self.partition.nodes:
+                continue
             for k, v in info.allocatable.resources.items():
                 total[k] = total.get(k, 0) + v
         cap = Resource(total)
-        self._cap_cache = (gen, cap)
+        self._cap_cache[self.partition.name] = (gen, cap)
         return cap
 
     def _inflight_overlay(self):
@@ -871,11 +1019,18 @@ class CoreScheduler(SchedulerAPI):
     # ------------------------------------------------------------- inspection
     def get_partition_dao(self) -> dict:
         with self._lock:
-            return {
-                "partition": self.partition.dao(),
-                "queues": self.queues.dao(),
+            default = self.partitions["default"]
+            dao = {
+                "partition": default.dao(),
+                "queues": self.queue_trees["default"].dao(),
                 "metrics": dict(self.metrics),
             }
+            if len(self.partitions) > 1:
+                dao["partitions"] = {
+                    name: {"partition": p.dao(), "queues": self.queue_trees[name].dao()}
+                    for name, p in self.partitions.items()
+                }
+            return dao
 
     def state_dump(self) -> str:
         return json.dumps(self.get_partition_dao(), default=str)

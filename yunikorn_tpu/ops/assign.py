@@ -556,11 +556,14 @@ def pad2d(arr, width, fill):
 
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
-                device=None) -> SolveResult:
+                device=None, node_mask=None) -> SolveResult:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     free_delta: optional [capacity, R] float array subtracted from node free
     capacity before the solve (the core's in-flight allocation overlay).
+    node_mask: optional [capacity] bool restricting candidate nodes (the
+    multi-partition case: one encoder holds every cache node, each
+    partition's solve sees only its own).
     """
     import numpy as np
 
@@ -575,6 +578,8 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         free_i = free_i - d
     cap_i = np.floor(na.capacity_arr).astype(np.int32)
     node_ok = na.valid & na.schedulable
+    if node_mask is not None:
+        node_ok = node_ok & node_mask[: node_ok.shape[0]]
     host_mask = batch.g_host_mask
     if host_mask is not None:
         host_mask = pad2d(host_mask, na.capacity, False)
