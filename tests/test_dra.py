@@ -1,0 +1,196 @@
+"""DynamicResourceAllocation: claim-aware feasibility (reference gates a DRA
+manager into the Context, context.go:116-130, and plumbs ResourceClaim
+informers, apifactory.go:39-59). Structured-parameters model: ResourceSlices
+advertise per-node devices, claims pin to a node at assume time."""
+import numpy as np
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import (ResourceClaim, ResourceSlice,
+                                         make_node, make_pod)
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.ops.assign import solve_batch
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+
+def make_env(nodes):
+    cache = SchedulerCache()
+    for n in nodes:
+        cache.update_node(n)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return cache, enc
+
+
+def ask_for(pod):
+    return AllocationAsk(pod.uid, "app-1", get_pod_resource(pod), pod=pod)
+
+
+def assignments(enc, res, batch):
+    a = np.asarray(res.assigned)
+    return {k: (enc.nodes.name_of(int(a[i])) if a[i] >= 0 else None)
+            for i, k in enumerate(batch.ask_keys)}
+
+
+def claim_pod(name, claims):
+    p = make_pod(name, cpu_milli=100, memory=2**20)
+    p.spec.resource_claims = list(claims)
+    return p
+
+
+def test_claim_pod_schedules_only_on_device_node():
+    cache, enc = make_env([make_node(f"n{i}", cpu_milli=8000) for i in range(3)])
+    cache.update_resource_slice(ResourceSlice("n2", "gpu.example.com", 1))
+    cache.update_resource_claim(ResourceClaim("c1", "default", "gpu.example.com"))
+    p = claim_pod("wants-gpu", ["c1"])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[p.uid] == "n2"
+
+
+def test_allocated_claim_pins_to_its_node():
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    cache.update_resource_slice(ResourceSlice("n0", "gpu.example.com", 4))
+    cache.update_resource_slice(ResourceSlice("n1", "gpu.example.com", 4))
+    cache.update_resource_claim(ResourceClaim(
+        "c1", "default", "gpu.example.com", allocated_node="n1"))
+    p = claim_pod("pinned", ["c1"])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[p.uid] == "n1"
+
+
+def test_unknown_claim_stays_pending():
+    cache, enc = make_env([make_node("n0")])
+    p = claim_pod("orphan", ["nope"])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[p.uid] is None
+
+
+def test_exhausted_devices_hold_pod_pending():
+    cache, enc = make_env([make_node("n0")])
+    cache.update_resource_slice(ResourceSlice("n0", "gpu.example.com", 1))
+    cache.update_resource_claim(ResourceClaim(
+        "c-used", "default", "gpu.example.com", allocated_node="n0",
+        reserved_for=["other-pod"]))
+    cache.update_resource_claim(ResourceClaim("c-new", "default", "gpu.example.com"))
+    p = claim_pod("wants-gpu", ["c-new"])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[p.uid] is None  # only device taken
+
+
+def test_unallocated_claim_group_serialized_then_follows():
+    """Two pods sharing one unallocated claim: first solve places one and the
+    assume pins the claim; the second follows onto the SAME node next cycle
+    (claims are node-local)."""
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    cache.update_resource_slice(ResourceSlice("n0", "gpu.example.com", 2))
+    cache.update_resource_slice(ResourceSlice("n1", "gpu.example.com", 2))
+    cache.update_resource_claim(ResourceClaim("shared", "default", "gpu.example.com"))
+    pods = [claim_pod(f"s{i}", ["shared"]) for i in range(2)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes)
+    got = assignments(enc, res, batch)
+    placed = {k: v for k, v in got.items() if v is not None}
+    assert len(placed) == 1
+    first_key, node = next(iter(placed.items()))
+    first = next(p for p in pods if p.uid == first_key)
+    first.spec.node_name = node
+    cache.assume_pod(first, True)  # pins the claim
+    assert cache.resource_claims["default/shared"].allocated_node == node
+    second = next(p for p in pods if p.uid != first_key)
+    batch2 = enc.build_batch([ask_for(second)])
+    res2 = solve_batch(batch2, enc.nodes)
+    assert assignments(enc, res2, batch2)[second.uid] == node
+
+
+def test_claim_released_on_pod_removal():
+    cache, enc = make_env([make_node("n0")])
+    cache.update_resource_slice(ResourceSlice("n0", "gpu.example.com", 1))
+    cache.update_resource_claim(ResourceClaim("c1", "default", "gpu.example.com"))
+    p = claim_pod("holder", ["c1"])
+    cache.update_pod(p)
+    p2 = p.deepcopy()
+    p2.spec.node_name = "n0"
+    cache.assume_pod(p2, True)
+    assert cache.resource_claims["default/c1"].allocated_node == "n0"
+    cache.remove_pod(p2)
+    assert cache.resource_claims["default/c1"].allocated_node == ""
+
+
+def test_dra_e2e_through_shim():
+    """Full path: conf gate on, claim/slice informers feed the cache, a
+    claim-bearing pod binds on the device node."""
+    from yunikorn_tpu.shim import mock_scheduler
+    from yunikorn_tpu.cache import task as task_mod
+
+    ms = mock_scheduler.MockScheduler()
+    ms.init(conf_extra={"service.enableDRA": "true"})
+    ms.start()
+    try:
+        ms.add_nodes([make_node(f"n{i}", cpu_milli=4000) for i in range(3)])
+        ms.cluster.add_resource_slice(ResourceSlice("n1", "tpu.example.com", 1))
+        ms.cluster.add_resource_claim(ResourceClaim("tc", "default", "tpu.example.com"))
+        pod = make_pod("dra-pod", cpu_milli=500, memory=2**27,
+                       labels={constants.LABEL_APPLICATION_ID: "dra-app"},
+                       scheduler_name=constants.SCHEDULER_NAME)
+        pod.spec.resource_claims = ["tc"]
+        ms.add_pod(pod)
+        ms.wait_for_task_state("dra-app", pod.uid, task_mod.BOUND)
+        assert ms.get_pod_assignment(pod) == "n1"
+    finally:
+        ms.stop()
+
+
+def test_same_class_demand_not_overallocated_within_solve():
+    """Two groups (distinct claims) of one device class racing one device:
+    only one may place per solve; the second follows only if devices remain."""
+    cache, enc = make_env([make_node("n0"), make_node("n1")])
+    cache.update_resource_slice(ResourceSlice("n0", "gpu.example.com", 1))
+    cache.update_resource_claim(ResourceClaim("cA", "default", "gpu.example.com"))
+    cache.update_resource_claim(ResourceClaim("cB", "default", "gpu.example.com"))
+    pa, pb = claim_pod("pa", ["cA"]), claim_pod("pb", ["cB"])
+    batch = enc.build_batch([ask_for(pa), ask_for(pb)])
+    res = solve_batch(batch, enc.nodes)
+    got = assignments(enc, res, batch)
+    placed = {k: v for k, v in got.items() if v is not None}
+    assert len(placed) == 1 and list(placed.values()) == ["n0"]
+    # assume the winner: the device is gone; the loser stays pending forever
+    win_key, node = next(iter(placed.items()))
+    winner = pa if pa.uid == win_key else pb
+    loser = pb if winner is pa else pa
+    w = winner.deepcopy(); w.spec.node_name = node
+    cache.update_pod(winner); cache.assume_pod(w, True)
+    batch2 = enc.build_batch([ask_for(loser)])
+    res2 = solve_batch(batch2, enc.nodes)
+    assert assignments(enc, res2, batch2)[loser.uid] is None
+
+
+def test_multi_claim_pod_needs_enough_devices():
+    """One pod with two same-class claims needs TWO free devices on a node."""
+    cache, enc = make_env([make_node("small"), make_node("big")])
+    cache.update_resource_slice(ResourceSlice("small", "gpu.example.com", 1))
+    cache.update_resource_slice(ResourceSlice("big", "gpu.example.com", 2))
+    cache.update_resource_claim(ResourceClaim("c1", "default", "gpu.example.com"))
+    cache.update_resource_claim(ResourceClaim("c2", "default", "gpu.example.com"))
+    p = claim_pod("dual", ["c1", "c2"])
+    batch = enc.build_batch([ask_for(p)])
+    res = solve_batch(batch, enc.nodes)
+    assert assignments(enc, res, batch)[p.uid] == "big"
+
+
+def test_informer_echo_does_not_free_reserved_device():
+    cache, enc = make_env([make_node("n0")])
+    cache.update_resource_slice(ResourceSlice("n0", "gpu.example.com", 1))
+    cache.update_resource_claim(ResourceClaim("c1", "default", "gpu.example.com"))
+    p = claim_pod("holder", ["c1"])
+    cache.update_pod(p)
+    p2 = p.deepcopy(); p2.spec.node_name = "n0"
+    cache.assume_pod(p2, True)
+    # API-server echo without allocation state must keep the reservation
+    cache.update_resource_claim(ResourceClaim("c1", "default", "gpu.example.com"))
+    claim = cache.resource_claims["default/c1"]
+    assert claim.allocated_node == "n0" and p.uid in claim.reserved_for

@@ -151,6 +151,21 @@ class Context:
             add_fn=self._on_namespace,
             update_fn=lambda old, new: self._on_namespace(new),
             delete_fn=self._on_namespace_deleted))
+        # DRA informers, gated exactly like the reference's DRA manager
+        # (context.go:116-130, apifactory.go:39-59)
+        from yunikorn_tpu.conf import schedulerconf as conf_mod
+
+        if conf_mod.get_scheduler_conf().enable_dra:
+            self.api_provider.add_event_handler(
+                InformerType.RESOURCE_CLAIM, ResourceEventHandlers(
+                    add_fn=self.schedulers_cache.update_resource_claim,
+                    update_fn=lambda old, new: self.schedulers_cache.update_resource_claim(new),
+                    delete_fn=self.schedulers_cache.remove_resource_claim))
+            self.api_provider.add_event_handler(
+                InformerType.RESOURCE_SLICE, ResourceEventHandlers(
+                    add_fn=self.schedulers_cache.update_resource_slice,
+                    update_fn=lambda old, new: self.schedulers_cache.update_resource_slice(new),
+                    delete_fn=self.schedulers_cache.remove_resource_slice))
 
     # ----------------------------------------------------------------- nodes
     def add_node(self, node: Node) -> None:

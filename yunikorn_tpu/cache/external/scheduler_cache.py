@@ -67,6 +67,11 @@ class SchedulerCache:
         self.assumed_pods: Dict[str, bool] = {}   # pod uid -> volumes all bound
         self.orphaned_pods: Dict[str, Pod] = {}
         self.pvc_ref_counts: Dict[str, int] = {}  # "ns/claim" -> count
+        # DRA state (reference context.go:116-130 gates a DRA manager;
+        # informers feed these maps): "ns/name" -> ResourceClaim and
+        # "node/class" -> ResourceSlice
+        self.resource_claims: Dict[str, object] = {}
+        self.resource_slices: Dict[str, object] = {}
         # generation tracking for incremental snapshot encoding
         self._generation = 0
         # bumped only when node allocatable capacity changes (add/remove/update
@@ -225,6 +230,8 @@ class SchedulerCache:
                     self._mark_dirty(node_name)
             self.assumed_pods.pop(key, None)
             self.orphaned_pods.pop(key, None)
+            if cur is not None:
+                self._dra_release_locked(cur)
 
     def get_pod(self, uid: str) -> Optional[Pod]:
         with self._lock.reader():
@@ -250,6 +257,117 @@ class SchedulerCache:
             key = pod.uid
             self._update_pod_locked(pod)
             self.assumed_pods[key] = all_volumes_bound
+            self._dra_reserve_locked(pod, pod.spec.node_name)
+
+    # ------------------------------------------------------------------- DRA
+    def update_resource_claim(self, claim) -> None:
+        with self._lock:
+            cur = self.resource_claims.get(claim.key)
+            if cur is not None and not claim.allocated_node and cur.allocated_node:
+                # assume-time reservations live only here; an informer echo
+                # without allocation state must not free an in-use device
+                claim.allocated_node = cur.allocated_node
+                claim.reserved_for = list(cur.reserved_for)
+            self.resource_claims[claim.key] = claim
+
+    def remove_resource_claim(self, claim) -> None:
+        with self._lock:
+            self.resource_claims.pop(claim.key, None)
+
+    def update_resource_slice(self, sl) -> None:
+        with self._lock:
+            self.resource_slices[sl.key] = sl
+
+    def remove_resource_slice(self, sl) -> None:
+        with self._lock:
+            self.resource_slices.pop(sl.key, None)
+
+    def _dra_reserve_locked(self, pod: Pod, node_name: str) -> None:
+        """Pin the pod's claims to its node at assume time (the structured-
+        parameters allocation the in-tree DRA plugin performs at Reserve)."""
+        for cname in pod.spec.resource_claims:
+            claim = self.resource_claims.get(f"{pod.namespace}/{cname}")
+            if claim is None:
+                continue
+            if claim.allocated_node and claim.allocated_node != node_name:
+                # the claim's device lives elsewhere; never record a
+                # reservation the node cannot satisfy
+                logger.error("DRA: pod %s assumed on %s but claim %s is "
+                             "allocated to %s", pod.uid, node_name,
+                             claim.key, claim.allocated_node)
+                continue
+            if not claim.allocated_node:
+                claim.allocated_node = node_name
+            if pod.uid not in claim.reserved_for:
+                claim.reserved_for.append(pod.uid)
+
+    def dra_release(self, pod: Pod) -> None:
+        """Drop the pod's reservations; a claim with no reservations left
+        deallocates (devices return to the node's free inventory)."""
+        with self._lock:
+            self._dra_release_locked(pod)
+
+    def _dra_release_locked(self, pod: Pod) -> None:
+        for cname in pod.spec.resource_claims:
+            claim = self.resource_claims.get(f"{pod.namespace}/{cname}")
+            if claim is None:
+                continue
+            if pod.uid in claim.reserved_for:
+                claim.reserved_for.remove(pod.uid)
+            if not claim.reserved_for:
+                claim.allocated_node = ""
+
+    def dra_feasible_nodes(self, namespace: str, claim_names) -> Optional[set]:
+        """Node names where every named claim can be satisfied, or None when
+        the pod has no claims. An unknown claim yields the empty set (the pod
+        stays pending until the claim object appears). Demand-aware: a node
+        must have as many free devices of a class as the claim set demands
+        unallocated (one pod with two gpu claims needs two free devices)."""
+        if not claim_names:
+            return None
+        with self._lock.reader():
+            # allocations per (node, class), one scan
+            used: Dict[Tuple[str, str], int] = {}
+            for other in self.resource_claims.values():
+                if other.allocated_node:
+                    k = (other.allocated_node, other.device_class)
+                    used[k] = used.get(k, 0) + 1
+            result: Optional[set] = None
+            demand: Dict[str, int] = {}  # unallocated demand per class
+            unalloc_classes: List[str] = []
+            for cname in claim_names:
+                claim = self.resource_claims.get(f"{namespace}/{cname}")
+                if claim is None:
+                    return set()
+                if claim.allocated_node:
+                    nodes = {claim.allocated_node}
+                    result = nodes if result is None else (result & nodes)
+                else:
+                    demand[claim.device_class] = demand.get(claim.device_class, 0) + 1
+                    unalloc_classes.append(claim.device_class)
+            for cls in set(unalloc_classes):
+                nodes = {
+                    sl.node_name for sl in self.resource_slices.values()
+                    if sl.device_class == cls
+                    and sl.count - used.get((sl.node_name, cls), 0) >= demand[cls]
+                }
+                result = nodes if result is None else (result & nodes)
+            return result or set()
+
+    def dra_unallocated_classes(self, namespace: str, claim_names):
+        """frozenset of device classes with at least one unallocated claim in
+        the set (empty when all are pinned); unknown claims count as
+        unallocated of class '<unknown>'. Locked accessor for the encoder's
+        serialization decision."""
+        with self._lock.reader():
+            out = set()
+            for cname in claim_names:
+                claim = self.resource_claims.get(f"{namespace}/{cname}")
+                if claim is None:
+                    out.add("<unknown>")
+                elif not claim.allocated_node:
+                    out.add(claim.device_class)
+            return frozenset(out)
 
     def forget_pod(self, pod: Pod) -> None:
         """Undo an assume (bind failed / rejected) — reference ForgetPod (:455-470)."""
@@ -263,6 +381,7 @@ class SchedulerCache:
                     info.remove_pod(cur)
                     self._update_pvc_refs(cur, add=False)
                     self._mark_dirty(node_name)
+                self._dra_release_locked(cur)
                 # keep the pod in pods_map but unassigned
                 cur.spec.node_name = ""
             self.assumed_pods.pop(key, None)

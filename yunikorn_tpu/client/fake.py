@@ -213,6 +213,12 @@ class FakeCluster(APIProvider):
             pod.status.reason = reason
         self._fire(InformerType.POD, "update", pod, old)
 
+    def add_resource_claim(self, claim) -> None:
+        self._fire(InformerType.RESOURCE_CLAIM, "add", claim)
+
+    def add_resource_slice(self, sl) -> None:
+        self._fire(InformerType.RESOURCE_SLICE, "add", sl)
+
     def add_node(self, node: Node) -> Node:
         with self._lock:
             self._nodes[node.name] = node

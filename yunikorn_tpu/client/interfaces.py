@@ -33,6 +33,10 @@ class InformerType(enum.Enum):
     JOB = "job"
     CSINODE = "csinode"
     PV = "pv"
+    # DRA informers (reference apifactory.go:39-59 when the
+    # DynamicResourceAllocation gate is on)
+    RESOURCE_CLAIM = "resourceclaim"
+    RESOURCE_SLICE = "resourceslice"
 
 
 class ResourceEventHandlers:

@@ -105,6 +105,38 @@ class TopologySpreadConstraint:
 
 
 @dataclasses.dataclass
+class ResourceClaim:
+    """Dynamic Resource Allocation claim (reference gates a DRA manager into
+    the Context, context.go:116-130, and plumbs ResourceClaim informers,
+    apifactory.go:39-59). Structured-parameters model: the claim names a
+    device class; allocation pins it to one node's devices."""
+
+    name: str = ""
+    namespace: str = "default"
+    device_class: str = ""
+    allocated_node: str = ""      # "" until allocated
+    reserved_for: List[str] = dataclasses.field(default_factory=list)  # pod uids
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclasses.dataclass
+class ResourceSlice:
+    """Per-node device inventory published by a DRA driver (K8s
+    ResourceSlice): `count` devices of `device_class` on `node_name`."""
+
+    node_name: str = ""
+    device_class: str = ""
+    count: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.node_name}/{self.device_class}"
+
+
+@dataclasses.dataclass
 class Volume:
     name: str = ""
     pvc_claim_name: Optional[str] = None  # persistentVolumeClaim.claimName
@@ -129,6 +161,8 @@ class PodSpec:
     restart_policy: str = "Always"
     overhead: Dict[str, Any] = dataclasses.field(default_factory=dict)
     service_account: str = ""
+    # DRA: names of ResourceClaims (same namespace) this pod requires
+    resource_claims: List[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

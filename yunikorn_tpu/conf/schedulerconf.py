@@ -35,6 +35,7 @@ CM_SVC_EVENT_CHANNEL_CAPACITY = PREFIX_SERVICE + "eventChannelCapacity"
 CM_SVC_DISPATCH_TIMEOUT = PREFIX_SERVICE + "dispatchTimeout"
 CM_SVC_DISABLE_GANG = PREFIX_SERVICE + "disableGangScheduling"
 CM_SVC_ENABLE_HOT_REFRESH = PREFIX_SERVICE + "enableConfigHotRefresh"
+CM_SVC_ENABLE_DRA = PREFIX_SERVICE + "enableDRA"
 CM_SVC_PLACEHOLDER_IMAGE = PREFIX_SERVICE + "placeholderImage"
 CM_SVC_PLACEHOLDER_RUN_AS_USER = PREFIX_SERVICE + "placeholderRunAsUser"
 CM_SVC_PLACEHOLDER_RUN_AS_GROUP = PREFIX_SERVICE + "placeholderRunAsGroup"
@@ -77,6 +78,8 @@ class SchedulerConf:
     kube_burst: int = 1000
     enable_config_hot_refresh: bool = True
     disable_gang_scheduling: bool = False
+    # DynamicResourceAllocation gate (reference context.go:116-130)
+    enable_dra: bool = False
     user_label_key: str = constants.DEFAULT_USER_LABEL
     instance_type_node_label_key: str = constants.NODE_INSTANCE_TYPE_LABEL
     generate_unique_app_ids: bool = False
@@ -183,6 +186,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
         conf.disable_gang_scheduling = _parse_bool(data[CM_SVC_DISABLE_GANG], conf.disable_gang_scheduling)
     if CM_SVC_ENABLE_HOT_REFRESH in data:
         conf.enable_config_hot_refresh = _parse_bool(data[CM_SVC_ENABLE_HOT_REFRESH], conf.enable_config_hot_refresh)
+    if CM_SVC_ENABLE_DRA in data:
+        conf.enable_dra = _parse_bool(data[CM_SVC_ENABLE_DRA], conf.enable_dra)
     if CM_SVC_PLACEHOLDER_RUN_AS_USER in data:
         conf.placeholder.run_as_user = _parse_int(data[CM_SVC_PLACEHOLDER_RUN_AS_USER], conf.placeholder.run_as_user)
     if CM_SVC_PLACEHOLDER_RUN_AS_GROUP in data:

@@ -542,8 +542,9 @@ class CoreScheduler(SchedulerAPI):
                 mask[idx] = True
         return mask
 
-    def _schedule_partition(self, restrict_nodes: bool = False) -> int:
-        """One scheduling cycle for the ACTIVE partition (core lock held)."""
+    def _schedule_partition(self, restrict_nodes: bool = False) -> Tuple[int, tuple]:
+        """One cycle for the ACTIVE partition (core lock held); returns
+        (allocation count, publish payload for _publish_cycle)."""
         t0 = time.time()
         self._check_app_completion()
         self._check_placeholder_timeouts()

@@ -32,14 +32,14 @@ class MockScheduler:
 
     # ------------------------------------------------------------- lifecycle
     def init(self, queues_yaml: str = "", interval: float = 0.05,
-             core_interval: float = 0.02, solver_policy: Optional[str] = None) -> None:
+             core_interval: float = 0.02, solver_policy: Optional[str] = None,
+             conf_extra: Optional[dict] = None) -> None:
         reset_for_tests()
         holder = get_holder()
-        holder.update_config_maps(
-            [{"service.schedulingInterval": str(interval),
-              "queues.yaml": queues_yaml}],
-            initial=True,
-        )
+        cm = {"service.schedulingInterval": str(interval),
+              "queues.yaml": queues_yaml}
+        cm.update(conf_extra or {})
+        holder.update_config_maps([cm], initial=True)
         dispatch_mod.reset_dispatcher()
         self.cluster = FakeCluster()
         cache = SchedulerCache()

@@ -150,6 +150,9 @@ class GroupSpec:
     host_affinity_terms: Optional[list] = None
     # preferred terms host-scored exactly (multi-value In / slot overflow)
     host_pref_terms: Optional[list] = None   # [(weight, term)]
+    # DRA: (namespace, (claim names...)) — feasibility restricted to nodes
+    # satisfying every claim (reference gates a DRA manager, context.go:116-130)
+    claims: Optional[Tuple[str, tuple]] = None
 
 
 @dataclasses.dataclass
@@ -490,7 +493,11 @@ class SnapshotEncoder:
         from yunikorn_tpu.snapshot.locality import locality_signature
 
         loc_sig = locality_signature(pod, self.cache)
-        return (sel, tols, aff, ports, pref, loc_sig)
+        # DRA claims are per-pod identities; pods sharing an identical claim
+        # list share a group (the host mask then holds for every member)
+        claims_sig = ((pod.namespace, tuple(sorted(pod.spec.resource_claims)))
+                      if pod.spec.resource_claims else ())
+        return (sel, tols, aff, ports, pref, loc_sig, claims_sig)
 
     def _encode_group(self, pod: Pod) -> GroupSpec:
         W = self.vocabs.labels.num_words
@@ -651,7 +658,8 @@ class SnapshotEncoder:
             anyof_valid=anyof_valid,
             tolerations=tol,
             ports=ports,
-            needs_host_eval=bool(host_exprs) or host_affinity_terms is not None,
+            needs_host_eval=(bool(host_exprs) or host_affinity_terms is not None
+                             or bool(pod.spec.resource_claims)),
             host_exprs=host_exprs,
             taint_vocab_version=self.vocabs.taints.used_bits(),
             pref_req=pref_req,
@@ -659,6 +667,8 @@ class SnapshotEncoder:
             pref_weight=pref_weight,
             host_affinity_terms=host_affinity_terms,
             host_pref_terms=host_pref_terms or None,
+            claims=((pod.namespace, tuple(sorted(pod.spec.resource_claims)))
+                    if pod.spec.resource_claims else None),
         )
 
     def _host_rows(self):
@@ -710,6 +720,13 @@ class SnapshotEncoder:
                     _node_matches_term(t, labels, name)
                     for t in spec.host_affinity_terms
                 )
+        if spec.claims is not None:
+            ns, names = spec.claims
+            allowed = self.cache.dra_feasible_nodes(ns, names)
+            if allowed is not None:
+                for idx, info in rows:
+                    if info is None or info.node.name not in allowed:
+                        mask[idx] = False
         return mask
 
     def _host_pref_scores(self, spec: GroupSpec, rows=None) -> np.ndarray:
@@ -873,22 +890,41 @@ class SnapshotEncoder:
                 host_soft[gid] += s[: self.nodes.capacity]
 
         if locality is not None and locality.fallback:
-            # Overflowed locality groups: exact host mask + one pod per solve
-            # (the mask is static w.r.t. this batch, so a second pod of the
-            # same group could otherwise violate intra-batch interactions).
+            # Overflowed locality groups: exact host mask evaluated against
+            # existing state (serialized below — the mask is static w.r.t.
+            # this batch)
             if host_mask is None:
                 host_mask = np.ones((G, self.nodes.capacity), bool)
             for gid, fb in locality.fallback.items():
                 host_mask[gid] &= fb[: self.nodes.capacity]
-            first_seen: set = set()
+
+        # Serialization (one shared pass): at most one pod per solve for
+        # (a) each locality-fallback group — its host mask can't see
+        # intra-batch placements — and (b) each device class with unallocated
+        # DRA claims — cross-GROUP: two groups demanding the same class would
+        # otherwise race one device inventory. Later pods retry next cycle
+        # against fresh state.
+        serial_keys_of: Dict[int, tuple] = {}
+        for gi, spec in enumerate(group_specs):
+            keys: list = []
+            if locality is not None and locality.fallback and gi in locality.fallback:
+                keys.append(("loc", gi))
+            if spec.claims is not None:
+                ns, names = spec.claims
+                keys.extend(("dra", c)
+                            for c in self.cache.dra_unallocated_classes(ns, names))
+            if keys:
+                serial_keys_of[gi] = tuple(keys)
+        if serial_keys_of:
+            seen_keys: set = set()
             for i in range(n):
-                gid = group_ids[i]
-                if gid not in locality.fallback:
+                keys = serial_keys_of.get(group_ids[i])
+                if not keys:
                     continue
-                if gid in first_seen:
-                    valid[i] = False  # retried next cycle with fresh counts
+                if any(k in seen_keys for k in keys):
+                    valid[i] = False
                 else:
-                    first_seen.add(gid)
+                    seen_keys.update(keys)
 
         return PodBatch(
             ask_keys=[a.allocation_key for a in asks],
