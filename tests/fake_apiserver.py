@@ -1,0 +1,213 @@
+"""A minimal in-process Kubernetes API server for adapter tests.
+
+Speaks just enough of the K8s REST protocol to drive client/kube.py the way
+kwok drives the reference's client-go layer (deployments/kwok-perf-test):
+LIST + streaming WATCH for the informer types, the pods/binding subresource,
+pod create/delete, configmap get. State lives in plain dicts of K8s JSON
+documents; bindings mutate spec.nodeName + status.phase and emit MODIFIED
+events exactly like a kubelet picking the pod up.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+_COLLECTIONS = {
+    "/api/v1/pods": "pods",
+    "/api/v1/nodes": "nodes",
+    "/api/v1/configmaps": "configmaps",
+    "/apis/scheduling.k8s.io/v1/priorityclasses": "priorityclasses",
+    "/api/v1/namespaces": "namespaces",
+    "/apis/resource.k8s.io/v1beta1/resourceclaims": "resourceclaims",
+    "/apis/resource.k8s.io/v1beta1/resourceslices": "resourceslices",
+}
+
+
+class FakeAPIServer:
+    def __init__(self):
+        self.store: Dict[str, Dict[str, dict]] = {c: {} for c in _COLLECTIONS.values()}
+        self._rv = 0
+        self._lock = threading.RLock()
+        self._watchers: Dict[str, List[queue.Queue]] = {c: [] for c in _COLLECTIONS.values()}
+        self.bindings: List[Tuple[str, str]] = []   # (pod name, node name)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _send_json(self, doc, code=200):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_body(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                coll = _COLLECTIONS.get(parsed.path)
+                if coll is not None:
+                    if q.get("watch", ["false"])[0] == "true":
+                        return self._watch(coll)
+                    with server._lock:
+                        items = list(server.store[coll].values())
+                        rv = str(server._rv)
+                    return self._send_json(
+                        {"items": items, "metadata": {"resourceVersion": rv}})
+                # GET one configmap: /api/v1/namespaces/{ns}/configmaps/{name}
+                parts = parsed.path.strip("/").split("/")
+                if (len(parts) == 6 and parts[:2] == ["api", "v1"]
+                        and parts[2] == "namespaces" and parts[4] == "configmaps"):
+                    key = f"{parts[3]}/{parts[5]}"
+                    with server._lock:
+                        doc = server.store["configmaps"].get(key)
+                    if doc is None:
+                        return self._send_json({"kind": "Status", "code": 404}, 404)
+                    return self._send_json(doc)
+                self._send_json({"kind": "Status", "code": 404}, 404)
+
+            def _watch(self, coll):
+                ch: queue.Queue = queue.Queue()
+                with server._lock:
+                    server._watchers[coll].append(ch)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        event = ch.get(timeout=30)
+                        if event is None:
+                            break
+                        line = (json.dumps(event) + "\n").encode()
+                        self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
+                                         + line + b"\r\n")
+                        self.wfile.flush()
+                except (queue.Empty, BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with server._lock:
+                        if ch in server._watchers[coll]:
+                            server._watchers[coll].remove(ch)
+
+            def do_POST(self):
+                parts = urlparse(self.path).path.strip("/").split("/")
+                body = self._read_body()
+                # pods/binding subresource
+                if len(parts) == 7 and parts[4] == "pods" and parts[6] == "binding":
+                    ns, name = parts[3], parts[5]
+                    node = (body.get("target") or {}).get("name", "")
+                    server.bind_pod(ns, name, node)
+                    return self._send_json({"kind": "Status", "status": "Success"}, 201)
+                if len(parts) == 5 and parts[4] == "pods":
+                    server.add("pods", body)
+                    return self._send_json(body, 201)
+                self._send_json({"kind": "Status", "code": 404}, 404)
+
+            def do_DELETE(self):
+                parts = urlparse(self.path).path.strip("/").split("/")
+                if len(parts) == 6 and parts[4] == "pods":
+                    ns, name = parts[3], parts[5]
+                    server.delete("pods", ns, name)
+                    return self._send_json({"kind": "Status", "status": "Success"})
+                self._send_json({"kind": "Status", "code": 404}, 404)
+
+            def do_PATCH(self):
+                self._read_body()
+                self._send_json({"kind": "Status", "status": "Success"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        return self._httpd.server_port
+
+    def stop(self) -> None:
+        with self._lock:
+            for chans in self._watchers.values():
+                for ch in chans:
+                    ch.put(None)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+
+    # ----------------------------------------------------------------- state
+    def _key(self, doc: dict) -> str:
+        m = doc.get("metadata") or {}
+        ns = m.get("namespace", "")
+        return f"{ns}/{m['name']}" if ns else m["name"]
+
+    def _emit(self, coll: str, etype: str, doc: dict) -> None:
+        for ch in list(self._watchers[coll]):
+            ch.put({"type": etype, "object": doc})
+
+    def add(self, coll: str, doc: dict) -> dict:
+        with self._lock:
+            self._rv += 1
+            meta = doc.setdefault("metadata", {})
+            meta.setdefault("uid", f"uid-{coll}-{self._rv}")
+            meta["resourceVersion"] = str(self._rv)
+            key = self._key(doc)
+            existed = key in self.store[coll]
+            self.store[coll][key] = doc
+            self._emit(coll, "MODIFIED" if existed else "ADDED", doc)
+        return doc
+
+    def delete(self, coll: str, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}" if namespace else name
+            doc = self.store[coll].pop(key, None)
+            if doc is not None:
+                self._rv += 1
+                self._emit(coll, "DELETED", doc)
+
+    def bind_pod(self, namespace: str, name: str, node: str) -> None:
+        """Apply a binding: nodeName + Running, MODIFIED event (kubelet-ish)."""
+        with self._lock:
+            key = f"{namespace}/{name}"
+            doc = self.store["pods"].get(key)
+            if doc is None:
+                return
+            self.bindings.append((name, node))
+            doc.setdefault("spec", {})["nodeName"] = node
+            doc.setdefault("status", {})["phase"] = "Running"
+            self._rv += 1
+            doc["metadata"]["resourceVersion"] = str(self._rv)
+            self._emit("pods", "MODIFIED", doc)
+
+    # ------------------------------------------------------ document helpers
+    def add_node_doc(self, name: str, cpu: str = "8", memory: str = "16Gi",
+                     pods: int = 110, labels: Optional[dict] = None) -> dict:
+        return self.add("nodes", {
+            "metadata": {"name": name, "labels": dict(labels or {})},
+            "spec": {},
+            "status": {"allocatable": {"cpu": cpu, "memory": memory, "pods": str(pods)},
+                       "capacity": {"cpu": cpu, "memory": memory, "pods": str(pods)}},
+        })
+
+    def add_pod_doc(self, name: str, namespace: str = "default",
+                    app_id: str = "app-1", cpu: str = "500m",
+                    memory: str = "128Mi") -> dict:
+        return self.add("pods", {
+            "metadata": {"name": name, "namespace": namespace,
+                         "labels": {"applicationId": app_id},
+                         "creationTimestamp": "2026-01-01T00:00:00Z"},
+            "spec": {"schedulerName": "yunikorn",
+                     "containers": [{"name": "sleep",
+                                     "resources": {"requests": {"cpu": cpu,
+                                                                "memory": memory}}}]},
+            "status": {"phase": "Pending"},
+        })

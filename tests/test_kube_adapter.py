@@ -1,0 +1,150 @@
+"""Real-K8s adapter tests: client/kube.py driven against an in-process API
+server speaking the K8s REST protocol (LIST/WATCH/bind/create). The full-stack
+test is the kwok-smoke analog the reference runs via
+deployments/kwok-perf-test/kwok-setup.sh: sleep pods bound onto fake nodes by
+the real scheduler path, through HTTP."""
+import ssl
+import time
+
+import pytest
+
+from tests.fake_apiserver import FakeAPIServer
+from yunikorn_tpu.client.interfaces import InformerType, ResourceEventHandlers
+from yunikorn_tpu.client.kube import KubeConfig, RealAPIProvider, RealKubeClient
+
+
+@pytest.fixture
+def api():
+    server = FakeAPIServer()
+    port = server.start()
+    cfg = KubeConfig(f"http://127.0.0.1:{port}", ssl.create_default_context())
+    yield server, cfg
+    server.stop()
+
+
+def test_list_and_watch_nodes(api):
+    server, cfg = api
+    server.add_node_doc("n0")
+    provider = RealAPIProvider(cfg)
+    seen = []
+    provider.add_event_handler(InformerType.NODE, ResourceEventHandlers(
+        add_fn=lambda n: seen.append(("add", n.name)),
+        delete_fn=lambda n: seen.append(("del", n.name))))
+    provider.start()
+    provider.wait_for_sync(timeout=10)
+    assert ("add", "n0") in seen
+    server.add_node_doc("n1")  # via watch
+    deadline = time.time() + 5
+    while ("add", "n1") not in seen and time.time() < deadline:
+        time.sleep(0.05)
+    assert ("add", "n1") in seen
+    server.delete("nodes", "", "n0")
+    deadline = time.time() + 5
+    while ("del", "n0") not in seen and time.time() < deadline:
+        time.sleep(0.05)
+    assert ("del", "n0") in seen
+    provider.stop()
+
+
+def test_pod_decode_and_bind_roundtrip(api):
+    server, cfg = api
+    server.add_pod_doc("p0", app_id="app-x")
+    client = RealKubeClient(cfg)
+    provider = RealAPIProvider(cfg)
+    provider.start()
+    provider.wait_for_sync(timeout=10)
+    pods = provider.list_pods()
+    assert len(pods) == 1
+    p = pods[0]
+    assert p.name == "p0" and p.metadata.labels["applicationId"] == "app-x"
+    assert p.spec.containers[0].resources_requests["cpu"] == "500m"
+    server.add_node_doc("n0")
+    client.bind(p, "n0")
+    assert server.bindings == [("p0", "n0")]
+    provider.stop()
+
+
+def test_configmap_bootstrap(api):
+    server, cfg = api
+    server.add("configmaps", {
+        "metadata": {"name": "yunikorn-defaults", "namespace": "yunikorn"},
+        "data": {"service.schedulingInterval": "2s"}})
+    from yunikorn_tpu.client.kube import load_bootstrap_configmaps
+
+    client = RealKubeClient(cfg)
+    maps, binary = load_bootstrap_configmaps(client, "yunikorn")
+    assert maps[0] == {"service.schedulingInterval": "2s"}
+    assert maps[1] is None  # yunikorn-configs absent
+    assert binary == [{}, {}]
+
+
+def test_full_scheduler_stack_against_api_server(api):
+    """The kwok-smoke analog: real shim + core + adapter scheduling sleep
+    pods onto API-server nodes over HTTP (reference bar: kwok-setup.sh)."""
+    server, cfg = api
+    from yunikorn_tpu.cache.context import Context
+    from yunikorn_tpu.cache import task as task_mod
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.conf.schedulerconf import get_holder, reset_for_tests
+    from yunikorn_tpu.core.scheduler import CoreScheduler
+    from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
+    from yunikorn_tpu.shim.scheduler import KubernetesShim
+
+    for i in range(3):
+        server.add_node_doc(f"kwok-{i}")
+    for i in range(6):
+        server.add_pod_doc(f"sleep-{i}", app_id="kwok-app")
+
+    reset_for_tests()
+    get_holder().update_config_maps(
+        [{"service.schedulingInterval": "0.05"}], initial=True)
+    dispatch_mod.reset_dispatcher()
+    provider = RealAPIProvider(cfg)
+    cache = SchedulerCache()
+    core = CoreScheduler(cache, interval=0.02)
+    ctx = Context(provider, core, cache=cache)
+    shim = KubernetesShim(provider, core, context=ctx)
+    core.start()
+    shim.run()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            app = ctx.get_application("kwok-app")
+            if app is not None:
+                tasks = [app.get_task(p.uid) for p in provider.list_pods()]
+                if (len(tasks) == 6 and all(
+                        t is not None and t.state == task_mod.BOUND for t in tasks)):
+                    break
+            time.sleep(0.1)
+        assert len(server.bindings) == 6
+        bound_nodes = {n for _, n in server.bindings}
+        assert bound_nodes <= {"kwok-0", "kwok-1", "kwok-2"}
+    finally:
+        core.stop()
+        shim.stop()
+        provider.stop()
+
+
+def test_bootstrap_binary_data_decoded(api):
+    server, cfg = api
+    import base64, gzip
+
+    payload = gzip.compress(b"queues-config-bytes")
+    server.add("configmaps", {
+        "metadata": {"name": "yunikorn-defaults", "namespace": "yunikorn"},
+        "data": {"a": "1"},
+        "binaryData": {"queues.yaml": base64.b64encode(payload).decode()}})
+    from yunikorn_tpu.client.kube import load_bootstrap_configmaps
+
+    maps, binary = load_bootstrap_configmaps(RealKubeClient(cfg), "yunikorn")
+    assert maps[0] == {"a": "1"}
+    assert binary[0]["queues.yaml"] == payload
+
+
+def test_namespaced_configmap_informer_path(api):
+    server, cfg = api
+    provider = RealAPIProvider(cfg, namespace="yunikorn")
+    from yunikorn_tpu.client.kube import _Informer
+
+    inf = provider._informers[InformerType.CONFIGMAP]
+    assert inf._list_path(False) == "/api/v1/namespaces/yunikorn/configmaps"

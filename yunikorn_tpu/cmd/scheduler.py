@@ -37,6 +37,9 @@ def main(argv=None) -> int:
     parser.add_argument("--rest-port", type=int, default=9080)
     parser.add_argument("--queues-yaml", type=str, default="",
                         help="path to a queues.yaml config file")
+    parser.add_argument("--kubeconfig", type=str, default="",
+                        help="schedule against a real cluster via this "
+                             "kubeconfig (kind/kwok); default: FakeCluster")
     args = parser.parse_args(argv)
 
     ensure_compilation_cache()
@@ -46,12 +49,36 @@ def main(argv=None) -> int:
         with open(args.queues_yaml) as f:
             queues_yaml = f.read()
     holder = get_holder()
-    holder.update_config_maps([{"queues.yaml": queues_yaml}], initial=True)
 
-    cluster = FakeCluster()
-    if args.nodes:
-        for node in make_kwok_nodes(args.nodes):
-            cluster.add_node(node)
+    if args.kubeconfig:
+        if args.nodes:
+            logger.warning("--nodes is ignored with --kubeconfig (nodes come "
+                           "from the cluster)")
+        # real cluster: bootstrap configmaps BEFORE informers, then build the
+        # provider from the bootstrapped conf (QPS/DRA may come from the
+        # cluster's configmaps) — reference client/bootstrap.go:28 ordering
+        from yunikorn_tpu.client.kube import (
+            KubeConfig, RealKubeClient, RealAPIProvider, load_bootstrap_configmaps)
+
+        kc = KubeConfig.load(args.kubeconfig)
+        boot_client = RealKubeClient(kc)
+        maps, binary_maps = load_bootstrap_configmaps(
+            boot_client, holder.get().namespace)
+        if queues_yaml:
+            maps.append({"queues.yaml": queues_yaml})
+            binary_maps.append({})
+        holder.update_config_maps(maps, initial=True, binary_maps=binary_maps)
+        conf0 = holder.get()
+        provider = RealAPIProvider(kc, qps=conf0.kube_qps, burst=conf0.kube_burst,
+                                   enable_dra=conf0.enable_dra,
+                                   namespace=conf0.namespace)
+        cluster = provider
+    else:
+        holder.update_config_maps([{"queues.yaml": queues_yaml}], initial=True)
+        cluster = FakeCluster()
+        if args.nodes:
+            for node in make_kwok_nodes(args.nodes):
+                cluster.add_node(node)
 
     cache = SchedulerCache()
     core = CoreScheduler(cache)
