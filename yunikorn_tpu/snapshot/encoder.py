@@ -764,6 +764,12 @@ class SnapshotEncoder:
         from yunikorn_tpu.snapshot.locality import all_anti_terms
 
         anti_terms = all_anti_terms(self.cache)
+        # hoisted: used_bits() takes the vocab lock — calling it per ask cost
+        # ~0.2s of the 50k-pod encode. Concurrent vocab growth (a node gains a
+        # previously unseen taint mid-encode) is then invisible until the next
+        # batch — one cycle of snapshot staleness, same class of tradeoff as
+        # the node-array sync point.
+        taint_bits = self.vocabs.taints.used_bits()
         group_specs: List[GroupSpec] = []
         group_ids: List[int] = []
         sig_to_gid: Dict[tuple, int] = {}
@@ -776,8 +782,10 @@ class SnapshotEncoder:
             gid = sig_to_gid.get(sig)
             if gid is not None:
                 # re-encode if the taint vocab grew since this group was cached
-                if group_specs[gid].taint_vocab_version != self.vocabs.taints.used_bits() and pod is not None:
+                if group_specs[gid].taint_vocab_version != taint_bits and pod is not None:
                     group_specs[gid] = self._encode_group(pod)
+                    # the spec was stamped with the (possibly grown) version
+                    taint_bits = group_specs[gid].taint_vocab_version
             else:
                 gid = len(group_specs)
                 sig_to_gid[sig] = gid
@@ -785,11 +793,12 @@ class SnapshotEncoder:
                     spec = self._empty_group()
                 else:
                     cached = self._group_cache.get(sig)
-                    if cached is not None and cached[1].taint_vocab_version == self.vocabs.taints.used_bits():
+                    if cached is not None and cached[1].taint_vocab_version == taint_bits:
                         spec = cached[1]
                         self._group_cache.move_to_end(sig)
                     else:
                         spec = self._encode_group(pod)
+                        taint_bits = spec.taint_vocab_version  # may have grown
                         self._group_cache[sig] = (0, spec)
                         self._group_cache.move_to_end(sig)
                         while len(self._group_cache) > self._group_cache_max:
@@ -807,19 +816,23 @@ class SnapshotEncoder:
 
         # requests dedup: large batches are dominated by identical shapes (a
         # deployment's pods all ask the same), so quantize each distinct
-        # resource once and scatter
+        # resource once and scatter all its rows in one vectorized assignment
         req = np.zeros((N, R), np.float32)
-        row_cache: Dict[tuple, np.ndarray] = {}
+        # sig -> (quantized row, row indices asking for it)
+        sig_rows: Dict[tuple, Tuple[np.ndarray, list]] = {}
         for i, ask in enumerate(asks):
             sig = tuple(sorted(ask.resource.resources.items()))
-            row = row_cache.get(sig)
-            if row is None:
+            entry = sig_rows.get(sig)
+            if entry is None:
                 row = self.quantize_request(ask.resource)
-                row_cache[sig] = row
-            if row.shape[0] > R:
-                # vocab grew past the padded width: restart with the wider R
-                return self.build_batch(asks, ranks, queue_ids, min_batch)
-            req[i, : row.shape[0]] = row
+                if row.shape[0] > R:
+                    # vocab grew past the padded width: restart wider
+                    return self.build_batch(asks, ranks, queue_ids, min_batch)
+                sig_rows[sig] = (row, [i])
+            else:
+                entry[1].append(i)
+        for row, idxs in sig_rows.values():
+            req[np.asarray(idxs, np.int64), : row.shape[0]] = row
 
         g_term_req = np.zeros((G, MAX_TERMS, W), np.uint32)
         g_term_forb = np.zeros((G, MAX_TERMS, W), np.uint32)
