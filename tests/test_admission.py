@@ -252,3 +252,127 @@ def test_webhook_manager_manifests():
     v = mgr.validating_webhook_config()
     assert v["webhooks"][0]["rules"][0]["resources"] == ["configmaps"]
     assert mgr.wait_for_certificate_expiration_seconds() > 0
+
+
+# ---------------------------------------------------------------------------
+# Round-2: namespace regex matrix (reference admission_controller_test.go's
+# processNamespaces/bypassNamespaces/labelNamespaces/noLabelNamespaces grid)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("process,bypass,ns,expected", [
+    # no lists: everything processed except built-in bypass defaults
+    ("", "", "default", True),
+    ("", "", "kube-system", False),          # default bypassNamespaces
+    ("", "", "kube-public", True),           # reference default bypasses only kube-system
+    # processNamespaces whitelist
+    ("^spark-,^batch$", "", "spark-jobs", True),
+    ("^spark-,^batch$", "", "batch", True),
+    ("^spark-,^batch$", "", "other", False),
+    ("^spark-,^batch$", "", "notbatch", False),
+    # bypass wins over process
+    ("^spark-", "^spark-skip", "spark-skip-1", False),
+    ("^spark-", "^spark-skip", "spark-ok", True),
+    # regex is a search, not fullmatch (reference semantics)
+    ("ml", "", "team-ml-jobs", True),
+    # invalid regex entries are dropped, valid ones still apply
+    ("[invalid,^good$", "", "good", True),
+    ("[invalid,^good$", "", "bad", False),
+])
+def test_namespace_processing_matrix(process, bypass, ns, expected):
+    flat = {"admissionController.filtering.processNamespaces": process}
+    if bypass:
+        flat["admissionController.filtering.bypassNamespaces"] = bypass
+    conf = parse_admission_conf(flat)
+    assert conf.should_process_namespace(ns) is expected
+
+
+@pytest.mark.parametrize("label,nolabel,ns,expected", [
+    ("", "", "anyns", True),
+    ("^spark", "", "spark-1", True),
+    ("^spark", "", "other", False),
+    ("", "^secret", "secret-ns", False),
+    ("", "^secret", "open-ns", True),
+    # noLabel wins over label
+    ("^spark", "^spark-hidden", "spark-hidden-2", False),
+])
+def test_namespace_labeling_matrix(label, nolabel, ns, expected):
+    flat = {}
+    if label:
+        flat["admissionController.filtering.labelNamespaces"] = label
+    if nolabel:
+        flat["admissionController.filtering.noLabelNamespaces"] = nolabel
+    conf = parse_admission_conf(flat)
+    assert conf.should_label_namespace(ns) is expected
+
+
+def test_conf_hot_reload_via_holder():
+    """Standalone-binary conf hot reload (reference am_conf.go:85-394): the
+    controller reads the LIVE conf through the holder."""
+    from yunikorn_tpu.admission.conf import AdmissionConfHolder
+
+    holder = AdmissionConfHolder()
+    ac = AdmissionController(holder.get(), conf_holder=holder)
+    pod = simple_pod()
+    res = ac.mutate(make_review(pod, namespace="skipme"))
+    assert ("add", "/spec/schedulerName") in patch_ops(res)  # processed
+    holder.update({"admissionController.filtering.bypassNamespaces": "^skipme$"})
+    res = ac.mutate(make_review(pod, namespace="skipme"))
+    # hot-reloaded: the schedulerName patch no longer applies (user-info
+    # annotation still does — auth is independent of namespace filtering)
+    assert ("add", "/spec/schedulerName") not in patch_ops(res)
+
+
+def test_admission_informer_attachment_feeds_conf_and_caches():
+    from yunikorn_tpu.admission.caches import attach_informers
+    from yunikorn_tpu.admission.conf import AdmissionConfHolder
+    from yunikorn_tpu.client.fake import FakeCluster
+    from yunikorn_tpu.common.objects import ConfigMap, Namespace, ObjectMeta, PriorityClass
+
+    cluster = FakeCluster()
+    holder = AdmissionConfHolder()
+    ns_cache, pc_cache = NamespaceCache(), PriorityClassCache()
+    attach_informers(cluster, holder, ns_cache, pc_cache)
+    cluster.start()  # informers fan out only after start
+    cluster.add_configmap(ConfigMap(
+        metadata=ObjectMeta(name="yunikorn-configs", namespace="yunikorn"),
+        data={"admissionController.filtering.processNamespaces": "^only$"}))
+    assert holder.get().should_process_namespace("only")
+    assert not holder.get().should_process_namespace("other")
+    cluster.add_namespace(Namespace(metadata=ObjectMeta(
+        name="annotated",
+        annotations={constants.ANNOTATION_ENABLE_YUNIKORN: "true"})))
+    assert ns_cache.enable_yunikorn("annotated") == 1
+    cluster.add_priority_class(PriorityClass(
+        metadata=ObjectMeta(name="no-preempt",
+                            annotations={constants.ANNOTATION_ALLOW_PREEMPTION: "false"}),
+        value=100))
+    assert not pc_cache.is_preemption_allowed("no-preempt")
+
+
+def test_certificate_expiration_loop_rotates():
+    import threading
+    import time as _time
+
+    from yunikorn_tpu.admission.pki import CACollection
+    from yunikorn_tpu.admission.webhook import WebhookManager
+
+    cas = CACollection()
+    manager = WebhookManager(AdmissionConf(), cas)
+    rotated = []
+    stop = threading.Event()
+    # make rotation immediately due: the 12-month certs are "within" the
+    # rotation window when the window is enormous
+    old_window = CACollection.ROTATE_BEFORE_SECONDS
+    CACollection.ROTATE_BEFORE_SECONDS = 10 * 365 * 24 * 3600.0
+    try:
+        manager.run_certificate_expiration_loop(
+            stop, on_rotated=lambda m, v: rotated.append((m, v)))
+        deadline = _time.time() + 15
+        while not rotated and _time.time() < deadline:
+            _time.sleep(0.05)
+    finally:
+        stop.set()
+        CACollection.ROTATE_BEFORE_SECONDS = old_window
+    assert rotated, "expected a rotation + webhook re-registration"
+    m, v = rotated[0]
+    assert m["webhooks"][0]["clientConfig"]["caBundle"]  # fresh bundle rendered

@@ -89,3 +89,79 @@ def test_webtest_proxy(stack):
             assert proxied["Healthy"] is True
         finally:
             wt.stop()
+
+
+def test_usage_trackers_and_events_endpoints():
+    """Round-2 REST catalogue: per-user/group trackers + events stream
+    (reference RClient usage/events APIs)."""
+    import json
+    import urllib.request
+
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.events import get_recorder
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (AddApplicationRequest, AllocationAsk,
+                                        AllocationRequest, ApplicationRequest,
+                                        NodeAction, NodeInfo, NodeRequest,
+                                        RegisterResourceManagerRequest,
+                                        UserGroupInfo)
+    from yunikorn_tpu.core.scheduler import CoreScheduler
+    from yunikorn_tpu.webapp.rest import RestServer
+
+    yaml_text = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: default
+            limits:
+              - users: ["*"]
+                maxresources: {vcore: 100}
+"""
+    cache = SchedulerCache()
+    core = CoreScheduler(cache)
+
+    class CB:
+        def update_allocation(self, r): pass
+        def update_application(self, r): pass
+        def update_node(self, r): pass
+        def predicates(self, a): return None
+        def preemption_predicates(self, a): return None
+        def send_event(self, e): pass
+        def update_container_scheduling_state(self, r): pass
+        def get_state_dump(self): return "{}"
+
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="r", policy_group="q", config=yaml_text), CB())
+    n = make_node("n0", cpu_milli=8000)
+    cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[NodeInfo(node_id="n0", action=NodeAction.CREATE)]))
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="ua", queue_name="root.default",
+        user=UserGroupInfo(user="alice", groups=["devs"]))]))
+    p = make_pod("p0", cpu_milli=1000, memory=2**20)
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, "ua", get_pod_resource(p), pod=p)]))
+    assert core.schedule_once() == 1
+    get_recorder().eventf("Pod", "default/p0", "Normal", "Scheduled", "bound to n0")
+
+    rest = RestServer(core, port=0)
+    port = rest.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+                return json.loads(r.read())
+
+        users = get("/ws/v1/partition/default/usage/users")
+        alice = next(u for u in users if u["name"] == "alice")
+        assert alice["queues"]["root.default"]["resourceUsage"].get("cpu") == 1000
+        assert alice["queues"]["root.default"]["runningApplications"] == 1
+        groups = get("/ws/v1/partition/default/usage/groups")
+        assert any(g["name"] == "devs" for g in groups)
+        events = get("/ws/v1/events/batch?count=10")
+        assert any(e["reason"] == "Scheduled" for e in events["EventRecords"])
+        assert get("/ws/v1/partitions") == ["default"]
+    finally:
+        rest.stop()

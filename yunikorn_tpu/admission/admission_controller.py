@@ -38,12 +38,20 @@ class AdmissionController:
     def __init__(self, conf: AdmissionConf,
                  namespace_cache: Optional[NamespaceCache] = None,
                  pc_cache: Optional[PriorityClassCache] = None,
-                 validate_conf_fn: Optional[Callable[[str], tuple]] = None):
-        self.conf = conf
+                 validate_conf_fn: Optional[Callable[[str], tuple]] = None,
+                 conf_holder=None):
+        # with a holder, every request reads the LIVE conf (standalone-binary
+        # hot reload, reference am_conf.go:85-394); else the snapshot given
+        self._conf = conf
+        self._conf_holder = conf_holder
         self.namespaces = namespace_cache or NamespaceCache()
         self.priority_classes = pc_cache or PriorityClassCache()
         # seam to the scheduler's /ws/v1/validate-conf (in-process or HTTP)
         self._validate_conf_fn = validate_conf_fn
+
+    @property
+    def conf(self) -> AdmissionConf:
+        return self._conf_holder.get() if self._conf_holder is not None else self._conf
 
     # ------------------------------------------------------------------ mutate
     def mutate(self, review: Dict) -> Dict:

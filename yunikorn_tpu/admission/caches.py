@@ -66,3 +66,53 @@ class PriorityClassCache:
         """Default True for unknown classes (reference behavior)."""
         with self._lock:
             return self._allow.get(name, True)
+
+
+def attach_informers(api_provider, conf_holder, ns_cache: NamespaceCache,
+                     pc_cache: PriorityClassCache,
+                     namespace: str = "yunikorn") -> None:
+    """Wire the admission controller's informer-fed state (reference
+    cmd/admissioncontroller/main.go:55-110 starts namespace + priorityclass
+    informers and the conf hot-reload; am_conf.go:85-394 reloads the
+    standalone conf from the yunikorn configmaps)."""
+    from yunikorn_tpu.client.interfaces import InformerType, ResourceEventHandlers
+
+    def on_ns(ns) -> None:
+        ns_cache.namespace_updated(ns.metadata.name, dict(ns.metadata.annotations))
+
+    def on_ns_deleted(ns) -> None:
+        ns_cache.namespace_deleted(ns.metadata.name)
+
+    def on_pc(pc) -> None:
+        pc_cache.priority_class_updated(pc.name, dict(pc.metadata.annotations))
+
+    def on_pc_deleted(pc) -> None:
+        pc_cache.priority_class_deleted(pc.name)
+
+    _cms: Dict[str, Dict[str, str]] = {}
+
+    def is_yunikorn_cm(cm) -> bool:
+        return (cm.metadata.namespace == namespace
+                and cm.metadata.name in ("yunikorn-defaults", "yunikorn-configs"))
+
+    def _rebuild() -> None:
+        flat: Dict[str, str] = {}
+        for name in ("yunikorn-defaults", "yunikorn-configs"):
+            flat.update(_cms.get(name, {}))
+        conf_holder.update(flat)
+
+    def on_cm(cm) -> None:
+        _cms[cm.metadata.name] = dict(cm.data)
+        _rebuild()
+
+    def on_cm_deleted(cm) -> None:
+        _cms.pop(cm.metadata.name, None)
+        _rebuild()
+
+    api_provider.add_event_handler(InformerType.NAMESPACE, ResourceEventHandlers(
+        add_fn=on_ns, update_fn=lambda old, new: on_ns(new), delete_fn=on_ns_deleted))
+    api_provider.add_event_handler(InformerType.PRIORITY_CLASS, ResourceEventHandlers(
+        add_fn=on_pc, update_fn=lambda old, new: on_pc(new), delete_fn=on_pc_deleted))
+    api_provider.add_event_handler(InformerType.CONFIGMAP, ResourceEventHandlers(
+        filter_fn=is_yunikorn_cm,
+        add_fn=on_cm, update_fn=lambda old, new: on_cm(new), delete_fn=on_cm_deleted))

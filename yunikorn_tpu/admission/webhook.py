@@ -170,3 +170,30 @@ class WebhookManager:
             p.seconds_until_expiry() - CACollection.ROTATE_BEFORE_SECONDS
             for p in self.cas.pairs
         )
+
+    def run_certificate_expiration_loop(self, stop_event,
+                                        on_rotated=None) -> "threading.Thread":
+        """Background re-registration loop (reference WaitForCertificateExpiration
+        :223-232): sleep until the next rotation is due, rotate the CA pair,
+        and re-render/patch the webhook configurations so the caBundle stays
+        valid. on_rotated(mutating_cfg, validating_cfg) applies the patch —
+        against a real cluster, an Update of both WebhookConfigurations."""
+
+        def loop():
+            while not stop_event.is_set():
+                wait = max(1.0, self.wait_for_certificate_expiration_seconds())
+                if stop_event.wait(timeout=wait):
+                    return
+                if self.cas.rotate_if_needed():
+                    logger.info("certificate rotation performed; "
+                                "re-registering webhooks")
+                    if on_rotated is not None:
+                        try:
+                            on_rotated(self.mutating_webhook_config(),
+                                       self.validating_webhook_config())
+                        except Exception:
+                            logger.exception("webhook re-registration failed")
+
+        t = threading.Thread(target=loop, name="cert-expiration", daemon=True)
+        t.start()
+        return t

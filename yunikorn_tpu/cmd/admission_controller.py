@@ -30,23 +30,50 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, default=9089)
     parser.add_argument("--host", type=str, default="0.0.0.0")
     parser.add_argument("--no-tls", action="store_true")
+    parser.add_argument("--kubeconfig", type=str, default="",
+                        help="watch namespaces/priorityclasses/configmaps in "
+                             "a real cluster (conf hot-reload)")
     args = parser.parse_args(argv)
 
     holder = AdmissionConfHolder()
     conf = holder.get()
     cas = CACollection()
     manager = WebhookManager(conf, cas)
+    ns_cache, pc_cache = NamespaceCache(), PriorityClassCache()
     controller = AdmissionController(
         conf,
-        namespace_cache=NamespaceCache(),
-        pc_cache=PriorityClassCache(),
+        namespace_cache=ns_cache,
+        pc_cache=pc_cache,
+        conf_holder=holder,
     )
+    provider = None
+    if args.kubeconfig:
+        from yunikorn_tpu.admission.caches import attach_informers
+        from yunikorn_tpu.client.kube import KubeConfig, RealAPIProvider
+
+        provider = RealAPIProvider(KubeConfig.load(args.kubeconfig),
+                                   namespace=conf.namespace)
+        attach_informers(provider, holder, ns_cache, pc_cache,
+                         namespace=conf.namespace)
+        provider.start()
     server = WebhookServer(controller, host=args.host, port=args.port,
                            use_tls=not args.no_tls, cas=cas)
     port = server.start()
     logger.info("admission controller on :%d (tls=%s)", port, not args.no_tls)
 
     stop = threading.Event()
+
+    def on_rotated(mutating_cfg, validating_cfg):
+        # restart the TLS server so it serves a cert signed by the fresh CA
+        # (same reload the SIGUSR1 path performs); against a real cluster an
+        # operator/adapter applies the re-rendered WebhookConfigurations
+        logger.info("applying rotated certificates (server restart)")
+        server.stop()
+        server.start()
+
+    # background cert re-registration (reference WaitForCertificateExpiration
+    # :223-232 + main.go restart-on-rotation)
+    manager.run_certificate_expiration_loop(stop, on_rotated=on_rotated)
 
     def handle_term(signum, frame):
         stop.set()
@@ -64,6 +91,8 @@ def main(argv=None) -> int:
         signal.signal(signal.SIGUSR1, handle_usr1)
     stop.wait()
     server.stop()
+    if provider is not None:
+        provider.stop()
     return 0
 
 

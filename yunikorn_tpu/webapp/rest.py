@@ -19,6 +19,35 @@ from yunikorn_tpu.log.logger import log
 logger = log("core")
 
 
+def _usage_dao(core, partition: str, kind: str) -> list:
+    """Per-user / per-group resource trackers (reference RClient usage APIs:
+    /ws/v1/partition/{p}/usage/users|groups over yunikorn-core's ugm): walk
+    the partition's queue tree and report each tracked user/group's allocated
+    resources and running application count per queue."""
+    tree = core.queue_trees.get(partition)
+    if tree is None:
+        return []
+    out: dict = {}
+
+    def walk(q):
+        alloc_map = q.user_allocated if kind == "users" else q.group_allocated
+        count_map = q.user_app_counts if kind == "users" else q.group_app_counts
+        for name, res in alloc_map.items():
+            entry = out.setdefault(name, {"name": name, "queues": {}})
+            entry["queues"][q.full_name] = {
+                "resourceUsage": dict(res.resources),
+                "runningApplications": count_map.get(name, 0),
+            }
+        for child in q.children.values():
+            walk(child)
+
+    # the scheduler thread mutates these maps under the core lock; every
+    # other endpoint reads through get_partition_dao() which locks too
+    with core._lock:
+        walk(tree.root)
+    return sorted(out.values(), key=lambda e: e["name"])
+
+
 class RestServer:
     def __init__(self, core, context=None, host: str = "127.0.0.1", port: int = 9080):
         self.core = core
@@ -47,16 +76,56 @@ class RestServer:
                 parsed = urlparse(self.path)
                 path = parsed.path.rstrip("/")
                 dao = core.get_partition_dao()
+
+                # /ws/v1/partition/{name}/{what...} — partition-parameterized
+                # (reference RClient drives per-partition paths)
+                parts = path.strip("/").split("/")
+                if len(parts) >= 4 and parts[:3] == ["ws", "v1", "partition"]:
+                    pname, what = parts[3], "/".join(parts[4:])
+                    pd = dao.get("partitions", {}).get(pname) if pname != "default" else dao
+                    if pd is None:
+                        return self._reply(404, {"error": f"unknown partition {pname}"})
+                    if what == "queues":
+                        return self._reply(200, pd["queues"])
+                    if what == "applications":
+                        return self._reply(200, pd["partition"]["applications"])
+                    if what == "nodes":
+                        return self._reply(200, pd["partition"]["nodes"])
+                    if what == "usage/users":
+                        return self._reply(200, _usage_dao(core, pname, "users"))
+                    if what == "usage/groups":
+                        return self._reply(200, _usage_dao(core, pname, "groups"))
+                    return self._reply(404, {"error": f"unknown path {path}"})
+
                 if path in ("/ws/v1/health", "/health"):
                     self._reply(200, {"Healthy": True})
-                elif path in ("/ws/v1/queues", "/ws/v1/partition/default/queues"):
+                elif path == "/ws/v1/partitions":
+                    with core._lock:
+                        names = sorted(core.partitions)
+                    self._reply(200, names)
+                elif path == "/ws/v1/queues":
                     self._reply(200, dao["queues"])
-                elif path in ("/ws/v1/apps", "/ws/v1/partition/default/applications"):
+                elif path == "/ws/v1/apps":
                     self._reply(200, dao["partition"]["applications"])
-                elif path in ("/ws/v1/nodes", "/ws/v1/partition/default/nodes"):
+                elif path == "/ws/v1/nodes":
                     self._reply(200, dao["partition"]["nodes"])
                 elif path == "/ws/v1/metrics":
                     self._reply(200, dao["metrics"])
+                elif path == "/ws/v1/events/batch":
+                    # K8s-event stream analog (reference RClient events API);
+                    # ?count=N bounds the tail
+                    from yunikorn_tpu.common.events import get_recorder
+
+                    q = parse_qs(parsed.query)
+                    try:
+                        count = max(1, int(q.get("count", ["1000"])[0]))
+                    except ValueError:
+                        return self._reply(400, {"error": "invalid count"})
+                    events = get_recorder().events()[-count:]
+                    self._reply(200, {"EventRecords": [
+                        {"objectKind": e.object_kind, "objectID": e.object_key,
+                         "type": e.event_type, "reason": e.reason,
+                         "message": e.message} for e in events]})
                 elif path == "/ws/v1/fullstatedump":
                     dump = {"core": dao}
                     if context is not None:
