@@ -1,0 +1,132 @@
+"""Exhaustive FSM transition matrices for Application and Task.
+
+Reference bar: application_state_test.go / task_state_test.go assert every
+(state, event) pair. Here the full matrix is written out explicitly: any
+change to the transition tables — intended or accidental (mutation) — fails
+exactly the affected cells. Driven on the bare FSM (no side-effect
+callbacks), which shares the Transition tables with the live objects.
+"""
+import pytest
+
+from yunikorn_tpu.cache import application as app_mod
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.utils.fsm import FSM, InvalidEventError
+
+
+def allowed_map(transitions):
+    out = {}
+    for t in transitions:
+        for src in t.sources:
+            out[(src, t.event)] = t.destination
+    return out
+
+
+APP_STATES = [app_mod.NEW, app_mod.SUBMITTED, app_mod.ACCEPTED, app_mod.RESERVING,
+              app_mod.RUNNING, app_mod.REJECTED, app_mod.COMPLETED, app_mod.KILLING,
+              app_mod.KILLED, app_mod.FAILING, app_mod.FAILED, app_mod.RESUMING]
+APP_EVENTS = [app_mod.SUBMIT_APPLICATION, app_mod.ACCEPT_APPLICATION,
+              app_mod.TRY_RESERVE, app_mod.UPDATE_RESERVATION,
+              app_mod.RESUMING_APPLICATION, app_mod.APP_TASK_COMPLETED,
+              app_mod.RUN_APPLICATION, app_mod.RELEASE_APP_ALLOCATION,
+              app_mod.COMPLETE_APPLICATION, app_mod.REJECT_APPLICATION,
+              app_mod.FAIL_APPLICATION, app_mod.KILL_APPLICATION,
+              app_mod.KILLED_APPLICATION]
+
+# the full expected matrix, written out (reference application_state.go:364-470)
+APP_EXPECTED = {
+    (app_mod.NEW, app_mod.SUBMIT_APPLICATION): app_mod.SUBMITTED,
+    (app_mod.SUBMITTED, app_mod.ACCEPT_APPLICATION): app_mod.ACCEPTED,
+    (app_mod.SUBMITTED, app_mod.REJECT_APPLICATION): app_mod.REJECTED,
+    (app_mod.SUBMITTED, app_mod.FAIL_APPLICATION): app_mod.FAILING,
+    (app_mod.ACCEPTED, app_mod.TRY_RESERVE): app_mod.RESERVING,
+    (app_mod.ACCEPTED, app_mod.RUN_APPLICATION): app_mod.RUNNING,
+    (app_mod.ACCEPTED, app_mod.RELEASE_APP_ALLOCATION): app_mod.RUNNING,
+    (app_mod.ACCEPTED, app_mod.FAIL_APPLICATION): app_mod.FAILING,
+    (app_mod.ACCEPTED, app_mod.KILL_APPLICATION): app_mod.KILLING,
+    (app_mod.RESERVING, app_mod.UPDATE_RESERVATION): app_mod.RESERVING,
+    (app_mod.RESERVING, app_mod.RESUMING_APPLICATION): app_mod.RESUMING,
+    (app_mod.RESERVING, app_mod.RUN_APPLICATION): app_mod.RUNNING,
+    (app_mod.RESERVING, app_mod.RELEASE_APP_ALLOCATION): app_mod.RUNNING,
+    (app_mod.RESERVING, app_mod.FAIL_APPLICATION): app_mod.FAILING,
+    (app_mod.RESERVING, app_mod.KILL_APPLICATION): app_mod.KILLING,
+    (app_mod.RESUMING, app_mod.APP_TASK_COMPLETED): app_mod.RESUMING,
+    (app_mod.RESUMING, app_mod.RUN_APPLICATION): app_mod.RUNNING,
+    (app_mod.RESUMING, app_mod.RELEASE_APP_ALLOCATION): app_mod.RESUMING,
+    (app_mod.RUNNING, app_mod.RUN_APPLICATION): app_mod.RUNNING,
+    (app_mod.RUNNING, app_mod.RELEASE_APP_ALLOCATION): app_mod.RUNNING,
+    (app_mod.RUNNING, app_mod.COMPLETE_APPLICATION): app_mod.COMPLETED,
+    (app_mod.RUNNING, app_mod.FAIL_APPLICATION): app_mod.FAILING,
+    (app_mod.RUNNING, app_mod.KILL_APPLICATION): app_mod.KILLING,
+    (app_mod.FAILING, app_mod.RELEASE_APP_ALLOCATION): app_mod.FAILING,
+    (app_mod.FAILING, app_mod.FAIL_APPLICATION): app_mod.FAILED,
+    (app_mod.REJECTED, app_mod.FAIL_APPLICATION): app_mod.FAILED,
+    (app_mod.KILLING, app_mod.KILLED_APPLICATION): app_mod.KILLED,
+}
+
+
+@pytest.mark.parametrize("state", APP_STATES)
+@pytest.mark.parametrize("event", APP_EVENTS)
+def test_application_fsm_matrix(state, event):
+    fsm = FSM(state, app_mod._TRANSITIONS, {})
+    expected = APP_EXPECTED.get((state, event))
+    if expected is None:
+        with pytest.raises(InvalidEventError):
+            fsm.event(event)
+        assert fsm.current == state  # unchanged on rejection
+    else:
+        fsm.event(event)
+        assert fsm.current == expected
+
+
+def test_application_matrix_is_exhaustive():
+    """The explicit matrix covers the live table exactly — a new or removed
+    transition must be acknowledged here."""
+    assert allowed_map(app_mod._TRANSITIONS) == APP_EXPECTED
+
+
+TASK_STATES = list(task_mod.ANY)
+TASK_EVENTS = [task_mod.INIT_TASK, task_mod.SUBMIT_TASK, task_mod.TASK_ALLOCATED,
+               task_mod.TASK_BOUND, task_mod.COMPLETE_TASK, task_mod.KILL_TASK,
+               task_mod.TASK_KILLED, task_mod.TASK_REJECTED, task_mod.TASK_FAIL]
+
+TASK_EXPECTED = {}
+for s in task_mod.ANY:
+    TASK_EXPECTED[(s, task_mod.COMPLETE_TASK)] = task_mod.COMPLETED
+TASK_EXPECTED.update({
+    (task_mod.NEW, task_mod.INIT_TASK): task_mod.PENDING,
+    (task_mod.NEW, task_mod.TASK_REJECTED): task_mod.REJECTED,
+    (task_mod.NEW, task_mod.TASK_FAIL): task_mod.FAILED,
+    (task_mod.PENDING, task_mod.SUBMIT_TASK): task_mod.SCHEDULING,
+    (task_mod.PENDING, task_mod.KILL_TASK): task_mod.KILLING,
+    (task_mod.PENDING, task_mod.TASK_REJECTED): task_mod.REJECTED,
+    (task_mod.PENDING, task_mod.TASK_FAIL): task_mod.FAILED,
+    (task_mod.SCHEDULING, task_mod.TASK_ALLOCATED): task_mod.ALLOCATED,
+    (task_mod.SCHEDULING, task_mod.KILL_TASK): task_mod.KILLING,
+    (task_mod.SCHEDULING, task_mod.TASK_REJECTED): task_mod.REJECTED,
+    (task_mod.SCHEDULING, task_mod.TASK_FAIL): task_mod.FAILED,
+    (task_mod.ALLOCATED, task_mod.TASK_BOUND): task_mod.BOUND,
+    (task_mod.ALLOCATED, task_mod.KILL_TASK): task_mod.KILLING,
+    (task_mod.ALLOCATED, task_mod.TASK_FAIL): task_mod.FAILED,
+    (task_mod.BOUND, task_mod.KILL_TASK): task_mod.KILLING,
+    (task_mod.KILLING, task_mod.TASK_KILLED): task_mod.KILLED,
+    (task_mod.REJECTED, task_mod.TASK_FAIL): task_mod.FAILED,
+    (task_mod.COMPLETED, task_mod.TASK_ALLOCATED): task_mod.COMPLETED,
+})
+
+
+@pytest.mark.parametrize("state", TASK_STATES)
+@pytest.mark.parametrize("event", TASK_EVENTS)
+def test_task_fsm_matrix(state, event):
+    fsm = FSM(state, task_mod._TRANSITIONS, {})
+    expected = TASK_EXPECTED.get((state, event))
+    if expected is None:
+        with pytest.raises(InvalidEventError):
+            fsm.event(event)
+        assert fsm.current == state
+    else:
+        fsm.event(event)
+        assert fsm.current == expected
+
+
+def test_task_matrix_is_exhaustive():
+    assert allowed_map(task_mod._TRANSITIONS) == TASK_EXPECTED

@@ -419,3 +419,89 @@ def test_preferred_term_overflow_host_scored():
     assert batch.g_host_soft is not None
     res = solve_batch(batch, enc.nodes, policy="spread")
     assert names_of(enc, res, batch)[p.uid] == "gold"
+
+
+# ---------------------------------------------------------------------------
+# Round-2: vocab growth / repad paths (reference has fixed Go types; the
+# tensor encoding must stay exact across label/taint word-boundary growth)
+# ---------------------------------------------------------------------------
+
+def test_label_vocab_growth_past_word_boundary():
+    """Start with a tiny label vocab, then add nodes/pods referencing >32
+    distinct label values (crosses the uint32 word boundary): selectors must
+    still match exactly after the repad."""
+    cache, enc = make_env([make_node("seed", labels={"zone": "a"})])
+    p0 = make_pod("p0", cpu_milli=100, memory=2**20, node_selector={"zone": "a"})
+    batch = enc.build_batch([ask_for(p0)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[p0.uid] == "seed"
+    words_before = enc.vocabs.labels.num_words
+    # grow: 130 new nodes each with a distinct label value — enough bits to
+    # outgrow the initial padded word width and force a node-array repad
+    for i in range(130):
+        cache.update_node(make_node(f"g{i}", labels={"shard": f"s{i}"}))
+    enc.sync_nodes()
+    assert enc.vocabs.labels.num_words > words_before  # repad actually happened
+    # selector for a value interned AFTER the boundary crossing
+    p1 = make_pod("p1", cpu_milli=100, memory=2**20,
+                  node_selector={"shard": "s127"})
+    p2 = make_pod("p2", cpu_milli=100, memory=2**20, node_selector={"zone": "a"})
+    batch = enc.build_batch([ask_for(p1), ask_for(p2)])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert got[p1.uid] == "g127"
+    assert got[p2.uid] == "seed"  # pre-growth bit still matches post-repad
+
+
+def test_taint_vocab_growth_invalidates_cached_groups():
+    """A cached group spec with an Exists toleration must re-encode when the
+    taint vocab grows, or it would not tolerate the new taint."""
+    cache, enc = make_env([
+        make_node("t0", taints=[Taint("a", "1", "NoSchedule")]),
+    ])
+    tol_all = make_pod("tol0", cpu_milli=100, memory=2**20)
+    tol_all.spec.tolerations = [Toleration(operator="Exists")]
+    batch = enc.build_batch([ask_for(tol_all)])
+    res = solve_batch(batch, enc.nodes)
+    assert names_of(enc, res, batch)[tol_all.uid] == "t0"
+    # new node with a brand-new taint key (vocab grows); same group signature
+    cache.update_node(make_node("t1", cpu_milli=32000,
+                                taints=[Taint("brand-new", "x", "NoSchedule")]))
+    enc.sync_nodes()
+    tol_b = make_pod("tol1", cpu_milli=100, memory=2**20)
+    tol_b.spec.tolerations = [Toleration(operator="Exists")]
+    # fill t0 COMPLETELY so only t1 can host tol_b: the cached Exists spec
+    # must have re-encoded to tolerate the NEW taint or tol_b goes unplaced
+    filler = make_pod("filler", cpu_milli=16000, memory=2**20)
+    filler.spec.tolerations = [Toleration(operator="Exists")]
+    batch = enc.build_batch([ask_for(filler), ask_for(tol_b)])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert got[filler.uid] == "t0"
+    assert got[tol_b.uid] == "t1"
+
+
+def test_resource_vocab_growth_restarts_batch():
+    """A pod asking for resource names never seen before (extended resources)
+    grows the resource vocab past the padded row width mid-encode; build_batch
+    must restart wider and still solve correctly."""
+    cache, enc = make_env([make_node("plain", cpu_milli=8000)])
+    r_before = enc.vocabs.resources.num_slots
+    # more NEW resource names than free padded slots → quantize_request grows
+    # the vocab past R and the `row.shape[0] > R` restart path fires
+    extras = {f"example.com/dev{i}": 1 for i in range(r_before + 1)}
+    gpu_node = make_node("gpu-node", cpu_milli=8000, extra_resources=dict(extras))
+    cache.update_node(gpu_node)
+    # deliberately NOT syncing first: the ask interns the new names mid-encode
+    p = make_pod("wants", cpu_milli=100, memory=2**20,
+                 extra_resources=dict(extras))
+    plain_pod = make_pod("plain-pod", cpu_milli=100, memory=2**20)
+    batch = enc.build_batch([ask_for(p), ask_for(plain_pod)])
+    assert enc.vocabs.resources.num_slots > r_before  # grew past the old pad
+    assert batch.req.shape[1] == enc.vocabs.resources.num_slots
+    enc.sync_nodes()
+    batch = enc.build_batch([ask_for(p), ask_for(plain_pod)])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    assert got[p.uid] == "gpu-node"
+    assert got[plain_pod.uid] is not None
