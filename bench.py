@@ -49,17 +49,30 @@ def _init_backend_or_die() -> str:
     try:
         import jax
         devs = jax.devices()
-    except Exception as e:  # backend unavailable: one diagnostic JSON line
-        done.set()
-        print(json.dumps({
-            "metric": "backend-unavailable",
-            "value": 0.0,
-            "unit": "pods/s",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:400],
-            "init_secs": round(time.time() - t0, 1),
-        }))
-        sys.exit(1)
+    except Exception as e:
+        # TPU relay unavailable: record the diagnosis on stderr and fall back
+        # to the CPU backend so the round still publishes a measured number —
+        # the metric string carries the platform, so a cpu result can never
+        # masquerade as the TPU north star. The heartbeat keeps running: the
+        # fallback init can itself block while the axon plugin drains.
+        print(f"# bench: TPU backend unavailable after "
+              f"{time.time() - t0:.1f}s ({type(e).__name__}: {e}); "
+              f"falling back to CPU", file=sys.stderr, flush=True)
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            devs = jax.devices("cpu")
+        except Exception as e2:  # no backend at all: one diagnostic JSON line
+            done.set()
+            print(json.dumps({
+                "metric": "backend-unavailable",
+                "value": 0.0,
+                "unit": "pods/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e2).__name__}: {e2}"[:400],
+                "init_secs": round(time.time() - t0, 1),
+            }))
+            sys.exit(1)
     done.set()
     platform = devs[0].platform
     print(f"# bench: backend up in {time.time() - t0:.1f}s: "
