@@ -28,15 +28,16 @@ def test_pallas_matches_xla_reference(seed):
     req, gid, feas, free, cap = random_problem(rng)
     scores = node_base_scores(jnp.asarray(free), jnp.asarray(cap), "binpacking")
 
+    soft = (rng.random((feas.shape[0], free.shape[0])).astype(np.float32) - 0.5)
     best_p, feas_p = pallas_best_nodes(
         jnp.asarray(req), jnp.asarray(gid), jnp.asarray(feas),
-        jnp.asarray(free), scores, interpret=True)
+        jnp.asarray(soft), jnp.asarray(free), scores, interpret=True)
 
     # dense reference
     fit = (free[None, :, :] >= req[:, None, :]).all(-1)          # [N, M]
     ok = fit & np.asarray(feas)[gid]
-    q = np.round(np.asarray(scores) * SCORE_SCALE)
-    masked = np.where(ok, q[None, :], -np.inf)
+    q = np.round((np.asarray(scores)[None, :] + soft[gid]) * SCORE_SCALE)
+    masked = np.where(ok, q, -np.inf)
     ref_feasible = ok.any(1)
     ref_best = masked.argmax(1)
 
@@ -58,9 +59,10 @@ def test_pallas_all_infeasible():
     req, gid, feas, free, cap = random_problem(rng)
     feas[:] = False
     scores = node_base_scores(jnp.asarray(free), jnp.asarray(cap), "binpacking")
+    soft = np.zeros((feas.shape[0], free.shape[0]), np.float32)
     best, feasible = pallas_best_nodes(
         jnp.asarray(req), jnp.asarray(gid), jnp.asarray(feas),
-        jnp.asarray(free), scores, interpret=True)
+        jnp.asarray(soft), jnp.asarray(free), scores, interpret=True)
     assert not np.asarray(feasible).any()
 
 
@@ -86,3 +88,52 @@ def test_solve_with_pallas_path():
     a2 = np.asarray(pal.assigned)[: batch.num_pods]
     assert (a1 >= 0).all() and (a2 >= 0).all()
     assert (np.asarray(pal.free_after) >= 0).all()
+
+
+def test_solve_with_pallas_and_soft_terms():
+    """Round-2: soft taints + preferred affinity no longer disable the fused
+    kernel — the combined group_soft matrix rides into it."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import (NodeSelectorRequirement,
+                                             NodeSelectorTerm, Affinity,
+                                             Taint, make_node, make_pod)
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for i in range(8):
+        taints = [Taint("noisy", "1", "PreferNoSchedule")] if i < 4 else []
+        cache.update_node(make_node(f"n{i}", cpu_milli=4000,
+                                    labels={"tier": "gold" if i >= 6 else "std"},
+                                    taints=taints))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = []
+    for i in range(16):
+        p = make_pod(f"p{i}", cpu_milli=500, memory=2**20)
+        p.spec.affinity = Affinity(node_preferred_terms=[
+            (100, NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("tier", "In", ["gold"])]))])
+        pods.append(p)
+    asks = [AllocationAsk(p.uid, "a", get_pod_resource(p), pod=p) for p in pods]
+    batch = enc.build_batch(asks)
+    assert batch.g_pref_weight.any()  # soft terms present
+    ref = solve_batch(batch, enc.nodes, chunk=64, policy="spread")
+    pal = solve_batch(batch, enc.nodes, chunk=64, policy="spread",
+                      use_pallas=True, pallas_interpret=True)
+    a1 = np.asarray(ref.assigned)[: batch.num_pods]
+    a2 = np.asarray(pal.assigned)[: batch.num_pods]
+    assert (a1 >= 0).all() and (a2 >= 0).all()
+    assert (np.asarray(pal.free_after) >= 0).all()
+
+    def gold_share(assigned):
+        # nodes n6/n7 carry tier=gold; the 100-weight preference must pull
+        # pods there until full (16 pods × 500m over 2 × 4000m = exactly all)
+        return sum(1 for idx in assigned if enc.nodes.name_of(int(idx)) in ("n6", "n7"))
+
+    # BOTH paths must honor the soft preference — if the kernel dropped
+    # group_soft, its gold share would collapse to the spread baseline
+    assert gold_share(a1) == 16
+    assert gold_share(a2) == 16

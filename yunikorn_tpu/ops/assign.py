@@ -489,8 +489,8 @@ def solve(
                 from yunikorn_tpu.ops.pallas_kernels import pallas_best_nodes
 
                 best, feasible = pallas_best_nodes(
-                    req, group_id, group_feas, cur_free, base_scores,
-                    interpret=pallas_interpret)
+                    req, group_id, group_feas, group_soft, cur_free,
+                    base_scores, interpret=pallas_interpret)
             else:
                 best, feasible = _best_nodes_chunked(
                     req, group_id, group_feas, group_soft, cur_free, capacity,
@@ -621,12 +621,11 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         max_rounds=max_rounds,
         chunk=chunk,
         policy=policy,
-        # the fused kernel scores from the base vector only; soft taints and
-        # preferred-affinity bonuses need the per-group adjustment, so fall
-        # back to the XLA path when either is present
-        use_pallas=(use_pallas and not na.has_soft_taints()
-                    and not batch.g_pref_weight.any()
-                    and getattr(batch, "g_host_soft", None) is None),
+        # the fused kernel takes the combined [G, M] soft adjustment (soft
+        # taints + preferred affinity + host-scored terms); only dynamic
+        # locality and the align policy fall back to the XLA path (handled
+        # inside solve)
+        use_pallas=use_pallas,
         pallas_interpret=pallas_interpret,
         has_loc_soft=(batch.locality is not None
                       and bool(np.any(batch.locality.g_weight))),

@@ -11,14 +11,18 @@ and the argmax. This kernel keeps everything in VMEM:
     fit[P, Mt]   = AND_r (free[n][:, r] >= req[p][:, r])     (VPU, unrolled R)
     feas[P, Mt]  = onehot(gid[p]) @ group_feas[:, n-tile]    (MXU — the gather
                    of a pod's feasibility row becomes a [P, G] x [G, Mt] matmul)
-    score[P, Mt] = base_scores[n-tile] masked by fit & feas
+    soft[P, Mt]  = onehot(gid[p]) @ group_soft[:, n-tile]    (MXU, HIGHEST
+                   precision — soft taints / preferred affinity / host terms)
+    score[P, Mt] = base_scores[n-tile] + soft, masked by fit & feas
     running packed max accumulates in VMEM scratch across node tiles and is
     written out on the last node tile.
 
 Selection and identification share one int32 max: scores are quantized to
-1/128 steps (9 bits of range) and packed as  q * 2^21 + (M - column), so the
-maximum picks the best score and, on ties, the LOWEST node index — exactly
-jnp.argmax semantics — with all arithmetic exact in int32.
+1/128 steps and packed as  q * index_span + (M - column)  with
+index_span = next_pow2(M) (min 2^10), so the maximum picks the best score
+and, on ties, the LOWEST node index — exactly jnp.argmax semantics — with
+all arithmetic exact in int32. The signed score range is
+±2^30/index_span/128 (e.g. ±256.0 at 32k nodes).
 
 Exposed through ops.assign.solve(..., use_pallas=True); the default stays the
 XLA path (property-tested identical). interpret=True runs the kernel on CPU.
@@ -35,12 +39,18 @@ from jax.experimental.pallas import tpu as pltpu
 POD_TILE = 256
 NODE_TILE = 512
 SCORE_SCALE = 128.0          # score quantization step = 1/128
-INDEX_SPAN = 1 << 21         # room for node indices below the score bits
 PACKED_MIN = -(1 << 30)  # plain int: jnp constants cannot be captured by kernels
 
 
-def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, free_ref, scores_ref,
-                      out_ref, acc_ref):
+def _index_span(m: int) -> int:
+    """Room for node indices below the score bits: smallest power of two
+    > m (min 2^10). Smaller spans leave more signed-score range: span 2^15
+    (32k nodes) still allows |score| < 2^15/SCORE_SCALE = 256.0 exactly."""
+    return 1 << max(10, m.bit_length())
+
+
+def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, soft_ref, free_ref,
+                      scores_ref, out_ref, acc_ref, *, index_span: int):
     """One (pod_tile, node_tile) step; node dimension is grid axis 1."""
     n_idx = pl.program_id(1)
     n_tiles = pl.num_programs(1)
@@ -60,12 +70,25 @@ def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, free_ref, scores_ref,
         onehot, feas, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) > 0.5          # [P, Mt]
 
+    # per-(pod, node) score: node base + the pod's group soft adjustment
+    # (PreferNoSchedule taints, preferred affinity, host-scored terms) —
+    # the gather of a pod's soft row is the same onehot matmul (MXU)
+    soft = soft_ref[:]                    # [G, Mt] f32
+    # HIGHEST precision: default MXU bf16 truncation of soft values could
+    # round (base+soft)*SCORE_SCALE across a .5 boundary and diverge from
+    # the XLA path (the feas matmul tolerates bf16 via its 0.5 threshold)
+    soft_rows = jax.lax.dot_general(
+        onehot, soft, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)               # [P, Mt]
+
     ok = fit & feas_rows
-    q = scores_ref[:]                     # [Mt] int32 quantized scores
+    base_q = scores_ref[:]                # [Mt] f32 base scores
+    q = jnp.round((base_q[None, :] + soft_rows) * SCORE_SCALE).astype(jnp.int32)
     col = jax.lax.broadcasted_iota(jnp.int32, (P, Mt), 1)
     global_col = col + Mt * n_idx
     total_m = Mt * n_tiles
-    packed = q[None, :] * INDEX_SPAN + (total_m - global_col)
+    packed = q * index_span + (total_m - global_col)
     packed = jnp.where(ok, packed, jnp.int32(PACKED_MIN))
     tile_best = jnp.max(packed, axis=1)   # [P]
 
@@ -82,16 +105,19 @@ def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, free_ref, scores_ref,
         best = acc_ref[:]
         feasible = best > jnp.int32(PACKED_MIN)
         # recover M - column from the packed low bits (floor-div is exact:
-        # the remainder term (total_m - col) is always in [1, INDEX_SPAN))
-        frac = best - (best // INDEX_SPAN) * INDEX_SPAN
+        # the remainder term (total_m - col) is always in [1, index_span))
+        frac = best - (best // index_span) * index_span
         out_ref[:, 0] = jnp.where(feasible, frac, 0)
         out_ref[:, 1] = jnp.where(feasible, 1, 0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def pallas_best_nodes(req, group_id, group_feas, free, base_scores, interpret=False):
+def pallas_best_nodes(req, group_id, group_feas, group_soft, free, base_scores,
+                      interpret=False):
     """Fused best-node computation. Shapes: req [N,R] i32, group_id [N] i32,
-    group_feas [G,M] bool, free [M,R] i32, base_scores [M] f32.
+    group_feas [G,M] bool, group_soft [G,M] f32 (per-group score adjustment:
+    soft taints + preferred affinity + host-scored terms), free [M,R] i32,
+    base_scores [M] f32.
 
     Returns (best [N] int32, feasible [N] bool). N and M are power-of-two
     padded upstream, so the tile divisibility requirements hold.
@@ -101,18 +127,20 @@ def pallas_best_nodes(req, group_id, group_feas, free, base_scores, interpret=Fa
     pt = min(POD_TILE, N)
     nt = min(NODE_TILE, M)
     assert N % pt == 0 and M % nt == 0
+    span = _index_span(M)
 
     onehot = jax.nn.one_hot(group_id, G, dtype=jnp.float32)            # [N, G]
-    q_scores = jnp.round(base_scores * SCORE_SCALE).astype(jnp.int32)  # [M]
     feas_f = group_feas.astype(jnp.float32)
+    soft_f = group_soft.astype(jnp.float32)
 
     out = pl.pallas_call(
-        _best_node_kernel,
+        functools.partial(_best_node_kernel, index_span=span),
         grid=(N // pt, M // nt),
         in_specs=[
             pl.BlockSpec((pt, R), lambda p, n: (p, 0)),                # req
             pl.BlockSpec((pt, G), lambda p, n: (p, 0)),                # onehot
             pl.BlockSpec((G, nt), lambda p, n: (0, n)),                # feas
+            pl.BlockSpec((G, nt), lambda p, n: (0, n)),                # soft
             pl.BlockSpec((nt, R), lambda p, n: (n, 0)),                # free
             pl.BlockSpec((nt,), lambda p, n: (n,)),                    # scores
         ],
@@ -120,7 +148,7 @@ def pallas_best_nodes(req, group_id, group_feas, free, base_scores, interpret=Fa
         out_shape=jax.ShapeDtypeStruct((N, 2), jnp.int32),
         scratch_shapes=[pltpu.VMEM((pt,), jnp.int32)],
         interpret=interpret,
-    )(req, onehot, feas_f, free, q_scores)
+    )(req, onehot, feas_f, soft_f, free, base_scores.astype(jnp.float32))
 
     feasible = out[:, 1] > 0
     best = jnp.where(feasible, M - out[:, 0], 0).astype(jnp.int32)

@@ -381,9 +381,6 @@ class NodeArrays:
     def num_nodes(self) -> int:
         return len(self._name_to_idx)
 
-    def has_soft_taints(self) -> bool:
-        return bool(self._soft_taint_rows)
-
 
 class SnapshotEncoder:
     """Maintains NodeArrays against a SchedulerCache + encodes pod batches."""
