@@ -137,3 +137,21 @@ def test_solve_with_pallas_and_soft_terms():
     # group_soft, its gold share would collapse to the spread baseline
     assert gold_share(a1) == 16
     assert gold_share(a2) == 16
+
+
+@pytest.mark.parametrize("seed", [7])
+def test_pallas_no_soft_variant_matches(seed):
+    """has_soft=False (no soft DMA/matmul) must equal the soft variant with a
+    zero matrix."""
+    rng = np.random.default_rng(seed)
+    req, gid, feas, free, cap = random_problem(rng)
+    scores = node_base_scores(jnp.asarray(free), jnp.asarray(cap), "binpacking")
+    zeros = np.zeros((feas.shape[0], free.shape[0]), np.float32)
+    b1, f1 = pallas_best_nodes(jnp.asarray(req), jnp.asarray(gid), jnp.asarray(feas),
+                               jnp.asarray(zeros), jnp.asarray(free), scores,
+                               interpret=True, has_soft=True)
+    b2, f2 = pallas_best_nodes(jnp.asarray(req), jnp.asarray(gid), jnp.asarray(feas),
+                               jnp.asarray(zeros), jnp.asarray(free), scores,
+                               interpret=True, has_soft=False)
+    np.testing.assert_array_equal(np.asarray(f1), np.asarray(f2))
+    np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))

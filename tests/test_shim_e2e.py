@@ -446,3 +446,28 @@ def test_core_events_published_on_pods(sched):
             break
         time.sleep(0.05)
     assert evs and "node-1" in evs[0].message
+
+
+def test_bind_pool_bounds_thread_count(sched):
+    """Round-2: binds ride a bounded worker pool, not a thread per task
+    (50k tasks would otherwise spike 50k OS threads)."""
+    import threading
+
+    sched.add_nodes([make_node(f"node-{i}", cpu_milli=64000) for i in range(4)])
+    before = threading.active_count()
+    pods = [sched.add_pod(yk_pod(f"bp-{i}", cpu=100)) for i in range(200)]
+    peak = before
+    deadline = time.time() + 30
+    app = None
+    while time.time() < deadline:
+        peak = max(peak, threading.active_count())
+        app = sched.context.get_application("app-1")
+        if app is not None and all(
+                (t := app.get_task(p.uid)) is not None and t.state == task_mod.BOUND
+                for p in pods):
+            break
+        time.sleep(0.05)
+    assert app is not None
+    assert all(app.get_task(p.uid).state == task_mod.BOUND for p in pods)
+    # 32 pool workers + harness threads; far below 200
+    assert peak - before <= 40, f"thread spike: {peak - before}"

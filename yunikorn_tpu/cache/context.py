@@ -123,6 +123,13 @@ class Context:
         self._foreign_sent: Dict[str, tuple] = {}
         self._lock = locking.RMutex()
         self._initialized = False
+        # bounded bind workers: the reference spawns a goroutine per bind
+        # (task.go:348-394, cheap in Go); a Python thread per task would spike
+        # to tens of thousands at the 50k bucket. Daemon workers: a bind hung
+        # on an unresponsive API server must not block interpreter exit.
+        from yunikorn_tpu.utils.workers import DaemonPool
+
+        self.bind_pool = DaemonPool(max_workers=32, name="bind")
 
     # convenience alias matching the reference naming
     @property

@@ -189,8 +189,16 @@ class Task:
                 except Exception:
                     pass
 
-        t = threading.Thread(target=bind, name=f"bind-{self.task_id}", daemon=True)
-        t.start()
+        pool = getattr(self.context, "bind_pool", None)
+        if pool is None:  # minimal contexts in tests
+            threading.Thread(target=bind, name=f"bind-{self.task_id}",
+                             daemon=True).start()
+        elif not pool.submit(bind):
+            # pool already shut down (shim stopping): run the failure path so
+            # the allocation is not leaked as forever-ALLOCATED
+            logger.warning("bind pool shut down; failing task %s", self.alias)
+            self.release_allocation(TerminationType.STOPPED_BY_RM,
+                                    "shim stopping before bind")
 
     def _post_bound(self) -> None:
         if self.placeholder:

@@ -374,7 +374,7 @@ def _segment_prefix_accept(snode, sreq, free_ext, M):
 @functools.partial(
     jax.jit,
     static_argnames=("max_rounds", "chunk", "policy", "use_pallas",
-                     "pallas_interpret", "has_loc_soft"),
+                     "pallas_interpret", "has_loc_soft", "pallas_has_soft"),
 )
 def solve(
     req,            # [N, R] int32
@@ -399,6 +399,7 @@ def solve(
     use_pallas: bool = False,
     pallas_interpret: bool = False,
     has_loc_soft: bool = True,
+    pallas_has_soft: bool = True,
 ):
     """One batched solve. Returns (assigned [N] int32, free_after, rounds).
 
@@ -490,7 +491,8 @@ def solve(
 
                 best, feasible = pallas_best_nodes(
                     req, group_id, group_feas, group_soft, cur_free,
-                    base_scores, interpret=pallas_interpret)
+                    base_scores, interpret=pallas_interpret,
+                    has_soft=pallas_has_soft)
             else:
                 best, feasible = _best_nodes_chunked(
                     req, group_id, group_feas, group_soft, cur_free, capacity,
@@ -629,5 +631,9 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         pallas_interpret=pallas_interpret,
         has_loc_soft=(batch.locality is not None
                       and bool(np.any(batch.locality.g_weight))),
+        # no-soft batches take the kernel variant without the soft DMA/matmul
+        pallas_has_soft=(bool(batch.g_pref_weight.any())
+                         or host_soft is not None
+                         or bool(np.any(na.taints_soft))),
     )
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -19,10 +19,10 @@ and the argmax. This kernel keeps everything in VMEM:
 
 Selection and identification share one int32 max: scores are quantized to
 1/128 steps and packed as  q * index_span + (M - column)  with
-index_span = next_pow2(M) (min 2^10), so the maximum picks the best score
-and, on ties, the LOWEST node index — exactly jnp.argmax semantics — with
-all arithmetic exact in int32. The signed score range is
-±2^30/index_span/128 (e.g. ±256.0 at 32k nodes).
+index_span = smallest power of two > M (min 2^10), so the maximum picks the
+best score and, on ties, the LOWEST node index — exactly jnp.argmax
+semantics — with all arithmetic exact in int32. The signed score range is
+±2^30/index_span/128 (e.g. span 2^16 at 16k<M≤32k nodes → |score| < 128.0).
 
 Exposed through ops.assign.solve(..., use_pallas=True); the default stays the
 XLA path (property-tested identical). interpret=True runs the kernel on CPU.
@@ -44,14 +44,24 @@ PACKED_MIN = -(1 << 30)  # plain int: jnp constants cannot be captured by kernel
 
 def _index_span(m: int) -> int:
     """Room for node indices below the score bits: smallest power of two
-    > m (min 2^10). Smaller spans leave more signed-score range: span 2^15
-    (32k nodes) still allows |score| < 2^15/SCORE_SCALE = 256.0 exactly."""
+    STRICTLY greater than m (the packed remainder reaches m), min 2^10.
+    Signed score range is ±2^30/span/SCORE_SCALE: e.g. span 2^16 at
+    16k<M≤32k nodes still allows |score| < 128.0 exactly."""
     return 1 << max(10, m.bit_length())
 
 
-def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, soft_ref, free_ref,
-                      scores_ref, out_ref, acc_ref, *, index_span: int):
-    """One (pod_tile, node_tile) step; node dimension is grid axis 1."""
+def _best_node_kernel(*refs, index_span: int, use_soft: bool):
+    """One (pod_tile, node_tile) step; node dimension is grid axis 1.
+
+    The soft input (and its DMA) exists only in the use_soft variant — the
+    common no-soft-terms batch pays neither the transfer nor the matmul."""
+    if use_soft:
+        (req_ref, gid_onehot_ref, feas_ref, soft_ref, free_ref,
+         scores_ref, out_ref, acc_ref) = refs
+    else:
+        (req_ref, gid_onehot_ref, feas_ref, free_ref,
+         scores_ref, out_ref, acc_ref) = refs
+        soft_ref = None
     n_idx = pl.program_id(1)
     n_tiles = pl.num_programs(1)
 
@@ -70,21 +80,24 @@ def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, soft_ref, free_ref,
         onehot, feas, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32) > 0.5          # [P, Mt]
 
-    # per-(pod, node) score: node base + the pod's group soft adjustment
-    # (PreferNoSchedule taints, preferred affinity, host-scored terms) —
-    # the gather of a pod's soft row is the same onehot matmul (MXU)
-    soft = soft_ref[:]                    # [G, Mt] f32
-    # HIGHEST precision: default MXU bf16 truncation of soft values could
-    # round (base+soft)*SCORE_SCALE across a .5 boundary and diverge from
-    # the XLA path (the feas matmul tolerates bf16 via its 0.5 threshold)
-    soft_rows = jax.lax.dot_general(
-        onehot, soft, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST)               # [P, Mt]
-
     ok = fit & feas_rows
     base_q = scores_ref[:]                # [Mt] f32 base scores
-    q = jnp.round((base_q[None, :] + soft_rows) * SCORE_SCALE).astype(jnp.int32)
+    if use_soft:
+        # per-(pod, node) score: node base + the pod's group soft adjustment
+        # (PreferNoSchedule taints, preferred affinity, host-scored terms) —
+        # the gather of a pod's soft row is the same onehot matmul (MXU).
+        # HIGHEST precision: default MXU bf16 truncation of soft values could
+        # round (base+soft)*SCORE_SCALE across a .5 boundary and diverge from
+        # the XLA path (the feas matmul tolerates bf16 via its 0.5 threshold).
+        soft = soft_ref[:]                # [G, Mt] f32
+        soft_rows = jax.lax.dot_general(
+            onehot, soft, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)           # [P, Mt]
+        q = jnp.round((base_q[None, :] + soft_rows) * SCORE_SCALE).astype(jnp.int32)
+    else:
+        q = jnp.broadcast_to(
+            jnp.round(base_q * SCORE_SCALE).astype(jnp.int32)[None, :], (P, Mt))
     col = jax.lax.broadcasted_iota(jnp.int32, (P, Mt), 1)
     global_col = col + Mt * n_idx
     total_m = Mt * n_tiles
@@ -111,13 +124,14 @@ def _best_node_kernel(req_ref, gid_onehot_ref, feas_ref, soft_ref, free_ref,
         out_ref[:, 1] = jnp.where(feasible, 1, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "has_soft"))
 def pallas_best_nodes(req, group_id, group_feas, group_soft, free, base_scores,
-                      interpret=False):
+                      interpret=False, has_soft=True):
     """Fused best-node computation. Shapes: req [N,R] i32, group_id [N] i32,
     group_feas [G,M] bool, group_soft [G,M] f32 (per-group score adjustment:
     soft taints + preferred affinity + host-scored terms), free [M,R] i32,
-    base_scores [M] f32.
+    base_scores [M] f32. has_soft=False (static) selects the variant without
+    the soft input — no extra DMA or matmul for the common case.
 
     Returns (best [N] int32, feasible [N] bool). N and M are power-of-two
     padded upstream, so the tile divisibility requirements hold.
@@ -131,24 +145,31 @@ def pallas_best_nodes(req, group_id, group_feas, group_soft, free, base_scores,
 
     onehot = jax.nn.one_hot(group_id, G, dtype=jnp.float32)            # [N, G]
     feas_f = group_feas.astype(jnp.float32)
-    soft_f = group_soft.astype(jnp.float32)
+
+    in_specs = [
+        pl.BlockSpec((pt, R), lambda p, n: (p, 0)),                    # req
+        pl.BlockSpec((pt, G), lambda p, n: (p, 0)),                    # onehot
+        pl.BlockSpec((G, nt), lambda p, n: (0, n)),                    # feas
+    ]
+    args = [req, onehot, feas_f]
+    if has_soft:
+        in_specs.append(pl.BlockSpec((G, nt), lambda p, n: (0, n)))    # soft
+        args.append(group_soft.astype(jnp.float32))
+    in_specs += [
+        pl.BlockSpec((nt, R), lambda p, n: (n, 0)),                    # free
+        pl.BlockSpec((nt,), lambda p, n: (n,)),                        # scores
+    ]
+    args += [free, base_scores.astype(jnp.float32)]
 
     out = pl.pallas_call(
-        functools.partial(_best_node_kernel, index_span=span),
+        functools.partial(_best_node_kernel, index_span=span, use_soft=has_soft),
         grid=(N // pt, M // nt),
-        in_specs=[
-            pl.BlockSpec((pt, R), lambda p, n: (p, 0)),                # req
-            pl.BlockSpec((pt, G), lambda p, n: (p, 0)),                # onehot
-            pl.BlockSpec((G, nt), lambda p, n: (0, n)),                # feas
-            pl.BlockSpec((G, nt), lambda p, n: (0, n)),                # soft
-            pl.BlockSpec((nt, R), lambda p, n: (n, 0)),                # free
-            pl.BlockSpec((nt,), lambda p, n: (n,)),                    # scores
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((pt, 2), lambda p, n: (p, 0)),
         out_shape=jax.ShapeDtypeStruct((N, 2), jnp.int32),
         scratch_shapes=[pltpu.VMEM((pt,), jnp.int32)],
         interpret=interpret,
-    )(req, onehot, feas_f, soft_f, free, base_scores.astype(jnp.float32))
+    )(*args)
 
     feasible = out[:, 1] > 0
     best = jnp.where(feasible, M - out[:, 0], 0).astype(jnp.int32)

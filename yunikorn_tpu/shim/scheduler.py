@@ -111,6 +111,11 @@ class KubernetesShim:
             self._pump_thread = None
         self.context.placeholder_manager.stop()
         dispatch_mod.get_dispatcher().stop()
+        # after the dispatcher: draining TASK_ALLOCATED events may still
+        # submit binds; a closed pool routes them to the failure path
+        pool = getattr(self.context, "bind_pool", None)
+        if pool is not None:
+            pool.shutdown()
         self.api_provider.stop()
 
 

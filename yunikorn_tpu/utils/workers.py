@@ -1,0 +1,54 @@
+"""A bounded daemon-thread worker pool.
+
+concurrent.futures.ThreadPoolExecutor spawns NON-daemon workers and joins
+them at interpreter exit, so one hung task (e.g. a bind blocked on an
+unresponsive API server) would block process shutdown forever. This pool
+keeps the bounded-concurrency property with daemon workers and a plain
+drop-after-shutdown submit, which is the semantics the bind path wants:
+after shutdown the caller's failure handling is responsible, not the pool.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+from yunikorn_tpu.log.logger import log
+
+logger = log("shim.utils")
+
+
+class DaemonPool:
+    def __init__(self, max_workers: int = 32, name: str = "worker"):
+        self._queue: "queue.Queue[Optional[Callable[[], None]]]" = queue.Queue()
+        self._shutdown = threading.Event()
+        self._threads = []
+        for i in range(max_workers):
+            t = threading.Thread(target=self._run, name=f"{name}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _run(self) -> None:
+        while True:
+            fn = self._queue.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:
+                logger.exception("pool task failed")
+
+    def submit(self, fn: Callable[[], None]) -> bool:
+        """Enqueue fn; returns False (not an exception) after shutdown so
+        callers can run their own failure path."""
+        if self._shutdown.is_set():
+            return False
+        self._queue.put(fn)
+        return True
+
+    def shutdown(self) -> None:
+        """Stop accepting work and wake idle workers; running tasks are
+        daemon threads and never block interpreter exit."""
+        self._shutdown.set()
+        for _ in self._threads:
+            self._queue.put(None)
