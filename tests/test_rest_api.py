@@ -165,3 +165,90 @@ partitions:
         assert get("/ws/v1/partitions") == ["default"]
     finally:
         rest.stop()
+
+
+def test_step_timing_and_profile_endpoints():
+    """SURVEY §5 tracing analog: per-cycle stage timing in metrics + a JAX
+    profiler capture surface."""
+    import json
+    import urllib.request
+
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (AddApplicationRequest, AllocationAsk,
+                                        AllocationRequest, ApplicationRequest,
+                                        NodeAction, NodeInfo, NodeRequest,
+                                        RegisterResourceManagerRequest,
+                                        UserGroupInfo)
+    from yunikorn_tpu.core.scheduler import CoreScheduler
+    from yunikorn_tpu.webapp.rest import RestServer
+
+    class CB:
+        def update_allocation(self, r): pass
+        def update_application(self, r): pass
+        def update_node(self, r): pass
+        def predicates(self, a): return None
+        def preemption_predicates(self, a): return None
+        def send_event(self, e): pass
+        def update_container_scheduling_state(self, r): pass
+        def get_state_dump(self): return "{}"
+
+    cache = SchedulerCache()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="r", policy_group="q"), CB())
+    n = make_node("n0", cpu_milli=8000)
+    cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[NodeInfo(node_id="n0", action=NodeAction.CREATE)]))
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="ta", queue_name="root.q", user=UserGroupInfo(user="u"))]))
+    p = make_pod("p0", cpu_milli=500, memory=2**20)
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, "ta", get_pod_resource(p), pod=p)]))
+    assert core.schedule_once() == 1
+    lc = core.metrics["last_cycle"]["default"]
+    assert lc["pods"] == 1
+    assert lc["total_ms"] >= lc["solve_ms"] >= 0
+    for k in ("gate_ms", "encode_ms", "solve_ms", "commit_ms", "post_ms"):
+        assert lc[k] >= 0
+    assert lc["at"] > 0
+
+    rest = RestServer(core, port=0)
+    port = rest.start()
+    started = False
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/ws/v1/metrics") as r:
+            metrics = json.loads(r.read())
+        assert "last_cycle" in metrics
+        # arbitrary paths rejected; only a run NAME under the base dir
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ws/v1/profile/start?name=../../etc",
+            method="POST")
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ws/v1/profile/start?name=resttest",
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+            assert body["tracing"] is True
+            assert body["dir"].endswith("/resttest")
+        started = True
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/ws/v1/profile/stop", method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["tracing"] is False
+        started = False
+    finally:
+        if started:  # never leak a process-global trace into later tests
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        rest.stop()

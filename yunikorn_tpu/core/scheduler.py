@@ -127,8 +127,9 @@ class CoreScheduler(SchedulerAPI):
         self._dirty = False
         self._thread: Optional[threading.Thread] = None
         # metrics (Prometheus-counter analogs, reference perf test samples
-        # yunikorn_scheduler_container_allocation_attempt_total)
-        self.metrics: Dict[str, int] = {
+        # yunikorn_scheduler_container_allocation_attempt_total; last_cycle
+        # holds the most recent cycle's per-stage timing breakdown)
+        self.metrics: Dict[str, object] = {
             "allocation_attempt_allocated": 0,
             "allocation_attempt_failed": 0,
             "solve_count": 0,
@@ -554,6 +555,7 @@ class CoreScheduler(SchedulerAPI):
         new_allocs: List[Allocation] = []
         skipped_keys: List[Tuple[str, str]] = []
         unplaced_asks: List = []
+        t_gate = time.time()
         if admitted:
             # overlay BEFORE sync: an assume landing in between then counts
             # twice (once in the overlay, once in synced free) — strictly
@@ -563,6 +565,7 @@ class CoreScheduler(SchedulerAPI):
             # mask AFTER the sync: the encoder assigns node rows lazily
             node_mask = self._partition_node_mask() if restrict_nodes else None
             batch = self.encoder.build_batch(admitted, ranks=ranks)
+            t_encode = time.time()
             policy = (self._policy if self._policy_forced or
                       self.partition.name == "default"
                       else self._partition_policy.get(self.partition.name, self._policy))
@@ -570,7 +573,10 @@ class CoreScheduler(SchedulerAPI):
                                  free_delta=overlay, node_mask=node_mask)
             import numpy as np
 
+            # materializing the result is the device sync point: everything
+            # up to here was async dispatch
             assigned = np.asarray(result.assigned)[: batch.num_pods]
+            t_solve = time.time()
             # commit with batched queue accounting: one ancestor walk per
             # leaf, not per allocation (matters at 50k allocations/cycle)
             # plain dict-of-int accumulators: Resource.add per alloc
@@ -619,6 +625,7 @@ class CoreScheduler(SchedulerAPI):
         self.metrics["allocation_attempt_failed"] += len(skipped_keys)
         self.metrics["solve_count"] += 1
         self.metrics["solve_time_ms_total"] += int((time.time() - t0) * 1000)
+        t_commit = time.time()
 
         # preemption: try to make room for unplaced high-priority asks
         preempt_releases: List[AllocationRelease] = []
@@ -660,6 +667,24 @@ class CoreScheduler(SchedulerAPI):
         # the publish payload is delivered by schedule_once AFTER the core
         # lock is released (callbacks may re-enter the core from other
         # threads; publishing under the lock risks stalls and deadlocks)
+        # per-stage step timing (SURVEY §5's TPU-profiling analog: the
+        # reference relies on pprof + Prometheus; here the cycle's stage
+        # breakdown is the first thing a perf investigation needs). Keyed by
+        # partition, stamped, and covering preemption planning ("post_ms") —
+        # only cycles with admitted pods record one.
+        if admitted:
+            end = time.time()
+            cycles = self.metrics.setdefault("last_cycle", {})
+            cycles[self.partition.name] = {
+                "at": round(end, 3),
+                "pods": len(admitted),
+                "gate_ms": round((t_gate - t0) * 1000, 2),
+                "encode_ms": round((t_encode - t_gate) * 1000, 2),
+                "solve_ms": round((t_solve - t_encode) * 1000, 2),
+                "commit_ms": round((t_commit - t_solve) * 1000, 2),
+                "post_ms": round((end - t_commit) * 1000, 2),
+                "total_ms": round((end - t0) * 1000, 2),
+            }
         return len(new_allocs), (pinned, replaced, new_allocs,
                                  preempt_releases, skipped_keys)
 

@@ -136,11 +136,42 @@ class RestServer:
 
             def do_POST(self):
                 parsed = urlparse(self.path)
-                if parsed.path.rstrip("/") == "/ws/v1/validate-conf":
+                path = parsed.path.rstrip("/")
+                if path == "/ws/v1/validate-conf":
                     length = int(self.headers.get("Content-Length", "0"))
                     body = self.rfile.read(length).decode()
                     ok, message = core.validate_configuration(body)
                     self._reply(200, {"allowed": ok, "reason": message})
+                elif path == "/ws/v1/profile/start":
+                    # JAX profiler capture (SURVEY §5: the reference captures
+                    # pprof in its perf test; the TPU analog is a profiler
+                    # trace viewable in TensorBoard/XProf). ?name=<run> picks a
+                    # subdirectory under the configured base — never an
+                    # arbitrary client-chosen path.
+                    import os
+                    import re as _re
+
+                    import jax
+
+                    q = parse_qs(parsed.query)
+                    name = q.get("name", ["trace"])[0]
+                    if not _re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name):
+                        return self._reply(400, {"error": "invalid trace name"})
+                    base = os.environ.get("YK_PROFILE_DIR", "/tmp/yk-profile")
+                    trace_dir = os.path.join(base, name)
+                    try:
+                        jax.profiler.start_trace(trace_dir)
+                        self._reply(200, {"tracing": True, "dir": trace_dir})
+                    except Exception as e:
+                        self._reply(409, {"error": str(e)})
+                elif path == "/ws/v1/profile/stop":
+                    import jax
+
+                    try:
+                        jax.profiler.stop_trace()
+                        self._reply(200, {"tracing": False})
+                    except Exception as e:
+                        self._reply(409, {"error": str(e)})
                 else:
                     self._reply(404, {"error": "not found"})
 
