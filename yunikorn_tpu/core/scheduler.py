@@ -396,11 +396,15 @@ class CoreScheduler(SchedulerAPI):
                 else:
                     self._use_partition(self._app_partition.get(alloc.application_id, "default"))
                     self._restore_allocation(alloc)
+            rel_totals: Dict[Tuple[str, str], Dict[str, int]] = {}
+            rel_user_totals: Dict[Tuple[str, str], Dict[Tuple[str, tuple], Dict[str, int]]] = {}
             for release in request.releases:
                 self._use_partition(self._app_partition.get(release.application_id, "default"))
-                rel = self._release_allocation(release)
+                rel = self._release_allocation(
+                    release, batch_acc=(rel_totals, rel_user_totals))
                 if rel is not None:
                     resp.released.append(rel)
+            self._apply_release_accounting(rel_totals, rel_user_totals)
         if (resp.new or resp.released or resp.rejected) and self.callback is not None:
             self.callback.update_allocation(resp)
         self.trigger()
@@ -446,7 +450,12 @@ class CoreScheduler(SchedulerAPI):
                 return pname
         return "default"
 
-    def _release_allocation(self, release: AllocationRelease) -> Optional[AllocationRelease]:
+    def _release_allocation(self, release: AllocationRelease,
+                            batch_acc=None) -> Optional[AllocationRelease]:
+        """Release one allocation. With batch_acc=(totals, user_totals), the
+        queue-accounting walk is deferred and accumulated — a 50k-pod mass
+        release pays one ancestor walk per leaf instead of one per pod
+        (_apply_release_accounting applies the sums)."""
         # foreign release (carries no app id; search the partitions)
         for part in self.partitions.values():
             foreign = part.foreign_allocations.pop(release.allocation_key, None)
@@ -469,18 +478,40 @@ class CoreScheduler(SchedulerAPI):
         alloc = app.allocations.pop(release.allocation_key, None)
         if alloc is None:
             return None
-        leaf = self.queues.resolve(app.queue_name, create=False)
-        if leaf is not None:
-            leaf.remove_allocated(alloc.resource)
-            if leaf.has_limits_in_chain():
-                leaf.remove_user_allocated(app.user.user, alloc.resource,
-                                           list(app.user.groups))
+        if batch_acc is not None:
+            totals, user_totals = batch_acc
+            qname = (self.partition.name, app.queue_name)
+            _acc_resource(totals.setdefault(qname, {}), alloc.resource)
+            if self.queues.any_limits():
+                _acc_resource(
+                    user_totals.setdefault(qname, {}).setdefault(
+                        (app.user.user, tuple(app.user.groups)), {}),
+                    alloc.resource)
+        else:
+            leaf = self.queues.resolve(app.queue_name, create=False)
+            if leaf is not None:
+                leaf.remove_allocated(alloc.resource)
+                if leaf.has_limits_in_chain():
+                    leaf.remove_user_allocated(app.user.user, alloc.resource,
+                                               list(app.user.groups))
         return AllocationRelease(
             application_id=release.application_id,
             allocation_key=release.allocation_key,
             termination_type=release.termination_type,
             message=release.message,
         )
+
+    def _apply_release_accounting(self, totals, user_totals) -> None:
+        """Apply accumulated release sums: one ancestor walk per touched leaf."""
+        for (pname, qname), acc in totals.items():
+            tree = self.queue_trees.get(pname)
+            leaf = tree.resolve(qname, create=False) if tree is not None else None
+            if leaf is None:
+                continue
+            leaf.remove_allocated(Resource(acc))
+            if leaf.has_limits_in_chain():
+                for (user, groups), uacc in user_totals.get((pname, qname), {}).items():
+                    leaf.remove_user_allocated(user, Resource(uacc), list(groups))
 
     # ----------------------------------------------------------- solve cycle
     def start(self) -> None:
@@ -605,14 +636,13 @@ class CoreScheduler(SchedulerAPI):
                     tags=dict(ask.tags),
                 )
                 app = self._commit_allocation(alloc, credit_queue=False)
-                acc = leaf_totals.setdefault(app.queue_name, {})
-                for rk, rv in alloc.resource.resources.items():
-                    acc[rk] = acc.get(rk, 0) + rv
+                _acc_resource(leaf_totals.setdefault(app.queue_name, {}),
+                              alloc.resource)
                 if limits_exist:
-                    uacc = user_totals.setdefault(app.queue_name, {}).setdefault(
-                        (app.user.user, tuple(app.user.groups)), {})
-                    for rk, rv in alloc.resource.resources.items():
-                        uacc[rk] = uacc.get(rk, 0) + rv
+                    _acc_resource(
+                        user_totals.setdefault(app.queue_name, {}).setdefault(
+                            (app.user.user, tuple(app.user.groups)), {}),
+                        alloc.resource)
                 new_allocs.append(alloc)
             for qname, total in leaf_totals.items():
                 leaf = self.queues.resolve(qname, create=False)
@@ -674,8 +704,7 @@ class CoreScheduler(SchedulerAPI):
         # only cycles with admitted pods record one.
         if admitted:
             end = time.time()
-            cycles = self.metrics.setdefault("last_cycle", {})
-            cycles[self.partition.name] = {
+            entry = {
                 "at": round(end, 3),
                 "pods": len(admitted),
                 "gate_ms": round((t_gate - t0) * 1000, 2),
@@ -684,6 +713,13 @@ class CoreScheduler(SchedulerAPI):
                 "commit_ms": round((t_commit - t_solve) * 1000, 2),
                 "post_ms": round((end - t_commit) * 1000, 2),
                 "total_ms": round((end - t0) * 1000, 2),
+            }
+            # copy-on-write, published fully built: get_partition_dao's
+            # shallow metrics copy may be serialized outside the lock; never
+            # mutate a dict a reader could be iterating
+            self.metrics["last_cycle"] = {
+                **(self.metrics.get("last_cycle") or {}),
+                self.partition.name: entry,
             }
         return len(new_allocs), (pinned, replaced, new_allocs,
                                  preempt_releases, skipped_keys)
@@ -1060,6 +1096,13 @@ class CoreScheduler(SchedulerAPI):
 
     def state_dump(self) -> str:
         return json.dumps(self.get_partition_dao(), default=str)
+
+
+def _acc_resource(acc: Dict[str, int], resource: Resource) -> None:
+    """Fold a resource into a plain int accumulator (Resource.add would copy
+    the dict per call — measurable at 50k allocations/releases)."""
+    for rk, rv in resource.resources.items():
+        acc[rk] = acc.get(rk, 0) + rv
 
 
 def _fits_quota_with(quota_chain, cycle_extra: Dict[str, Resource], req: Resource) -> bool:

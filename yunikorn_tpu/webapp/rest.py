@@ -155,7 +155,9 @@ class RestServer:
 
                     q = parse_qs(parsed.query)
                     name = q.get("name", ["trace"])[0]
-                    if not _re.fullmatch(r"[A-Za-z0-9._-]{1,64}", name):
+                    # at least one alphanumeric: rejects "." / ".." aliases
+                    if not _re.fullmatch(r"(?=.*[A-Za-z0-9])[A-Za-z0-9._-]{1,64}",
+                                         name):
                         return self._reply(400, {"error": "invalid trace name"})
                     base = os.environ.get("YK_PROFILE_DIR", "/tmp/yk-profile")
                     trace_dir = os.path.join(base, name)
