@@ -1,0 +1,101 @@
+"""RClient: REST test helper with wait-for-state combinators.
+
+Reference analog: test/e2e/framework/helpers/yunikorn/rest_api_utils.go —
+the ginkgo suites drive the scheduler's /ws/v1 surface through a typed client
+with retrying wait helpers. Tests (and operators) use this against a live
+RestServer, exactly as the reference e2e drives a deployed scheduler.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+
+class RClient:
+    def __init__(self, port: int, host: str = "127.0.0.1"):
+        self.base = f"http://{host}:{port}"
+
+    # ------------------------------------------------------------- raw verbs
+    def get(self, path: str, timeout: float = 5.0):
+        with urllib.request.urlopen(self.base + path, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def post(self, path: str, body: Optional[str] = None):
+        req = urllib.request.Request(
+            self.base + path,
+            data=body.encode() if body is not None else None,
+            method="POST")
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            return json.loads(r.read())
+
+    # ------------------------------------------------------------ typed gets
+    def health(self) -> bool:
+        try:
+            return bool(self.get("/ws/v1/health").get("Healthy"))
+        except (urllib.error.URLError, ConnectionError):
+            return False
+
+    def queues(self, partition: str = "default"):
+        return self.get(f"/ws/v1/partition/{partition}/queues")
+
+    def apps(self, partition: str = "default"):
+        return self.get(f"/ws/v1/partition/{partition}/applications")
+
+    def app(self, app_id: str, partition: str = "default"):
+        return self.apps(partition).get(app_id)
+
+    def nodes(self, partition: str = "default"):
+        return self.get(f"/ws/v1/partition/{partition}/nodes")
+
+    def metrics(self):
+        return self.get("/ws/v1/metrics")
+
+    def user_usage(self, partition: str = "default"):
+        return self.get(f"/ws/v1/partition/{partition}/usage/users")
+
+    def events(self, count: int = 1000):
+        return self.get(f"/ws/v1/events/batch?count={count}")["EventRecords"]
+
+    def full_state_dump(self):
+        return self.get("/ws/v1/fullstatedump")
+
+    def validate_conf(self, queues_yaml: str):
+        return self.post("/ws/v1/validate-conf", queues_yaml)
+
+    # -------------------------------------------------- wait-for combinators
+    def wait_for(self, predicate: Callable[[], bool], timeout: float = 10.0,
+                 interval: float = 0.1, what: str = "condition") -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if predicate():
+                    return
+            except (urllib.error.URLError, ConnectionError, KeyError):
+                pass
+            time.sleep(interval)
+        raise TimeoutError(f"timed out waiting for {what}")
+
+    def wait_for_health(self, timeout: float = 10.0) -> None:
+        self.wait_for(self.health, timeout, what="scheduler health")
+
+    def wait_for_app_state(self, app_id: str, state: str,
+                           partition: str = "default",
+                           timeout: float = 10.0) -> None:
+        self.wait_for(
+            lambda: (self.app(app_id, partition) or {}).get("state") == state,
+            timeout, what=f"app {app_id} state {state}")
+
+    def wait_for_allocation_count(self, app_id: str, count: int,
+                                  partition: str = "default",
+                                  timeout: float = 10.0) -> None:
+        self.wait_for(
+            lambda: len((self.app(app_id, partition) or {}).get("allocations", [])) == count,
+            timeout, what=f"app {app_id} to hold {count} allocations")
+
+    def wait_for_node_count(self, count: int, partition: str = "default",
+                            timeout: float = 10.0) -> None:
+        self.wait_for(lambda: len(self.nodes(partition)) == count,
+                      timeout, what=f"{count} nodes")

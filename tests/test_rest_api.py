@@ -12,6 +12,17 @@ from yunikorn_tpu.shim.mock_scheduler import MockScheduler
 from yunikorn_tpu.webapp.rest import RestServer
 
 
+class NullCB:
+    def update_allocation(self, r): pass
+    def update_application(self, r): pass
+    def update_node(self, r): pass
+    def predicates(self, a): return None
+    def preemption_predicates(self, a): return None
+    def send_event(self, e): pass
+    def update_container_scheduling_state(self, r): pass
+    def get_state_dump(self): return "{}"
+
+
 @pytest.fixture
 def stack():
     ms = MockScheduler()
@@ -123,18 +134,8 @@ partitions:
     cache = SchedulerCache()
     core = CoreScheduler(cache)
 
-    class CB:
-        def update_allocation(self, r): pass
-        def update_application(self, r): pass
-        def update_node(self, r): pass
-        def predicates(self, a): return None
-        def preemption_predicates(self, a): return None
-        def send_event(self, e): pass
-        def update_container_scheduling_state(self, r): pass
-        def get_state_dump(self): return "{}"
-
     core.register_resource_manager(RegisterResourceManagerRequest(
-        rm_id="r", policy_group="q", config=yaml_text), CB())
+        rm_id="r", policy_group="q", config=yaml_text), NullCB())
     n = make_node("n0", cpu_milli=8000)
     cache.update_node(n)
     core.update_node(NodeRequest(nodes=[NodeInfo(node_id="n0", action=NodeAction.CREATE)]))
@@ -184,20 +185,10 @@ def test_step_timing_and_profile_endpoints():
     from yunikorn_tpu.core.scheduler import CoreScheduler
     from yunikorn_tpu.webapp.rest import RestServer
 
-    class CB:
-        def update_allocation(self, r): pass
-        def update_application(self, r): pass
-        def update_node(self, r): pass
-        def predicates(self, a): return None
-        def preemption_predicates(self, a): return None
-        def send_event(self, e): pass
-        def update_container_scheduling_state(self, r): pass
-        def get_state_dump(self): return "{}"
-
     cache = SchedulerCache()
     core = CoreScheduler(cache)
     core.register_resource_manager(RegisterResourceManagerRequest(
-        rm_id="r", policy_group="q"), CB())
+        rm_id="r", policy_group="q"), NullCB())
     n = make_node("n0", cpu_milli=8000)
     cache.update_node(n)
     core.update_node(NodeRequest(nodes=[NodeInfo(node_id="n0", action=NodeAction.CREATE)]))
@@ -251,4 +242,52 @@ def test_step_timing_and_profile_endpoints():
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+        rest.stop()
+
+
+def test_rclient_waits_and_typed_gets():
+    """RClient-style REST harness (reference helpers/yunikorn/rest_api_utils.go):
+    typed gets + wait-for-state combinators against a live server."""
+    from tests.rclient import RClient
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (AddApplicationRequest, AllocationAsk,
+                                        AllocationRequest, ApplicationRequest,
+                                        NodeAction, NodeInfo, NodeRequest,
+                                        RegisterResourceManagerRequest,
+                                        UserGroupInfo)
+    from yunikorn_tpu.core.scheduler import CoreScheduler
+    from yunikorn_tpu.webapp.rest import RestServer
+
+    cache = SchedulerCache()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="r", policy_group="q"), NullCB())
+    rest = RestServer(core, port=0)
+    port = rest.start()
+    rc = RClient(port)
+    try:
+        rc.wait_for_health()
+        n = make_node("n0", cpu_milli=8000)
+        cache.update_node(n)
+        core.update_node(NodeRequest(nodes=[NodeInfo(node_id="n0",
+                                                     action=NodeAction.CREATE)]))
+        rc.wait_for_node_count(1)
+        core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+            application_id="rc-app", queue_name="root.q",
+            user=UserGroupInfo(user="u"))]))
+        p = make_pod("p0", cpu_milli=500, memory=2**20)
+        core.update_allocation(AllocationRequest(asks=[
+            AllocationAsk(p.uid, "rc-app", get_pod_resource(p), pod=p)]))
+        core.schedule_once()
+        rc.wait_for_app_state("rc-app", "Running")
+        rc.wait_for_allocation_count("rc-app", 1)
+        assert rc.app("rc-app")["allocations"][p.uid]["nodeId"] == "n0"
+        assert rc.queues()["queuename"] == "root"
+        ok = rc.validate_conf("partitions:\n  - name: default\n    queues:\n      - name: root\n")
+        assert ok["allowed"] is True
+        with pytest.raises(TimeoutError):
+            rc.wait_for_app_state("rc-app", "Completed", timeout=0.5)
+    finally:
         rest.stop()
