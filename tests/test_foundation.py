@@ -422,3 +422,11 @@ def test_rmutex_reentrant_and_detects():
         m2.release()
     finally:
         lk.DETECTION_ENABLED, lk.TIMEOUT_SECONDS = old_enabled, old_timeout
+
+
+def test_prewarm_buckets_compiles():
+    from yunikorn_tpu.utils.jaxtools import prewarm_buckets
+
+    t = prewarm_buckets("64x128, bogus, 32x64")
+    t.join(timeout=120)
+    assert not t.is_alive()

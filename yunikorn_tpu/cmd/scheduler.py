@@ -40,6 +40,11 @@ def main(argv=None) -> int:
     parser.add_argument("--kubeconfig", type=str, default="",
                         help="schedule against a real cluster via this "
                              "kubeconfig (kind/kwok); default: FakeCluster")
+    parser.add_argument("--prewarm", type=str, default="",
+                        help="compile standard solve buckets at startup in "
+                             "the background, e.g. '1024x4096,16384x65536' "
+                             "(nodes x pods); removes the first-cycle XLA "
+                             "compile stall (persistent cache fills too)")
     args = parser.parse_args(argv)
 
     ensure_compilation_cache()
@@ -90,6 +95,11 @@ def main(argv=None) -> int:
     shim.run()
     port = rest.start()
     logger.info("scheduler up; REST on :%d", port)
+
+    if args.prewarm:
+        from yunikorn_tpu.utils.jaxtools import prewarm_buckets
+
+        prewarm_buckets(args.prewarm)
 
     stop = threading.Event()
 

@@ -558,7 +558,7 @@ def pad2d(arr, width, fill):
 
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
-                device=None, node_mask=None) -> SolveResult:
+                device=None, node_mask=None, compile_only=False) -> SolveResult:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     free_delta: optional [capacity, R] float array subtracted from node free
@@ -566,6 +566,9 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     node_mask: optional [capacity] bool restricting candidate nodes (the
     multi-partition case: one encoder holds every cache node, each
     partition's solve sees only its own).
+    compile_only: AOT-lower and compile this shape/static-variant without
+    executing (bucket prewarm) — fills the jit + persistent caches at zero
+    device time; returns None.
     """
     import numpy as np
 
@@ -595,7 +598,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
             lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
             lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight,
         ))
-    assigned, free_after, rounds = solve(
+    solve_args = (
         jnp.asarray(batch.req.astype(np.int32)),
         jnp.asarray(batch.group_id),
         jnp.asarray(batch.rank),
@@ -620,6 +623,8 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         jnp.asarray(host_mask) if host_mask is not None else None,
         jnp.asarray(host_soft) if host_soft is not None else None,
         loc,
+    )
+    solve_kwargs = dict(
         max_rounds=max_rounds,
         chunk=chunk,
         policy=policy,
@@ -636,4 +641,8 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
                          or host_soft is not None
                          or bool(np.any(na.taints_soft))),
     )
+    if compile_only:
+        solve.lower(*solve_args, **solve_kwargs).compile()
+        return None
+    assigned, free_after, rounds = solve(*solve_args, **solve_kwargs)
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -39,6 +39,85 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def prewarm_buckets(spec: str) -> "object":
+    """Compile standard solve buckets in a background thread.
+
+    spec: comma-separated "NODESxPODS" pairs (e.g. "1024x4096,16384x65536").
+    Each bucket builds throwaway synthetic problems through the real encoder
+    and AOT-compiles the solve (no execution, zero device time) for the
+    static variants production uses — both nodesort policies, with and
+    without soft/locality constraints. The jit cache (and the persistent
+    compilation cache) then covers the production cycle's shapes, removing
+    the first-cycle compile stall (~minutes at the 50k bucket). Exotic
+    configurations (e.g. unusual locality domain counts) may still trigger a
+    compile. Isolated caches/encoders; never touches live state. Returns the
+    daemon thread (join it in tests)."""
+    import threading
+
+    def warm_bucket(n_nodes: int, n_pods: int) -> None:
+        from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+        from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+        from yunikorn_tpu.common.objects import (Affinity, NodeSelectorRequirement,
+                                                 NodeSelectorTerm,
+                                                 TopologySpreadConstraint)
+        from yunikorn_tpu.common.resource import get_pod_resource
+        from yunikorn_tpu.common.si import AllocationAsk
+        from yunikorn_tpu.ops.assign import solve_batch
+        from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+        cache = SchedulerCache()
+        for node in make_kwok_nodes(n_nodes):
+            cache.update_node(node)
+        enc = SnapshotEncoder(cache)
+        enc.sync_nodes(full=True)
+        pods = make_sleep_pods(n_pods, "prewarm", queue="root.prewarm")
+        # make the last pod carry soft + locality constraints so the
+        # locality/soft static variants of the solve compile too — those are
+        # exactly the configurations whose first cycle hurts the most
+        rich = pods[-1]
+        rich.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", when_unsatisfiable="ScheduleAnyway",
+            label_selector={"matchLabels": {"prewarm": "1"}})]
+        rich.metadata.labels["prewarm"] = "1"
+        rich.spec.affinity = Affinity(node_preferred_terms=[
+            (10, NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("zone", "In", ["z0"])]))])
+        asks = [AllocationAsk(p.uid, "prewarm", get_pod_resource(p), pod=p)
+                for p in pods]
+        plain = enc.build_batch(asks[:-1])
+        rich_batch = enc.build_batch(asks)
+        # AOT compile (no execution): both nodesort policies × plain and
+        # soft/locality variants — the static combinations production uses
+        for policy in ("binpacking", "spread"):
+            solve_batch(plain, enc.nodes, policy=policy, compile_only=True)
+            solve_batch(rich_batch, enc.nodes, policy=policy, compile_only=True)
+
+    def run():
+        ensure_compilation_cache()
+        import logging
+
+        for pair in spec.split(","):
+            pair = pair.strip().lower()
+            if not pair:
+                continue
+            try:
+                nodes_s, pods_s = pair.split("x")
+                n_nodes, n_pods = int(nodes_s), int(pods_s)
+            except ValueError:
+                logging.getLogger(__name__).warning(
+                    "invalid prewarm bucket %r (want NODESxPODS)", pair)
+                continue
+            try:  # per bucket: one failure must not abort the rest
+                warm_bucket(n_nodes, n_pods)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "prewarm of bucket %dx%d failed", n_nodes, n_pods)
+
+    t = threading.Thread(target=run, name="bucket-prewarm", daemon=True)
+    t.start()
+    return t
+
+
 def ensure_compilation_cache(path: str | None = None) -> None:
     global _initialized
     if _initialized:
