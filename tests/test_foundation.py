@@ -427,6 +427,9 @@ def test_rmutex_reentrant_and_detects():
 def test_prewarm_buckets_compiles():
     from yunikorn_tpu.utils.jaxtools import prewarm_buckets
 
-    t = prewarm_buckets("64x128, bogus, 32x64")
+    results = []
+    t = prewarm_buckets("64x128, bogus, 32x64", results=results)
     t.join(timeout=120)
     assert not t.is_alive()
+    # bogus skipped; both valid buckets genuinely compiled
+    assert results == [(64, 128, True), (32, 64, True)]

@@ -558,7 +558,8 @@ def pad2d(arr, width, fill):
 
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
-                device=None, node_mask=None, compile_only=False) -> SolveResult:
+                device=None, node_mask=None,
+                compile_only=False) -> Optional[SolveResult]:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     free_delta: optional [capacity, R] float array subtracted from node free
@@ -594,34 +595,32 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     loc = None
     if batch.locality is not None:
         lb = batch.locality
-        loc = tuple(jnp.asarray(a) for a in (
-            lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
-            lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight,
-        ))
-    solve_args = (
-        jnp.asarray(batch.req.astype(np.int32)),
-        jnp.asarray(batch.group_id),
-        jnp.asarray(batch.rank),
-        jnp.asarray(batch.valid),
-        jnp.asarray(batch.g_term_req.view(np.uint32)),
-        jnp.asarray(batch.g_term_forb.view(np.uint32)),
-        jnp.asarray(batch.g_term_valid),
-        jnp.asarray(batch.g_anyof.view(np.uint32)),
-        jnp.asarray(batch.g_anyof_valid),
-        jnp.asarray(batch.g_tol.view(np.uint32)),
-        jnp.asarray(batch.g_ports.view(np.uint32)),
-        jnp.asarray(batch.g_pref_req.view(np.uint32)),
-        jnp.asarray(batch.g_pref_forb.view(np.uint32)),
-        jnp.asarray(batch.g_pref_weight),
-        jnp.asarray(na.labels.view(np.uint32)),
-        jnp.asarray(na.taints_hard.view(np.uint32)),
-        jnp.asarray(na.taints_soft.view(np.uint32)),
-        jnp.asarray(na.ports.view(np.uint32)),
-        jnp.asarray(node_ok),
-        jnp.asarray(free_i),
-        jnp.asarray(cap_i),
-        jnp.asarray(host_mask) if host_mask is not None else None,
-        jnp.asarray(host_soft) if host_soft is not None else None,
+        loc = (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
+               lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight)
+    np_args = (
+        batch.req.astype(np.int32),
+        batch.group_id,
+        batch.rank,
+        batch.valid,
+        batch.g_term_req.view(np.uint32),
+        batch.g_term_forb.view(np.uint32),
+        batch.g_term_valid,
+        batch.g_anyof.view(np.uint32),
+        batch.g_anyof_valid,
+        batch.g_tol.view(np.uint32),
+        batch.g_ports.view(np.uint32),
+        batch.g_pref_req.view(np.uint32),
+        batch.g_pref_forb.view(np.uint32),
+        batch.g_pref_weight,
+        na.labels.view(np.uint32),
+        na.taints_hard.view(np.uint32),
+        na.taints_soft.view(np.uint32),
+        na.ports.view(np.uint32),
+        node_ok,
+        free_i,
+        cap_i,
+        host_mask,
+        host_soft,
         loc,
     )
     solve_kwargs = dict(
@@ -642,7 +641,11 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
                          or bool(np.any(na.taints_soft))),
     )
     if compile_only:
-        solve.lower(*solve_args, **solve_kwargs).compile()
+        # specs instead of arrays: no host->device transfer at all
+        specs = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args)
+        solve.lower(*specs, **solve_kwargs).compile()
         return None
+    solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
     assigned, free_after, rounds = solve(*solve_args, **solve_kwargs)
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

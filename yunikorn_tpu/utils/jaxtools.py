@@ -39,7 +39,27 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
-def prewarm_buckets(spec: str) -> "object":
+def backend_or_cpu() -> str:
+    """Initialize the default JAX backend; fall back to CPU when the TPU
+    relay is unavailable (UNAVAILABLE after its internal wait). Returns the
+    platform in use. Never kills or times out the init attempt — see the
+    relay-claim semantics in the repo docs."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "default JAX backend unavailable (%s: %s); falling back to CPU — "
+            "solves will run minutes-slow until the TPU returns",
+            type(e).__name__, e)
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices("cpu")[0].platform
+
+
+def prewarm_buckets(spec: str, results: "list | None" = None) -> "object":
     """Compile standard solve buckets in a background thread.
 
     spec: comma-separated "NODESxPODS" pairs (e.g. "1024x4096,16384x65536").
@@ -109,9 +129,13 @@ def prewarm_buckets(spec: str) -> "object":
                 continue
             try:  # per bucket: one failure must not abort the rest
                 warm_bucket(n_nodes, n_pods)
+                if results is not None:
+                    results.append((n_nodes, n_pods, True))
             except Exception:
                 logging.getLogger(__name__).exception(
                     "prewarm of bucket %dx%d failed", n_nodes, n_pods)
+                if results is not None:
+                    results.append((n_nodes, n_pods, False))
 
     t = threading.Thread(target=run, name="bucket-prewarm", daemon=True)
     t.start()
