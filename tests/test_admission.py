@@ -161,18 +161,6 @@ def test_preemption_annotation_from_priority_class(ac):
     assert merged.get(constants.ANNOTATION_ALLOW_PREEMPTION) == "false"
 
 
-def test_workload_template_injection(ac):
-    deployment = {
-        "metadata": {"name": "d1"},
-        "spec": {"template": {"metadata": {}, "spec": {}}},
-    }
-    result = ac.mutate(make_review(deployment, kind="Deployment", username="bob"))
-    patch = decode_patch(result)
-    assert patch and patch[0]["path"] == "/spec/template/metadata/annotations"
-    info = json.loads(patch[0]["value"][constants.ANNOTATION_USER_INFO])
-    assert info["user"] == "bob"
-
-
 def test_cronjob_template_path(ac):
     cj = {
         "metadata": {"name": "c1"},
@@ -376,3 +364,19 @@ def test_certificate_expiration_loop_rotates():
     assert rotated, "expected a rotation + webhook re-registration"
     m, v = rotated[0]
     assert m["webhooks"][0]["clientConfig"]["caBundle"]  # fresh bundle rendered
+
+
+@pytest.mark.parametrize("kind", ["Deployment", "DaemonSet", "StatefulSet",
+                                  "ReplicaSet", "Job"])
+def test_all_workload_kinds_get_user_info(ac, kind):
+    """processWorkload covers all 6 kinds (reference :218-281); CronJob's
+    nested template path is covered separately."""
+    wl = {
+        "metadata": {"name": f"{kind.lower()}-1"},
+        "spec": {"template": {"metadata": {}, "spec": {}}},
+    }
+    result = ac.mutate(make_review(wl, kind=kind, username="carol"))
+    patch = decode_patch(result)
+    assert patch and patch[0]["path"] == "/spec/template/metadata/annotations"
+    info = json.loads(patch[0]["value"][constants.ANNOTATION_USER_INFO])
+    assert info["user"] == "carol"
