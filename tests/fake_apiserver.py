@@ -3,9 +3,17 @@
 Speaks just enough of the K8s REST protocol to drive client/kube.py the way
 kwok drives the reference's client-go layer (deployments/kwok-perf-test):
 LIST + streaming WATCH for the informer types, the pods/binding subresource,
-pod create/delete, configmap get. State lives in plain dicts of K8s JSON
-documents; bindings mutate spec.nodeName + status.phase and emit MODIFIED
-events exactly like a kubelet picking the pod up.
+object create/update/patch/delete, configmap get. State lives in plain dicts
+of K8s JSON documents; bindings mutate spec.nodeName + status.phase and emit
+MODIFIED events exactly like a kubelet picking the pod up.
+
+Watch semantics match the real apiserver closely enough to test reflector
+edge cases: events are buffered per collection with their resourceVersion,
+a watch with `resourceVersion=N` replays buffered events newer than N (so
+an event emitted between LIST and WATCH connect is never lost), and
+`compact()` discards the buffer so a stale-rv watch gets an ERROR 410
+event — driving the client's relist path. `kill_watches()` severs live
+watch streams mid-flight for chaos tests.
 """
 from __future__ import annotations
 
@@ -24,16 +32,60 @@ _COLLECTIONS = {
     "/api/v1/namespaces": "namespaces",
     "/apis/resource.k8s.io/v1beta1/resourceclaims": "resourceclaims",
     "/apis/resource.k8s.io/v1beta1/resourceslices": "resourceslices",
+    "/api/v1/persistentvolumeclaims": "persistentvolumeclaims",
+    "/api/v1/persistentvolumes": "persistentvolumes",
+    "/apis/storage.k8s.io/v1/storageclasses": "storageclasses",
+    "/apis/storage.k8s.io/v1/csinodes": "csinodes",
+    "/apis/admissionregistration.k8s.io/v1/validatingwebhookconfigurations":
+        "validatingwebhookconfigurations",
+    "/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations":
+        "mutatingwebhookconfigurations",
 }
+
+# collection name → whether objects are namespaced (for object-path routing)
+_NAMESPACED = {
+    "pods": True, "configmaps": True, "persistentvolumeclaims": True,
+    "resourceclaims": True,
+    "nodes": False, "priorityclasses": False, "namespaces": False,
+    "resourceslices": False, "persistentvolumes": False,
+    "storageclasses": False, "csinodes": False,
+    "validatingwebhookconfigurations": False,
+    "mutatingwebhookconfigurations": False,
+}
+
+def _coll_of(segment: str) -> Optional[str]:
+    """URL path segment → collection name (they coincide for every kind)."""
+    return segment if segment in _NAMESPACED else None
+
+_KILL = object()  # sentinel: sever the watch stream abruptly
+
+
+def _deep_merge(dst: dict, src: dict) -> dict:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        elif v is None:
+            dst.pop(k, None)
+        else:
+            dst[k] = v
+    return dst
 
 
 class FakeAPIServer:
+    # how many events each collection buffers for watch replay
+    EVENT_LOG_LIMIT = 10000
+
     def __init__(self):
         self.store: Dict[str, Dict[str, dict]] = {c: {} for c in _COLLECTIONS.values()}
         self._rv = 0
         self._lock = threading.RLock()
         self._watchers: Dict[str, List[queue.Queue]] = {c: [] for c in _COLLECTIONS.values()}
+        # per-collection (rv, event) buffer for watch replay
+        self._events: Dict[str, List[Tuple[int, dict]]] = {c: [] for c in _COLLECTIONS.values()}
+        # rv up to which the event log was compacted (watch below this → 410)
+        self._compacted: Dict[str, int] = {c: 0 for c in _COLLECTIONS.values()}
         self.bindings: List[Tuple[str, str]] = []   # (pod name, node name)
+        self.requests: List[Tuple[str, str]] = []   # (method, path) audit log
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     # ------------------------------------------------------------- lifecycle
@@ -58,47 +110,109 @@ class FakeAPIServer:
                 n = int(self.headers.get("Content-Length", 0) or 0)
                 return json.loads(self.rfile.read(n) or b"{}")
 
+            # object path forms:
+            #   /api/v1/namespaces/{ns}/{kind}/{name}[/{sub}]
+            #   /api/v1/{kind}/{name}            (cluster-scoped core)
+            #   /apis/{group}/{ver}/{kind}/{name} (cluster-scoped grouped)
+            def _object_path(self, parts):
+                """Returns (coll, ns, name, subresource) or None."""
+                if len(parts) >= 6 and parts[0] == "api" and parts[2] == "namespaces":
+                    coll = _coll_of(parts[4])
+                    if coll and _NAMESPACED.get(coll):
+                        sub = parts[6] if len(parts) > 6 else ""
+                        return coll, parts[3], parts[5], sub
+                if len(parts) == 4 and parts[0] == "api":
+                    coll = _coll_of(parts[2])
+                    if coll and not _NAMESPACED.get(coll, True):
+                        return coll, "", parts[3], ""
+                if len(parts) == 5 and parts[0] == "apis":
+                    coll = _coll_of(parts[3])
+                    if coll and not _NAMESPACED.get(coll, True):
+                        return coll, "", parts[4], ""
+                # namespace object itself: /api/v1/namespaces/{name}
+                if len(parts) == 4 and parts[:3] == ["api", "v1", "namespaces"]:
+                    return "namespaces", "", parts[3], ""
+                return None
+
             def do_GET(self):
                 parsed = urlparse(self.path)
+                server.requests.append(("GET", parsed.path))
                 q = parse_qs(parsed.query)
                 coll = _COLLECTIONS.get(parsed.path)
+                ns_scope = ""
+                if coll is None and parsed.path.count("/namespaces/") == 1:
+                    # namespaced LIST, e.g. /api/v1/namespaces/ns/configmaps
+                    parts = parsed.path.strip("/").split("/")
+                    if len(parts) == 5 and parts[2] == "namespaces":
+                        coll = _coll_of(parts[4])
+                        ns_scope = parts[3]
                 if coll is not None:
                     if q.get("watch", ["false"])[0] == "true":
-                        return self._watch(coll)
+                        rv = int(q.get("resourceVersion", ["0"])[0] or 0)
+                        return self._watch(coll, rv, ns_scope)
                     with server._lock:
-                        items = list(server.store[coll].values())
+                        items = [d for d in server.store[coll].values()
+                                 if not ns_scope
+                                 or (d.get("metadata") or {}).get("namespace") == ns_scope]
                         rv = str(server._rv)
                     return self._send_json(
                         {"items": items, "metadata": {"resourceVersion": rv}})
-                # GET one configmap: /api/v1/namespaces/{ns}/configmaps/{name}
                 parts = parsed.path.strip("/").split("/")
-                if (len(parts) == 6 and parts[:2] == ["api", "v1"]
-                        and parts[2] == "namespaces" and parts[4] == "configmaps"):
-                    key = f"{parts[3]}/{parts[5]}"
+                obj = self._object_path(parts)
+                if obj is not None:
+                    coll, ns, name, _ = obj
+                    key = f"{ns}/{name}" if ns else name
                     with server._lock:
-                        doc = server.store["configmaps"].get(key)
+                        doc = server.store[coll].get(key)
                     if doc is None:
                         return self._send_json({"kind": "Status", "code": 404}, 404)
                     return self._send_json(doc)
                 self._send_json({"kind": "Status", "code": 404}, 404)
 
-            def _watch(self, coll):
+            def _watch(self, coll, since_rv, ns_scope=""):
+                def in_scope(event):
+                    if not ns_scope or event is _KILL or event is None:
+                        return True
+                    meta = (event.get("object") or {}).get("metadata") or {}
+                    return meta.get("namespace") == ns_scope
+
                 ch: queue.Queue = queue.Queue()
                 with server._lock:
+                    if since_rv and since_rv < server._compacted[coll]:
+                        # resume window lost: ERROR event carrying 410
+                        # (real apiserver "too old resource version")
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        self._write_chunk({"type": "ERROR", "object": {
+                            "kind": "Status", "code": 410,
+                            "reason": "Expired",
+                            "message": f"too old resource version: {since_rv}"}})
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+                    replay = [e for (erv, e) in server._events[coll]
+                              if erv > since_rv and in_scope(e)] if since_rv else []
                     server._watchers[coll].append(ch)
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 try:
+                    for event in replay:
+                        self._write_chunk(event)
                     while True:
                         event = ch.get(timeout=30)
                         if event is None:
                             break
-                        line = (json.dumps(event) + "\n").encode()
-                        self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
-                                         + line + b"\r\n")
-                        self.wfile.flush()
+                        if event is _KILL:
+                            # abrupt close, no terminal chunk: the client sees
+                            # a dead socket mid-stream
+                            self.wfile.flush()
+                            self.connection.close()
+                            return
+                        if in_scope(event):
+                            self._write_chunk(event)
                 except (queue.Empty, BrokenPipeError, ConnectionResetError):
                     pass
                 finally:
@@ -106,8 +220,15 @@ class FakeAPIServer:
                         if ch in server._watchers[coll]:
                             server._watchers[coll].remove(ch)
 
+            def _write_chunk(self, event):
+                line = (json.dumps(event) + "\n").encode()
+                self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
+                                 + line + b"\r\n")
+                self.wfile.flush()
+
             def do_POST(self):
                 parts = urlparse(self.path).path.strip("/").split("/")
+                server.requests.append(("POST", urlparse(self.path).path))
                 body = self._read_body()
                 # pods/binding subresource
                 if len(parts) == 7 and parts[4] == "pods" and parts[6] == "binding":
@@ -115,21 +236,71 @@ class FakeAPIServer:
                     node = (body.get("target") or {}).get("name", "")
                     server.bind_pod(ns, name, node)
                     return self._send_json({"kind": "Status", "status": "Success"}, 201)
-                if len(parts) == 5 and parts[4] == "pods":
-                    server.add("pods", body)
+                # namespaced collection create
+                if len(parts) == 5 and parts[2] == "namespaces":
+                    coll = _SEGMENT_TO_COLL.get(parts[4])
+                    if coll is not None:
+                        body.setdefault("metadata", {}).setdefault("namespace", parts[3])
+                        server.add(coll, body)
+                        return self._send_json(body, 201)
+                # cluster-scoped collection create
+                coll = _COLLECTIONS.get(urlparse(self.path).path)
+                if coll is not None:
+                    server.add(coll, body)
                     return self._send_json(body, 201)
+                self._send_json({"kind": "Status", "code": 404}, 404)
+
+            def do_PUT(self):
+                parts = urlparse(self.path).path.strip("/").split("/")
+                server.requests.append(("PUT", urlparse(self.path).path))
+                body = self._read_body()
+                obj = self._object_path(parts)
+                if obj is not None:
+                    coll, ns, name, _ = obj
+                    body.setdefault("metadata", {})["name"] = name
+                    if ns:
+                        body["metadata"]["namespace"] = ns
+                    # a replace must keep the object's identity: client
+                    # bodies don't carry the fake's synthetic uid
+                    key = f"{ns}/{name}" if ns else name
+                    with server._lock:
+                        existing = server.store[coll].get(key)
+                        if existing is not None:
+                            body["metadata"].setdefault(
+                                "uid", (existing.get("metadata") or {}).get("uid"))
+                    server.add(coll, body)
+                    return self._send_json(body)
                 self._send_json({"kind": "Status", "code": 404}, 404)
 
             def do_DELETE(self):
                 parts = urlparse(self.path).path.strip("/").split("/")
-                if len(parts) == 6 and parts[4] == "pods":
-                    ns, name = parts[3], parts[5]
-                    server.delete("pods", ns, name)
+                server.requests.append(("DELETE", urlparse(self.path).path))
+                obj = self._object_path(parts)
+                if obj is not None:
+                    coll, ns, name, _ = obj
+                    server.delete(coll, ns, name)
                     return self._send_json({"kind": "Status", "status": "Success"})
                 self._send_json({"kind": "Status", "code": 404}, 404)
 
             def do_PATCH(self):
-                self._read_body()
+                parts = urlparse(self.path).path.strip("/").split("/")
+                server.requests.append(("PATCH", urlparse(self.path).path))
+                body = self._read_body()
+                obj = self._object_path(parts)
+                if obj is not None:
+                    coll, ns, name, sub = obj
+                    key = f"{ns}/{name}" if ns else name
+                    with server._lock:
+                        doc = server.store[coll].get(key)
+                        if doc is not None:
+                            # strategic-merge ≈ deep merge for our use
+                            _deep_merge(doc, body)
+                            server._rv += 1
+                            doc["metadata"]["resourceVersion"] = str(server._rv)
+                            server._emit(coll, "MODIFIED", doc)
+                            return self._send_json(doc)
+                    if doc is None and sub == "":
+                        return self._send_json({"kind": "Status", "code": 404}, 404)
                 self._send_json({"kind": "Status", "status": "Success"})
 
         self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
@@ -144,6 +315,25 @@ class FakeAPIServer:
         if self._httpd is not None:
             self._httpd.shutdown()
 
+    # ------------------------------------------------------------ chaos hooks
+    def kill_watches(self, coll: Optional[str] = None) -> int:
+        """Sever live watch streams mid-flight (no clean end). Returns count."""
+        n = 0
+        with self._lock:
+            colls = [coll] if coll else list(self._watchers)
+            for c in colls:
+                for ch in list(self._watchers[c]):
+                    ch.put(_KILL)
+                    n += 1
+        return n
+
+    def compact(self, coll: Optional[str] = None) -> None:
+        """Discard the replay buffer; stale-rv watches now get 410 Gone."""
+        with self._lock:
+            for c in ([coll] if coll else list(self._events)):
+                self._events[c].clear()
+                self._compacted[c] = self._rv + 1
+
     # ----------------------------------------------------------------- state
     def _key(self, doc: dict) -> str:
         m = doc.get("metadata") or {}
@@ -151,8 +341,21 @@ class FakeAPIServer:
         return f"{ns}/{m['name']}" if ns else m["name"]
 
     def _emit(self, coll: str, etype: str, doc: dict) -> None:
+        """Must be called with self._lock held (add/delete/bind do).
+
+        Buffers a deep copy: store docs are mutated in place by bind/PATCH
+        while watcher threads serialize queued events, and replay must be a
+        faithful history, not the object's current state."""
+        event = {"type": etype, "object": json.loads(json.dumps(doc))}
+        log = self._events[coll]
+        log.append((self._rv, event))
+        if len(log) > self.EVENT_LOG_LIMIT:
+            drop = len(log) // 2
+            # everything at or below the last dropped rv is now unreplayable
+            self._compacted[coll] = log[drop - 1][0] + 1
+            del log[:drop]
         for ch in list(self._watchers[coll]):
-            ch.put({"type": etype, "object": doc})
+            ch.put(event)
 
     def add(self, coll: str, doc: dict) -> dict:
         with self._lock:
@@ -200,8 +403,8 @@ class FakeAPIServer:
 
     def add_pod_doc(self, name: str, namespace: str = "default",
                     app_id: str = "app-1", cpu: str = "500m",
-                    memory: str = "128Mi") -> dict:
-        return self.add("pods", {
+                    memory: str = "128Mi", volumes: Optional[list] = None) -> dict:
+        doc = {
             "metadata": {"name": name, "namespace": namespace,
                          "labels": {"applicationId": app_id},
                          "creationTimestamp": "2026-01-01T00:00:00Z"},
@@ -210,4 +413,54 @@ class FakeAPIServer:
                                      "resources": {"requests": {"cpu": cpu,
                                                                 "memory": memory}}}]},
             "status": {"phase": "Pending"},
+        }
+        if volumes:
+            doc["spec"]["volumes"] = volumes
+        return self.add("pods", doc)
+
+    def add_pvc_doc(self, name: str, namespace: str = "default",
+                    storage_class: str = "standard", storage: str = "1Gi",
+                    access_modes: Optional[list] = None,
+                    volume_name: str = "", phase: str = "Pending") -> dict:
+        return self.add("persistentvolumeclaims", {
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"storageClassName": storage_class,
+                     "accessModes": list(access_modes or ["ReadWriteOnce"]),
+                     "volumeName": volume_name,
+                     "resources": {"requests": {"storage": storage}}},
+            "status": {"phase": phase},
+        })
+
+    def add_pv_doc(self, name: str, storage_class: str = "standard",
+                   storage: str = "1Gi", access_modes: Optional[list] = None,
+                   claim_ref: Optional[dict] = None,
+                   node_affinity_hosts: Optional[list] = None,
+                   phase: str = "Available") -> dict:
+        spec = {"storageClassName": storage_class, "capacity": {"storage": storage},
+                "accessModes": list(access_modes or ["ReadWriteOnce"])}
+        if claim_ref:
+            spec["claimRef"] = claim_ref
+        if node_affinity_hosts:
+            spec["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+                {"matchExpressions": [{"key": "kubernetes.io/hostname",
+                                       "operator": "In",
+                                       "values": list(node_affinity_hosts)}]}]}}
+        return self.add("persistentvolumes", {
+            "metadata": {"name": name}, "spec": spec, "status": {"phase": phase}})
+
+    def add_storage_class_doc(self, name: str, binding_mode: str = "Immediate",
+                              provisioner: str = "kubernetes.io/no-provisioner") -> dict:
+        return self.add("storageclasses", {
+            "metadata": {"name": name},
+            "provisioner": provisioner,
+            "volumeBindingMode": binding_mode,
+        })
+
+    def add_csinode_doc(self, name: str, drivers: Optional[list] = None) -> dict:
+        return self.add("csinodes", {
+            "metadata": {"name": name},
+            "spec": {"drivers": [
+                {"name": d, "nodeID": name, "allocatable": {"count": 8}}
+                for d in (drivers or [])
+            ]},
         })

@@ -961,3 +961,67 @@ def test_duplicate_node_across_partitions_rejected():
         attributes={"si/node-partition": "gpu"})]))
     assert "n0" in core.partitions["default"].nodes
     assert "n0" not in core.partitions["gpu"].nodes
+
+
+def test_foreign_move_across_partitions_releases_old_entry():
+    """A foreign pod re-sent on a node in a DIFFERENT partition must drop the
+    old partition's tracked entry and decrement that node's occupied
+    (ADVICE r2: _track_foreign searched only the new partition)."""
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="rm-1", policy_group="queues", config=MULTI_PARTITION_YAML), cb)
+    infos = []
+    for name, part in (("cpu-0", ""), ("gpu-0", "gpu")):
+        n = make_node(name, cpu_milli=8000)
+        cache.update_node(n)
+        attrs = {"si/node-partition": part} if part else {}
+        infos.append(NodeInfo(node_id=name, action=NodeAction.CREATE, attributes=attrs))
+    core.update_node(NodeRequest(nodes=infos))
+
+    f = Allocation(allocation_key="f0", application_id="", node_id="cpu-0",
+                   resource=ResourceBuilder().cpu(3000).build(), foreign=True)
+    core.update_allocation(AllocationRequest(allocations=[f]))
+    assert core.partitions["default"].nodes["cpu-0"].occupied.get("cpu") == 3000
+    # the pod moves onto a gpu-partition node
+    f2 = Allocation(allocation_key="f0", application_id="", node_id="gpu-0",
+                    resource=ResourceBuilder().cpu(3000).build(), foreign=True)
+    core.update_allocation(AllocationRequest(allocations=[f2]))
+    assert core.partitions["default"].nodes["cpu-0"].occupied.get("cpu") == 0
+    assert "f0" not in core.partitions["default"].foreign_allocations
+    assert core.partitions["gpu"].nodes["gpu-0"].occupied.get("cpu") == 3000
+    # release finds it exactly once
+    core.update_allocation(AllocationRequest(releases=[
+        AllocationRelease(application_id="", allocation_key="f0")]))
+    assert core.partitions["gpu"].nodes["gpu-0"].occupied.get("cpu") == 0
+
+
+def test_partition_capacity_memo_invalidated_by_membership_change():
+    """Node registration into a partition changes its capacity without a
+    cache capacity_version bump in between (the cache saw the node before
+    the memo was computed) — the memo must still invalidate (ADVICE r2)."""
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache)
+    core.register_resource_manager(RegisterResourceManagerRequest(
+        rm_id="rm-1", policy_group="queues", config=MULTI_PARTITION_YAML), cb)
+    n0 = make_node("gpu-0", cpu_milli=8000)
+    cache.update_node(n0)
+    core.update_node(NodeRequest(nodes=[NodeInfo(
+        node_id="gpu-0", action=NodeAction.CREATE,
+        schedulable_resource=ResourceBuilder().cpu(8000).build(),
+        attributes={"si/node-partition": "gpu"})]))
+    # second node lands in the CACHE first (capacity_version bumps here) ...
+    n1 = make_node("gpu-1", cpu_milli=8000)
+    cache.update_node(n1)
+    core._use_partition("gpu")
+    cap_before = core._cluster_capacity()   # memoized at the current versions
+    assert cap_before.get("cpu") == 8000    # gpu-1 not yet registered in core
+    # ... then registers at the core with NO further cache version bump
+    core.update_node(NodeRequest(nodes=[NodeInfo(
+        node_id="gpu-1", action=NodeAction.CREATE,
+        schedulable_resource=ResourceBuilder().cpu(8000).build(),
+        attributes={"si/node-partition": "gpu"})]))
+    core._use_partition("gpu")
+    assert core._cluster_capacity().get("cpu") == 16000

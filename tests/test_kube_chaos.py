@@ -1,0 +1,188 @@
+"""Reflector chaos tests: the failure modes client-go's reflector is built
+around (watch replay windows, severed streams, 410 Gone storms, backoff),
+driven against the fake API server's real REST protocol. Reference behavior:
+client-go reflector semantics cited in client/kube.py."""
+import ssl
+import time
+
+import pytest
+
+from tests.fake_apiserver import FakeAPIServer
+from yunikorn_tpu.client.interfaces import InformerType, ResourceEventHandlers
+from yunikorn_tpu.client.kube import KubeConfig, RealAPIProvider
+
+
+@pytest.fixture
+def api():
+    server = FakeAPIServer()
+    port = server.start()
+    cfg = KubeConfig(f"http://127.0.0.1:{port}", ssl.create_default_context())
+    yield server, cfg
+    server.stop()
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _node_provider(cfg, seen):
+    provider = RealAPIProvider(cfg)
+    provider.add_event_handler(InformerType.NODE, ResourceEventHandlers(
+        add_fn=lambda n: seen.append(("add", n.name)),
+        update_fn=lambda old, n: seen.append(("upd", n.name)),
+        delete_fn=lambda n: seen.append(("del", n.name))))
+    return provider
+
+
+def test_event_between_list_and_watch_replayed(api):
+    """An event emitted after LIST but before the WATCH connects must be
+    replayed from the server's rv-indexed buffer — the flake ADVICE.md r2
+    called out. Emulated deterministically: connect a watch at the rv of an
+    earlier LIST and verify intermediate events arrive."""
+    server, cfg = api
+    server.add_node_doc("n0")
+    with server._lock:
+        list_rv = server._rv  # what a LIST at this instant would return
+    # events land between the LIST and the WATCH connect
+    server.add_node_doc("n1")
+    server.delete("nodes", "", "n0")
+
+    import json
+    import urllib.request
+
+    url = (f"{cfg.server}/api/v1/nodes?watch=true&resourceVersion={list_rv}"
+           f"&allowWatchBookmarks=true")
+    events = []
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        for line in resp:
+            events.append(json.loads(line))
+            if len(events) == 2:
+                break
+    kinds = [(e["type"], e["object"]["metadata"]["name"]) for e in events]
+    assert kinds == [("ADDED", "n1"), ("DELETED", "n0")]
+
+
+def test_watch_killed_midstream_resumes_without_loss(api):
+    server, cfg = api
+    server.add_node_doc("n0")
+    seen = []
+    provider = _node_provider(cfg, seen)
+    provider.start()
+    provider.wait_for_sync(timeout=10)
+    assert _wait(lambda: ("add", "n0") in seen)
+
+    # sever every live watch stream, then immediately add a node: the
+    # reflector must reconnect from its resume rv and deliver it
+    killed = server.kill_watches()
+    assert killed >= 1
+    server.add_node_doc("n1")
+    assert _wait(lambda: ("add", "n1") in seen), seen
+    provider.stop()
+
+
+def test_410_storm_forces_relist_and_recovers(api):
+    server, cfg = api
+    server.add_node_doc("n0")
+    seen = []
+    provider = _node_provider(cfg, seen)
+    provider.start()
+    provider.wait_for_sync(timeout=10)
+    assert _wait(lambda: ("add", "n0") in seen)
+
+    for _ in range(3):
+        # compact the event log so the reflector's resume rv is too old,
+        # then kill the stream: reconnect gets ERROR 410 → relist
+        server.compact("nodes")
+        server.kill_watches("nodes")
+        time.sleep(0.1)
+    server.add_node_doc("n-after-storm")
+    assert _wait(lambda: any(n == "n-after-storm" for _, n in seen)), seen
+    # the relists must not have manufactured spurious deletes
+    assert ("del", "n0") not in seen
+    provider.stop()
+
+
+def test_informer_error_backoff_is_exponential():
+    """Server errors on every request: reconnect attempts must slow down
+    (exponential backoff with jitter), not hammer at a fixed rate."""
+    from yunikorn_tpu.client.kube import _Informer
+
+    class FailingClient:
+        def __init__(self):
+            self.attempts = []
+
+        def request_json(self, *a, **k):
+            self.attempts.append(time.monotonic())
+            raise ConnectionError("boom")
+
+        def _request(self, *a, **k):  # pragma: no cover - relist fails first
+            raise ConnectionError("boom")
+
+    client = FailingClient()
+    inf = _Informer(client, InformerType.NODE)
+    inf._BACKOFF_BASE = 0.05
+    inf.run()
+    deadline = time.time() + 4
+    while len(client.attempts) < 5 and time.time() < deadline:
+        time.sleep(0.02)
+    inf.stop()
+    assert len(client.attempts) >= 5, "informer stopped retrying"
+    gaps = [b - a for a, b in zip(client.attempts, client.attempts[1:])]
+    # later gaps must be materially larger than the first (doubling, with
+    # jitter in [0.5x, 1.5x]) — a fixed-interval retry loop fails this
+    assert gaps[3] > gaps[0] * 1.9, gaps
+
+
+def test_partial_sync_timeout_names_the_laggard(api):
+    """wait_for_sync failing must say WHICH informer didn't sync."""
+    server, cfg = api
+    provider = RealAPIProvider(cfg)
+    # do not start(): nothing syncs
+    with pytest.raises(TimeoutError) as exc:
+        provider.wait_for_sync(timeout=0.3)
+    assert "informer" in str(exc.value)
+
+
+def test_store_snapshot_consistent_under_churn(api):
+    """list_pods during heavy watch churn must not raise (store lock)."""
+    server, cfg = api
+    provider = RealAPIProvider(cfg)
+    provider.start()
+    provider.wait_for_sync(timeout=10)
+
+    import threading
+
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            server.add_pod_doc(f"p{i % 50}", app_id="churn")
+            if i % 7 == 0:
+                server.delete("pods", "default", f"p{(i - 3) % 50}")
+            i += 1
+
+    def read():
+        while not stop.is_set():
+            try:
+                provider.list_pods()
+            except Exception as e:  # pragma: no cover - the bug under test
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=churn), threading.Thread(target=read),
+               threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    time.sleep(1.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+    provider.stop()

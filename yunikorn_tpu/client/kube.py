@@ -228,6 +228,9 @@ class _Informer:
         self.namespace = namespace
         self.handlers: List[ResourceEventHandlers] = []
         self.store: Dict[str, object] = {}          # uid/name -> object
+        # guards store mutation vs snapshot readers (list_pods/list_nodes run
+        # on other threads while the informer thread applies watch events)
+        self._store_lock = threading.Lock()
         self.synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -235,6 +238,10 @@ class _Informer:
     def _key(self, obj) -> str:
         uid = getattr(getattr(obj, "metadata", None), "uid", "")
         return uid or getattr(obj, "key", "") or getattr(obj, "name", "")
+
+    def snapshot(self) -> List[object]:
+        with self._store_lock:
+            return list(self.store.values())
 
     def _deliver(self, kind: str, obj, old=None) -> None:
         for h in self.handlers:
@@ -272,22 +279,35 @@ class _Informer:
     def stop(self) -> None:
         self._stop.set()
 
+    _BACKOFF_BASE = 0.5
+    _BACKOFF_MAX = 30.0
+
     def _loop(self) -> None:
+        import random
+
         rv = ""
+        backoff = self._BACKOFF_BASE
         while not self._stop.is_set():
             try:
                 if not rv:
                     rv = self._relist()
+                    backoff = self._BACKOFF_BASE  # healthy again
                 # returns the resume resourceVersion on a clean stream end
                 # (idle timeout), "" on 410 Gone → relist (client-go reflector)
                 rv = self._watch(rv)
             except TimeoutError:
                 continue  # idle watch socket; resume from the same rv
             except Exception as e:
-                logger.warning("informer %s restarting after error: %s",
-                               self.informer.value, e)
+                # exponential backoff with full jitter (client-go reflector
+                # backs off the same way); a flapping API server must not be
+                # hammered at a fixed 1 Hz by every informer at once
+                delay = backoff * (0.5 + random.random())
+                backoff = min(backoff * 2.0, self._BACKOFF_MAX)
+                logger.warning("informer %s restarting after error (backoff %.1fs): %s",
+                               self.informer.value, delay, e)
                 rv = ""
-                time.sleep(1.0)
+                if self._stop.wait(delay):
+                    return
 
     def _relist(self) -> str:
         doc = self.client.request_json("GET", self._list_path(False))
@@ -296,15 +316,17 @@ class _Informer:
         for item in doc.get("items") or []:
             obj = self.decoder(item)
             fresh[self._key(obj)] = obj
+        with self._store_lock:
+            old = self.store
+            self.store = fresh
         for key, obj in fresh.items():
-            if key in self.store:
-                self._deliver("update", obj, self.store[key])
+            if key in old:
+                self._deliver("update", obj, old[key])
             else:
                 self._deliver("add", obj)
-        for key, obj in list(self.store.items()):
+        for key, obj in old.items():
             if key not in fresh:
                 self._deliver("delete", obj)
-        self.store = fresh
         self.synced.set()
         return rv
 
@@ -334,16 +356,14 @@ class _Informer:
                     continue
                 obj = self.decoder(obj_doc)
                 key = self._key(obj)
-                if etype == "ADDED":
-                    old = self.store.get(key)
-                    self.store[key] = obj
+                if etype in ("ADDED", "MODIFIED"):
+                    with self._store_lock:
+                        old = self.store.get(key)
+                        self.store[key] = obj
                     self._deliver("update" if old is not None else "add", obj, old)
-                elif etype == "MODIFIED":
-                    old = self.store.get(key)
-                    self.store[key] = obj
-                    self._deliver("update", obj, old)
                 elif etype == "DELETED":
-                    self.store.pop(key, None)
+                    with self._store_lock:
+                        self.store.pop(key, None)
                     self._deliver("delete", obj)
         return last_rv
 
@@ -378,7 +398,7 @@ class RealAPIProvider(APIProvider):
         inf.handlers.append(handlers)
         if self._started and inf.synced.is_set():
             # late registration replays the store (client-go semantics)
-            for obj in list(inf.store.values()):
+            for obj in inf.snapshot():
                 if handlers.filter_fn is not None and not handlers.filter_fn(obj):
                     continue
                 if handlers.add_fn:
@@ -405,13 +425,13 @@ class RealAPIProvider(APIProvider):
                     f"informer {inf.informer.value} did not sync in {timeout}s")
 
     def list_pods(self) -> List[Pod]:
-        return list(self._informers[InformerType.POD].store.values())
+        return self._informers[InformerType.POD].snapshot()
 
     def list_nodes(self) -> List[Node]:
-        return list(self._informers[InformerType.NODE].store.values())
+        return self._informers[InformerType.NODE].snapshot()
 
     def list_priority_classes(self) -> List[PriorityClass]:
-        return list(self._informers[InformerType.PRIORITY_CLASS].store.values())
+        return self._informers[InformerType.PRIORITY_CLASS].snapshot()
 
 
 def load_bootstrap_configmaps(client: RealKubeClient, namespace: str):

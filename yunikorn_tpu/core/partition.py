@@ -80,6 +80,10 @@ class Partition:
         self.applications: Dict[str, CoreApplication] = {}
         self.nodes: Dict[str, CoreNode] = {}
         self.foreign_allocations: Dict[str, Allocation] = {}  # key -> allocation
+        # bumped whenever node membership changes; capacity memos depend on
+        # it in multi-partition mode (the cache's capacity_version alone
+        # doesn't see which partition a node landed in)
+        self.membership_gen = 0
         # set when a config reload drops this partition: existing work drains,
         # no new apps and no new scheduling cycles
         self.draining = False

@@ -115,8 +115,8 @@ class CoreScheduler(SchedulerAPI):
         # submitted (the shim replays pods during InitializeState, app
         # submission happens on the first pump tick) — park them here
         self._pending_restores: Dict[str, List[Allocation]] = {}
-        # per-partition (capacity_version, total) memo
-        self._cap_cache: Dict[str, Tuple[int, Resource]] = {}
+        # per-partition ((capacity_version, membership_gen), total) memo
+        self._cap_cache: Dict[str, Tuple[Tuple[int, int], Resource]] = {}
         # asks we already preempted for → timestamp; prevents stacking fresh
         # victims every cycle while the previous evictions drain
         self._preempted_for: Dict[str, float] = {}
@@ -253,6 +253,7 @@ class CoreScheduler(SchedulerAPI):
                         occupied=info.occupied_resource or Resource(),
                     )
                     self.partition.nodes[nid] = node
+                    self.partition.membership_gen += 1
                     self.encoder.set_node_schedulable(nid, node.schedulable)
                     for alloc in info.existing_allocations:
                         self._restore_allocation(alloc)
@@ -277,7 +278,8 @@ class CoreScheduler(SchedulerAPI):
                         node.schedulable = False
                         self.encoder.set_node_schedulable(nid, False)
                 elif info.action == NodeAction.DECOMISSION:
-                    self.partition.nodes.pop(nid, None)
+                    if self.partition.nodes.pop(nid, None) is not None:
+                        self.partition.membership_gen += 1
                     self.encoder.set_node_schedulable(nid, False)
         if (resp.accepted or resp.rejected) and self.callback is not None:
             self.callback.update_node(resp)
@@ -431,12 +433,16 @@ class CoreScheduler(SchedulerAPI):
     def _track_foreign(self, alloc: Allocation) -> None:
         # The shim re-sends a foreign allocation whenever (node, resource)
         # changes; un-count the tracked predecessor or occupied drifts up on
-        # every update/move.
-        prev = self.partition.foreign_allocations.get(alloc.allocation_key)
-        if prev is not None:
-            old_node = self.partition.nodes.get(prev.node_id)
-            if old_node is not None:
-                old_node.occupied = old_node.occupied.sub(prev.resource)
+        # every update/move. The predecessor may live in a DIFFERENT partition
+        # (the pod moved nodes across a partition boundary), so search all of
+        # them like _release_allocation does.
+        for part in self.partitions.values():
+            prev = part.foreign_allocations.pop(alloc.allocation_key, None)
+            if prev is not None:
+                old_node = part.nodes.get(prev.node_id)
+                if old_node is not None:
+                    old_node.occupied = old_node.occupied.sub(prev.resource)
+                break
         self.partition.foreign_allocations[alloc.allocation_key] = alloc
         node = self.partition.nodes.get(alloc.node_id)
         if node is not None:
@@ -825,7 +831,13 @@ class CoreScheduler(SchedulerAPI):
         """Total allocatable of the ACTIVE partition, memoized by the cache's
         capacity version (bumped only on node add/remove/update, not pod
         churn — 10k nodes would otherwise cost a Python reduce per cycle)."""
-        gen = self.cache.capacity_version()
+        # include the partition's node-membership generation: registering a
+        # node into a partition changes its capacity without bumping the
+        # cache's version (nodes land in the cache before core registration).
+        # The partition count matters too — single-partition mode sums ALL
+        # cache nodes, multi-partition filters by membership.
+        gen = (self.cache.capacity_version(), self.partition.membership_gen,
+               len(self.partitions) > 1)
         cached = self._cap_cache.get(self.partition.name)
         if cached is not None and cached[0] == gen:
             return cached[1]
