@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Hardware A/B: Pallas fused best-node kernel vs the XLA path, on a real TPU.
+
+Runs in phases, printing one JSON line per result as it lands (the relay can
+die mid-run; earlier phases' evidence survives):
+
+  phase 1 — kernel validation: pallas_best_nodes vs the XLA chunked path on
+            random problems at several shapes, on-device (not interpret).
+  phase 2 — solve-level A/B at a mid bucket (8k pods x 2k nodes), plain and
+            locality-bearing batches: compile time + warm median for both
+            paths; asserts identical assignments.
+  phase 3 — solve-level A/B at the north-star bucket (50k x 10k), plain batch.
+
+Usage: python scripts/tpu_ab.py [--skip-big]
+Writes docs/PALLAS_AB.json with everything it measured.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = []
+OUT_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "docs", "PALLAS_AB.json")
+
+
+def emit(rec):
+    rec = dict(rec)
+    RESULTS.append(rec)
+    print(json.dumps(rec), flush=True)
+    try:
+        with open(OUT_PATH, "w") as f:
+            json.dump(RESULTS, f, indent=1)
+    except OSError:
+        pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-big", action="store_true")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    emit({"phase": "init", "platform": platform, "devices": len(devs),
+          "secs": round(time.time() - t0, 1)})
+    if platform != "tpu":
+        emit({"phase": "abort", "reason": "not a tpu backend"})
+        return 1
+
+    from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
+
+    ensure_compilation_cache()
+
+    # ---------------------------------------------------------------- phase 1
+    from yunikorn_tpu.ops.assign import _best_nodes_chunked
+    from yunikorn_tpu.ops.pallas_kernels import pallas_best_nodes
+
+    rng = np.random.default_rng(7)
+    for (N, M, G, R) in ((512, 512, 8, 6), (2048, 1024, 64, 6), (8192, 2048, 256, 6)):
+        req = rng.integers(1, 50, size=(N, R)).astype(np.int32)
+        gid = rng.integers(0, G, size=(N,)).astype(np.int32)
+        feas = rng.random((G, M)) < 0.7
+        soft = (rng.integers(-8, 8, size=(G, M)) / 4.0).astype(np.float32)
+        free = rng.integers(0, 200, size=(M, R)).astype(np.int32)
+        cap = np.maximum(free, 1).astype(np.int32)
+        base = (rng.integers(0, 64, size=(M,)) / 8.0).astype(np.float32)
+        try:
+            tpb0 = time.time()
+            pb, pf = pallas_best_nodes(jnp.asarray(req), jnp.asarray(gid),
+                                       jnp.asarray(feas), jnp.asarray(soft),
+                                       jnp.asarray(free), jnp.asarray(base),
+                                       has_soft=True)
+            pb.block_until_ready()
+            t_compile = time.time() - tpb0
+            xb, xf = _best_nodes_chunked(jnp.asarray(req), jnp.asarray(gid),
+                                         jnp.asarray(feas), jnp.asarray(soft),
+                                         jnp.asarray(free), jnp.asarray(cap),
+                                         jnp.asarray(base), min(512, N), "binpacking")
+            pb, pf, xb, xf = (np.asarray(a) for a in (pb, pf, xb, xf))
+            match_f = bool((pf == xf).all())
+            match_b = bool((pb[pf] == xb[pf]).all()) if pf.any() else True
+            emit({"phase": "kernel-validate", "shape": [N, M, G, R],
+                  "feasible_match": match_f, "best_match": match_b,
+                  "compile_s": round(t_compile, 1)})
+            if not (match_f and match_b):
+                diff = int((pb[pf] != xb[pf]).sum()) if pf.any() else 0
+                emit({"phase": "kernel-validate-detail", "shape": [N, M, G, R],
+                      "mismatches": diff})
+        except Exception as e:
+            emit({"phase": "kernel-validate", "shape": [N, M, G, R],
+                  "error": f"{type(e).__name__}: {e}"[:500]})
+            # kernel broken on hardware: no point timing the solve paths
+            emit({"phase": "abort", "reason": "kernel failed on device"})
+            return 2
+
+    # ------------------------------------------------------- batch builders
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.common.objects import TopologySpreadConstraint
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    def build_env(n_nodes, n_pods, with_loc):
+        cache = SchedulerCache()
+        for i, node in enumerate(make_kwok_nodes(n_nodes)):
+            node.metadata.labels["zone"] = f"z{i % 4}"
+            cache.update_node(node)
+        enc = SnapshotEncoder(cache)
+        enc.sync_nodes(full=True)
+        pods = make_sleep_pods(n_pods, "ab", queue="root.ab")
+        if with_loc:
+            for p in pods[: n_pods // 8]:
+                p.metadata.labels["grp"] = "spread"
+                p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+                    max_skew=1, topology_key="zone",
+                    when_unsatisfiable="DoNotSchedule",
+                    label_selector={"matchLabels": {"grp": "spread"}})]
+        asks = [AllocationAsk(p.uid, "ab", get_pod_resource(p), pod=p)
+                for p in pods]
+        return enc, enc.build_batch(asks)
+
+    def time_solve(enc, batch, use_pallas, reps=3):
+        tc0 = time.time()
+        r = solve_batch(batch, enc.nodes, use_pallas=use_pallas)
+        r.block_until_ready()
+        compile_s = time.time() - tc0
+        times = []
+        for _ in range(reps):
+            t1 = time.time()
+            r = solve_batch(batch, enc.nodes, use_pallas=use_pallas)
+            r.block_until_ready()
+            times.append(time.time() - t1)
+        return r, compile_s, sorted(times)[len(times) // 2]
+
+    # ---------------------------------------------------------------- phase 2
+    for with_loc in (False, True):
+        enc, batch = build_env(2048, 8192, with_loc)
+        try:
+            rx, cx, wx = time_solve(enc, batch, use_pallas=False)
+            rp, cp, wp = time_solve(enc, batch, use_pallas=True)
+            ax = np.asarray(rx.assigned)[: batch.num_pods]
+            ap = np.asarray(rp.assigned)[: batch.num_pods]
+            emit({"phase": "solve-ab-8kx2k", "locality": with_loc,
+                  "xla": {"compile_s": round(cx, 1), "warm_s": round(wx, 4)},
+                  "pallas": {"compile_s": round(cp, 1), "warm_s": round(wp, 4)},
+                  "identical": bool((ax == ap).all()),
+                  "assigned_xla": int((ax >= 0).sum()),
+                  "assigned_pallas": int((ap >= 0).sum())})
+        except Exception as e:
+            emit({"phase": "solve-ab-8kx2k", "locality": with_loc,
+                  "error": f"{type(e).__name__}: {e}"[:500]})
+
+    # ---------------------------------------------------------------- phase 3
+    if not args.skip_big:
+        enc, batch = build_env(10_000, 50_000, False)
+        for name, up in (("xla", False), ("pallas", True)):
+            try:
+                r, cs, ws = time_solve(enc, batch, use_pallas=up, reps=3)
+                emit({"phase": "solve-ab-50kx10k", "path": name,
+                      "compile_s": round(cs, 1), "warm_s": round(ws, 4),
+                      "assigned": int((np.asarray(r.assigned)[: batch.num_pods] >= 0).sum())})
+            except Exception as e:
+                emit({"phase": "solve-ab-50kx10k", "path": name,
+                      "error": f"{type(e).__name__}: {e}"[:500]})
+
+    emit({"phase": "done", "total_secs": round(time.time() - t0, 1)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

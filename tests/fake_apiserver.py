@@ -114,6 +114,7 @@ class FakeAPIServer:
             #   /api/v1/namespaces/{ns}/{kind}/{name}[/{sub}]
             #   /api/v1/{kind}/{name}            (cluster-scoped core)
             #   /apis/{group}/{ver}/{kind}/{name} (cluster-scoped grouped)
+            #   /apis/{group}/{ver}/namespaces/{ns}/{kind}/{name} (namespaced grouped)
             def _object_path(self, parts):
                 """Returns (coll, ns, name, subresource) or None."""
                 if len(parts) >= 6 and parts[0] == "api" and parts[2] == "namespaces":
@@ -121,6 +122,11 @@ class FakeAPIServer:
                     if coll and _NAMESPACED.get(coll):
                         sub = parts[6] if len(parts) > 6 else ""
                         return coll, parts[3], parts[5], sub
+                if len(parts) >= 7 and parts[0] == "apis" and parts[3] == "namespaces":
+                    coll = _coll_of(parts[5])
+                    if coll and _NAMESPACED.get(coll):
+                        sub = parts[7] if len(parts) > 7 else ""
+                        return coll, parts[4], parts[6], sub
                 if len(parts) == 4 and parts[0] == "api":
                     coll = _coll_of(parts[2])
                     if coll and not _NAMESPACED.get(coll, True):
@@ -141,11 +147,15 @@ class FakeAPIServer:
                 coll = _COLLECTIONS.get(parsed.path)
                 ns_scope = ""
                 if coll is None and parsed.path.count("/namespaces/") == 1:
-                    # namespaced LIST, e.g. /api/v1/namespaces/ns/configmaps
+                    # namespaced LIST: /api/v1/namespaces/ns/configmaps or
+                    # /apis/g/v/namespaces/ns/resourceclaims
                     parts = parsed.path.strip("/").split("/")
-                    if len(parts) == 5 and parts[2] == "namespaces":
+                    if len(parts) == 5 and parts[0] == "api" and parts[2] == "namespaces":
                         coll = _coll_of(parts[4])
                         ns_scope = parts[3]
+                    elif len(parts) == 6 and parts[0] == "apis" and parts[3] == "namespaces":
+                        coll = _coll_of(parts[5])
+                        ns_scope = parts[4]
                 if coll is not None:
                     if q.get("watch", ["false"])[0] == "true":
                         rv = int(q.get("resourceVersion", ["0"])[0] or 0)
@@ -236,11 +246,17 @@ class FakeAPIServer:
                     node = (body.get("target") or {}).get("name", "")
                     server.bind_pod(ns, name, node)
                     return self._send_json({"kind": "Status", "status": "Success"}, 201)
-                # namespaced collection create
-                if len(parts) == 5 and parts[2] == "namespaces":
-                    coll = _SEGMENT_TO_COLL.get(parts[4])
+                # namespaced collection create — core (/api/v1/namespaces/ns/k)
+                # or grouped (/apis/g/v/namespaces/ns/k)
+                ns = kind_seg = None
+                if len(parts) == 5 and parts[0] == "api" and parts[2] == "namespaces":
+                    ns, kind_seg = parts[3], parts[4]
+                elif len(parts) == 6 and parts[0] == "apis" and parts[3] == "namespaces":
+                    ns, kind_seg = parts[4], parts[5]
+                if kind_seg is not None:
+                    coll = _coll_of(kind_seg)
                     if coll is not None:
-                        body.setdefault("metadata", {}).setdefault("namespace", parts[3])
+                        body.setdefault("metadata", {}).setdefault("namespace", ns)
                         server.add(coll, body)
                         return self._send_json(body, 201)
                 # cluster-scoped collection create
@@ -375,6 +391,11 @@ class FakeAPIServer:
             doc = self.store[coll].pop(key, None)
             if doc is not None:
                 self._rv += 1
+                # the event object must carry the DELETE's rv: reflectors
+                # resume from the last event's metadata.resourceVersion, and
+                # a stale rv would make the replay buffer re-deliver
+                # everything since the object was last written
+                doc.setdefault("metadata", {})["resourceVersion"] = str(self._rv)
                 self._emit(coll, "DELETED", doc)
 
     def bind_pod(self, namespace: str, name: str, node: str) -> None:

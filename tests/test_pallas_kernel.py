@@ -139,6 +139,68 @@ def test_solve_with_pallas_and_soft_terms():
     assert gold_share(a2) == 16
 
 
+def test_solve_with_pallas_locality_batch():
+    """Round-3: locality constraints no longer bypass the fused kernel — the
+    per-round rules/scores are hoisted into the kernel's [G, M] feasibility and
+    soft inputs (VERDICT r2 item 3: the old `not has_loc` gate excluded every
+    affinity/spread-bearing workload). The pallas path must match the XLA path
+    assignment-for-assignment and honor the locality semantics."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import (Affinity, PodAffinityTerm,
+                                             TopologySpreadConstraint,
+                                             make_node, make_pod)
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for i in range(12):
+        cache.update_node(make_node(
+            f"n{i}", cpu_milli=8000, memory=8 * 2**30,
+            labels={"zone": f"z{i % 3}", "kubernetes.io/hostname": f"n{i}"}))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = []
+    for i in range(18):  # hard spread over 3 zones
+        p = make_pod(f"sp{i}", cpu_milli=400, memory=2**26)
+        p.metadata.labels["grp"] = "spread"
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", when_unsatisfiable="DoNotSchedule",
+            label_selector={"matchLabels": {"grp": "spread"}})]
+        pods.append(p)
+    for i in range(6):   # anti-affinity: one per hostname
+        p = make_pod(f"an{i}", cpu_milli=400, memory=2**26)
+        p.metadata.labels["grp"] = "anti"
+        p.spec.affinity = Affinity(pod_anti_affinity_required=[PodAffinityTerm(
+            label_selector={"matchLabels": {"grp": "anti"}},
+            topology_key="kubernetes.io/hostname")])
+        pods.append(p)
+    asks = [AllocationAsk(p.uid, "a", get_pod_resource(p), pod=p) for p in pods]
+    batch = enc.build_batch(asks)
+    assert batch.locality is not None
+    ref = solve_batch(batch, enc.nodes, chunk=32)
+    pal = solve_batch(batch, enc.nodes, chunk=32, use_pallas=True,
+                      pallas_interpret=True)
+    a1 = np.asarray(ref.assigned)[: batch.num_pods]
+    a2 = np.asarray(pal.assigned)[: batch.num_pods]
+    np.testing.assert_array_equal(a1, a2)
+    assert (a1 >= 0).all()
+    # locality semantics hold on the pallas result: spread balanced across
+    # zones within maxSkew, anti pods on distinct hostnames
+    zone_counts = {}
+    hosts = set()
+    for i, idx in enumerate(a2):
+        name = enc.nodes.name_of(int(idx))
+        zone = int(name[1:]) % 3
+        if i < 18:
+            zone_counts[zone] = zone_counts.get(zone, 0) + 1
+        else:
+            assert name not in hosts, "anti-affinity violated on pallas path"
+            hosts.add(name)
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
 @pytest.mark.parametrize("seed", [7])
 def test_pallas_no_soft_variant_matches(seed):
     """has_soft=False (no soft DMA/matmul) must equal the soft variant with a

@@ -92,3 +92,59 @@ def make_mixed_binpack_pods(
         )
         pods.append(pod)
     return pods
+
+
+def make_rich_constraint_pods(
+    n_plain: int,
+    n_spread: int = 0,
+    n_anti: int = 0,
+    n_hostmask: int = 0,
+    n_soft: int = 0,
+    name_prefix: str = "",
+) -> List[Pod]:
+    """A constraint mix covering every solve channel: plain pods, hard
+    topology spread (locality), pod anti-affinity (locality), >MAX_TERMS node
+    affinity (host-mask fallback), and preferred node affinity (soft scores).
+    Shared by tests/test_parallel.py and __graft_entry__.dryrun_multichip so
+    the driver's multichip validation and CI cover the same channels.
+    Nodes are expected to carry zone (z0..z3) and kubernetes.io/hostname
+    labels (make_kwok_nodes + zone stamping, or make_node with labels).
+    """
+    from yunikorn_tpu.common.objects import (Affinity, NodeSelectorRequirement,
+                                             NodeSelectorTerm, PodAffinityTerm,
+                                             TopologySpreadConstraint)
+
+    pods = []
+    for i in range(n_plain):
+        pods.append(make_pod(f"{name_prefix}plain{i}",
+                             cpu_milli=100 + 50 * (i % 4), memory=2**26))
+    for i in range(n_spread):
+        p = make_pod(f"{name_prefix}spread{i}", cpu_milli=200, memory=2**26)
+        p.metadata.labels["grp"] = "spread"
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=2, topology_key="zone", when_unsatisfiable="DoNotSchedule",
+            label_selector={"matchLabels": {"grp": "spread"}})]
+        pods.append(p)
+    for i in range(n_anti):
+        p = make_pod(f"{name_prefix}anti{i}", cpu_milli=200, memory=2**26)
+        p.metadata.labels["grp"] = "anti"
+        p.spec.affinity = Affinity(pod_anti_affinity_required=[PodAffinityTerm(
+            label_selector={"matchLabels": {"grp": "anti"}},
+            topology_key="kubernetes.io/hostname")])
+        pods.append(p)
+    for i in range(n_hostmask):
+        p = make_pod(f"{name_prefix}hostm{i}", cpu_milli=200, memory=2**26)
+        # 9 OR terms > snapshot.encoder.MAX_TERMS (8): the whole affinity
+        # falls back to the host-mask channel
+        p.spec.affinity = Affinity(node_required_terms=[
+            NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("zone", "In", [f"z{t % 4}"])])
+            for t in range(9)])
+        pods.append(p)
+    for i in range(n_soft):
+        p = make_pod(f"{name_prefix}soft{i}", cpu_milli=200, memory=2**26)
+        p.spec.affinity = Affinity(node_preferred_terms=[
+            (50, NodeSelectorTerm(match_expressions=[
+                NodeSelectorRequirement("zone", "In", ["z1"])]))])
+        pods.append(p)
+    return pods

@@ -85,8 +85,11 @@ def main(argv=None) -> int:
             for node in make_kwok_nodes(args.nodes):
                 cluster.add_node(node)
 
+    from yunikorn_tpu.core.scheduler import SolverOptions
+
     cache = SchedulerCache()
-    core = CoreScheduler(cache)
+    core = CoreScheduler(cache,
+                         solver_options=SolverOptions.from_conf(holder.get()))
     context = Context(cluster, core, cache=cache)
     shim = KubernetesShim(cluster, core, context=context)
     rest = RestServer(core, context, port=args.rest_port)
@@ -99,7 +102,7 @@ def main(argv=None) -> int:
     if args.prewarm:
         from yunikorn_tpu.utils.jaxtools import prewarm_buckets
 
-        prewarm_buckets(args.prewarm)
+        prewarm_buckets(args.prewarm, core=core)
 
     stop = threading.Event()
 

@@ -52,6 +52,8 @@ CM_SOLVER_MAX_ROUNDS = PREFIX_SOLVER + "maxAssignRounds"
 CM_SOLVER_POD_CHUNK = PREFIX_SOLVER + "podChunk"
 CM_SOLVER_SCORING_POLICY = PREFIX_SOLVER + "scoringPolicy"
 CM_SOLVER_DEVICE_PLATFORM = PREFIX_SOLVER + "platform"
+CM_SOLVER_USE_PALLAS = PREFIX_SOLVER + "usePallas"     # auto | true | false
+CM_SOLVER_SHARD = PREFIX_SOLVER + "shardSolve"         # auto | true | false
 
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
@@ -86,11 +88,16 @@ class SchedulerConf:
     namespace: str = "yunikorn"
     operator_plugins: str = "general"
     placeholder: PlaceholderConfig = dataclasses.field(default_factory=PlaceholderConfig)
-    # --- solver knobs ---
-    solver_max_rounds: int = 32
-    solver_pod_chunk: int = 1024
+    # --- solver knobs --- (defaults match ops.assign.solve_batch so the
+    # prewarm buckets and the production cycle share compiled variants)
+    solver_max_rounds: int = 16
+    solver_pod_chunk: int = 512
     solver_scoring_policy: str = "binpacking"  # binpacking | fair | spread
     solver_platform: str = ""                  # "" = jax default; "cpu" forces host
+    # tri-state device-path gates: "auto" resolves against the live backend
+    # at first solve (pallas: TPU only; shard: >1 visible device)
+    solver_use_pallas: str = "auto"
+    solver_shard: str = "auto"
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -198,6 +205,15 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
         conf.solver_max_rounds = _parse_int(data[CM_SOLVER_MAX_ROUNDS], conf.solver_max_rounds)
     if CM_SOLVER_POD_CHUNK in data:
         conf.solver_pod_chunk = _parse_int(data[CM_SOLVER_POD_CHUNK], conf.solver_pod_chunk)
+    for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
+                      (CM_SOLVER_SHARD, "solver_shard")):
+        if key in data:
+            v = data[key].strip().lower()
+            if v in ("auto", "true", "false"):
+                setattr(conf, attr, v)
+            else:
+                logger.warning("invalid tri-state value %r for %s, keeping %s",
+                               data[key], key, getattr(conf, attr))
     return conf
 
 

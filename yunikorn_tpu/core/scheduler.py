@@ -78,15 +78,54 @@ logger = log("core.scheduler")
 DEFAULT_PLACEHOLDER_TIMEOUT = 15 * 60.0  # core default when the app sets none
 COMPLETING_TIMEOUT = 30.0  # Running app with nothing left → Completed after this
 
+# Whether solver.usePallas=auto turns the fused kernel on for TPU backends.
+# Flipped by the hardware A/B (docs/PERF.md): stays False until the kernel
+# measurably beats the XLA path on a real chip.
+PALLAS_TPU_DEFAULT = False
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class SolverOptions:
+    """Device-path knobs for the batched solve (conf solver.* keys).
+
+    use_pallas / shard are tri-state: None = "auto", resolved once against
+    the live backend at the first scheduling cycle — pallas only on a real
+    TPU backend (the kernel targets Mosaic; on CPU the interpret path would
+    be strictly slower than XLA), shard only when >1 device is visible.
+    Defaults match ops.assign.solve_batch so the prewarm buckets and the
+    production cycle compile the same static variants.
+    """
+    max_rounds: int = 16
+    chunk: int = 512
+    use_pallas: Optional[bool] = None
+    shard: Optional[bool] = None
+
+    @classmethod
+    def from_conf(cls, conf) -> "SolverOptions":
+        tri = {"auto": None, "true": True, "false": False}
+        return cls(
+            max_rounds=conf.solver_max_rounds,
+            chunk=conf.solver_pod_chunk,
+            use_pallas=tri.get(conf.solver_use_pallas, None),
+            shard=tri.get(conf.solver_shard, None),
+        )
+
 
 class CoreScheduler(SchedulerAPI):
     """One partition, one solver. Thread-safe via a single core lock."""
 
     def __init__(self, cache: SchedulerCache, interval: float = 0.1,
-                 solver_policy: Optional[str] = None):
+                 solver_policy: Optional[str] = None,
+                 solver_options: Optional[SolverOptions] = None):
         self._lock = locking.RMutex()
         self.cache = cache
         self.encoder = SnapshotEncoder(cache)
+        self.solver = solver_options or SolverOptions()
+        self._solver_resolved = False
+        self._use_pallas = False
+        self._mesh = None
         # Multi-partition: self.partition / self.queues are the ACTIVE
         # pointers (set per request/cycle under the core lock); the dicts hold
         # every partition the config or node attributes named. The single
@@ -115,8 +154,8 @@ class CoreScheduler(SchedulerAPI):
         # submitted (the shim replays pods during InitializeState, app
         # submission happens on the first pump tick) — park them here
         self._pending_restores: Dict[str, List[Allocation]] = {}
-        # per-partition ((capacity_version, membership_gen), total) memo
-        self._cap_cache: Dict[str, Tuple[Tuple[int, int], Resource]] = {}
+        # per-partition ((capacity_version, membership_gen, multi), total) memo
+        self._cap_cache: Dict[str, Tuple[Tuple[int, int, bool], Resource]] = {}
         # asks we already preempted for → timestamp; prevents stacking fresh
         # victims every cycle while the previous evictions drain
         self._preempted_for: Dict[str, float] = {}
@@ -568,6 +607,54 @@ class CoreScheduler(SchedulerAPI):
             self._publish_cycle(payload)
         return total
 
+    def _resolve_solver_runtime(self) -> None:
+        """Resolve the tri-state device-path gates once, at first solve.
+
+        Deferred to here (not __init__) so constructing a CoreScheduler never
+        dials the TPU relay — the backend comes up on the first cycle, which
+        is also where the first compile lands anyway. Takes the core lock
+        (reentrant, so calling from inside the cycle is fine): the prewarm
+        thread resolves concurrently with the pump's first cycle.
+        """
+        with self._lock:
+            self._resolve_solver_runtime_locked()
+
+    def _resolve_solver_runtime_locked(self) -> None:
+        if self._solver_resolved:
+            return
+        from yunikorn_tpu.utils.jaxtools import backend_or_cpu
+
+        platform = backend_or_cpu()
+        so = self.solver
+        self._use_pallas = (platform == "tpu" and PALLAS_TPU_DEFAULT
+                            if so.use_pallas is None else so.use_pallas)
+        import jax
+
+        n_dev = len(jax.devices())
+        # auto-shard only on real accelerators: the CPU test environment
+        # pins 8 virtual devices, and sharding every unit test's solve over
+        # them would be pure overhead — tests opt in with shard=True
+        want_shard = (n_dev > 1 and platform == "tpu") if so.shard is None else so.shard
+        if want_shard and n_dev > 1:
+            from yunikorn_tpu.parallel.mesh import make_mesh
+
+            # largest power-of-two device prefix: NodeArrays capacities are
+            # powers of two (min 128), so divisibility holds whenever the
+            # mesh size is a power of two ≤ capacity — a non-2^k device
+            # count must not wedge every cycle on the M % n_dev assertion
+            mesh_n = 1 << (n_dev.bit_length() - 1)
+            self._mesh = make_mesh(jax.devices()[:mesh_n])
+            # sharded solves stay on the XLA path (see mesh.solve_sharded)
+            self._use_pallas = False
+            logger.info("solver: node-dim sharding over %d/%d %s devices",
+                        mesh_n, n_dev, platform)
+        else:
+            self._mesh = None
+        logger.info("solver runtime: platform=%s pallas=%s mesh=%s",
+                    platform, self._use_pallas,
+                    n_dev if self._mesh is not None else "off")
+        self._solver_resolved = True
+
     def _partition_node_mask(self):
         """[capacity] bool mask restricting the solve to this partition's
         nodes (multi-partition only; the encoder holds the whole cache)."""
@@ -606,8 +693,21 @@ class CoreScheduler(SchedulerAPI):
             policy = (self._policy if self._policy_forced or
                       self.partition.name == "default"
                       else self._partition_policy.get(self.partition.name, self._policy))
-            result = solve_batch(batch, self.encoder.nodes, policy=policy,
-                                 free_delta=overlay, node_mask=node_mask)
+            self._resolve_solver_runtime()
+            so = self.solver
+            if (self._mesh is not None
+                    and self.encoder.nodes.capacity % self._mesh.devices.size == 0):
+                from yunikorn_tpu.parallel.mesh import solve_sharded
+
+                result = solve_sharded(batch, self.encoder.nodes, self._mesh,
+                                       max_rounds=so.max_rounds, chunk=so.chunk,
+                                       policy=policy, free_delta=overlay,
+                                       node_mask=node_mask)
+            else:
+                result = solve_batch(batch, self.encoder.nodes, policy=policy,
+                                     max_rounds=so.max_rounds, chunk=so.chunk,
+                                     use_pallas=self._use_pallas,
+                                     free_delta=overlay, node_mask=node_mask)
             import numpy as np
 
             # materializing the result is the device sync point: everything

@@ -173,9 +173,12 @@ def _loc_soft_scores(gid_rows, dom_cols, loc, cnt, minc, contrib_rows):
 
 
 def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
-                        base_scores, chunk: int, policy: str, loc=None, cnt=None,
-                        minc=None, total=None, has_loc_soft=True):
-    """For every pod: (best node, any feasible?) without materializing [N, M]."""
+                        base_scores, chunk: int, policy: str):
+    """For every pod: (best node, any feasible?) without materializing [N, M].
+
+    Locality rules/scores arrive pre-folded into group_feas/group_soft (the
+    per-round [G, M] hoist in `solve`), so this stage is pure gather + fit.
+    """
     N, R = req.shape
     M = free.shape[0]
     n_chunks = N // chunk
@@ -191,11 +194,6 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
             margin = jnp.minimum(margin, free[:, r][None, :] - creq[:, r][:, None])
         ok = cfeas & (margin >= 0)
         scores = jnp.broadcast_to(base_scores[None, :], (chunk, M)) + group_soft[cgid]
-        if loc is not None:
-            ccontrib = lax.dynamic_slice(loc[3], (start, 0), (chunk, loc[3].shape[1]))
-            ok &= _loc_rules_mask(cgid, None, loc, cnt, minc, total, ccontrib)
-            if has_loc_soft:
-                scores = scores + _loc_soft_scores(cgid, None, loc, cnt, minc, ccontrib)
         if policy == "align":
             scores = scores + alignment_scores(creq, free, capacity)
         scores = jnp.where(ok, scores, NEG_INF)
@@ -208,8 +206,8 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
 
 
 def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
-                          base_scores, group_soft, loc=None, cnt=None,
-                          minc=None, group_contrib=None):
+                          base_scores, group_soft, g_rr_dom=None,
+                          g_capped=None):
     """Capacity-aware proposals: the batched analog of "fill nodes in score order".
 
     Plain per-pod argmax herds every pod in a constraint group onto the same
@@ -220,6 +218,15 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
     capacity first covers pod i's cumulative demand. For homogeneous pods this
     reproduces exact sequential bin-packing in ONE round.
 
+    Groups under a per-domain locality cap (hard spread / anti-affinity —
+    g_capped, with g_rr_dom [G, M] giving each node's domain for the group's
+    tightest capped slot) take ROUND-ROBIN proposals instead: the group's
+    k-th pod goes to the k-th node of an ordering that rotates across domains
+    (best node of each domain, then second-best of each, ...). Capacity fill
+    would pile a whole group onto one node → one domain → the accept cap
+    trims it to ~1 pod/round; rotation lets a balanced spread land in one
+    round (paired with the level-fill accept cap).
+
     Returns proposals [N] int32 (node row, or M when the group's total
     capacity is exhausted before this pod's position).
     """
@@ -229,35 +236,59 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
 
     # rank order of pods (global; group-wise prefix sums are masked cumsums)
     pod_order = jnp.argsort(rank)
-    sreq = req[pod_order].astype(jnp.float32)                  # [N, R]
+    sreq = req[pod_order]                                      # [N, R] int32
     sgid = group_id[pod_order]
     sactive = active[pod_order]
+    idx_m = jnp.arange(M, dtype=jnp.int32)
 
     def per_group(g):
         feas = group_feas[g]                                   # [M]
-        score = base_scores + group_soft[g]
-        if loc is not None:
-            score = score + _loc_soft_scores(
-                jnp.reshape(g, (1,)), None, loc, cnt, minc,
-                group_contrib[jnp.reshape(g, (1,))])[0]
-        score = jnp.where(feas, score, NEG_INF)
+        score = jnp.where(feas, base_scores + group_soft[g], NEG_INF)
         node_order = jnp.argsort(-score)                       # feasible first
-        ofree = jnp.where(feas[node_order, None], free[node_order].astype(jnp.float32), 0.0)
-        cumF = jnp.cumsum(ofree, axis=0)                       # [M, R]
+        # int32 cumsums: exact regardless of how GSPMD associates the scan —
+        # an f32 cumsum loses integrality past 2^24, which would make the
+        # sharded solve diverge from single-device at >2k-node scale. Bounds:
+        # cluster-wide free per resource (device units) must stay < 2^31,
+        # same contract as the segment prefix sums (module docstring).
+        ofree = jnp.where(feas[node_order, None],
+                          jnp.maximum(free[node_order], 0), 0)
+        cumF = jnp.cumsum(ofree, axis=0, dtype=jnp.int32)      # [M, R]
         mine = sactive & (sgid == g)
-        demand = jnp.where(mine[:, None], sreq, 0.0)
-        C = jnp.cumsum(demand, axis=0)                         # [N, R] inclusive
+        demand = jnp.where(mine[:, None], sreq, 0)
+        C = jnp.cumsum(demand, axis=0, dtype=jnp.int32)        # [N, R] inclusive
         pos = jnp.zeros((N,), jnp.int32)
         for r in range(R):
-            # both sides are monotone; sort-based rank beats binary-search
-            # gathers on TPU by ~4x
+            # both sides are monotone (free clamped ≥0); side="left" finds the
+            # first node whose cumulative capacity covers this pod's demand;
+            # sort-based rank beats binary-search gathers on TPU by ~4x
             pos = jnp.maximum(
                 pos,
-                jnp.searchsorted(cumF[:, r], C[:, r] - 0.5, method="sort").astype(jnp.int32),
+                jnp.searchsorted(cumF[:, r], C[:, r], side="left",
+                                 method="sort").astype(jnp.int32),
             )
         ok = pos < M
         node = jnp.where(ok & mine, node_order[jnp.clip(pos, 0, M - 1)], M)
-        return jnp.where(mine, node, M).astype(jnp.int32)
+        wf_prop = jnp.where(mine, node, M).astype(jnp.int32)
+        if g_rr_dom is None:
+            return wf_prop
+        # ---- round-robin proposals for locality-capped groups ----
+        dom_s = g_rr_dom[g][node_order]                        # [M] in score order
+        ord2 = jnp.argsort(dom_s, stable=True)                 # domains together
+        k2 = dom_s[ord2]
+        seg_start = jnp.concatenate([jnp.array([True]), k2[1:] != k2[:-1]])
+        head = lax.cummax(jnp.where(seg_start, idx_m, 0))
+        within = idx_m - head                                  # rank inside domain
+        wr = jnp.zeros((M,), jnp.int32).at[ord2].set(within)
+        # rotate across domains tier by tier (wr primary), but inside a tier
+        # keep SCORE order (idx_m = position in score order): the best node
+        # of the best-scoring domain leads, so soft preferences still steer
+        wr_eff = jnp.where(feas[node_order], wr, jnp.int32(2**30))
+        rr_order = node_order[jnp.lexsort((idx_m, wr_eff))]
+        n_feas = jnp.sum(feas.astype(jnp.int32))
+        kk = jnp.cumsum(mine.astype(jnp.int32)) - 1            # within-group rank
+        rr_node = rr_order[jnp.clip(kk % jnp.maximum(n_feas, 1), 0, M - 1)]
+        rr_prop = jnp.where(mine & (n_feas > 0), rr_node, M).astype(jnp.int32)
+        return jnp.where(g_capped[g], rr_prop, wf_prop)
 
     per_group_nodes = jax.vmap(per_group)(jnp.arange(G))       # [G, N] in sorted pod order
     chosen_sorted = jnp.min(per_group_nodes, axis=0)           # each pod active in ≤1 group
@@ -267,9 +298,10 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
 
 
 def _loc_capped_flags(loc):
-    """Per locality group: is it referenced by a spread/anti (capped) slot,
-    by an affinity slot (for seeding caps), or by a ScheduleAnyway spread
-    slot (for the balance allowance)? Computed once per solve."""
+    """Per locality group: which slot kinds reference it, and the tightest
+    spread skew across referencing slots. Computed once per solve.
+
+    Returns (spread_l, aff_l, soft_spread_l, anti_l, min_skew_l)."""
     from yunikorn_tpu.snapshot.locality import (
         KIND_AFFINITY,
         KIND_ANTI_AFFINITY,
@@ -278,51 +310,81 @@ def _loc_capped_flags(loc):
     )
 
     loc_dom = loc[0]
-    g_refs, g_kind = loc[4], loc[5]
+    g_refs, g_kind, g_skew = loc[4], loc[5], loc[6]
     L = loc_dom.shape[0]
-    capped = []
+    big = jnp.int32(2**30)
+    spread = []
     aff = []
     soft_spread = []
+    anti = []
+    min_skew = []
     for l in range(L):
         ref_l = g_refs == l
-        capped.append(jnp.any(ref_l & ((g_kind == KIND_SPREAD) | (g_kind == KIND_ANTI_AFFINITY))))
+        is_spread = ref_l & (g_kind == KIND_SPREAD)
+        spread.append(jnp.any(is_spread))
+        anti.append(jnp.any(ref_l & (g_kind == KIND_ANTI_AFFINITY)))
         aff.append(jnp.any(ref_l & (g_kind == KIND_AFFINITY)))
         soft_spread.append(jnp.any(ref_l & (g_kind == KIND_SOFT_SPREAD)))
-    return jnp.stack(capped), jnp.stack(aff), jnp.stack(soft_spread)
+        min_skew.append(jnp.min(jnp.where(is_spread, g_skew, big)))
+    return (jnp.stack(spread), jnp.stack(aff), jnp.stack(soft_spread),
+            jnp.stack(anti), jnp.stack(min_skew))
 
 
-def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total,
-                    capped_l, aff_l, allowance_l):
+def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, cnt, total,
+                    spread_l, aff_l, anti_l, min_skew_l, allowance_l):
     """Cap accepted pods contributing to a locality group per (group, domain)
-    per round: 1 for hard spread/anti groups, `allowance_l` (≈ remaining /
-    domains) for ScheduleAnyway spread groups so a batch balances without
-    throttling throughput.
+    per round so that between-round count updates cannot overshoot.
 
     Contribution — not the pod's own constraint slots — is what changes the
     counts, so the cap keys on contrib: a plain pod whose labels match another
     pod's anti-affinity selector is capped alongside it (symmetry holds even
-    within one round). Affinity groups cap only while *seeding* (total==0),
-    and then per GROUP (one domain seeds per round) so a self-affinitized
-    group cannot split across domains.
+    within one round). Per-kind caps:
 
-    Counts only update between rounds; without this cap several pods could
-    land in one domain in a single round and overshoot maxSkew or violate
-    anti-affinity. One-per-domain-per-round is exact for anti-affinity and
-    converges for spread.
+    - anti-affinity: 1 per domain (a second pod in the same domain would see
+      cnt>0 only next round — exact).
+    - affinity while *seeding* (total==0): 1 per GROUP (one domain seeds per
+      round) so a self-affinitized group cannot split across domains.
+    - hard spread: LEVEL FILL — jointly choose per-domain accepts a_d from
+      the tentative counts t_d by the fixed point
+          level = skew + min_valid_d(cnt_d + a_d),  a_d = min(t_d, level - cnt_d)
+      Final counts then satisfy max_d - min_d <= skew even if some domains
+      accept nothing (their cnt pins the min). A balanced batch fills in ONE
+      round instead of 1-per-domain-per-round — 18 pods / 3 zones / skew 1
+      lands in one round, not six (round-3 throughput fix).
+    - ScheduleAnyway spread: `allowance_l` (≈ remaining/domains) as before.
     """
-    loc_dom = loc[0]
+    loc_dom, dom_valid = loc[0], loc[2]
     L, _ = loc_dom.shape
+    D = cnt.shape[1]
     N = accept_sorted.shape[0]
+    big = jnp.int32(2**30)
     idx = jnp.arange(N, dtype=jnp.int32)
     node_cl = jnp.clip(snode, 0, M - 1)
     for l in range(L):
         seeding = aff_l[l] & (total[l] == 0)
-        cap_now = (allowance_l[l] < N) | seeding
-        limit = jnp.where(capped_l[l] | seeding, 1, allowance_l[l])
+        capped = spread_l[l] | anti_l[l] | seeding | (allowance_l[l] < N)
         dom_i = loc_dom[l, node_cl]                                    # [N]
-        active = cap_now & scontrib[:, l] & (dom_i >= 0) & (snode < M) & accept_sorted
-        # seeding caps per GROUP (key 0); spread/anti per domain
+        active = capped & scontrib[:, l] & (dom_i >= 0) & (snode < M) & accept_sorted
+        dom_cl = jnp.clip(dom_i, 0, D - 1)
+        # tentative per-domain accept counts for this group
+        t = jnp.zeros((D,), jnp.int32).at[dom_cl].add(active.astype(jnp.int32))
+        # hard-spread level fill (monotone fixed point; iterations bound the
+        # level from above, so early exit is safe-by-construction)
+        cl = cnt[l]
+        valid = dom_valid[l]
+        skew = jnp.where(min_skew_l[l] < big, min_skew_l[l], 0)
+        level = skew + jnp.min(jnp.where(valid, cl + t, big))
+        for _ in range(8):
+            a_sp = jnp.minimum(t, jnp.maximum(level - cl, 0))
+            level = skew + jnp.min(jnp.where(valid, cl + a_sp, big))
+        a_spread = jnp.minimum(t, jnp.maximum(level - cl, 0))          # [D]
+        limit_d = jnp.full((D,), N, jnp.int32)
+        limit_d = jnp.where(allowance_l[l] < N, allowance_l[l], limit_d)
+        limit_d = jnp.where(spread_l[l], jnp.minimum(limit_d, a_spread), limit_d)
+        limit_d = jnp.where(anti_l[l], jnp.minimum(limit_d, 1), limit_d)
+        # seeding caps per GROUP (key 0, limit 1); others per domain
         key = jnp.where(active, jnp.where(seeding, 0, dom_i), (M + 2) + idx)
+        limit_row = jnp.where(seeding, 1, limit_d[dom_cl])             # [N]
         order2 = jnp.argsort(key)                                      # stable
         k2 = key[order2]
         act2 = active[order2]
@@ -331,7 +393,7 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, total,
         head = lax.cummax(jnp.where(seg_start, idx, 0))
         base = jnp.where(head > 0, c[jnp.maximum(head - 1, 0)], 0)
         within = c - base                                              # inclusive
-        keep2 = (~act2) | (within <= limit)
+        keep2 = (~act2) | (within <= limit_row[order2])
         keep = jnp.zeros((N,), bool).at[order2].set(keep2)
         accept_sorted = accept_sorted & keep
     return accept_sorted
@@ -408,9 +470,13 @@ def solve(
     provably sums to zero when every g_weight is 0.
 
     use_pallas routes the per-round best-node computation through the fused
-    Pallas kernel (ops/pallas_kernels.py). Only separable scoring policies are
-    fused and locality constraints fall back to the XLA path (they need the
-    dynamic per-round masks).
+    Pallas kernel (ops/pallas_kernels.py). Locality batches work too: the
+    dynamic per-round rules/scores are hoisted to [G, M] adjustments (pods in
+    a group share locality state by construction — the constraint-group
+    signature folds pod labels in whenever locality applies,
+    snapshot/locality.py locality_signature) and folded into the kernel's
+    feasibility/soft inputs. Only the align policy (per-pod alignment scores)
+    stays on the XLA path.
     """
     N, R = req.shape
     M = free.shape[0]
@@ -435,21 +501,43 @@ def solve(
     has_loc = loc is not None
     free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
     cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
+    # the pallas kernel needs its soft input whenever the per-round hoist
+    # folds soft-locality scores into it (both flags are static)
+    pallas_soft = pallas_has_soft or has_loc_soft
     if has_loc:
-        loc_capped_l, loc_aff_l, loc_softspread_l = _loc_capped_flags(loc)
+        (loc_spread_l, loc_aff_l, loc_softspread_l, loc_anti_l,
+         loc_min_skew_l) = _loc_capped_flags(loc)
         # per-group contribution flags (all pods in a group share them — the
-        # signature folds labels in whenever locality applies): lets the
-        # water-fill score soft locality per group
-        if has_loc_soft:
-            G = group_feas.shape[0]
-            L = loc[0].shape[0]
-            group_contrib = (jnp.zeros((G, L), jnp.int32)
-                             .at[group_id].max(loc[3].astype(jnp.int32))
-                             .astype(bool))
-        else:
-            group_contrib = None
+        # signature folds labels in whenever locality applies): locality
+        # rules/scores are evaluated once per round per GROUP, [G, L] → [G, M]
+        G = group_feas.shape[0]
+        L = loc[0].shape[0]
+        group_contrib = (jnp.zeros((G, L), jnp.int32)
+                         .at[group_id].max(loc[3].astype(jnp.int32))
+                         .astype(bool))
+        # per-group round-robin domain rows for the water-fill: the first
+        # hard-spread/anti slot's locality group defines the domain partition
+        # its proposals rotate across; -1 row = plain capacity fill
+        from yunikorn_tpu.snapshot.locality import (
+            KIND_ANTI_AFFINITY as _K_ANTI,
+            KIND_SPREAD as _K_SPREAD,
+        )
+
+        g_refs_t, g_kind_t = loc[4], loc[5]
+        S = g_refs_t.shape[1]
+        l_ref = jnp.full((G,), -1, jnp.int32)
+        for s in range(S - 1, -1, -1):  # first capped slot wins
+            is_capped_slot = (((g_kind_t[:, s] == _K_SPREAD) |
+                               (g_kind_t[:, s] == _K_ANTI)) &
+                              (g_refs_t[:, s] >= 0))
+            l_ref = jnp.where(is_capped_slot, g_refs_t[:, s], l_ref)
+        g_capped = l_ref >= 0
+        g_rr_dom = jnp.where(g_capped[:, None],
+                             loc[0][jnp.clip(l_ref, 0, L - 1)], -1)
     else:
         group_contrib = None
+        g_capped = None
+        g_rr_dom = None
     init = (
         free_ext0,
         ~valid,                                     # "done" = assigned or invalid
@@ -471,34 +559,40 @@ def solve(
         active = ~done
         if has_loc:
             minc, total = _loc_round_stats(loc, cnt)
+            # hoist: locality rules/scores per GROUP for this round — one
+            # [G, M] mask/adjustment shared by every downstream stage
+            gidx = jnp.arange(group_feas.shape[0], dtype=jnp.int32)
+            loc_mask_g = _loc_rules_mask(gidx, None, loc, cnt, minc, total,
+                                         group_contrib)               # [G, M]
+            feas_round = group_feas & loc_mask_g
+            soft_round = (group_soft + _loc_soft_scores(gidx, None, loc, cnt,
+                                                        minc, group_contrib)
+                          if has_loc_soft else group_soft)
         else:
-            minc = total = None
+            loc_mask_g = None
+            feas_round, soft_round = group_feas, group_soft
 
-        proposals = _water_fill_proposals(req, group_id, rank, active, group_feas,
-                                          cur_free, base_scores, group_soft,
-                                          loc if has_loc_soft else None,
-                                          cnt, minc, group_contrib)
+        proposals = _water_fill_proposals(req, group_id, rank, active, feas_round,
+                                          cur_free, base_scores, soft_round,
+                                          g_rr_dom, g_capped)
         prop_fits = jnp.all(free_ext[proposals] >= req, axis=1) & (proposals < M)
         if has_loc:
             # proposals must also satisfy the dynamic locality rules
-            prop_fits &= _loc_rules_mask(group_id, jnp.clip(proposals, 0, M - 1),
-                                         loc, cnt, minc, total, loc[3])
+            prop_fits &= loc_mask_g[group_id, jnp.clip(proposals, 0, M - 1)]
 
         def with_argmax(_):
             # exact per-pod argmax; guarantees ≥1 accept per contended node
-            if use_pallas and not has_loc and policy != "align":
+            if use_pallas and policy != "align":
                 from yunikorn_tpu.ops.pallas_kernels import pallas_best_nodes
 
                 best, feasible = pallas_best_nodes(
-                    req, group_id, group_feas, group_soft, cur_free,
+                    req, group_id, feas_round, soft_round, cur_free,
                     base_scores, interpret=pallas_interpret,
-                    has_soft=pallas_has_soft)
+                    has_soft=pallas_soft)
             else:
                 best, feasible = _best_nodes_chunked(
-                    req, group_id, group_feas, group_soft, cur_free, capacity,
-                    base_scores, chunk, policy, loc, cnt, minc, total,
-                    has_loc_soft,
-                )
+                    req, group_id, feas_round, soft_round, cur_free, capacity,
+                    base_scores, chunk, policy)
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
 
@@ -521,11 +615,12 @@ def solve(
             remaining = jnp.sum((active[:, None] & loc[3]).astype(jnp.int32), axis=0)
             n_dom = jnp.maximum(jnp.sum(loc[2].astype(jnp.int32), axis=1), 1)
             soft_allow = jnp.maximum((remaining + n_dom - 1) // n_dom, 1)
-            allowance_l = jnp.where(loc_capped_l, 1,
+            allowance_l = jnp.where(loc_spread_l | loc_anti_l, N,
                                     jnp.where(loc_softspread_l, soft_allow, N))
             accept_sorted = _loc_accept_cap(accept_sorted, snode, loc[3][order],
-                                            loc, M, total, loc_capped_l,
-                                            loc_aff_l, allowance_l)
+                                            loc, M, cnt, total,
+                                            loc_spread_l, loc_aff_l, loc_anti_l,
+                                            loc_min_skew_l, allowance_l)
         # commit accepted capacity
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
         free_ext = free_ext.at[snode].add(-delta)
@@ -556,20 +651,18 @@ def pad2d(arr, width, fill):
     return out
 
 
-def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
-                free_delta=None, use_pallas=False, pallas_interpret=False,
-                device=None, node_mask=None,
-                compile_only=False) -> Optional[SolveResult]:
-    """Convenience host wrapper: numpy in → SolveResult out.
+def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
+    """Assemble the positional numpy args + static kwargs for `solve`.
+
+    Shared by solve_batch (single device) and parallel.mesh.solve_sharded
+    (node-dim GSPMD) so the two paths cannot drift: same dtype views, same
+    overlay/mask handling, same static-variant selection.
 
     free_delta: optional [capacity, R] float array subtracted from node free
     capacity before the solve (the core's in-flight allocation overlay).
     node_mask: optional [capacity] bool restricting candidate nodes (the
     multi-partition case: one encoder holds every cache node, each
     partition's solve sees only its own).
-    compile_only: AOT-lower and compile this shape/static-variant without
-    executing (bucket prewarm) — fills the jit + persistent caches at zero
-    device time; returns None.
     """
     import numpy as np
 
@@ -623,22 +716,41 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         host_soft,
         loc,
     )
-    solve_kwargs = dict(
-        max_rounds=max_rounds,
-        chunk=chunk,
-        policy=policy,
-        # the fused kernel takes the combined [G, M] soft adjustment (soft
-        # taints + preferred affinity + host-scored terms); only dynamic
-        # locality and the align policy fall back to the XLA path (handled
-        # inside solve)
-        use_pallas=use_pallas,
-        pallas_interpret=pallas_interpret,
+    static_kwargs = dict(
         has_loc_soft=(batch.locality is not None
                       and bool(np.any(batch.locality.g_weight))),
         # no-soft batches take the kernel variant without the soft DMA/matmul
         pallas_has_soft=(bool(batch.g_pref_weight.any())
                          or host_soft is not None
                          or bool(np.any(na.taints_soft))),
+    )
+    return np_args, static_kwargs
+
+
+def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
+                free_delta=None, use_pallas=False, pallas_interpret=False,
+                device=None, node_mask=None,
+                compile_only=False) -> Optional[SolveResult]:
+    """Convenience host wrapper: numpy in → SolveResult out.
+
+    See prepare_solve_args for free_delta / node_mask semantics.
+    compile_only: AOT-lower and compile this shape/static-variant without
+    executing (bucket prewarm) — fills the jit + persistent caches at zero
+    device time; returns None.
+    """
+    np_args, static_kwargs = prepare_solve_args(
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask)
+    solve_kwargs = dict(
+        max_rounds=max_rounds,
+        chunk=chunk,
+        policy=policy,
+        # the fused kernel takes the combined [G, M] soft adjustment (soft
+        # taints + preferred affinity + host-scored terms + per-round hoisted
+        # locality scores); only the align policy falls back to the XLA path
+        # (handled inside solve)
+        use_pallas=use_pallas,
+        pallas_interpret=pallas_interpret,
+        **static_kwargs,
     )
     if compile_only:
         # specs instead of arrays: no host->device transfer at all

@@ -49,76 +49,65 @@ def _shardings(mesh: Mesh):
 
 def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                   chunk: int = 512, policy: str = "binpacking",
-                  free_delta=None) -> assign_mod.SolveResult:
+                  free_delta=None, node_mask=None,
+                  compile_only: bool = False) -> Optional[assign_mod.SolveResult]:
     """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
 
     M must be divisible by the mesh size (NodeArrays capacities are powers of
-    two, meshes are 2^k chips, so this holds by construction).
+    two, meshes are 2^k chips, so this holds by construction). Arg assembly
+    (dtype views, inflight overlay, partition node_mask, static-variant
+    selection) is shared with the single-device path via prepare_solve_args,
+    so the production scheduler can route here without semantic drift. The
+    sharded program stays on the XLA path (no pallas): pallas_call under
+    GSPMD auto-partitioning would need a shard_map wrapper, and the sharded
+    argmax-over-M already reduces over ICI.
     """
     na = node_arrays
     n_dev = mesh.devices.size
     M = na.capacity
     assert M % n_dev == 0, f"node capacity {M} not divisible by mesh size {n_dev}"
     node_s, node_s2, repl = _shardings(mesh)
-
-    free_i = np.floor(na.free).astype(np.int32)
-    if free_delta is not None:
-        d = np.zeros_like(free_i)
-        rows = min(free_i.shape[0], free_delta.shape[0])
-        cols = min(free_i.shape[1], free_delta.shape[1])
-        d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
-        free_i = free_i - d
-    node_ok = na.valid & na.schedulable
-
-    put = jax.device_put
-    args = (
-        put(batch.req.astype(np.int32), repl),
-        put(batch.group_id, repl),
-        put(batch.rank, repl),
-        put(batch.valid, repl),
-        put(batch.g_term_req, repl),
-        put(batch.g_term_forb, repl),
-        put(batch.g_term_valid, repl),
-        put(batch.g_anyof, repl),
-        put(batch.g_anyof_valid, repl),
-        put(batch.g_tol, repl),
-        put(batch.g_ports, repl),
-        put(batch.g_pref_req, repl),
-        put(batch.g_pref_forb, repl),
-        put(batch.g_pref_weight, repl),
-        put(na.labels, node_s2),
-        put(na.taints_hard, node_s2),
-        put(na.taints_soft, node_s2),
-        put(na.ports, node_s2),
-        put(node_ok, node_s),
-        put(free_i, node_s2),
-        put(np.floor(na.capacity_arr).astype(np.int32), node_s2),
-    )
     group_node_s = NamedSharding(mesh, P(None, NODE_AXIS))
-    host_mask = batch.g_host_mask
-    mask_arg = (put(assign_mod.pad2d(host_mask, M, False), group_node_s)
-                if host_mask is not None else None)
-    host_soft = getattr(batch, "g_host_soft", None)
-    soft_arg = (put(assign_mod.pad2d(host_soft, M, np.float32(0.0)), group_node_s)
-                if host_soft is not None else None)
 
-    loc_arg = None
-    if batch.locality is not None:
-        lb = batch.locality
-        # locality tables ride replicated: tiny relative to the node arrays,
-        # and the per-round count updates are global reductions anyway
-        loc_arg = tuple(
-            put(a, repl) for a in (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
-                                   lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed,
-                                   lb.g_weight)
-        )
+    np_args, static_kwargs = assign_mod.prepare_solve_args(
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask)
+    (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
+     g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
+     g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
+     free_i, cap_i, host_mask, host_soft, loc) = np_args
 
+    if compile_only:
+        # AOT-lower with sharded input specs (no transfer, no execution):
+        # fills the jit + persistent caches with exactly the program the
+        # production sharded cycle runs (bucket prewarm)
+        put = lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
+    else:
+        put = jax.device_put
+    args = (
+        put(req, repl), put(group_id, repl), put(rank, repl), put(valid, repl),
+        put(g_term_req, repl), put(g_term_forb, repl), put(g_term_valid, repl),
+        put(g_anyof, repl), put(g_anyof_valid, repl),
+        put(g_tol, repl), put(g_ports, repl),
+        put(g_pref_req, repl), put(g_pref_forb, repl), put(g_pref_weight, repl),
+        put(labels, node_s2), put(taints_hard, node_s2),
+        put(taints_soft, node_s2), put(ports, node_s2),
+        put(node_ok, node_s), put(free_i, node_s2), put(cap_i, node_s2),
+    )
+    mask_arg = put(host_mask, group_node_s) if host_mask is not None else None
+    soft_arg = put(host_soft, group_node_s) if host_soft is not None else None
+    # locality tables ride replicated: tiny relative to the node arrays,
+    # and the per-round count updates are global reductions anyway
+    loc_arg = tuple(put(a, repl) for a in loc) if loc is not None else None
+
+    solve_kwargs = dict(
+        max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
+        policy=policy, has_loc_soft=static_kwargs["has_loc_soft"],
+    )
     with mesh:
+        if compile_only:
+            assign_mod.solve.lower(
+                *args, mask_arg, soft_arg, loc_arg, **solve_kwargs).compile()
+            return None
         assigned, free_after, rounds = assign_mod.solve(
-            *args, mask_arg, soft_arg, loc_arg,
-            max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
-            policy=policy,
-            has_loc_soft=(batch.locality is not None
-                          and bool(np.any(batch.locality.g_weight))),
-        )
+            *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

@@ -43,7 +43,11 @@ class MockScheduler:
         dispatch_mod.reset_dispatcher()
         self.cluster = FakeCluster()
         cache = SchedulerCache()
-        self.core = CoreScheduler(cache, interval=core_interval)
+        from yunikorn_tpu.core.scheduler import SolverOptions
+
+        self.core = CoreScheduler(
+            cache, interval=core_interval, solver_policy=solver_policy,
+            solver_options=SolverOptions.from_conf(holder.get()))
         self.context = Context(self.cluster, self.core, cache=cache)
         self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
 

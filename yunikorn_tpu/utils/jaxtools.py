@@ -59,7 +59,8 @@ def backend_or_cpu() -> str:
         return jax.devices("cpu")[0].platform
 
 
-def prewarm_buckets(spec: str, results: "list | None" = None) -> "object":
+def prewarm_buckets(spec: str, results: "list | None" = None,
+                    core=None) -> "object":
     """Compile standard solve buckets in a background thread.
 
     spec: comma-separated "NODESxPODS" pairs (e.g. "1024x4096,16384x65536").
@@ -71,7 +72,13 @@ def prewarm_buckets(spec: str, results: "list | None" = None) -> "object":
     the first-cycle compile stall (~minutes at the 50k bucket). Exotic
     configurations (e.g. unusual locality domain counts) may still trigger a
     compile. Isolated caches/encoders; never touches live state. Returns the
-    daemon thread (join it in tests)."""
+    daemon thread (join it in tests).
+
+    core: the production CoreScheduler, when available — prewarm then
+    compiles the VARIANT production will run (conf-driven max_rounds/chunk,
+    sharded over the resolved mesh, pallas gate) instead of solve_batch
+    defaults, so the warmed cache entries actually match the first cycle's
+    program."""
     import threading
 
     def warm_bucket(n_nodes: int, n_pods: int) -> None:
@@ -106,11 +113,31 @@ def prewarm_buckets(spec: str, results: "list | None" = None) -> "object":
                 for p in pods]
         plain = enc.build_batch(asks[:-1])
         rich_batch = enc.build_batch(asks)
+        # resolve the production variant when a core was handed in; the
+        # no-core fallback takes SolverOptions() so defaults cannot drift
+        from yunikorn_tpu.core.scheduler import SolverOptions
+
+        so = SolverOptions()
+        use_pallas, mesh = False, None
+        if core is not None:
+            core._resolve_solver_runtime()
+            so = core.solver
+            use_pallas, mesh = core._use_pallas, core._mesh
+        max_rounds, chunk = so.max_rounds, so.chunk
         # AOT compile (no execution): both nodesort policies × plain and
         # soft/locality variants — the static combinations production uses
         for policy in ("binpacking", "spread"):
-            solve_batch(plain, enc.nodes, policy=policy, compile_only=True)
-            solve_batch(rich_batch, enc.nodes, policy=policy, compile_only=True)
+            for b in (plain, rich_batch):
+                if (mesh is not None
+                        and enc.nodes.capacity % mesh.devices.size == 0):
+                    from yunikorn_tpu.parallel.mesh import solve_sharded
+
+                    solve_sharded(b, enc.nodes, mesh, max_rounds=max_rounds,
+                                  chunk=chunk, policy=policy, compile_only=True)
+                else:
+                    solve_batch(b, enc.nodes, policy=policy,
+                                max_rounds=max_rounds, chunk=chunk,
+                                use_pallas=use_pallas, compile_only=True)
 
     def run():
         ensure_compilation_cache()
