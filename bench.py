@@ -22,6 +22,11 @@ import time
 N_NODES = int(os.environ.get("YK_BENCH_NODES", 10_000))
 N_PODS = int(os.environ.get("YK_BENCH_PODS", 50_000))
 TARGET_PODS_PER_S = 50_000.0  # north star: 50k pods in 1s
+# core  — the batched-solve cycle only (north-star configuration)
+# shim  — BindStats end-to-end: pods in via informer events, first→last bind
+#         (the reference's measurement, scheduler_perf_test.go:138-142)
+# both  — run core first (warms the compile caches), then shim; publish shim
+MODE = os.environ.get("YK_BENCH_MODE", "both")
 
 
 def _init_backend_or_die() -> str:
@@ -34,6 +39,16 @@ def _init_backend_or_die() -> str:
     further) but emit heartbeats to stderr so the run is diagnosable.
     """
     import threading
+
+    if os.environ.get("YK_BENCH_FORCE_CPU"):
+        # explicit CPU run (local testing): beat the axon plugin before any
+        # backend init — the env var alone cannot (plugin overrides it)
+        from yunikorn_tpu.utils.jaxtools import force_cpu_platform
+
+        force_cpu_platform(1)
+        import jax
+
+        return jax.devices()[0].platform
 
     t0 = time.time()
     done = threading.Event()
@@ -80,12 +95,58 @@ def _init_backend_or_die() -> str:
     return platform
 
 
+def run_shim_mode(shim_pods: int, shim_nodes: int):
+    """BindStats end-to-end: the full framework path — informer events →
+    app/task FSMs → dispatcher → core batched solve → AssumePod → bind pool →
+    FakeCluster binding — measured first-bind→last-bind like the reference's
+    BenchmarkSchedulingThroughPut (scheduler_perf_test.go:73-149).
+
+    Returns (pods_per_s, wall_s, bound, total)."""
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+
+    n_queues = 5
+    ms = MockScheduler()
+    # WARN logging: per-transition INFO lines would add ~6 log records per
+    # pod (300k at 50k pods) of pure formatting overhead to the measurement
+    ms.init(interval=0.05, core_interval=0.05,
+            conf_extra={"log.level": "WARN"})
+    try:
+        for node in make_kwok_nodes(shim_nodes):
+            ms.cluster.add_node(node)
+        pods = []
+        for q in range(n_queues):
+            pods.extend(make_sleep_pods(
+                shim_pods // n_queues, f"bench-shim-{q}", queue=f"root.q{q}",
+                name_prefix=f"sq{q}"))
+        # pods land before the shim starts: InitializeState replays them in
+        # creation order (recovery path), then the pump schedules everything
+        for p in pods:
+            ms.cluster.add_pod(p)
+        t_start = time.time()
+        ms.start()
+        deadline = t_start + float(os.environ.get("YK_BENCH_SHIM_TIMEOUT", 1800))
+        stats = ms.cluster.get_client().bind_stats
+        while time.time() < deadline:
+            if stats.success_count >= len(pods):
+                break
+            time.sleep(0.25)
+        wall = time.time() - t_start
+        return stats.throughput(), wall, stats.success_count, len(pods)
+    finally:
+        ms.stop()
+
+
 def main() -> int:
     platform = _init_backend_or_die()
 
     from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
 
     ensure_compilation_cache()
+
+    if MODE == "shim":
+        print(json.dumps(_shim_result(platform)))
+        return 0
 
     from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
     from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
@@ -187,16 +248,60 @@ def main() -> int:
         print(f"WARNING: only {n_warm}/{N_PODS} scheduled", file=sys.stderr)
 
     pods_per_s = n_warm / dt_warm if dt_warm > 0 else 0.0
+    print(f"# cold cycle: {n_cold} pods in {dt_cold:.2f}s; warm cycle: {n_warm} pods in {dt_warm:.3f}s",
+          file=sys.stderr)
+    timing = core.metrics.get("last_cycle") or {}
+    if timing:
+        print(f"# warm cycle split: {timing}", file=sys.stderr)
+
     result = {
         "metric": f"pods-scheduled/sec (e2e core cycle: quota+rank+encode+{platform} solve+commit; {N_NODES} nodes, {N_PODS} pods, 5 queues)",
         "value": round(pods_per_s, 1),
         "unit": "pods/s",
         "vs_baseline": round(pods_per_s / TARGET_PODS_PER_S, 3),
     }
+
+    if MODE == "both":
+        # BindStats end-to-end through the whole shim (the reference's own
+        # measurement methodology, scheduler_perf_test.go:138-142). The
+        # headline value/vs_baseline stay the core-cycle number — that is
+        # what BASELINE.json's north star (50k x 10k < 1s batched solve)
+        # defines the target against — with the shim-measured e2e riding in
+        # the same line so the comparable number is never hidden.
+        result = _shim_result(platform, core_pods_per_s=pods_per_s,
+                              core_warm_s=dt_warm)
     print(json.dumps(result))
-    print(f"# cold cycle: {n_cold} pods in {dt_cold:.2f}s; warm cycle: {n_warm} pods in {dt_warm:.3f}s",
-          file=sys.stderr)
     return 0
+
+
+def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None) -> dict:
+    """Run the BindStats shim mode and build the bench JSON for it. With a
+    core-cycle number, that stays the headline (north-star metric) and the
+    shim e2e rides along; standalone shim mode publishes the shim number."""
+    shim_tp, shim_wall, bound, total = run_shim_mode(N_PODS, N_NODES)
+    print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
+          f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
+    if core_pods_per_s is None:
+        return {
+            "metric": (f"pods-bound/sec (BindStats e2e: informers+FSMs+dispatcher+"
+                       f"{platform} solve+assume+bind; {N_NODES} nodes, {N_PODS} pods)"),
+            "value": round(shim_tp, 1),
+            "unit": "pods/s",
+            "vs_baseline": round(shim_tp / TARGET_PODS_PER_S, 3),
+            "shim_e2e_bound": bound,
+        }
+    return {
+        "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
+                   f"{platform} solve+commit; {N_NODES} nodes, {N_PODS} pods, "
+                   f"5 queues; BindStats shim e2e: {round(shim_tp, 1)} pods/s "
+                   f"host-bound)"),
+        "value": round(core_pods_per_s, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(core_pods_per_s / TARGET_PODS_PER_S, 3),
+        "shim_e2e_pods_per_s": round(shim_tp, 1),
+        "shim_e2e_bound": bound,
+        "core_cycle_warm_s": round(core_warm_s, 3),
+    }
 
 
 if __name__ == "__main__":

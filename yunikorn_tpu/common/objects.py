@@ -210,9 +210,42 @@ class Pod:
         return bool(self.spec.node_name)
 
     def deepcopy(self) -> "Pod":
-        import copy
+        """Fast structural clone for event old/new snapshots.
 
-        return copy.deepcopy(self)
+        Copies every layer the system mutates in place — metadata
+        label/annotation maps, spec scalars (node_name), container resource
+        maps (in-place resize), status phase/conditions — and SHARES the
+        immutable-by-K8s-convention constraint objects (affinity,
+        tolerations, topology spread constraints, volumes): changing those
+        in K8s is a pod replacement, never an in-place patch. ~20x cheaper
+        than copy.deepcopy's full graph walk, which dominated the shim
+        pipeline's host cost at 50k-pod scale (2 clones per bind).
+        """
+        md = self.metadata
+        new_md = dataclasses.replace(
+            md, labels=dict(md.labels), annotations=dict(md.annotations),
+            owner_references=list(md.owner_references))
+        sp = self.spec
+        new_spec = dataclasses.replace(
+            sp,
+            containers=[dataclasses.replace(
+                c, resources_requests=dict(c.resources_requests),
+                resources_limits=dict(c.resources_limits))
+                for c in sp.containers],
+            init_containers=[dataclasses.replace(
+                c, resources_requests=dict(c.resources_requests),
+                resources_limits=dict(c.resources_limits))
+                for c in sp.init_containers],
+            node_selector=dict(sp.node_selector),
+            scheduling_gates=list(sp.scheduling_gates),
+            resource_claims=list(sp.resource_claims),
+        )
+        st = self.status
+        new_status = dataclasses.replace(
+            st,
+            conditions=[dataclasses.replace(c) for c in st.conditions],
+            container_statuses=[dict(cs) for cs in st.container_statuses])
+        return Pod(metadata=new_md, spec=new_spec, status=new_status)
 
 
 # ---------------------------------------------------------------------------

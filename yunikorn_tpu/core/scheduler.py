@@ -105,9 +105,14 @@ class SolverOptions:
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
         tri = {"auto": None, "true": True, "false": False}
+        # chunk must divide the (power-of-two padded) batch size: round an
+        # operator-set value down to a power of two instead of letting
+        # solve()'s divisibility assert kill every scheduling cycle
+        chunk = max(int(conf.solver_pod_chunk), 1)
+        chunk = 1 << (chunk.bit_length() - 1)
         return cls(
-            max_rounds=conf.solver_max_rounds,
-            chunk=conf.solver_pod_chunk,
+            max_rounds=max(int(conf.solver_max_rounds), 1),
+            chunk=chunk,
             use_pallas=tri.get(conf.solver_use_pallas, None),
             shard=tri.get(conf.solver_shard, None),
         )
@@ -159,6 +164,9 @@ class CoreScheduler(SchedulerAPI):
         # asks we already preempted for → timestamp; prevents stacking fresh
         # victims every cycle while the previous evictions drain
         self._preempted_for: Dict[str, float] = {}
+        # ask-arrival counter observed at the last cycle start: lets the run
+        # loop skip the accumulation wait when nothing new arrived
+        self._seq_at_cycle = 0
         self._completing_since: Dict[str, float] = {}
         self._completing_timeout = COMPLETING_TIMEOUT
         self._running = threading.Event()
@@ -586,6 +594,24 @@ class CoreScheduler(SchedulerAPI):
                     self._wake.wait(timeout=self._interval)
                 self._dirty = False
             try:
+                # adaptive accumulation: while asks are still streaming in
+                # from the FSM pipeline, give them a tick to land so one
+                # cycle solves one big batch instead of many fragment waves
+                # (each wave pays full encode+solve overhead). Bounded: at
+                # most ~10 intervals (cap 0.5s), stops the moment the
+                # arrival counter goes quiet, and skipped entirely on idle
+                # cycles (no asks since the last cycle) so node/config wakes
+                # pay no extra latency.
+                if self._ask_seq != self._seq_at_cycle:
+                    deadline = time.time() + min(0.5, 10 * self._interval)
+                    prev = -1
+                    while self._running.is_set() and time.time() < deadline:
+                        cur = self._ask_seq
+                        if cur == prev:
+                            break
+                        prev = cur
+                        time.sleep(min(self._interval / 2, 0.02))
+                self._seq_at_cycle = self._ask_seq
                 self.schedule_once()
             except Exception:
                 logger.exception("scheduling cycle failed")

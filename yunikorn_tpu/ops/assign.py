@@ -245,17 +245,22 @@ def _water_fill_proposals(req, group_id, rank, active, group_feas, free,
         feas = group_feas[g]                                   # [M]
         score = jnp.where(feas, base_scores + group_soft[g], NEG_INF)
         node_order = jnp.argsort(-score)                       # feasible first
-        # int32 cumsums: exact regardless of how GSPMD associates the scan —
-        # an f32 cumsum loses integrality past 2^24, which would make the
-        # sharded solve diverge from single-device at >2k-node scale. Bounds:
-        # cluster-wide free per resource (device units) must stay < 2^31,
-        # same contract as the segment prefix sums (module docstring).
-        ofree = jnp.where(feas[node_order, None],
-                          jnp.maximum(free[node_order], 0), 0)
-        cumF = jnp.cumsum(ofree, axis=0, dtype=jnp.int32)      # [M, R]
+        # SATURATING int32 scans: exact below the cap, monotone always, and
+        # integer-assoc — bit-identical under any GSPMD sharding (an f32
+        # cumsum loses integrality past 2^24; a plain int32 cumsum WRAPS at
+        # cluster scale: 10k nodes x 256GiB in MiB units = 2.6e9 > 2^31,
+        # breaking searchsorted's monotonicity precondition). Saturating add
+        # min(a+b, CAP) is associative for non-negatives; positions past the
+        # saturation point degrade to a conservative proposal that prop_fits
+        # re-checks, so correctness never depends on the cap.
+        CAP = jnp.int32(2**30 - 1)
+        sat_add = lambda a, b: jnp.minimum(a + b, CAP)
+        ofree = jnp.minimum(jnp.where(feas[node_order, None],
+                                      jnp.maximum(free[node_order], 0), 0), CAP)
+        cumF = lax.associative_scan(sat_add, ofree, axis=0)    # [M, R]
         mine = sactive & (sgid == g)
-        demand = jnp.where(mine[:, None], sreq, 0)
-        C = jnp.cumsum(demand, axis=0, dtype=jnp.int32)        # [N, R] inclusive
+        demand = jnp.minimum(jnp.where(mine[:, None], sreq, 0), CAP)
+        C = lax.associative_scan(sat_add, demand, axis=0)      # [N, R] inclusive
         pos = jnp.zeros((N,), jnp.int32)
         for r in range(R):
             # both sides are monotone (free clamped ≥0); side="left" finds the
