@@ -16,6 +16,7 @@ the serialization point the TPU design removes (SURVEY.md L2 note).
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional
 
 from yunikorn_tpu.locking import locking
@@ -58,51 +59,182 @@ logger = log("shim.context")
 
 
 class VolumeBinder:
-    """Volume binding seam (reference volumebinding.NewVolumeBinder with the
-    10-minute bind timeout, apifactory.go:92-165; FindPodVolumes/
-    AssumePodVolumes semantics in context.go:747-827).
+    """Provider-agnostic volume binder (reference volumebinding.NewVolumeBinder
+    with the 10-minute bind timeout, apifactory.go:92-165; FindPodVolumes/
+    AssumePodVolumes/bindPodVolumes semantics in context.go:747-827).
 
-    The in-repo implementation binds against the FakeCluster PVC store; a
-    real-K8s adapter replaces this with the scheduler-framework volume binder.
+    State is informer-fed — Context routes PVC/PV/StorageClass events here —
+    and writes go through the KubeClient volume-update methods, so the same
+    binder drives the in-memory FakeCluster and the real HTTP adapter.
+
+    - find_pod_volumes(pod, node): feasibility at assume time — every claim
+      is known and either bound (its PV's node affinity matching the node),
+      statically matchable to an Available PV, or dynamically provisionable
+      through its StorageClass.
+    - assume_pod_volumes: reserve the static PV picks in-memory so parallel
+      assumes cannot double-commit one PV.
+    - bind_pod_volumes: static picks get PV.claimRef + PVC.volumeName written
+      through the API; WaitForFirstConsumer claims get the
+      volume.kubernetes.io/selected-node annotation and wait for the external
+      provisioner; everything then waits (bounded by bind_timeout) until the
+      informer stream reports the claim Bound.
     """
 
-    def __init__(self, api_provider: APIProvider, bind_timeout: float = 600.0):
+    def __init__(self, api_provider: APIProvider, cache: SchedulerCache,
+                 bind_timeout: float = 600.0):
         self.api = api_provider
+        self.cache = cache                      # PVC/PV/SC single source
         self.bind_timeout = bind_timeout
+        self._lock = locking.Mutex()
+        self._reserved: Dict[str, str] = {}     # pv name -> claim key
 
-    def all_bound(self, pod: Pod) -> bool:
-        if not any(v.pvc_claim_name for v in pod.spec.volumes):
-            return True
-        get_pvc = getattr(self.api, "get_pvc", None)
-        if get_pvc is None:
-            return True
-        return all(
-            (pvc := get_pvc(pod.namespace, v.pvc_claim_name)) is not None and pvc.bound
-            for v in pod.spec.volumes if v.pvc_claim_name
-        )
-
-    def bind_pod_volumes(self, pod: Pod) -> None:
-        """Bind all of the pod's unbound PVCs (AssumePodVolumes + bind)."""
-        bind_pvc = getattr(self.api, "bind_pvc", None)
-        get_pvc = getattr(self.api, "get_pvc", None)
-        if bind_pvc is None or get_pvc is None:
-            return
-        import time as _time
-
-        deadline = _time.time() + self.bind_timeout
+    # ------------------------------------------------------------- internals
+    def _claims(self, pod: Pod):
         for v in pod.spec.volumes:
-            if not v.pvc_claim_name:
+            if v.pvc_claim_name:
+                yield f"{pod.namespace}/{v.pvc_claim_name}"
+
+    def _get_pvc(self, key: str):
+        ns, name = key.split("/", 1)
+        pvc = self.cache.get_pvc_obj(ns, name)
+        if pvc is not None:
+            return pvc
+        # informer may not have synced yet: fall through to the provider
+        get = getattr(self.api, "get_pvc", None)
+        return get(ns, name) if get is not None else None
+
+    def _match_pv(self, pvc, node, claim_key: str):
+        """Smallest Available PV satisfying the claim on this node."""
+        from yunikorn_tpu.common.volumes import pv_matches_claim
+
+        with self._lock:
+            candidates = [pv for pv in self.cache.list_pv_objs()
+                          if pv_matches_claim(pv, pvc, node, claim_key,
+                                              reserved=self._reserved.get)]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda pv: (pv.capacity, pv.metadata.name))
+
+    # ------------------------------------------------------------ public API
+    def all_bound(self, pod: Pod) -> bool:
+        for key in self._claims(pod):
+            pvc = self._get_pvc(key)
+            if pvc is None or not pvc.bound:
+                return False
+        return True
+
+    def find_pod_volumes(self, pod: Pod, node) -> bool:
+        """FindPodVolumes: can every claim be satisfied on this node?"""
+        for key in self._claims(pod):
+            pvc = self._get_pvc(key)
+            if pvc is None:
+                return False                    # unknown claim: unschedulable
+            if pvc.bound:
+                from yunikorn_tpu.common.volumes import node_matches_pv_affinity
+
+                pv = self.cache.get_pv_obj(pvc.volume_name)
+                if pv is not None and not node_matches_pv_affinity(pv, node):
+                    return False                # volume not reachable here
                 continue
-            while _time.time() < deadline:
-                pvc = get_pvc(pod.namespace, v.pvc_claim_name)
-                if pvc is not None:
-                    if not pvc.bound:
-                        bind_pvc(pod.namespace, v.pvc_claim_name)
+            if self._match_pv(pvc, node, key) is not None:
+                continue                        # static binding possible
+            sc = self.cache.get_storage_class_obj(pvc.storage_class)
+            if sc is not None and not sc.provisioner:
+                return False                    # class exists, cannot provision
+            # class unknown (informer lag / legacy provider): optimistic —
+            # dynamic provisioning is attempted and the 10-min bind timeout
+            # is the enforcement, mirroring the reference's bind-time failure
+            # handling rather than its PreFilter rejection
+        return True
+
+    def assume_pod_volumes(self, pod: Pod, node) -> None:
+        """Reserve static PV picks so parallel assumes can't share a PV."""
+        for key in self._claims(pod):
+            pvc = self._get_pvc(key)
+            if pvc is None or pvc.bound:
+                continue
+            pv = self._match_pv(pvc, node, key)
+            if pv is not None:
+                with self._lock:
+                    self._reserved[pv.metadata.name] = key
+
+    def release_pod_volumes(self, pod: Pod) -> None:
+        """Drop assume-time PV reservations held for this pod's claims
+        (forget path, and cleanup after a completed bind)."""
+        keys = set(self._claims(pod))
+        if not keys:
+            return
+        with self._lock:
+            for pv_name, holder in list(self._reserved.items()):
+                if holder in keys:
+                    del self._reserved[pv_name]
+
+    def bind_pod_volumes(self, pod: Pod, node_name: str = "") -> None:
+        """Bind every unbound claim, then wait until the API reports Bound.
+
+        Writes go through the API on COPIES — the informer echo of a
+        successful write is what updates the caches, so a failed PUT leaves
+        no phantom "Bound" state behind (real-adapter transient errors)."""
+        import dataclasses as _dc
+
+        client = self.api.get_client()
+        info = self.cache.get_node(node_name) if node_name else None
+        node = info.node if info is not None else None
+        waiting = []
+        for key in self._claims(pod):
+            pvc = self._get_pvc(key)
+            if pvc is None:
+                raise RuntimeError(f"pvc {key} disappeared before bind")
+            if pvc.bound:
+                continue
+            # prefer the PV reserved for this claim at assume time
+            pv = None
+            with self._lock:
+                for pv_name, holder in self._reserved.items():
+                    if holder == key:
+                        pv = self.cache.get_pv_obj(pv_name)
+                        break
+            if pv is None:
+                pv = self._match_pv(pvc, node, key)
+            update_pvc = getattr(client, "update_pvc", None)
+            update_pv = getattr(client, "update_pv", None)
+            if pv is not None and update_pv is not None and update_pvc is not None:
+                update_pv(_dc.replace(pv, claim_ref=key, phase="Bound"))
+                update_pvc(_dc.replace(
+                    pvc, volume_name=pv.metadata.name, bound=True,
+                    metadata=_dc.replace(
+                        pvc.metadata,
+                        annotations=dict(pvc.metadata.annotations))))
+                waiting.append(key)
+                continue
+            if update_pvc is not None and node_name:
+                # dynamic provisioning: hand the claim to the provisioner
+                # with the node decision (WaitForFirstConsumer semantics;
+                # harmless for Immediate classes — provisioners key on the
+                # annotation's presence)
+                anns = dict(pvc.metadata.annotations)
+                anns["volume.kubernetes.io/selected-node"] = node_name
+                update_pvc(_dc.replace(
+                    pvc, metadata=_dc.replace(pvc.metadata, annotations=anns)))
+            elif update_pvc is None:
+                # legacy provider (no volume update API): best-effort direct bind
+                bind_pvc = getattr(self.api, "bind_pvc", None)
+                if bind_pvc is not None:
+                    ns, name = key.split("/", 1)
+                    bind_pvc(ns, name)
+                    continue
+            waiting.append(key)
+        deadline = time.time() + self.bind_timeout
+        for key in waiting:
+            while time.time() < deadline:
+                pvc = self._get_pvc(key)
+                if pvc is not None and pvc.bound:
                     break
-                _time.sleep(0.05)
+                time.sleep(0.05)
             else:
-                raise TimeoutError(
-                    f"volume bind timeout for pvc {v.pvc_claim_name}")
+                raise TimeoutError(f"volume bind timeout for pvc {key}")
+        # every claim bound: assume-time reservations served their purpose
+        self.release_pod_volumes(pod)
 
 
 class Context:
@@ -115,9 +247,14 @@ class Context:
         # the cache is shared with the in-process core (its encoder reads it)
         self.schedulers_cache = cache if cache is not None else SchedulerCache()
         self.placeholder_manager = PlaceholderManager(api_provider)
-        self.volume_binder = VolumeBinder(api_provider)
+        self.volume_binder = VolumeBinder(
+            api_provider, self.schedulers_cache,
+            bind_timeout=self.conf.volume_bind_timeout)
         self._apps: Dict[str, Application] = {}
-        self._pvcs: Dict[str, object] = {}
+        # CSINode attach limits seen so far: applied to nodes on arrival in
+        # EITHER order (the CSINode and Node informers are independent watch
+        # streams; a limit landing first must not be dropped)
+        self._csinode_limits: Dict[str, int] = {}
         self._namespaces: Dict[str, Dict[str, str]] = {}
         # foreign pods already reported to the core: uid -> (node, resource)
         self._foreign_sent: Dict[str, tuple] = {}
@@ -154,6 +291,23 @@ class Context:
         self.api_provider.add_event_handler(InformerType.PVC, ResourceEventHandlers(
             add_fn=self._on_pvc, update_fn=lambda old, new: self._on_pvc(new),
             delete_fn=self._on_pvc_deleted))
+        # volume state: PV / StorageClass / CSINode (reference
+        # apifactory.go:39-59 informer set; CSINode drives per-node
+        # attachable-volume limits like the K8s volume-limits plugin). The
+        # cache is the single store — binder and encoder both read it.
+        cache = self.schedulers_cache
+        self.api_provider.add_event_handler(InformerType.PV, ResourceEventHandlers(
+            add_fn=cache.update_pv_obj,
+            update_fn=lambda old, new: cache.update_pv_obj(new),
+            delete_fn=cache.remove_pv_obj))
+        self.api_provider.add_event_handler(InformerType.STORAGE_CLASS, ResourceEventHandlers(
+            add_fn=cache.update_storage_class_obj,
+            update_fn=lambda old, new: cache.update_storage_class_obj(new),
+            delete_fn=cache.remove_storage_class_obj))
+        self.api_provider.add_event_handler(InformerType.CSINODE, ResourceEventHandlers(
+            add_fn=self._on_csinode,
+            update_fn=lambda old, new: self._on_csinode(new),
+            delete_fn=self._on_csinode_deleted))
         self.api_provider.add_event_handler(InformerType.NAMESPACE, ResourceEventHandlers(
             add_fn=self._on_namespace,
             update_fn=lambda old, new: self._on_namespace(new),
@@ -176,6 +330,13 @@ class Context:
 
     # ----------------------------------------------------------------- nodes
     def add_node(self, node: Node) -> None:
+        from yunikorn_tpu.common.resource import VOLUME_ATTACH
+
+        with self._lock:
+            csi_limit = self._csinode_limits.get(node.name)
+        if csi_limit is not None:
+            # CSINode arrived first: apply its attach limit on node arrival
+            node.status.allocatable[VOLUME_ATTACH] = csi_limit
         adopted = self.schedulers_cache.update_node(node)
         capacity = get_node_resource(node.status.allocatable)
         self.scheduler_api.update_node(NodeRequest(nodes=[NodeInfo(
@@ -343,11 +504,21 @@ class Context:
 
     # ------------------------------------------------------ assume / forget
     def assume_pod(self, pod_uid: str, node_name: str) -> bool:
-        """Optimistically place the pod in the cache (reference :828-888)."""
+        """Optimistically place the pod in the cache (reference :828-888):
+        FindPodVolumes feasibility, AssumePodVolumes reservation, then the
+        cache assume — a volume-infeasible node fails the assume so the core
+        re-schedules the task elsewhere."""
         pod = self.schedulers_cache.get_pod(pod_uid)
         if pod is None:
             logger.warning("assume: pod %s not in cache", pod_uid)
             return False
+        info = self.schedulers_cache.get_node(node_name)
+        node = info.node if info is not None else None
+        if not self.volume_binder.find_pod_volumes(pod, node):
+            logger.warning("assume: pod %s volumes unsatisfiable on node %s",
+                           pod_uid, node_name)
+            return False
+        self.volume_binder.assume_pod_volumes(pod, node)
         all_bound = self.volume_binder.all_bound(pod)
         assumed = pod.deepcopy()
         assumed.spec.node_name = node_name
@@ -357,11 +528,12 @@ class Context:
     def forget_pod(self, pod_uid: str) -> None:
         pod = self.schedulers_cache.get_pod(pod_uid)
         if pod is not None:
+            self.volume_binder.release_pod_volumes(pod)
             self.schedulers_cache.forget_pod(pod)
 
-    def bind_pod_volumes(self, pod: Pod) -> None:
+    def bind_pod_volumes(self, pod: Pod, node_name: str = "") -> None:
         if not self.schedulers_cache.are_pod_volumes_all_bound(pod.uid):
-            self.volume_binder.bind_pod_volumes(pod)
+            self.volume_binder.bind_pod_volumes(pod, node_name)
 
     def _on_namespace(self, ns) -> None:
         with self._lock:
@@ -384,17 +556,49 @@ class Context:
         return {}
 
     def _on_pvc(self, pvc) -> None:
-        with self._lock:
-            self._pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+        self.schedulers_cache.update_pvc_obj(pvc)
 
     def _on_pvc_deleted(self, pvc) -> None:
+        pvc.deleted = True
+        self.schedulers_cache.remove_pvc_obj(pvc)
+
+    def _on_csinode(self, csinode) -> None:
+        """CSINode attach limits → node attachable-volumes capacity: patch
+        the node's allocatable and replay it through the normal node-update
+        path so the cache, encoder and core all see the new limit. The limit
+        is remembered so a Node arriving AFTER its CSINode still gets it
+        (applied in add_node)."""
+        limit = csinode.total_limit()
+        if limit is None:
+            return
         with self._lock:
-            pvc.deleted = True
-            self._pvcs.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+            self._csinode_limits[csinode.name] = limit
+        info = self.schedulers_cache.get_node(csinode.name)
+        if info is None:
+            return                      # applied when the node arrives
+        from yunikorn_tpu.common.resource import VOLUME_ATTACH
+
+        node = info.node
+        if node.status.allocatable.get(VOLUME_ATTACH) == limit:
+            return
+        node.status.allocatable[VOLUME_ATTACH] = limit
+        self.update_node(node, node)
+
+    def _on_csinode_deleted(self, csinode) -> None:
+        from yunikorn_tpu.common.resource import VOLUME_ATTACH
+
+        with self._lock:
+            self._csinode_limits.pop(csinode.name, None)
+        info = self.schedulers_cache.get_node(csinode.name)
+        if info is None:
+            return
+        node = info.node
+        if VOLUME_ATTACH in node.status.allocatable:
+            node.status.allocatable.pop(VOLUME_ATTACH, None)
+            self.update_node(node, node)
 
     def get_pvc(self, namespace: str, name: str):
-        with self._lock:
-            pvc = self._pvcs.get(f"{namespace}/{name}")
+        pvc = self.schedulers_cache.get_pvc_obj(namespace, name)
         if pvc is not None:
             return pvc
         # fall through to the cluster store (informer may not have synced yet)

@@ -72,6 +72,11 @@ class SchedulerCache:
         # "node/class" -> ResourceSlice
         self.resource_claims: Dict[str, object] = {}
         self.resource_slices: Dict[str, object] = {}
+        # volume objects ("ns/name" PVCs, PV/StorageClass by name): fed by
+        # informers, read by the VolumeBinder and the encoder's volume mask
+        self.pvcs_map: Dict[str, object] = {}
+        self.pvs_map: Dict[str, object] = {}
+        self.storage_classes_map: Dict[str, object] = {}
         # generation tracking for incremental snapshot encoding
         self._generation = 0
         # bumped only when node allocatable capacity changes (add/remove/update
@@ -258,6 +263,51 @@ class SchedulerCache:
             self._update_pod_locked(pod)
             self.assumed_pods[key] = all_volumes_bound
             self._dra_reserve_locked(pod, pod.spec.node_name)
+
+    # --------------------------------------------------------------- volumes
+    # PVC/PV/StorageClass object stores: single source for the VolumeBinder
+    # (find/assume/bind) and the encoder's vectorized volume-feasibility mask
+    # (reference keeps these in informer listers the volumebinding plugin
+    # reads, apifactory.go:39-59).
+    def update_pvc_obj(self, pvc) -> None:
+        with self._lock:
+            self.pvcs_map[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+
+    def remove_pvc_obj(self, pvc) -> None:
+        with self._lock:
+            self.pvcs_map.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+
+    def get_pvc_obj(self, namespace: str, name: str):
+        with self._lock.reader():
+            return self.pvcs_map.get(f"{namespace}/{name}")
+
+    def update_pv_obj(self, pv) -> None:
+        with self._lock:
+            self.pvs_map[pv.metadata.name] = pv
+
+    def remove_pv_obj(self, pv) -> None:
+        with self._lock:
+            self.pvs_map.pop(pv.metadata.name, None)
+
+    def get_pv_obj(self, name: str):
+        with self._lock.reader():
+            return self.pvs_map.get(name)
+
+    def list_pv_objs(self) -> list:
+        with self._lock.reader():
+            return list(self.pvs_map.values())
+
+    def update_storage_class_obj(self, sc) -> None:
+        with self._lock:
+            self.storage_classes_map[sc.metadata.name] = sc
+
+    def remove_storage_class_obj(self, sc) -> None:
+        with self._lock:
+            self.storage_classes_map.pop(sc.metadata.name, None)
+
+    def get_storage_class_obj(self, name: str):
+        with self._lock.reader():
+            return self.storage_classes_map.get(name)
 
     # ------------------------------------------------------------------- DRA
     def update_resource_claim(self, claim) -> None:

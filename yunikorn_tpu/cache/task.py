@@ -171,7 +171,7 @@ class Task:
 
         def bind():
             try:
-                self.context.bind_pod_volumes(self.pod)
+                self.context.bind_pod_volumes(self.pod, self.node_name)
                 self.context.api_provider.get_client().bind(self.pod, self.node_name)
                 get_recorder().eventf("Pod", self.alias, "Normal", "PodBindSuccessful",
                                       "Pod %s is successfully bound to node %s",

@@ -62,6 +62,12 @@ class FakeKubeClient(KubeClient):
         self.delete_fn = None
         self._lock = locking.Mutex()
 
+    def update_pvc(self, pvc) -> None:
+        self._cluster.update_pvc(pvc)
+
+    def update_pv(self, pv) -> None:
+        self._cluster.update_pv(pv)
+
     def bind(self, pod: Pod, node_name: str) -> None:
         try:
             if self.bind_fn is not None:
@@ -114,6 +120,11 @@ class FakeCluster(APIProvider):
         self._configmaps: Dict[str, ConfigMap] = {}
         self._priority_classes: Dict[str, PriorityClass] = {}
         self._pvcs: Dict[str, PersistentVolumeClaim] = {}
+        self._pvs: Dict[str, object] = {}
+        self._storage_classes: Dict[str, object] = {}
+        self._csinodes: Dict[str, object] = {}
+        # built-in provisioner sim: see update_pvc
+        self.auto_provision = True
         self._namespaces: Dict[str, Namespace] = {}
         self._handlers: Dict[InformerType, List[ResourceEventHandlers]] = {}
         self._client = FakeKubeClient(self)
@@ -284,6 +295,48 @@ class FakeCluster(APIProvider):
             pvc.volume_name = volume_name or f"pv-{name}"
         self._fire(InformerType.PVC, "update", pvc, pvc)
 
+    # ---------------------------------------------------- volumes (PV/SC/CSI)
+    def add_pv(self, pv) -> None:
+        with self._lock:
+            self._pvs[pv.metadata.name] = pv
+        self._fire(InformerType.PV, "add", pv)
+
+    def get_pv(self, name: str):
+        with self._lock:
+            return self._pvs.get(name)
+
+    def update_pv(self, pv) -> None:
+        with self._lock:
+            self._pvs[pv.metadata.name] = pv
+        self._fire(InformerType.PV, "update", pv, pv)
+
+    def add_storage_class(self, sc) -> None:
+        with self._lock:
+            self._storage_classes[sc.metadata.name] = sc
+        self._fire(InformerType.STORAGE_CLASS, "add", sc)
+
+    def add_csinode(self, csinode) -> None:
+        with self._lock:
+            self._csinodes[csinode.metadata.name] = csinode
+        self._fire(InformerType.CSINODE, "add", csinode)
+
+    def update_pvc(self, pvc) -> None:
+        """Replace a claim (binder writes volumeName/bound/annotations).
+
+        The fake cluster doubles as the external provisioner (auto_provision,
+        default on): an unbound claim carrying the
+        volume.kubernetes.io/selected-node annotation gets bound immediately,
+        like a CSI provisioner acting on the scheduler's node decision. Tests
+        exercising real WaitForFirstConsumer latency set auto_provision=False
+        and bind the claim themselves."""
+        if (self.auto_provision and not pvc.bound
+                and pvc.metadata.annotations.get("volume.kubernetes.io/selected-node")):
+            pvc.bound = True
+            pvc.volume_name = pvc.volume_name or f"pv-{pvc.metadata.name}"
+        with self._lock:
+            self._pvcs[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+        self._fire(InformerType.PVC, "update", pvc, pvc)
+
     def add_priority_class(self, pc: PriorityClass) -> None:
         with self._lock:
             self._priority_classes[pc.name] = pc
@@ -309,6 +362,12 @@ class FakeCluster(APIProvider):
             return list(self._pvcs.values())
         if informer == InformerType.NAMESPACE:
             return list(self._namespaces.values())
+        if informer == InformerType.PV:
+            return list(self._pvs.values())
+        if informer == InformerType.STORAGE_CLASS:
+            return list(self._storage_classes.values())
+        if informer == InformerType.CSINODE:
+            return list(self._csinodes.values())
         return []
 
     def _fire(self, informer: InformerType, kind: str, obj, old=None) -> None:

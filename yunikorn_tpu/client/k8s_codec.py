@@ -314,3 +314,140 @@ def decode_resource_slice(doc: Dict[str, Any]) -> ResourceSlice:
         device_class=cls,
         count=len(devices) or int(spec.get("count", 0) or 0),
     )
+
+
+# --------------------------------------------------------------------------
+# Volume kinds (PVC / PV / StorageClass / CSINode) — real-adapter coverage of
+# the reference's volume informers (apifactory.go:39-59) and the shim-side
+# binder's write path.
+# --------------------------------------------------------------------------
+
+def decode_pvc(doc: Dict[str, Any]) -> "PersistentVolumeClaim":
+    from yunikorn_tpu.common.objects import PersistentVolumeClaim
+    from yunikorn_tpu.common.resource import parse_quantity
+
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    requested = 0
+    res = ((spec.get("resources") or {}).get("requests")) or {}
+    if "storage" in res:
+        try:
+            requested = parse_quantity(res["storage"])
+        except ValueError:
+            requested = 0
+    volume_name = spec.get("volumeName", "") or ""
+    phase = status.get("phase", "") or ""
+    return PersistentVolumeClaim(
+        metadata=_meta(doc),
+        storage_class=spec.get("storageClassName", "") or "",
+        bound=(phase == "Bound") or bool(volume_name and phase != "Lost"),
+        volume_name=volume_name,
+        requested_storage=requested,
+        access_modes=list(spec.get("accessModes") or ["ReadWriteOnce"]),
+    )
+
+
+def encode_pvc(pvc) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": {
+            "name": pvc.metadata.name,
+            "namespace": pvc.metadata.namespace,
+            "annotations": dict(pvc.metadata.annotations),
+            "labels": dict(pvc.metadata.labels),
+        },
+        "spec": {
+            "accessModes": list(pvc.access_modes),
+            "storageClassName": pvc.storage_class,
+        },
+    }
+    if pvc.requested_storage:
+        doc["spec"]["resources"] = {"requests": {"storage": str(pvc.requested_storage)}}
+    if pvc.volume_name:
+        doc["spec"]["volumeName"] = pvc.volume_name
+    if pvc.bound:
+        doc["status"] = {"phase": "Bound"}
+    return doc
+
+
+def decode_pv(doc: Dict[str, Any]) -> "PersistentVolume":
+    from yunikorn_tpu.common.objects import PersistentVolume
+    from yunikorn_tpu.common.resource import parse_quantity
+
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    capacity = 0
+    cap = spec.get("capacity") or {}
+    if "storage" in cap:
+        try:
+            capacity = parse_quantity(cap["storage"])
+        except ValueError:
+            capacity = 0
+    claim_ref = ""
+    cr = spec.get("claimRef") or {}
+    if cr.get("name"):
+        claim_ref = f"{cr.get('namespace', 'default')}/{cr['name']}"
+    # simplified node affinity: flatten required matchExpressions with a
+    # single In value into label equality (the common zonal-volume shape)
+    node_affinity: Dict[str, str] = {}
+    na = ((spec.get("nodeAffinity") or {}).get("required")) or {}
+    for term in na.get("nodeSelectorTerms") or []:
+        for e in term.get("matchExpressions") or []:
+            vals = e.get("values") or []
+            if e.get("operator") == "In" and len(vals) == 1:
+                node_affinity[e.get("key", "")] = vals[0]
+    return PersistentVolume(
+        metadata=_meta(doc),
+        capacity=capacity,
+        access_modes=list(spec.get("accessModes") or ["ReadWriteOnce"]),
+        storage_class=spec.get("storageClassName", "") or "",
+        claim_ref=claim_ref,
+        phase=status.get("phase", "Available") or "Available",
+        node_affinity=node_affinity,
+    )
+
+
+def encode_pv(pv) -> Dict[str, Any]:
+    doc: Dict[str, Any] = {
+        "apiVersion": "v1",
+        "kind": "PersistentVolume",
+        "metadata": {"name": pv.metadata.name},
+        "spec": {
+            "capacity": {"storage": str(pv.capacity)},
+            "accessModes": list(pv.access_modes),
+            "storageClassName": pv.storage_class,
+        },
+        "status": {"phase": pv.phase},
+    }
+    if pv.claim_ref:
+        ns, name = pv.claim_ref.split("/", 1)
+        doc["spec"]["claimRef"] = {"namespace": ns, "name": name}
+    if pv.node_affinity:
+        doc["spec"]["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
+            {"matchExpressions": [
+                {"key": k, "operator": "In", "values": [v]}
+                for k, v in pv.node_affinity.items()]}]}}
+    return doc
+
+
+def decode_storage_class(doc: Dict[str, Any]) -> "StorageClass":
+    from yunikorn_tpu.common.objects import StorageClass
+
+    return StorageClass(
+        metadata=_meta(doc),
+        provisioner=doc.get("provisioner", "") or "",
+        volume_binding_mode=doc.get("volumeBindingMode", "Immediate") or "Immediate",
+    )
+
+
+def decode_csinode(doc: Dict[str, Any]) -> "CSINodeInfo":
+    from yunikorn_tpu.common.objects import CSINodeInfo
+
+    spec = doc.get("spec") or {}
+    limits: Dict[str, int] = {}
+    for drv in spec.get("drivers") or []:
+        count = ((drv.get("allocatable") or {}).get("count"))
+        if count is not None:
+            limits[drv.get("name", "")] = int(count)
+    return CSINodeInfo(metadata=_meta(doc), driver_limits=limits)

@@ -54,6 +54,13 @@ _RESOURCES: Dict[InformerType, Tuple[str, Callable]] = {
         "/apis/resource.k8s.io/v1beta1/resourceclaims", codec.decode_resource_claim),
     InformerType.RESOURCE_SLICE: (
         "/apis/resource.k8s.io/v1beta1/resourceslices", codec.decode_resource_slice),
+    # volume informers (reference apifactory.go:39-59: PV/PVC/StorageClass/
+    # CSINode feed the volume binder and per-node attach limits)
+    InformerType.PVC: ("/api/v1/persistentvolumeclaims", codec.decode_pvc),
+    InformerType.PV: ("/api/v1/persistentvolumes", codec.decode_pv),
+    InformerType.STORAGE_CLASS: (
+        "/apis/storage.k8s.io/v1/storageclasses", codec.decode_storage_class),
+    InformerType.CSINODE: ("/apis/storage.k8s.io/v1/csinodes", codec.decode_csinode),
 }
 
 
@@ -203,6 +210,21 @@ class RealKubeClient(KubeClient):
             content_type="application/strategic-merge-patch+json",
         )
         return True
+
+    def update_pvc(self, pvc) -> None:
+        """Replace a claim: the binder writes volumeName / the
+        selected-node annotation (volume binding write path)."""
+        self.request_json(
+            "PUT",
+            f"/api/v1/namespaces/{pvc.metadata.namespace}"
+            f"/persistentvolumeclaims/{pvc.metadata.name}",
+            codec.encode_pvc(pvc))
+
+    def update_pv(self, pv) -> None:
+        """Replace a PV: the binder sets claimRef on static binds."""
+        self.request_json(
+            "PUT", f"/api/v1/persistentvolumes/{pv.metadata.name}",
+            codec.encode_pv(pv))
 
     def get_configmap(self, namespace: str, name: str) -> Optional[ConfigMap]:
         try:
@@ -376,7 +398,9 @@ class RealAPIProvider(APIProvider):
         self.config = config
         self.client = RealKubeClient(config, qps=qps, burst=burst)
         types = [InformerType.POD, InformerType.NODE, InformerType.CONFIGMAP,
-                 InformerType.PRIORITY_CLASS, InformerType.NAMESPACE]
+                 InformerType.PRIORITY_CLASS, InformerType.NAMESPACE,
+                 InformerType.PVC, InformerType.PV,
+                 InformerType.STORAGE_CLASS, InformerType.CSINODE]
         if enable_dra:
             types += [InformerType.RESOURCE_CLAIM, InformerType.RESOURCE_SLICE]
         self._informers: Dict[InformerType, _Informer] = {
@@ -423,6 +447,18 @@ class RealAPIProvider(APIProvider):
             if not inf.synced.wait(timeout=remaining):
                 raise TimeoutError(
                     f"informer {inf.informer.value} did not sync in {timeout}s")
+
+    def get_pvc(self, namespace: str, name: str):
+        """Claim lookup from the PVC informer store (volume-binder fallback
+        when its own cache hasn't seen the claim yet)."""
+        inf = self._informers.get(InformerType.PVC)
+        if inf is None:
+            return None
+        for pvc in inf.snapshot():
+            if (pvc.metadata.namespace == namespace
+                    and pvc.metadata.name == name):
+                return pvc
+        return None
 
     def list_pods(self) -> List[Pod]:
         return self._informers[InformerType.POD].snapshot()

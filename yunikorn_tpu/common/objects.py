@@ -322,6 +322,61 @@ class PersistentVolumeClaim:
     storage_class: str = ""
     bound: bool = False
     volume_name: str = ""
+    # requested storage bytes + access modes for static PV matching
+    requested_storage: int = 0
+    access_modes: List[str] = dataclasses.field(default_factory=lambda: ["ReadWriteOnce"])
+
+    @property
+    def selected_node(self) -> str:
+        """WaitForFirstConsumer: the node the scheduler picked; an external
+        provisioner watches this annotation (volume.kubernetes.io/selected-node)."""
+        return self.metadata.annotations.get("volume.kubernetes.io/selected-node", "")
+
+
+@dataclasses.dataclass
+class PersistentVolume:
+    """Cluster-scoped volume for static PVC binding (reference relies on the
+    K8s volumebinding plugin; here the shim's own binder matches claims)."""
+    metadata: ObjectMeta
+    capacity: int = 0                       # storage bytes
+    access_modes: List[str] = dataclasses.field(default_factory=lambda: ["ReadWriteOnce"])
+    storage_class: str = ""
+    claim_ref: str = ""                     # "namespace/name" when bound/reserved
+    phase: str = "Available"                # Available | Bound | Released
+    # simplified node affinity: required node-label matches ({} = any node)
+    node_affinity: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclasses.dataclass
+class StorageClass:
+    metadata: ObjectMeta
+    provisioner: str = ""
+    volume_binding_mode: str = "Immediate"  # Immediate | WaitForFirstConsumer
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclasses.dataclass
+class CSINodeInfo:
+    """Per-node CSI driver attach limits (storage.k8s.io/v1 CSINode)."""
+    metadata: ObjectMeta                    # name == node name
+    # driver name -> max attachable volume count
+    driver_limits: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def total_limit(self) -> Optional[int]:
+        if not self.driver_limits:
+            return None
+        return min(self.driver_limits.values())
 
 
 def make_pod(

@@ -153,6 +153,10 @@ class GroupSpec:
     # DRA: (namespace, (claim names...)) — feasibility restricted to nodes
     # satisfying every claim (reference gates a DRA manager, context.go:116-130)
     claims: Optional[Tuple[str, tuple]] = None
+    # volumes: (namespace, (pvc names...)) — nodes restricted by PV node
+    # affinity / static matchability (vectorized FindPodVolumes; the
+    # reference runs the volumebinding PreFilter inside the Predicates upcall)
+    volumes: Optional[Tuple[str, tuple]] = None
 
 
 @dataclasses.dataclass
@@ -494,7 +498,10 @@ class SnapshotEncoder:
         # list share a group (the host mask then holds for every member)
         claims_sig = ((pod.namespace, tuple(sorted(pod.spec.resource_claims)))
                       if pod.spec.resource_claims else ())
-        return (sel, tols, aff, ports, pref, loc_sig, claims_sig)
+        # PVC claims likewise: the volume mask is claim-specific, so pods
+        # with different claims must not share a group
+        vol_sig = self._volume_claims_of(pod) or ()
+        return (sel, tols, aff, ports, pref, loc_sig, claims_sig, vol_sig)
 
     def _encode_group(self, pod: Pod) -> GroupSpec:
         W = self.vocabs.labels.num_words
@@ -666,13 +673,81 @@ class SnapshotEncoder:
             host_pref_terms=host_pref_terms or None,
             claims=((pod.namespace, tuple(sorted(pod.spec.resource_claims)))
                     if pod.spec.resource_claims else None),
+            volumes=self._volume_claims_of(pod),
         )
+
+    @staticmethod
+    def _volume_claims_of(pod: Pod):
+        names = sorted(v.pvc_claim_name for v in pod.spec.volumes
+                       if v.pvc_claim_name)
+        return (pod.namespace, tuple(names)) if names else None
 
     def _host_rows(self):
         """[(node idx, NodeInfo)] — one cache read per node, shared by the
         host-evaluation passes within one build_batch."""
         return [(idx, self.cache.get_node(name))
                 for idx, name in list(self.nodes._idx_to_name.items())]
+
+    def _volume_mask(self, volumes: Tuple[str, tuple]) -> Optional[np.ndarray]:
+        """[capacity] bool mask of nodes where every claim is satisfiable, or
+        None when the claims impose no node restriction (the common case).
+
+        Mirrors VolumeBinder.find_pod_volumes group-wise: bound claims pin to
+        their PV's node affinity; unbound claims allow nodes with a matching
+        Available PV, any node when dynamically provisionable (class unknown
+        or has a provisioner), and nothing otherwise. The per-(pod,node)
+        reference equivalent is the volumebinding PreFilter inside the
+        Predicates upcall (predicate_manager.go:302-392)."""
+        from yunikorn_tpu.common.volumes import pv_matches_claim
+
+        ns, names = volumes
+        M = self.nodes.capacity
+        mask: Optional[np.ndarray] = None
+        rows = self._host_rows()               # one cache pass per call
+
+        def label_mask(affinity: Dict[str, str]) -> np.ndarray:
+            out = np.zeros((M,), bool)
+            for idx, info in rows:
+                if info is None:
+                    continue
+                labels = info.node.metadata.labels
+                if all(labels.get(k) == v for k, v in affinity.items()):
+                    out[idx] = True
+            return out
+
+        for name in names:
+            pvc = self.cache.get_pvc_obj(ns, name)
+            if pvc is None:
+                # unknown claim: leave unrestricted — the task-level PVC
+                # sanity check and assume-time find fail it with a message
+                continue
+            if pvc.bound:
+                pv = self.cache.get_pv_obj(pvc.volume_name)
+                if pv is not None and pv.node_affinity:
+                    m = label_mask(pv.node_affinity)
+                    mask = m if mask is None else (mask & m)
+                continue
+            sc = self.cache.get_storage_class_obj(pvc.storage_class)
+            if sc is None or sc.provisioner:
+                continue                       # provisionable anywhere
+            # static-only claim: nodes covered by some compatible PV
+            # (matching semantics shared with the binder — common/volumes.py;
+            # assume-time reservations are deliberately ignored here: the
+            # mask is group-level, the binder re-checks exactly)
+            allowed = np.zeros((M,), bool)
+            unrestricted = False
+            key = f"{ns}/{name}"
+            for pv in self.cache.list_pv_objs():
+                if not pv_matches_claim(pv, pvc, None, key):
+                    continue
+                if not pv.node_affinity:
+                    unrestricted = True
+                    break
+                allowed |= label_mask(pv.node_affinity)
+            if unrestricted:
+                continue
+            mask = allowed if mask is None else (mask & allowed)
+        return mask
 
     def _host_eval_mask(self, spec: GroupSpec, rows=None) -> np.ndarray:
         """Evaluate non-tensorizable expressions for every node.
@@ -868,6 +943,21 @@ class SnapshotEncoder:
                 if host_soft is None:
                     host_soft = np.zeros((G, self.nodes.capacity), np.float32)
                 host_soft[gi] = self._host_pref_scores(spec, host_rows)
+
+        # volume feasibility: claims restrict candidate nodes by PV node
+        # affinity / static matchability (vectorized FindPodVolumes)
+        vol_mask_cache: Dict[Tuple[str, tuple], Optional[np.ndarray]] = {}
+        for gi, spec in enumerate(group_specs):
+            if spec.volumes is None:
+                continue
+            vm = vol_mask_cache.get(spec.volumes, False)
+            if vm is False:
+                vm = vol_mask_cache[spec.volumes] = self._volume_mask(spec.volumes)
+            if vm is None:
+                continue  # unconstrained
+            if host_mask is None:
+                host_mask = np.ones((G, self.nodes.capacity), bool)
+            host_mask[gi] &= vm
 
         rank_arr = np.zeros((N,), np.float32)
         if ranks is not None:
