@@ -1025,3 +1025,78 @@ def test_partition_capacity_memo_invalidated_by_membership_change():
         attributes={"si/node-partition": "gpu"})]))
     core._use_partition("gpu")
     assert core._cluster_capacity().get("cpu") == 16000
+
+
+# ---------------------------------------------------------------------------
+# Locality-fallback drain: overflow groups schedule in intra-cycle rounds
+# (round-2 behavior was one pod per group per CYCLE — a silent 1000x cliff)
+# ---------------------------------------------------------------------------
+
+def _overflow_anti_ask(app_id, name, n_terms=7):
+    """Mutually anti-affine pods whose term count overflows the tensor
+    encoding (MAX_CONSTRAINT_SLOTS=6): must take the exact host path."""
+    from yunikorn_tpu.common.objects import Affinity, PodAffinityTerm
+
+    pod = make_pod(name, cpu_milli=100, memory=2**20, labels={"x0": "t"})
+    pod.spec.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(label_selector={"matchLabels": {f"x{i}": "t"}},
+                        topology_key="kubernetes.io/hostname")
+        for i in range(n_terms)
+    ])
+    return AllocationAsk(allocation_key=name, application_id=app_id,
+                         resource=get_pod_resource(pod), pod=pod)
+
+
+def test_locality_fallback_drains_whole_group_in_one_cycle():
+    cache, cb, core = make_core(nodes=8)
+    add_app(core, "app-fb")
+    asks = [_overflow_anti_ask("app-fb", f"fb-{i}") for i in range(6)]
+    core.update_allocation(AllocationRequest(asks=asks))
+    n = core.schedule_once()
+    # ALL six land in ONE cycle (main solve places 1, drain rounds the rest)
+    assert n == 6
+    by_key = {a.allocation_key: a.node_id for a in cb.allocations}
+    assert len(by_key) == 6
+    # mutual hostname anti-affinity: every pod on a DISTINCT node — proves the
+    # drain's extra_placed overlay sees intra-cycle commitments (without it,
+    # two drain rounds could stack pods on one node)
+    assert len(set(by_key.values())) == 6
+    # operator visibility: metric counters + pod events
+    assert core.metrics.get("locality_fallback_groups_total", 0) >= 1
+    assert core.metrics.get("locality_fallback_deferred_total", 0) == 5
+    reasons = {e.reason for e in cb.events}
+    assert "LocalityEncodingOverflow" in reasons
+    entry = core.metrics["last_cycle"]["default"]
+    assert entry["fallback_placed"] == 5 and entry["fallback_rounds"] >= 5
+
+
+def test_locality_fallback_rounds_zero_keeps_serialized_behavior():
+    from yunikorn_tpu.core.scheduler import SolverOptions
+
+    cache = SchedulerCache()
+    cb = RecordingCallback()
+    core = CoreScheduler(cache, solver_options=SolverOptions(fallback_rounds=0))
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="rm-1", policy_group="queues",
+                                       config=QUEUES_YAML), cb)
+    infos = []
+    for i in range(4):
+        nd = make_node(f"node-{i}", cpu_milli=8000, memory=16 * 2**30)
+        cache.update_node(nd)
+        infos.append(NodeInfo(node_id=nd.name, action=NodeAction.CREATE,
+                              schedulable_resource=ResourceBuilder().cpu(8000).build()))
+    core.update_node(NodeRequest(nodes=infos))
+    add_app(core, "app-fb0")
+    asks = [_overflow_anti_ask("app-fb0", f"z-{i}") for i in range(3)]
+    core.update_allocation(AllocationRequest(asks=asks))
+    assert core.schedule_once() == 1      # one pod per cycle when disabled
+    # the rest remain pending and drain over subsequent cycles; a commit is
+    # not yet in the cache, so later cycles rely on the inflight overlay +
+    # host mask re-evaluation against extra_placed=None (cache-only state).
+    # Simulate the shim's assume so the next cycle's mask sees the placement.
+    for a in cb.allocations:
+        ask = next(x for x in asks if x.allocation_key == a.allocation_key)
+        ask.pod.spec.node_name = a.node_id
+        ask.pod.status.phase = "Running"
+        cache.update_pod(ask.pod)
+    assert core.schedule_once() == 1

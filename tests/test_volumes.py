@@ -249,3 +249,73 @@ def test_real_adapter_pvc_flow_over_fake_apiserver():
             provider.stop()
     finally:
         server.stop()
+
+
+def test_csinode_limit_survives_routine_node_update(sched):
+    """A kubelet heartbeat (Node UPDATE with no attach info) must not revert
+    the CSINode-driven attach cap to the default."""
+    n0 = make_node("n0", cpu_milli=16000)
+    sched.add_node(n0)
+    sched.cluster.add_csinode(CSINodeInfo(
+        metadata=ObjectMeta(name="n0"),
+        driver_limits={"csi.example.com": 2}))
+    for i in range(3):
+        sched.cluster.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=f"uc{i}", namespace="default"),
+            storage_class="anything"))
+    # routine status update: a fresh Node object with no VOLUME_ATTACH key
+    sched.cluster.update_node(make_node("n0", cpu_milli=16000))
+    pods = [sched.add_pod(vol_pod(f"up-{i}", f"uc{i}", cpu=100))
+            for i in range(3)]
+    sched.wait_for_bound_count(2)
+    time.sleep(0.5)
+    bound = [p for p in pods if sched.get_pod_assignment(p)]
+    assert len(bound) == 2                     # limit still 2, not default
+
+
+def test_codec_roundtrip_preserves_unmodeled_fields():
+    """encode_pv/encode_pvc must merge binder mutations into the ORIGINAL
+    API document: a PV without its volume source (csi/nfs/...) or a PVC
+    stripped of volumeMode/resourceVersion is rejected by a real API server."""
+    import dataclasses as _dc
+
+    from yunikorn_tpu.client.k8s_codec import (decode_pv, decode_pvc,
+                                               encode_pv, encode_pvc)
+
+    pv_doc = {
+        "apiVersion": "v1", "kind": "PersistentVolume",
+        "metadata": {"name": "pv-x", "resourceVersion": "42"},
+        "spec": {"capacity": {"storage": "10Gi"},
+                 "accessModes": ["ReadWriteOnce"],
+                 "storageClassName": "local",
+                 "csi": {"driver": "csi.example.com", "volumeHandle": "h-1"},
+                 "volumeMode": "Filesystem"},
+        "status": {"phase": "Available"},
+    }
+    pv = decode_pv(pv_doc)
+    bound = _dc.replace(pv, claim_ref="default/data-0", phase="Bound")
+    out = encode_pv(bound)
+    assert out["spec"]["csi"] == pv_doc["spec"]["csi"]       # source kept
+    assert out["metadata"]["resourceVersion"] == "42"
+    assert out["spec"]["claimRef"]["name"] == "data-0"
+    assert out["status"]["phase"] == "Bound"
+
+    pvc_doc = {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "data-0", "namespace": "default",
+                     "resourceVersion": "7"},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "storageClassName": "local",
+                 "volumeMode": "Block",
+                 "selector": {"matchLabels": {"tier": "db"}},
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    }
+    pvc = decode_pvc(pvc_doc)
+    bound_pvc = _dc.replace(pvc, volume_name="pv-x", bound=True)
+    out = encode_pvc(bound_pvc)
+    assert out["spec"]["volumeMode"] == "Block"              # immutable kept
+    assert out["spec"]["selector"] == pvc_doc["spec"]["selector"]
+    assert out["metadata"]["resourceVersion"] == "7"
+    assert out["spec"]["volumeName"] == "pv-x"
+    # encoding must not mutate the original raw document
+    assert "volumeName" not in pvc_doc["spec"]

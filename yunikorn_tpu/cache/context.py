@@ -103,17 +103,24 @@ class VolumeBinder:
         get = getattr(self.api, "get_pvc", None)
         return get(ns, name) if get is not None else None
 
-    def _match_pv(self, pvc, node, claim_key: str):
-        """Smallest Available PV satisfying the claim on this node."""
+    def _match_pv(self, pvc, node, claim_key: str, reserve: bool = False):
+        """Smallest Available PV satisfying the claim on this node.
+
+        reserve=True records the pick in _reserved under the same lock as the
+        candidate scan — check-then-reserve must be atomic or two bind-pool
+        threads (or parallel assumes) can hand one PV to two claims."""
         from yunikorn_tpu.common.volumes import pv_matches_claim
 
         with self._lock:
             candidates = [pv for pv in self.cache.list_pv_objs()
                           if pv_matches_claim(pv, pvc, node, claim_key,
                                               reserved=self._reserved.get)]
-        if not candidates:
-            return None
-        return min(candidates, key=lambda pv: (pv.capacity, pv.metadata.name))
+            if not candidates:
+                return None
+            pv = min(candidates, key=lambda pv: (pv.capacity, pv.metadata.name))
+            if reserve:
+                self._reserved[pv.metadata.name] = claim_key
+            return pv
 
     # ------------------------------------------------------------ public API
     def all_bound(self, pod: Pod) -> bool:
@@ -153,10 +160,7 @@ class VolumeBinder:
             pvc = self._get_pvc(key)
             if pvc is None or pvc.bound:
                 continue
-            pv = self._match_pv(pvc, node, key)
-            if pv is not None:
-                with self._lock:
-                    self._reserved[pv.metadata.name] = key
+            self._match_pv(pvc, node, key, reserve=True)
 
     def release_pod_volumes(self, pod: Pod) -> None:
         """Drop assume-time PV reservations held for this pod's claims
@@ -195,7 +199,9 @@ class VolumeBinder:
                         pv = self.cache.get_pv_obj(pv_name)
                         break
             if pv is None:
-                pv = self._match_pv(pvc, node, key)
+                # no assume-time reservation (PV appeared late / optimistic
+                # find): reserve here so a concurrent bind can't take it too
+                pv = self._match_pv(pvc, node, key, reserve=True)
             update_pvc = getattr(client, "update_pvc", None)
             update_pv = getattr(client, "update_pv", None)
             if pv is not None and update_pv is not None and update_pvc is not None:
@@ -217,12 +223,14 @@ class VolumeBinder:
                 update_pvc(_dc.replace(
                     pvc, metadata=_dc.replace(pvc.metadata, annotations=anns)))
             elif update_pvc is None:
-                # legacy provider (no volume update API): best-effort direct bind
+                # legacy provider (no volume update API): best-effort direct
+                # bind — still joins the waiting list below so the bind
+                # timeout is enforced (an async/failed bind_pvc must not let
+                # the pod proceed with unbound volumes)
                 bind_pvc = getattr(self.api, "bind_pvc", None)
                 if bind_pvc is not None:
                     ns, name = key.split("/", 1)
                     bind_pvc(ns, name)
-                    continue
             waiting.append(key)
         deadline = time.time() + self.bind_timeout
         for key in waiting:
@@ -354,6 +362,15 @@ class Context:
             self.update_pod(None, pod)
 
     def update_node(self, old: Optional[Node], node: Node) -> None:
+        from yunikorn_tpu.common.resource import VOLUME_ATTACH
+
+        with self._lock:
+            csi_limit = self._csinode_limits.get(node.name)
+        if csi_limit is not None:
+            # routine node updates (kubelet heartbeats) carry no attach limit;
+            # without re-applying it every update would silently revert the
+            # CSI driver's cap to the default until the next CSINode event
+            node.status.allocatable[VOLUME_ATTACH] = csi_limit
         self.schedulers_cache.update_node(node)
         capacity = get_node_resource(node.status.allocatable)
         infos = [NodeInfo(node_id=node.name, action=NodeAction.UPDATE,
@@ -503,27 +520,40 @@ class Context:
             app.remove_from_core()
 
     # ------------------------------------------------------ assume / forget
-    def assume_pod(self, pod_uid: str, node_name: str) -> bool:
+    def assume_pod(self, pod_uid: str, node_name: str):
         """Optimistically place the pod in the cache (reference :828-888):
         FindPodVolumes feasibility, AssumePodVolumes reservation, then the
         cache assume — a volume-infeasible node fails the assume so the core
-        re-schedules the task elsewhere."""
+        re-schedules the task elsewhere.
+
+        Returns (ok, reason, retryable): reason/retryable drive the
+        callback's bounded retry — a pod missing from the cache is informer
+        lag worth a short retry; volume infeasibility is not (volume state
+        will not change within the retry window) and must be reported as
+        what it is."""
         pod = self.schedulers_cache.get_pod(pod_uid)
         if pod is None:
             logger.warning("assume: pod %s not in cache", pod_uid)
-            return False
+            return False, "pod missing from cache", True
         info = self.schedulers_cache.get_node(node_name)
         node = info.node if info is not None else None
+        for key in self.volume_binder._claims(pod):
+            if self.volume_binder._get_pvc(key) is None:
+                # unknown claim is informer lag, not infeasibility — the
+                # retry window exists exactly for this case
+                logger.warning("assume: pod %s claim %s not yet in cache",
+                               pod_uid, key)
+                return False, f"pvc {key} not yet in cache", True
         if not self.volume_binder.find_pod_volumes(pod, node):
             logger.warning("assume: pod %s volumes unsatisfiable on node %s",
                            pod_uid, node_name)
-            return False
+            return False, f"volumes unsatisfiable on node {node_name}", False
         self.volume_binder.assume_pod_volumes(pod, node)
         all_bound = self.volume_binder.all_bound(pod)
         assumed = pod.deepcopy()
         assumed.spec.node_name = node_name
         self.schedulers_cache.assume_pod(assumed, all_bound)
-        return True
+        return True, "", False
 
     def forget_pod(self, pod_uid: str) -> None:
         pod = self.schedulers_cache.get_pod(pod_uid)

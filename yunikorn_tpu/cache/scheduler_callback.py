@@ -51,18 +51,19 @@ class AsyncRMCallback(ResourceManagerCallback):
             # pod vanished mid-solve). On failure the task fails and the core
             # allocation is released; the pod re-enters via the informer if it
             # still exists.
-            ok = False
+            ok, reason = False, ""
             for _ in range(ASSUME_RETRY_STEPS):
-                if self.context.assume_pod(alloc.allocation_key, alloc.node_id):
-                    ok = True
+                ok, reason, retryable = self.context.assume_pod(
+                    alloc.allocation_key, alloc.node_id)
+                if ok or not retryable:
                     break
                 time.sleep(ASSUME_RETRY_INTERVAL)
             if not ok:
-                logger.error("failed to assume pod %s on %s; failing task",
-                             alloc.allocation_key, alloc.node_id)
+                logger.error("failed to assume pod %s on %s (%s); failing task",
+                             alloc.allocation_key, alloc.node_id, reason)
                 dispatch_mod.dispatch(TaskEventRecord(
                     alloc.application_id, alloc.allocation_key, task_mod.TASK_FAIL,
-                    ("failed to assume pod (pod missing from cache)",)))
+                    (f"failed to assume pod ({reason})",)))
                 continue
             dispatch_mod.dispatch(TaskEventRecord(
                 alloc.application_id, alloc.allocation_key, task_mod.TASK_ALLOCATED,

@@ -13,6 +13,7 @@ here is exactly what cache/context.py + the snapshot encoder read.
 from __future__ import annotations
 
 import calendar
+import copy
 import time
 from typing import Any, Dict, List, Optional
 
@@ -344,11 +345,29 @@ def decode_pvc(doc: Dict[str, Any]) -> "PersistentVolumeClaim":
         volume_name=volume_name,
         requested_storage=requested,
         access_modes=list(spec.get("accessModes") or ["ReadWriteOnce"]),
+        raw=doc,
     )
 
 
 def encode_pvc(pvc) -> Dict[str, Any]:
-    doc: Dict[str, Any] = {
+    """PVC → API document.
+
+    When the claim came from the API (raw present), merge the binder's
+    mutations into a copy of the original document: a full-object PUT must
+    keep volumeMode/selector/dataSource/resourceVersion or the real API
+    server rejects it (immutable-spec validation / conflict detection).
+    """
+    if getattr(pvc, "raw", None):
+        doc = copy.deepcopy(pvc.raw)
+        meta = doc.setdefault("metadata", {})
+        meta["annotations"] = dict(pvc.metadata.annotations)
+        meta["labels"] = dict(pvc.metadata.labels)
+        if pvc.volume_name:
+            doc.setdefault("spec", {})["volumeName"] = pvc.volume_name
+        if pvc.bound:
+            doc.setdefault("status", {})["phase"] = "Bound"
+        return doc
+    doc = {
         "apiVersion": "v1",
         "kind": "PersistentVolumeClaim",
         "metadata": {
@@ -405,11 +424,32 @@ def decode_pv(doc: Dict[str, Any]) -> "PersistentVolume":
         claim_ref=claim_ref,
         phase=status.get("phase", "Available") or "Available",
         node_affinity=node_affinity,
+        raw=doc,
     )
 
 
+def _claim_ref_doc(claim_ref: str) -> Dict[str, Any]:
+    ns, name = claim_ref.split("/", 1)
+    return {"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "namespace": ns, "name": name}
+
+
 def encode_pv(pv) -> Dict[str, Any]:
-    doc: Dict[str, Any] = {
+    """PV → API document.
+
+    When the volume came from the API (raw present), merge the binder's
+    mutations (claimRef, phase) into a copy of the original document — PV
+    validation requires exactly one volume source (csi/nfs/hostPath/...),
+    which the simplified model does not carry, so a synthesized document
+    would be rejected by a real API server.
+    """
+    if getattr(pv, "raw", None):
+        doc = copy.deepcopy(pv.raw)
+        if pv.claim_ref:
+            doc.setdefault("spec", {})["claimRef"] = _claim_ref_doc(pv.claim_ref)
+        doc.setdefault("status", {})["phase"] = pv.phase
+        return doc
+    doc = {
         "apiVersion": "v1",
         "kind": "PersistentVolume",
         "metadata": {"name": pv.metadata.name},
@@ -421,8 +461,7 @@ def encode_pv(pv) -> Dict[str, Any]:
         "status": {"phase": pv.phase},
     }
     if pv.claim_ref:
-        ns, name = pv.claim_ref.split("/", 1)
-        doc["spec"]["claimRef"] = {"namespace": ns, "name": name}
+        doc["spec"]["claimRef"] = _claim_ref_doc(pv.claim_ref)
     if pv.node_affinity:
         doc["spec"]["nodeAffinity"] = {"required": {"nodeSelectorTerms": [
             {"matchExpressions": [

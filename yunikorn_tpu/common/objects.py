@@ -325,6 +325,11 @@ class PersistentVolumeClaim:
     # requested storage bytes + access modes for static PV matching
     requested_storage: int = 0
     access_modes: List[str] = dataclasses.field(default_factory=lambda: ["ReadWriteOnce"])
+    # original API document (real adapter): encode_pvc merges mutations into
+    # a copy of this so full-object PUTs keep volumeMode/selector/resources/
+    # resourceVersion — fields the simplified model does not carry
+    raw: Optional[Dict[str, Any]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     @property
     def selected_node(self) -> str:
@@ -345,6 +350,11 @@ class PersistentVolume:
     phase: str = "Available"                # Available | Bound | Released
     # simplified node affinity: required node-label matches ({} = any node)
     node_affinity: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # original API document (real adapter): encode_pv merges mutations into a
+    # copy of this so full-object PUTs keep the volume source (csi/nfs/...)
+    # and resourceVersion — a PV without a source fails API validation
+    raw: Optional[Dict[str, Any]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     @property
     def name(self) -> str:

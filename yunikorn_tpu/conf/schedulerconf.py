@@ -54,6 +54,7 @@ CM_SOLVER_SCORING_POLICY = PREFIX_SOLVER + "scoringPolicy"
 CM_SOLVER_DEVICE_PLATFORM = PREFIX_SOLVER + "platform"
 CM_SOLVER_USE_PALLAS = PREFIX_SOLVER + "usePallas"     # auto | true | false
 CM_SOLVER_SHARD = PREFIX_SOLVER + "shardSolve"         # auto | true | false
+CM_SOLVER_FALLBACK_ROUNDS = PREFIX_SOLVER + "localityFallbackRounds"
 
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
@@ -98,6 +99,9 @@ class SchedulerConf:
     # at first solve (pallas: TPU only; shard: >1 visible device)
     solver_use_pallas: str = "auto"
     solver_shard: str = "auto"
+    # intra-cycle drain rounds for locality groups that overflow the tensor
+    # encoding (0 disables: one pod per group per cycle, round-2 behavior)
+    solver_fallback_rounds: int = 16
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -205,6 +209,9 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
         conf.solver_max_rounds = _parse_int(data[CM_SOLVER_MAX_ROUNDS], conf.solver_max_rounds)
     if CM_SOLVER_POD_CHUNK in data:
         conf.solver_pod_chunk = _parse_int(data[CM_SOLVER_POD_CHUNK], conf.solver_pod_chunk)
+    if CM_SOLVER_FALLBACK_ROUNDS in data:
+        conf.solver_fallback_rounds = _parse_int(
+            data[CM_SOLVER_FALLBACK_ROUNDS], conf.solver_fallback_rounds)
     for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
                       (CM_SOLVER_SHARD, "solver_shard")):
         if key in data:

@@ -101,6 +101,9 @@ class SolverOptions:
     chunk: int = 512
     use_pallas: Optional[bool] = None
     shard: Optional[bool] = None
+    # intra-cycle drain rounds for locality-fallback groups (0 = one pod per
+    # group per cycle)
+    fallback_rounds: int = 16
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -115,6 +118,7 @@ class SolverOptions:
             chunk=chunk,
             use_pallas=tri.get(conf.solver_use_pallas, None),
             shard=tri.get(conf.solver_shard, None),
+            fallback_rounds=max(int(conf.solver_fallback_rounds), 0),
         )
 
 
@@ -705,6 +709,8 @@ class CoreScheduler(SchedulerAPI):
         new_allocs: List[Allocation] = []
         skipped_keys: List[Tuple[str, str]] = []
         unplaced_asks: List = []
+        fallback_keys: List[str] = []   # allocs placed by the fallback drain
+        fb_rounds = 0
         t_gate = time.time()
         if admitted:
             # overlay BEFORE sync: an assume landing in between then counts
@@ -748,9 +754,15 @@ class CoreScheduler(SchedulerAPI):
             # qname -> (user, groups-tuple) -> accumulator
             user_totals: Dict[str, Dict[Tuple[str, tuple], Dict[str, int]]] = {}
             limits_exist = self.queues.any_limits()
+            # asks parked by locality-fallback serialization: drained in
+            # intra-cycle rounds below instead of waiting a cycle per pod
+            deferred_set = set(batch.deferred) if self.solver.fallback_rounds > 0 else set()
+            fallback_placed: List[Tuple[object, str]] = []
             for i, ask in enumerate(admitted):
                 idx = int(assigned[i])
                 if idx < 0:
+                    if i in deferred_set:
+                        continue  # retried below, same cycle
                     skipped_keys.append((ask.application_id, ask.allocation_key))
                     unplaced_asks.append(ask)
                     continue
@@ -775,6 +787,8 @@ class CoreScheduler(SchedulerAPI):
                         user_totals.setdefault(app.queue_name, {}).setdefault(
                             (app.user.user, tuple(app.user.groups)), {}),
                         alloc.resource)
+                if deferred_set and ask.pod is not None:
+                    fallback_placed.append((ask.pod, node_name))
                 new_allocs.append(alloc)
             for qname, total in leaf_totals.items():
                 leaf = self.queues.resolve(qname, create=False)
@@ -783,6 +797,22 @@ class CoreScheduler(SchedulerAPI):
                     if limits_exist and leaf.has_limits_in_chain():
                         for (user, groups), ut in user_totals.get(qname, {}).items():
                             leaf.add_user_allocated(user, Resource(ut), list(groups))
+            if batch.locality is not None and batch.locality.fallback:
+                self.metrics["locality_fallback_groups_total"] = (
+                    self.metrics.get("locality_fallback_groups_total", 0)
+                    + len(batch.locality.fallback))
+            if deferred_set:
+                self.metrics["locality_fallback_deferred_total"] = (
+                    self.metrics.get("locality_fallback_deferred_total", 0)
+                    + len(deferred_set))
+                drained, still_blocked, fb_rounds = self._drain_locality_fallback(
+                    [admitted[i] for i in sorted(deferred_set)],
+                    fallback_placed, node_mask, policy)
+                new_allocs.extend(drained)
+                fallback_keys.extend(a.allocation_key for a in drained)
+                for ask in still_blocked:
+                    skipped_keys.append((ask.application_id, ask.allocation_key))
+                    unplaced_asks.append(ask)
         self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
         self.metrics["allocation_attempt_failed"] += len(skipped_keys)
         self.metrics["solve_count"] += 1
@@ -846,6 +876,9 @@ class CoreScheduler(SchedulerAPI):
                 "post_ms": round((end - t_commit) * 1000, 2),
                 "total_ms": round((end - t0) * 1000, 2),
             }
+            if fb_rounds:
+                entry["fallback_rounds"] = fb_rounds
+                entry["fallback_placed"] = len(fallback_keys)
             # copy-on-write, published fully built: get_partition_dao's
             # shallow metrics copy may be serialized outside the lock; never
             # mutate a dict a reader could be iterating
@@ -854,11 +887,12 @@ class CoreScheduler(SchedulerAPI):
                 self.partition.name: entry,
             }
         return len(new_allocs), (pinned, replaced, new_allocs,
-                                 preempt_releases, skipped_keys)
+                                 preempt_releases, skipped_keys, fallback_keys)
 
     def _publish_cycle(self, payload) -> None:
         """Deliver one partition cycle's RM-callback traffic (lock NOT held)."""
-        pinned, replaced, new_allocs, preempt_releases, skipped_keys = payload
+        (pinned, replaced, new_allocs, preempt_releases, skipped_keys,
+         fallback_keys) = payload
         if self.callback is None:
             return
         # core event stream → shim PublishEvents (reference forwards core
@@ -871,6 +905,17 @@ class CoreScheduler(SchedulerAPI):
                         message=f"allocated on node {a.node_id}")
             for a in new_allocs[:200]  # bounded per cycle
         ]
+        # operator visibility for the locality-overflow path: these pods'
+        # constraints exceed the tensor encoding and took the exact
+        # host-evaluated fallback (throughput: rounds, not one pod per cycle)
+        fb = set(fallback_keys[:100])
+        events.extend(
+            EventRecord(type=EventRecordType.REQUEST, object_id=a.allocation_key,
+                        reference_id=a.node_id, reason="LocalityEncodingOverflow",
+                        message="constraints overflow the tensor encoding; "
+                                "scheduled via exact host-path fallback")
+            for a in new_allocs if a.allocation_key in fb
+        )
         if events:
             self.callback.send_event(events)
         if pinned:
@@ -890,6 +935,73 @@ class CoreScheduler(SchedulerAPI):
                     reason="insufficient cluster resources or no feasible node",
                 )
             )
+
+    def _drain_locality_fallback(self, remaining, placements, node_mask,
+                                 policy) -> Tuple[List[Allocation], List, int]:
+        """Same-cycle drain of locality-fallback groups (core lock held).
+
+        Groups whose constraints overflow the tensor encoding get an exact
+        host-evaluated mask that cannot see intra-batch placements, so each
+        solve admits one pod per group. Instead of paying a full scheduling
+        cycle per pod (the round-2 cliff: 1 pod/cycle), re-solve the parked
+        remainder in small intra-cycle rounds: each round rebuilds the host
+        masks with this cycle's commitments overlaid (extra_placed) and the
+        inflight free-delta, so an overflowing group schedules in O(rounds).
+
+        Returns (committed allocations, still-unplaced asks, rounds used).
+        """
+        import numpy as np
+
+        so = self.solver
+        committed: List[Allocation] = []
+        rounds = 0
+        while remaining and rounds < so.fallback_rounds:
+            rounds += 1
+            # same ordering invariant as the main cycle: overlay BEFORE sync.
+            # The overlay picks up this cycle's commits; an assume landing in
+            # between counts twice (overlay + synced free) — conservative,
+            # never over-committing. Without the re-sync, an assume landing
+            # mid-drain would drop its alloc from the overlay while the free
+            # arrays still predate it — under-counting, over-commit.
+            overlay = self._inflight_overlay()
+            self.encoder.sync_nodes()
+            batch = self.encoder.build_batch(remaining, extra_placed=placements)
+            result = solve_batch(batch, self.encoder.nodes, policy=policy,
+                                 max_rounds=so.max_rounds, chunk=so.chunk,
+                                 use_pallas=self._use_pallas,
+                                 free_delta=overlay, node_mask=node_mask)
+            assigned = np.asarray(result.assigned)[: batch.num_pods]
+            progress = False
+            next_remaining: List = []
+            for i, ask in enumerate(remaining):
+                idx = int(assigned[i])
+                node_name = (self.encoder.nodes.name_of(idx) if idx >= 0
+                             else None)
+                if node_name is None:
+                    # parked again (next group slot) or infeasible right now;
+                    # feasibility can improve as siblings place, so keep it
+                    # until a round makes no progress at all
+                    next_remaining.append(ask)
+                    continue
+                alloc = Allocation(
+                    allocation_key=ask.allocation_key,
+                    application_id=ask.application_id,
+                    node_id=node_name,
+                    resource=ask.resource,
+                    priority=ask.priority,
+                    placeholder=ask.placeholder,
+                    task_group_name=ask.task_group_name,
+                    tags=dict(ask.tags),
+                )
+                self._commit_allocation(alloc)
+                if ask.pod is not None:
+                    placements.append((ask.pod, node_name))
+                committed.append(alloc)
+                progress = True
+            if not progress:
+                break
+            remaining = next_remaining
+        return committed, remaining, rounds
 
     def _allocate_required_node_asks(self) -> List[Allocation]:
         """DaemonSet-style asks pinned to one node (ask.preferred_node, the

@@ -185,6 +185,12 @@ class PodBatch:
     locality: Optional[object]         # snapshot.locality.LocalityBatch or None
     num_pods: int
     num_groups: int
+    # ask indices parked by locality-fallback serialization ONLY (their host
+    # mask can't see intra-batch placements); the core's fallback drain loop
+    # re-solves these same-cycle with an extra_placed overlay. Pods parked
+    # for DRA class serialization are NOT here — re-solving them before the
+    # shim pins device allocations would race one inventory.
+    deferred: List[int] = dataclasses.field(default_factory=list)
 
 
 class NodeArrays:
@@ -688,7 +694,8 @@ class SnapshotEncoder:
         return [(idx, self.cache.get_node(name))
                 for idx, name in list(self.nodes._idx_to_name.items())]
 
-    def _volume_mask(self, volumes: Tuple[str, tuple]) -> Optional[np.ndarray]:
+    def _volume_mask(self, volumes: Tuple[str, tuple],
+                     rows=None) -> Optional[np.ndarray]:
         """[capacity] bool mask of nodes where every claim is satisfiable, or
         None when the claims impose no node restriction (the common case).
 
@@ -703,7 +710,8 @@ class SnapshotEncoder:
         ns, names = volumes
         M = self.nodes.capacity
         mask: Optional[np.ndarray] = None
-        rows = self._host_rows()               # one cache pass per call
+        if rows is None:
+            rows = self._host_rows()           # one cache pass per call
 
         def label_mask(affinity: Dict[str, str]) -> np.ndarray:
             out = np.zeros((M,), bool)
@@ -825,8 +833,14 @@ class SnapshotEncoder:
         ranks: Optional[Sequence[float]] = None,
         queue_ids: Optional[Sequence[int]] = None,
         min_batch: int = 64,
+        extra_placed=None,
     ) -> PodBatch:
-        """Encode a list of pending asks into one padded solve batch."""
+        """Encode a list of pending asks into one padded solve batch.
+
+        extra_placed: [(Pod, node_name)] intra-cycle placements not yet in
+        the cache, overlaid onto host-evaluated locality masks/scores (used
+        by the core's locality-fallback drain rounds).
+        """
         rv = self.vocabs.resources
         n = len(asks)
         N = _bucket(max(n, 1), min_batch)
@@ -952,7 +966,10 @@ class SnapshotEncoder:
                 continue
             vm = vol_mask_cache.get(spec.volumes, False)
             if vm is False:
-                vm = vol_mask_cache[spec.volumes] = self._volume_mask(spec.volumes)
+                if host_rows is None:
+                    host_rows = self._host_rows()
+                vm = vol_mask_cache[spec.volumes] = self._volume_mask(
+                    spec.volumes, host_rows)
             if vm is None:
                 continue  # unconstrained
             if host_mask is None:
@@ -978,7 +995,8 @@ class SnapshotEncoder:
         from yunikorn_tpu.snapshot.locality import encode_locality
 
         locality = encode_locality(asks, group_ids, len(group_specs),
-                                   self.nodes, self.cache, N, G)
+                                   self.nodes, self.cache, N, G,
+                                   extra_placed=extra_placed)
 
         if locality is not None and locality.soft_static:
             # soft constraints that spilled the slot budget: statically scored
@@ -1015,6 +1033,7 @@ class SnapshotEncoder:
                             for c in self.cache.dra_unallocated_classes(ns, names))
             if keys:
                 serial_keys_of[gi] = tuple(keys)
+        deferred: List[int] = []
         if serial_keys_of:
             seen_keys: set = set()
             for i in range(n):
@@ -1023,6 +1042,10 @@ class SnapshotEncoder:
                     continue
                 if any(k in seen_keys for k in keys):
                     valid[i] = False
+                    # drainable same-cycle only when every blocking key is a
+                    # locality one (DRA inventory needs the shim's assume)
+                    if all(k[0] == "loc" for k in keys):
+                        deferred.append(i)
                 else:
                     seen_keys.update(keys)
 
@@ -1048,6 +1071,7 @@ class SnapshotEncoder:
             locality=locality,
             num_pods=n,
             num_groups=len(group_specs),
+            deferred=deferred,
         )
 
     def quantize_request(self, r: Resource) -> np.ndarray:

@@ -298,9 +298,14 @@ class _LocAccum:
         return idx
 
 
-def _host_eval_env(cache, node_arrays):
+def _host_eval_env(cache, node_arrays, extra_placed=None):
     """Shared scaffolding for the host evaluation paths: node rows, placed
-    (pod, node-idx) pairs, and a memoized per-topo-key domain-value map."""
+    (pod, node-idx) pairs, and a memoized per-topo-key domain-value map.
+
+    extra_placed: optional [(Pod, node_name)] overlay of placements not yet
+    visible in the cache (this cycle's committed allocations) — lets the
+    fallback drain loop re-evaluate masks against intra-cycle state.
+    """
     rows = list(node_arrays._idx_to_name.items())
     placed: List[Tuple[Pod, int]] = []
     for p in cache.pods_map.values():
@@ -310,6 +315,14 @@ def _host_eval_env(cache, node_arrays):
         n_idx = node_arrays._name_to_idx.get(node_name)
         if n_idx is not None:
             placed.append((p, n_idx))
+    if extra_placed:
+        in_cache = {p.uid for p, _ in placed}
+        for p, node_name in extra_placed:
+            if p.uid in in_cache:
+                continue  # assume already landed; don't double count
+            n_idx = node_arrays._name_to_idx.get(node_name)
+            if n_idx is not None:
+                placed.append((p, n_idx))
     dom_cache: Dict[str, Dict[int, Optional[str]]] = {}
 
     def vals_of(topo_key: str) -> Dict[int, Optional[str]]:
@@ -331,7 +344,7 @@ def _host_eval_env(cache, node_arrays):
     return rows, placed, vals_of
 
 
-def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
+def host_locality_mask(pod: Pod, cache, node_arrays, extra_placed=None) -> np.ndarray:
     """Exact per-pod evaluation of locality constraints on the host.
 
     Fallback for constraint groups that overflow the tensor encoding
@@ -340,11 +353,12 @@ def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
     against *existing* cluster state — the reference's per-(pod,node) behavior
     (InterPodAffinity / PodTopologySpread filters). Callers must serialize
     such groups (at most one pod per solve) so intra-batch placements cannot
-    violate the constraints; each cycle re-evaluates with fresh counts.
+    violate the constraints; the core's fallback drain loop re-solves with an
+    extra_placed overlay so an overflowing group costs rounds, not cycles.
     """
     M = node_arrays.capacity
     ok = np.zeros(M, bool)
-    rows, placed, vals_of = _host_eval_env(cache, node_arrays)
+    rows, placed, vals_of = _host_eval_env(cache, node_arrays, extra_placed)
     for idx, _name in rows:
         ok[idx] = True
 
@@ -396,7 +410,8 @@ def host_locality_mask(pod: Pod, cache, node_arrays) -> np.ndarray:
     return ok
 
 
-def host_locality_soft_scores(pod: Pod, soft_cons, cache, node_arrays) -> np.ndarray:
+def host_locality_soft_scores(pod: Pod, soft_cons, cache, node_arrays,
+                              extra_placed=None) -> np.ndarray:
     """[M] float32 score adjustment for soft constraints scored on the host.
 
     Used when soft slots spill the tensor budget: same rules as the in-solve
@@ -406,7 +421,7 @@ def host_locality_soft_scores(pod: Pod, soft_cons, cache, node_arrays) -> np.nda
     """
     M = node_arrays.capacity
     scores = np.zeros((M,), np.float32)
-    rows, placed, vals_of = _host_eval_env(cache, node_arrays)
+    rows, placed, vals_of = _host_eval_env(cache, node_arrays, extra_placed)
 
     for kind, spec, weight in soft_cons:
         vals = vals_of(spec.topo_key)
@@ -438,13 +453,15 @@ def encode_locality(
     cache,
     batch_n: int,
     batch_g: int,
+    extra_placed=None,
 ) -> Optional[LocalityBatch]:
     """Build the LocalityBatch for a solve, or None if nothing needs it.
 
     Groups whose constraints cannot be encoded (slot or group overflow) get
     an exact host-evaluated feasibility mask in .fallback instead — the
     encoder serializes them to one pod per solve so they schedule correctly
-    rather than starving.
+    rather than starving; the core drains the rest in intra-cycle rounds
+    (extra_placed carries this cycle's commitments into the mask).
     """
     accum = _LocAccum()
     g_refs = np.full((batch_g, MAX_CONSTRAINT_SLOTS), -1, np.int32)
@@ -462,11 +479,12 @@ def encode_locality(
     def fall_back(gid: int, pod: Pod, why: str) -> None:
         # Constraints that overflow the tensor encoding are evaluated exactly
         # on the host instead of blocking the group (pods would starve with
-        # no feedback); the encoder serializes the group to one pod per solve.
+        # no feedback); the encoder serializes the group to one pod per solve
+        # and the core drains the remainder in intra-cycle fallback rounds.
         logger.info("locality constraints for group %d overflow the tensor "
                     "encoding (%s); falling back to host evaluation "
-                    "(serialized to one pod per cycle)", gid, why)
-        fallback[gid] = host_locality_mask(pod, cache, node_arrays)
+                    "(serialized to one pod per solve)", gid, why)
+        fallback[gid] = host_locality_mask(pod, cache, node_arrays, extra_placed)
 
     for ask, gid in zip(asks, group_ids):
         if gid in seen_groups or ask.pod is None:
@@ -506,7 +524,7 @@ def encode_locality(
             fall_back(gid, pod, "group or slot overflow")
             if soft_cons:
                 soft_static[gid] = host_locality_soft_scores(
-                    pod, soft_cons, cache, node_arrays)
+                    pod, soft_cons, cache, node_arrays, extra_placed)
             continue
         # soft (scoring) slots fill whatever budget remains; ones that don't
         # fit are scored statically against existing state instead (approximate
@@ -523,7 +541,7 @@ def encode_locality(
             slots.append((l_idx, kind, 0, False, weight))
         if soft_spill:
             soft_static[gid] = host_locality_soft_scores(
-                pod, soft_spill, cache, node_arrays)
+                pod, soft_spill, cache, node_arrays, extra_placed)
         for s, (l, kind, skew, seed, weight) in enumerate(slots):
             g_refs[gid, s] = l
             g_kind[gid, s] = kind
