@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Create or delete sleep-pod deployments targeting the yunikorn-tpu scheduler
+# (analog of the reference's deploy-tool.sh:35-67 workload driver).
+#
+# Each deployment is one application: pods carry the applicationId/queue
+# labels the shim's metadata extraction reads, set schedulerName so the
+# default scheduler leaves them alone, and tolerate the kwok node taint.
+#
+# Usage:
+#   ./deploy-tool.sh [-i <seconds>] <deployment_count> <replicas_per_deployment>
+#   ./deploy-tool.sh -d <deployment_count>            # delete
+set -euo pipefail
+
+SCHEDULER_NAME="${SCHEDULER_NAME:-yunikorn}"
+QUEUE="${QUEUE:-root.default}"
+delete=false
+interval=0
+
+while getopts ":di:" opt; do
+  case $opt in
+    d) delete=true ;;
+    i) interval="$OPTARG" ;;
+    *) echo "usage: $0 [-d] [-i interval] <count> [replicas]" >&2; exit 1 ;;
+  esac
+done
+shift $((OPTIND - 1))
+COUNT="${1:?usage: $0 [-d] [-i interval] <count> [replicas]}"
+
+if $delete; then
+  for ((i = 0; i < COUNT; i++)); do
+    kubectl delete "deploy/sleep-app-${i}" --ignore-not-found
+  done
+  exit 0
+fi
+
+REPLICAS="${2:?replicas_per_deployment required when creating}"
+for ((i = 0; i < COUNT; i++)); do
+  kubectl apply -f - <<EOF
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: sleep-app-${i}
+  labels: {app: sleep, applicationId: "sleep-app-${i}", queue: "${QUEUE}"}
+spec:
+  replicas: ${REPLICAS}
+  selector:
+    matchLabels: {deployment: sleep-app-${i}}
+  template:
+    metadata:
+      labels:
+        deployment: sleep-app-${i}
+        applicationId: "sleep-app-${i}"
+        queue: "${QUEUE}"
+    spec:
+      schedulerName: ${SCHEDULER_NAME}
+      containers:
+        - name: sleep
+          image: alpine:latest
+          command: ["sleep", "300"]
+          resources:
+            requests: {cpu: 100m, memory: 128Mi}
+      tolerations:
+        - {key: kwok.x-k8s.io/node, operator: Exists, effect: NoSchedule}
+EOF
+  [ "$interval" != 0 ] && sleep "$interval"
+done
+echo "created ${COUNT} deployments x ${REPLICAS} replicas"

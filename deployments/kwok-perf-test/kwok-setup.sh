@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Provision kwok-simulated nodes for scheduler perf testing (analog of the
+# reference's kwok setup, deployments/kwok-perf-test/kwok-setup.sh:30-60).
+#
+# Installs the kwok controller + fast stages into the current kube context,
+# then registers N fake nodes shaped like the BASELINE.md perf fixture
+# (32 cpu / 256Gi / 110 pods). Nodes carry the kwok NoSchedule taint so real
+# workloads stay off them; the deploy-tool's pods tolerate it.
+#
+# Usage: ./kwok-setup.sh <number_of_nodes> [node_prefix]
+set -euo pipefail
+
+NODES="${1:?usage: $0 <number_of_nodes> [node_prefix]}"
+PREFIX="${2:-kwok-node}"
+KWOK_REPO="kubernetes-sigs/kwok"
+
+if ! kubectl get deployment -n kube-system kwok-controller >/dev/null 2>&1; then
+  TAG=$(curl -s "https://api.github.com/repos/${KWOK_REPO}/releases/latest" \
+        | sed -n 's/.*"tag_name": *"\([^"]*\)".*/\1/p')
+  echo "installing kwok ${TAG}"
+  kubectl apply -f "https://github.com/${KWOK_REPO}/releases/download/${TAG}/kwok.yaml"
+  kubectl apply -f "https://github.com/${KWOK_REPO}/releases/download/${TAG}/stage-fast.yaml"
+fi
+
+# One generated manifest, one server-side apply: registering 10k nodes via
+# per-node kubectl round-trips takes ~hours; this takes ~a minute.
+MANIFEST=$(mktemp /tmp/kwok-nodes-XXXX.yaml)
+trap 'rm -f "$MANIFEST"' EXIT
+for ((i = 0; i < NODES; i++)); do
+  cat >>"$MANIFEST" <<EOF
+apiVersion: v1
+kind: Node
+metadata:
+  name: ${PREFIX}-${i}
+  annotations:
+    node.alpha.kubernetes.io/ttl: "0"
+    kwok.x-k8s.io/node: fake
+  labels:
+    kubernetes.io/hostname: ${PREFIX}-${i}
+    kubernetes.io/os: linux
+    node-role.kubernetes.io/agent: ""
+    type: kwok
+spec:
+  taints:
+    - key: kwok.x-k8s.io/node
+      value: fake
+      effect: NoSchedule
+status:
+  allocatable: {cpu: "32", memory: 256Gi, pods: "110"}
+  capacity: {cpu: "32", memory: 256Gi, pods: "110"}
+  nodeInfo: {kubeletVersion: fake, operatingSystem: linux, architecture: amd64}
+  phase: Running
+---
+EOF
+done
+kubectl apply --server-side -f "$MANIFEST"
+echo "registered ${NODES} kwok nodes (${PREFIX}-0 .. ${PREFIX}-$((NODES - 1)))"

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Run the yunikorn-tpu scheduler binary against a live cluster (kind, kwok,
+# or real): the counterpart of deploying the reference's scheduler image
+# (deployments/scheduler/scheduler.yaml) for an out-of-cluster perf run.
+#
+# Usage: ./run-scheduler.sh [kubeconfig] [extra scheduler args...]
+set -euo pipefail
+
+KUBECONFIG_PATH="${1:-${KUBECONFIG:-$HOME/.kube/config}}"
+shift || true
+REPO_ROOT="$(cd "$(dirname "$0")/../.." && pwd)"
+
+PYTHONPATH="${REPO_ROOT}${PYTHONPATH:+:$PYTHONPATH}" \
+exec python -m yunikorn_tpu.cmd.scheduler \
+  --kubeconfig "$KUBECONFIG_PATH" "$@"

@@ -92,6 +92,12 @@ class FakeAPIServer:
     def start(self) -> int:
         server = self
 
+        class Server(ThreadingHTTPServer):
+            # default accept backlog (5) resets connections when 32 bind-pool
+            # workers + relisting informers hit the server at once — a real
+            # apiserver doesn't shed load that way
+            request_queue_size = 256
+
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
@@ -244,6 +250,17 @@ class FakeAPIServer:
                 if len(parts) == 7 and parts[4] == "pods" and parts[6] == "binding":
                     ns, name = parts[3], parts[5]
                     node = (body.get("target") or {}).get("name", "")
+                    with server._lock:
+                        doc = server.store["pods"].get(f"{ns}/{name}")
+                        already = (doc or {}).get("spec", {}).get("nodeName", "")
+                    if already:
+                        # real apiserver: binding an assigned pod is 409
+                        # Conflict — exactly what a retried bind whose first
+                        # attempt landed (connection reset after commit) sees
+                        return self._send_json(
+                            {"kind": "Status", "code": 409, "reason": "Conflict",
+                             "message": f"pod {name} is already assigned "
+                                        f"to node {already}"}, 409)
                     server.bind_pod(ns, name, node)
                     return self._send_json({"kind": "Status", "status": "Success"}, 201)
                 # namespaced collection create — core (/api/v1/namespaces/ns/k)
@@ -319,7 +336,7 @@ class FakeAPIServer:
                         return self._send_json({"kind": "Status", "code": 404}, 404)
                 self._send_json({"kind": "Status", "status": "Success"})
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._httpd = Server(("127.0.0.1", 0), Handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         return self._httpd.server_port
 

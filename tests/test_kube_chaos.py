@@ -186,3 +186,73 @@ def test_store_snapshot_consistent_under_churn(api):
         t.join(timeout=5)
     assert not errors
     provider.stop()
+
+
+def test_transient_connection_errors_retried(api):
+    """request_json retries connection-level failures with backoff; HTTP
+    status errors pass through untouched."""
+    import urllib.error
+
+    from yunikorn_tpu.client.kube import KubeConfig, RealKubeClient
+
+    server, cfg = api
+    client = RealKubeClient(cfg)
+    calls = {"n": 0}
+    real = client._request
+
+    def flaky(method, path, body=None, content_type="application/json",
+              timeout=30.0):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise ConnectionResetError(104, "Connection reset by peer")
+        return real(method, path, body, content_type, timeout)
+
+    client._request = flaky
+    server.add_node_doc("rt-n0")
+    doc = client.request_json("GET", "/api/v1/nodes/rt-n0")
+    assert doc["metadata"]["name"] == "rt-n0"
+    assert calls["n"] == 3                      # two resets, one success
+
+    before = calls["n"]                         # stub past its flaky window
+    with pytest.raises(urllib.error.HTTPError):
+        client.request_json("GET", "/api/v1/nodes/does-not-exist")
+    assert calls["n"] == before + 1             # 404 not retried
+
+
+def test_bind_retry_after_committed_first_attempt(api):
+    """A bind whose first POST landed but whose response was lost (connection
+    reset) is retried; the retry's 409 Conflict resolves to success because
+    the pod is assigned to OUR node. A 409 against a different node raises."""
+    import urllib.error
+
+    from yunikorn_tpu.client.k8s_codec import decode_pod
+    from yunikorn_tpu.client.kube import KubeConfig, RealKubeClient
+
+    server, cfg = api
+    client = RealKubeClient(cfg)
+    server.add_node_doc("bn0")
+    server.add_pod_doc("bp0")
+    pod = decode_pod(server.store["pods"]["default/bp0"])
+
+    # sever the response of the FIRST binding POST only
+    real = client._request
+    state = {"first": True}
+
+    def reset_after_commit(method, path, body=None,
+                           content_type="application/json", timeout=30.0):
+        if path.endswith("/binding") and state["first"]:
+            state["first"] = False
+            real(method, path, body, content_type, timeout).read()  # commits
+            raise ConnectionResetError(104, "Connection reset by peer")
+        return real(method, path, body, content_type, timeout)
+
+    client._request = reset_after_commit
+    client.bind(pod, "bn0")                     # retry sees 409 -> ours -> ok
+    assert server.bindings == [("bp0", "bn0")]  # exactly one binding
+
+    # conflicting assignment to a DIFFERENT node must still raise
+    server.add_pod_doc("bp1")
+    pod1 = decode_pod(server.store["pods"]["default/bp1"])
+    server.bind_pod("default", "bp1", "other-node")
+    with pytest.raises(urllib.error.HTTPError):
+        client.bind(pod1, "bn0")

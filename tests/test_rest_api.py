@@ -291,3 +291,37 @@ def test_rclient_waits_and_typed_gets():
             rc.wait_for_app_state("rc-app", "Completed", timeout=0.5)
     finally:
         rest.stop()
+
+
+def test_prometheus_metrics_endpoint(stack):
+    """/metrics serves Prometheus text exposition (the scrape target of
+    deployments/scheduler/prometheus.yml and the Grafana dashboard)."""
+    ms, port = stack
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common import constants
+
+    ms.add_node(make_node("prom-n0", cpu_milli=8000))
+    pod = ms.add_pod(make_pod(
+        "prom-p0", cpu_milli=200, memory=2**20,
+        labels={constants.LABEL_APPLICATION_ID: "prom-app"},
+        scheduler_name=constants.SCHEDULER_NAME))
+    from yunikorn_tpu.cache import task as task_mod
+    ms.wait_for_task_state("prom-app", pod.uid, task_mod.BOUND)
+
+    req = urllib.request.Request(f"http://127.0.0.1:{port}/metrics")
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        text = resp.read().decode()
+    assert ctype.startswith("text/plain")
+    lines = text.splitlines()
+    assert any(l.startswith("yunikorn_allocation_attempt_allocated ") for l in lines)
+    assert any(l.startswith("# TYPE yunikorn_solve_count") for l in lines)
+    # per-partition cycle gauges carry a partition label
+    assert any(l.startswith('yunikorn_cycle_total_ms{partition="default"}')
+               for l in lines)
+    # every sample line parses as `name{labels} value`
+    for l in lines:
+        if l.startswith("#") or not l:
+            continue
+        name_part, _, value = l.rpartition(" ")
+        float(value)

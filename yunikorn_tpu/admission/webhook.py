@@ -106,14 +106,85 @@ class WebhookServer:
 class WebhookManager:
     """Maintains the webhook registrations + caBundle (reference :57-799).
 
-    Against a real cluster this installs/patches Mutating/Validating
-    WebhookConfiguration objects; here it renders the manifests so an adapter
-    (or operator) can apply them, and owns CA rotation.
+    Renders the Mutating/Validating WebhookConfiguration manifests, owns CA
+    rotation, and — given an API client — installs/patches them against the
+    cluster (reference InstallWebhooks, webhook_manager.go:185-379: create
+    when absent, update in place when the stored object drifts from desired,
+    notably after a caBundle rotation).
     """
+
+    WEBHOOK_PATHS = {
+        "MutatingWebhookConfiguration":
+            "/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations",
+        "ValidatingWebhookConfiguration":
+            "/apis/admissionregistration.k8s.io/v1/validatingwebhookconfigurations",
+    }
 
     def __init__(self, conf, cas: Optional[CACollection] = None):
         self.conf = conf
         self.cas = cas or CACollection()
+
+    # ------------------------------------------------------- cluster install
+    def install_webhooks(self, client) -> None:
+        """Create-or-update both WebhookConfigurations through the API.
+
+        client: anything with request_json(method, path, body) —
+        RealKubeClient in production, the fake API server's client in tests.
+        """
+        for cfg in (self.mutating_webhook_config(),
+                    self.validating_webhook_config()):
+            self._apply_webhook_config(client, cfg)
+
+    def _apply_webhook_config(self, client, cfg: dict) -> None:
+        import urllib.error
+
+        base = self.WEBHOOK_PATHS[cfg["kind"]]
+        name = cfg["metadata"]["name"]
+        try:
+            existing = client.request_json("GET", f"{base}/{name}")
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            client.request_json("POST", base, cfg)
+            logger.info("installed %s %s", cfg["kind"], name)
+            return
+        if not self._webhooks_drifted(existing.get("webhooks"), cfg["webhooks"]):
+            return                               # up to date (common case)
+        # preserve resourceVersion for optimistic concurrency on the replace
+        rv = (existing.get("metadata") or {}).get("resourceVersion")
+        if rv is not None:
+            cfg = {**cfg, "metadata": {**cfg["metadata"], "resourceVersion": rv}}
+        client.request_json("PUT", f"{base}/{name}", cfg)
+        logger.info("updated %s %s (caBundle/rules drift)", cfg["kind"], name)
+
+    @staticmethod
+    def _webhooks_drifted(existing, desired) -> bool:
+        """Compare only the fields this manager owns, with server-side
+        defaults stripped. A real apiserver defaults matchPolicy/
+        timeoutSeconds/namespaceSelector/... on the webhook, scope on each
+        rule, and port on the service ref; a verbatim comparison would see
+        permanent drift and rewrite the configurations on every startup and
+        rotation. (A false positive only costs one redundant PUT.)"""
+        def norm(w: dict) -> dict:
+            cc = dict(w.get("clientConfig") or {})
+            svc = dict(cc.get("service") or {})
+            if svc.get("port") == 443:           # server default
+                svc.pop("port")
+            cc["service"] = svc
+            rules = []
+            for r in w.get("rules") or []:
+                r = dict(r)
+                if r.get("scope") == "*":        # server default
+                    r.pop("scope")
+                rules.append(r)
+            return {"name": w.get("name"), "clientConfig": cc, "rules": rules,
+                    "failurePolicy": w.get("failurePolicy"),
+                    "sideEffects": w.get("sideEffects"),
+                    "admissionReviewVersions": w.get("admissionReviewVersions")}
+
+        if existing is None or len(existing) != len(desired):
+            return True
+        return any(norm(h) != norm(w) for h, w in zip(existing, desired))
 
     def mutating_webhook_config(self) -> dict:
         return {

@@ -600,6 +600,10 @@ class Context:
         (applied in add_node)."""
         limit = csinode.total_limit()
         if limit is None:
+            # CSINode still exists but reports no driver limits (driver
+            # uninstalled): forget the cap, or update_node's re-apply would
+            # pin the stale limit forever
+            self._on_csinode_deleted(csinode)
             return
         with self._lock:
             self._csinode_limits[csinode.name] = limit

@@ -16,8 +16,10 @@ behavior).
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import os
+import random
 import ssl
 import tempfile
 import threading
@@ -167,24 +169,59 @@ class RealKubeClient(KubeClient):
         return urllib.request.urlopen(req, context=self.config.ssl_context,
                                       timeout=timeout)
 
+    # transient transport failures worth a bounded retry: connection-level
+    # errors where no HTTP status ever arrived (apiserver restart, LB blip,
+    # accept-queue shed). HTTP errors are NOT retried here — the caller owns
+    # status semantics (e.g. bind() treating 409 as already-bound).
+    RETRY_STEPS = 3
+    _TRANSIENT = (ConnectionResetError, ConnectionRefusedError,
+                  BrokenPipeError, http.client.RemoteDisconnected,
+                  TimeoutError)
+
     def request_json(self, method: str, path: str, body: Optional[dict] = None,
                      content_type: str = "application/json") -> dict:
-        with self._request(method, path, body, content_type) as resp:
-            return json.loads(resp.read() or b"{}")
+        for attempt in range(self.RETRY_STEPS + 1):
+            try:
+                with self._request(method, path, body, content_type) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError:
+                raise
+            except urllib.error.URLError as e:
+                if (attempt >= self.RETRY_STEPS
+                        or not isinstance(e.reason, self._TRANSIENT)):
+                    raise
+            except self._TRANSIENT:
+                if attempt >= self.RETRY_STEPS:
+                    raise
+            time.sleep(0.1 * (2 ** attempt) + random.uniform(0, 0.05))
 
     # -- KubeClient ---------------------------------------------------------
     def bind(self, pod: Pod, node_name: str) -> None:
-        """pods/binding subresource (reference kubeclient.go:111-134)."""
-        self.request_json(
-            "POST",
-            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
-            {
-                "apiVersion": "v1",
-                "kind": "Binding",
-                "metadata": {"name": pod.name, "uid": pod.uid},
-                "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
-            },
-        )
+        """pods/binding subresource (reference kubeclient.go:111-134).
+
+        409 Conflict means the pod is already assigned — either our own
+        retried POST whose first attempt landed before the connection died,
+        or a genuine race; the task's Bound/informer path reconciles both."""
+        try:
+            self.request_json(
+                "POST",
+                f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}/binding",
+                {
+                    "apiVersion": "v1",
+                    "kind": "Binding",
+                    "metadata": {"name": pod.name, "uid": pod.uid},
+                    "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+                },
+            )
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+            # already assigned: success only if it is assigned to OUR node
+            doc = self.request_json(
+                "GET", f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}")
+            assigned = ((doc.get("spec") or {}).get("nodeName")) or ""
+            if assigned != node_name:
+                raise
 
     def create(self, pod: Pod) -> Pod:
         doc = self.request_json(

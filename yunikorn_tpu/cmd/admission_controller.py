@@ -56,6 +56,9 @@ def main(argv=None) -> int:
         attach_informers(provider, holder, ns_cache, pc_cache,
                          namespace=conf.namespace)
         provider.start()
+        # register the webhooks with the current caBundle (reference
+        # main.go: wm.InstallWebhooks before serving)
+        manager.install_webhooks(provider.get_client())
     server = WebhookServer(controller, host=args.host, port=args.port,
                            use_tls=not args.no_tls, cas=cas)
     port = server.start()
@@ -65,11 +68,13 @@ def main(argv=None) -> int:
 
     def on_rotated(mutating_cfg, validating_cfg):
         # restart the TLS server so it serves a cert signed by the fresh CA
-        # (same reload the SIGUSR1 path performs); against a real cluster an
-        # operator/adapter applies the re-rendered WebhookConfigurations
+        # (same reload the SIGUSR1 path performs), then re-patch the cluster's
+        # WebhookConfigurations so their caBundle matches the new CA
         logger.info("applying rotated certificates (server restart)")
         server.stop()
         server.start()
+        if provider is not None:
+            manager.install_webhooks(provider.get_client())
 
     # background cert re-registration (reference WaitForCertificateExpiration
     # :223-232 + main.go restart-on-rotation)

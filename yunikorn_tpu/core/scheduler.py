@@ -1329,6 +1329,13 @@ class CoreScheduler(SchedulerAPI):
             self.callback.update_application(ApplicationResponse(updated=updates))
 
     # ------------------------------------------------------------- inspection
+    def metrics_snapshot(self) -> dict:
+        """Shallow metrics copy for hot read paths (/metrics scrapes): values
+        are scalars or copy-on-write dicts (last_cycle), so a shallow copy
+        under the lock is race-free without the full-DAO serialization."""
+        with self._lock:
+            return dict(self.metrics)
+
     def get_partition_dao(self) -> dict:
         with self._lock:
             default = self.partitions["default"]

@@ -48,6 +48,29 @@ def _usage_dao(core, partition: str, kind: str) -> list:
     return sorted(out.values(), key=lambda e: e["name"])
 
 
+def _prometheus_text(metrics: dict) -> str:
+    """Flatten the core's metrics dict into Prometheus exposition format:
+    numeric top-level entries become `yunikorn_<name>` counters/gauges; the
+    per-partition last_cycle stage timings become
+    `yunikorn_cycle_<stage>{partition="..."}` gauges."""
+    lines = []
+    for key, val in sorted(metrics.items()):
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        name = f"yunikorn_{key}"
+        kind = "counter" if key.endswith("_total") or key.endswith("_count") \
+            or key.startswith("allocation_") else "gauge"
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {val}")
+    for pname, entry in sorted((metrics.get("last_cycle") or {}).items()):
+        for stage, v in sorted(entry.items()):
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            name = f"yunikorn_cycle_{stage}"
+            lines.append(f'{name}{{partition="{pname}"}} {v}')
+    return "\n".join(lines) + "\n"
+
+
 class RestServer:
     def __init__(self, core, context=None, host: str = "127.0.0.1", port: int = 9080):
         self.core = core
@@ -75,6 +98,23 @@ class RestServer:
             def do_GET(self):
                 parsed = urlparse(self.path)
                 path = parsed.path.rstrip("/")
+
+                # hot endpoints first: /health (probes) and /metrics
+                # (Prometheus scrapes every few seconds) must not build the
+                # full partition DAO — serializing 10k nodes under the core
+                # lock per scrape would stall scheduling cycles
+                if path in ("/ws/v1/health", "/health"):
+                    return self._reply(200, {"Healthy": True})
+                if path == "/metrics":
+                    body = _prometheus_text(core.metrics_snapshot()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+
                 dao = core.get_partition_dao()
 
                 # /ws/v1/partition/{name}/{what...} — partition-parameterized
@@ -97,9 +137,7 @@ class RestServer:
                         return self._reply(200, _usage_dao(core, pname, "groups"))
                     return self._reply(404, {"error": f"unknown path {path}"})
 
-                if path in ("/ws/v1/health", "/health"):
-                    self._reply(200, {"Healthy": True})
-                elif path == "/ws/v1/partitions":
+                if path == "/ws/v1/partitions":
                     with core._lock:
                         names = sorted(core.partitions)
                     self._reply(200, names)
