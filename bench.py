@@ -95,6 +95,18 @@ def _init_backend_or_die() -> str:
     return platform
 
 
+def _cache_entries() -> int:
+    """Entry count of the persistent XLA compilation cache (cross-process
+    cold-start evidence: a backend whose compiles don't serialize — e.g. a
+    remote-compile relay — writes nothing, and cold cost recurs per process)."""
+    from yunikorn_tpu.utils.jaxtools import compile_cache_dir
+
+    try:
+        return len(os.listdir(compile_cache_dir()))
+    except OSError:
+        return 0
+
+
 def run_shim_mode(shim_pods: int, shim_nodes: int):
     """BindStats end-to-end: the full framework path — informer events →
     app/task FSMs → dispatcher → core batched solve → AssumePod → bind pool →
@@ -143,6 +155,7 @@ def main() -> int:
     from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
 
     ensure_compilation_cache()
+    cache_entries_before = _cache_entries()
 
     if MODE == "shim":
         print(json.dumps(_shim_result(platform)))
@@ -250,6 +263,12 @@ def main() -> int:
     pods_per_s = n_warm / dt_warm if dt_warm > 0 else 0.0
     print(f"# cold cycle: {n_cold} pods in {dt_cold:.2f}s; warm cycle: {n_warm} pods in {dt_warm:.3f}s",
           file=sys.stderr)
+    # compile-vs-execute split: warm == execute-only, so cold - warm is the
+    # XLA (or relay remote_compile) compile stall at this bucket; the
+    # persistent-cache delta says whether a future process can skip it
+    print(f"# compile overhead at this bucket ≈ {max(dt_cold - dt_warm, 0):.2f}s "
+          f"(persistent cache wrote {_cache_entries() - cache_entries_before} "
+          f"new entries this run)", file=sys.stderr)
     timing = core.metrics.get("last_cycle") or {}
     if timing:
         print(f"# warm cycle split: {timing}", file=sys.stderr)

@@ -452,3 +452,180 @@ def test_webhook_drift_ignores_server_defaults():
     assert not WebhookManager._webhooks_drifted(stored, desired)
     w["clientConfig"]["caBundle"] = "ZHJpZnRlZA=="
     assert WebhookManager._webhooks_drifted(stored, desired)
+
+
+# ---------------------------------------------------------------------------
+# External authentication matrix (reference TestExternalAuthentication
+# :709-874): pre-set user-info annotations are denied unless the submitter is
+# an allowed external identity, and must carry valid user info JSON.
+# ---------------------------------------------------------------------------
+
+def ext_ac(**extra):
+    flat = {"admissionController.accessControl.externalUsers": "^testExtUser$",
+            "admissionController.accessControl.externalGroups": "^extgroup$"}
+    flat.update(extra)
+    return AdmissionController(parse_admission_conf(flat))
+
+
+USER_INFO_ANN = constants.ANNOTATION_USER_INFO
+VALID_INFO = '{"user": "remoteuser", "groups": ["remotegrp"]}'
+
+
+@pytest.mark.parametrize("username,groups,info,allowed", [
+    # not whitelisted: denied even with valid payload
+    ("test", ["dev"], VALID_INFO, False),
+    # whitelisted external user: allowed, annotation kept
+    ("testExtUser", ["dev"], VALID_INFO, True),
+    # whitelisted via group
+    ("random", ["extgroup"], VALID_INFO, True),
+    # whitelisted but malformed JSON: denied
+    ("testExtUser", ["dev"], "xyzxyz", False),
+    # whitelisted but wrong shape (groups not a list): denied
+    ("testExtUser", ["dev"], '{"user": "u", "groups": "nope"}', False),
+])
+def test_external_auth_pod_matrix(username, groups, info, allowed):
+    ac = ext_ac()
+    pod = simple_pod(annotations={USER_INFO_ANN: info})
+    result = ac.mutate(make_review(pod, username=username, groups=groups))
+    assert result["response"]["allowed"] is allowed
+    if allowed:
+        # the pre-set identity is preserved verbatim — no overwrite patch
+        ann = [p for p in decode_patch(result)
+               if p["path"] == "/metadata/annotations"]
+        assert not ann
+
+
+@pytest.mark.parametrize("kind", ["Deployment", "ReplicaSet", "Job"])
+def test_external_auth_workload_template(kind):
+    """Templates pre-setting the identity follow the same rule as pods."""
+    ac = ext_ac()
+    wl = {"metadata": {"name": "w1"},
+          "spec": {"template": {
+              "metadata": {"annotations": {USER_INFO_ANN: VALID_INFO}},
+              "spec": {}}}}
+    denied = ac.mutate(make_review(wl, kind=kind, username="test"))
+    assert denied["response"]["allowed"] is False
+    ok = ac.mutate(make_review(wl, kind=kind, username="testExtUser"))
+    assert ok["response"]["allowed"] is True
+    assert decode_patch(ok) == []               # identity kept as set
+
+
+def test_replicaset_from_system_user_never_patched():
+    """A controller-created ReplicaSet must not be touched even with
+    trustControllers=false — patching it respawns a new ReplicaSet forever
+    (reference shouldProcessWorkload :330-344)."""
+    ac = AdmissionController(parse_admission_conf(
+        {"admissionController.accessControl.trustControllers": "false"}))
+    rs = {"metadata": {"name": "rs1"},
+          "spec": {"template": {"metadata": {}, "spec": {}}}}
+    result = ac.mutate(make_review(
+        rs, kind="ReplicaSet",
+        username="system:serviceaccount:kube-system:deployment-controller"))
+    assert result["response"]["allowed"] and decode_patch(result) == []
+    # a plain Deployment from the same user IS processed with trust off
+    dep = {"metadata": {"name": "d1"},
+           "spec": {"template": {"metadata": {}, "spec": {}}}}
+    result = ac.mutate(make_review(
+        dep, kind="Deployment",
+        username="system:serviceaccount:kube-system:deployment-controller"))
+    assert decode_patch(result)
+
+
+# ---------------------------------------------------------------------------
+# Label handling breadth (reference TestUpdateLabels :54-253)
+# ---------------------------------------------------------------------------
+
+def labels_patch_value(result):
+    ps = [p for p in decode_patch(result) if p["path"] == "/metadata/labels"]
+    return ps[0]["value"] if ps else None
+
+
+def test_update_labels_preserves_existing_random_labels(ac):
+    pod = simple_pod(labels={"random": "random"})
+    value = labels_patch_value(ac.mutate(make_review(pod)))
+    assert value["random"] == "random"
+    assert value[constants.LABEL_APPLICATION_ID].startswith("yunikorn-")
+
+
+def test_update_labels_existing_queue_kept(ac):
+    pod = simple_pod(labels={"queue": "root.custom"})
+    value = labels_patch_value(ac.mutate(make_review(pod)))
+    # queue untouched; only the generated appId is added
+    assert value["queue"] == "root.custom"
+    assert constants.LABEL_QUEUE_NAME not in value or \
+        value[constants.LABEL_QUEUE_NAME] == "root.custom"
+
+
+def test_update_labels_generate_name_pod(ac):
+    """Pods from generateName (no metadata.name yet) still get an appId."""
+    pod = {"metadata": {"generateName": "burst-", "uid": "u-gen"}, "spec": {}}
+    value = labels_patch_value(ac.mutate(make_review(pod)))
+    assert value and value[constants.LABEL_APPLICATION_ID]
+
+
+def test_update_labels_unique_app_ids():
+    ac = AdmissionController(parse_admission_conf(
+        {"admissionController.filtering.generateUniqueAppId": "true"}))
+    pod = simple_pod("uniq")
+    value = labels_patch_value(ac.mutate(make_review(pod)))
+    app_id = value[constants.LABEL_APPLICATION_ID]
+    assert "uid-uniq" in app_id                 # per-pod unique, not shared
+    other = labels_patch_value(ac.mutate(make_review(simple_pod("uniq2"))))
+    assert other[constants.LABEL_APPLICATION_ID] != app_id
+
+
+def test_update_labels_empty_namespace_defaults(ac):
+    pod = simple_pod()
+    result = ac.mutate(make_review(pod, namespace=""))
+    value = labels_patch_value(result)
+    assert value[constants.LABEL_APPLICATION_ID] == "yunikorn-default-autogen"
+
+
+# ---------------------------------------------------------------------------
+# validate-conf edge cases (reference TestValidateConfigMap* :266-328)
+# ---------------------------------------------------------------------------
+
+def test_validate_conf_empty_configmap_allowed():
+    ac = AdmissionController(AdmissionConf(), validate_conf_fn=lambda y: (True, ""))
+    cm = {"metadata": {"name": "yunikorn-configs"}}
+    assert ac.validate_conf(make_review(cm, kind="ConfigMap"))["response"]["allowed"]
+
+
+def test_validate_conf_missing_object_fails_open():
+    ac = AdmissionController(AdmissionConf(), validate_conf_fn=lambda y: (True, ""))
+    review = {"request": {"uid": "x", "kind": {"kind": "ConfigMap"},
+                          "operation": "UPDATE"}}
+    out = ac.validate_conf(review)
+    assert out["response"]["uid"] == "x"
+    assert out["response"]["allowed"] in (True, False)  # well-formed response
+
+
+def test_validate_conf_delete_operation_allowed():
+    """DELETE of the config map reverts to defaults — always allowed."""
+    ac = AdmissionController(AdmissionConf(),
+                             validate_conf_fn=lambda y: (False, "never"))
+    cm = {"metadata": {"name": "yunikorn-configs"}, "data": {}}
+    out = ac.validate_conf(make_review(cm, kind="ConfigMap", operation="DELETE"))
+    assert out["response"]["allowed"]
+
+
+def test_workload_update_with_own_injected_annotation_allowed():
+    """Scale/apply on a workload whose template carries the annotation WE
+    injected at CREATE must not be denied; changing it still is."""
+    ac = ext_ac()
+    injected = '{"user": "alice", "groups": ["dev"]}'
+    tmpl = {"metadata": {"annotations": {USER_INFO_ANN: injected}}, "spec": {}}
+    wl = {"metadata": {"name": "w1"}, "spec": {"template": tmpl,
+                                               "replicas": 3}}
+    old = {"metadata": {"name": "w1"}, "spec": {"template": tmpl,
+                                                "replicas": 1}}
+    result = ac.mutate(make_review(wl, kind="Deployment", operation="UPDATE",
+                                   old=old, username="alice"))
+    assert result["response"]["allowed"] is True
+    # but ALTERING the identity on update is still denied for non-externals
+    wl2 = {"metadata": {"name": "w1"}, "spec": {"template": {
+        "metadata": {"annotations": {USER_INFO_ANN: '{"user":"mallory","groups":[]}'}},
+        "spec": {}}}}
+    result = ac.mutate(make_review(wl2, kind="Deployment", operation="UPDATE",
+                                   old=old, username="alice"))
+    assert result["response"]["allowed"] is False

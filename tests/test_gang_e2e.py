@@ -173,3 +173,93 @@ def test_placeholder_spec_copies_constraints():
 
     r = get_pod_resource(pod)
     assert r.get("cpu") == 1000 and r.get("memory") == 2**30
+
+
+def test_gang_multiple_task_groups(sched):
+    """Two task groups with different shapes: placeholders per group, members
+    replace within THEIR group's placeholders only (reference multi-taskgroup
+    gang e2e)."""
+    sched.add_nodes([make_node(f"mn{i}", cpu_milli=8000, memory=8 * 2**30)
+                     for i in range(3)])
+    tgs = [{"name": "drivers", "minMember": 1,
+            "minResource": {"cpu": "1", "memory": "512Mi"}},
+           {"name": "workers", "minMember": 4,
+            "minResource": {"cpu": "500m", "memory": "256Mi"}}]
+    origin = gang_pod("origin", "gang-multi", tgs, cpu=200)
+    sched.add_pod(origin)
+    sched.wait_for_app_state("gang-multi", app_mod.RUNNING, timeout=20)
+    assert count_placeholders(sched, "gang-multi") == 5
+
+    def ph_by_group(group):
+        return {p.spec.node_name for p in sched.cluster.list_pods()
+                if p.metadata.annotations.get(constants.ANNOTATION_PLACEHOLDER_FLAG)
+                and p.metadata.annotations.get(
+                    constants.ANNOTATION_TASK_GROUP_NAME) == group}
+
+    driver_nodes = ph_by_group("drivers")
+    worker_nodes = ph_by_group("workers")
+    assert driver_nodes and worker_nodes
+    # a drivers member lands on a drivers placeholder node
+    d = gang_pod("driver-0", "gang-multi", tgs, tg_name="drivers", cpu=1000)
+    sched.add_pod(d)
+    sched.wait_for_task_state("gang-multi", d.uid, task_mod.BOUND, timeout=15)
+    assert sched.get_pod_assignment(d) in driver_nodes
+    # and all workers land within the workers placeholder set
+    workers = [gang_pod(f"wk-{i}", "gang-multi", tgs, tg_name="workers")
+               for i in range(4)]
+    for w in workers:
+        sched.add_pod(w)
+    for w in workers:
+        sched.wait_for_task_state("gang-multi", w.uid, task_mod.BOUND, timeout=15)
+        assert sched.get_pod_assignment(w) in worker_nodes
+    deadline = time.time() + 10
+    while time.time() < deadline and count_placeholders(sched, "gang-multi") > 0:
+        time.sleep(0.05)
+    assert count_placeholders(sched, "gang-multi") == 0
+
+
+def test_gang_extra_members_beyond_min(sched):
+    """Members beyond minMember (burst past the gang floor) schedule through
+    the normal path once placeholders are exhausted."""
+    sched.add_nodes([make_node(f"xn{i}", cpu_milli=8000) for i in range(2)])
+    tgs = [{"name": "workers", "minMember": 2,
+            "minResource": {"cpu": "500m", "memory": "256Mi"}}]
+    origin = gang_pod("origin", "gang-extra", tgs, cpu=200)
+    sched.add_pod(origin)
+    sched.wait_for_app_state("gang-extra", app_mod.RUNNING, timeout=20)
+    members = [gang_pod(f"xw-{i}", "gang-extra", tgs, tg_name="workers")
+               for i in range(5)]                 # 3 beyond the floor
+    for m in members:
+        sched.add_pod(m)
+    for m in members:
+        sched.wait_for_task_state("gang-extra", m.uid, task_mod.BOUND, timeout=20)
+    assert count_placeholders(sched, "gang-extra") == 0
+
+
+def test_gang_app_completion_cleans_leftover_placeholders(sched):
+    """Fewer members than minMember arrive and the app finishes: leftover
+    placeholders must be deleted, their resources freed (reference
+    placeholder_manager cleanUp)."""
+    sched.add_nodes([make_node(f"cn{i}", cpu_milli=4000) for i in range(2)])
+    tgs = [{"name": "workers", "minMember": 3,
+            "minResource": {"cpu": "500m", "memory": "256Mi"}}]
+    origin = gang_pod("origin", "gang-clean", tgs, cpu=200)
+    sched.add_pod(origin)
+    sched.wait_for_app_state("gang-clean", app_mod.RUNNING, timeout=20)
+    assert count_placeholders(sched, "gang-clean") == 3
+    one = gang_pod("only-worker", "gang-clean", tgs, tg_name="workers")
+    sched.add_pod(one)
+    sched.wait_for_task_state("gang-clean", one.uid, task_mod.BOUND, timeout=15)
+    # the workload ends: everything real completes
+    sched.succeed_pod(one)
+    sched.succeed_pod(origin)
+    deadline = time.time() + 20
+    while time.time() < deadline and count_placeholders(sched, "gang-clean") > 0:
+        time.sleep(0.1)
+    assert count_placeholders(sched, "gang-clean") == 0
+    # capacity released: a full-node pod fits again
+    probe = make_pod("probe", cpu_milli=3500,
+                     labels={constants.LABEL_APPLICATION_ID: "probe-app"},
+                     scheduler_name=constants.SCHEDULER_NAME)
+    sched.add_pod(probe)
+    sched.wait_for_task_state("probe-app", probe.uid, task_mod.BOUND, timeout=15)

@@ -1,0 +1,199 @@
+"""Placement-rule matrix: the configured rule chain (provided/user/group/
+tag/fixed, filters, create flags, nested parents) resolved against a queue
+tree — the yunikorn-core placement-manager semantics the reference shim
+delegates to (reference placement tests in yunikorn-core's
+pkg/scheduler/placement; shim side context.go:922-1023).
+"""
+import pytest
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.si import AddApplicationRequest, UserGroupInfo
+from yunikorn_tpu.core.placement import (PlacementEngine, RuleFilter,
+                                         apply_namespace_quota,
+                                         parse_placement_rules)
+from yunikorn_tpu.core.queues import QueueTree, parse_queues_yaml
+
+YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: static
+          - name: teams
+            parent: true
+            queues:
+              - name: blue
+"""
+
+
+def tree():
+    return QueueTree(parse_queues_yaml(YAML))
+
+
+def add_req(queue="", user="alice", groups=("dev",), tags=None):
+    return AddApplicationRequest(
+        application_id="app-1", queue_name=queue,
+        user=UserGroupInfo(user=user, groups=list(groups)),
+        tags=dict(tags or {}))
+
+
+def engine(*rule_docs):
+    return PlacementEngine(parse_placement_rules(
+        {"placementrules": list(rule_docs)}))
+
+
+# ---------------------------------------------------------------- rule kinds
+
+def test_provided_rule_resolves_named_queue():
+    e = engine({"name": "provided", "create": False})
+    leaf = e.place(add_req(queue="root.static"), tree())
+    assert leaf is not None and leaf.full_name == "root.static"
+
+
+def test_provided_rule_create_false_rejects_unknown():
+    e = engine({"name": "provided", "create": False})
+    assert e.place(add_req(queue="root.nope"), tree()) is None
+
+
+def test_provided_rule_create_true_makes_queue():
+    e = engine({"name": "provided", "create": True})
+    leaf = e.place(add_req(queue="root.newq"), tree())
+    assert leaf is not None and leaf.full_name == "root.newq"
+
+
+def test_user_rule_sanitizes_dots():
+    e = engine({"name": "user"})
+    leaf = e.place(add_req(user="first.last"), tree())
+    assert leaf.full_name == "root.first_dot_last"
+
+
+def test_group_rule_uses_primary_group():
+    e = engine({"name": "group"})
+    leaf = e.place(add_req(groups=("ops", "dev")), tree())
+    assert leaf.full_name == "root.ops"
+
+
+def test_group_rule_no_groups_falls_through_to_next():
+    e = engine({"name": "group"}, {"name": "fixed", "value": "root.static"})
+    leaf = e.place(add_req(groups=()), tree())
+    assert leaf.full_name == "root.static"
+
+
+def test_tag_rule_namespace():
+    e = engine({"name": "tag", "value": "namespace"})
+    leaf = e.place(add_req(tags={constants.APP_TAG_NAMESPACE: "team-ns"}), tree())
+    assert leaf.full_name == "root.team-ns"
+
+
+def test_tag_rule_missing_tag_skips():
+    e = engine({"name": "tag", "value": "custom-key"},
+               {"name": "fixed", "value": "root.static"})
+    assert e.place(add_req(), tree()).full_name == "root.static"
+
+
+def test_fixed_rule_always_places():
+    e = engine({"name": "fixed", "value": "root.static"})
+    assert e.place(add_req(), tree()).full_name == "root.static"
+
+
+def test_unknown_rule_name_ignored():
+    e = engine({"name": "bogus"}, {"name": "fixed", "value": "root.static"})
+    assert len(e.rules) == 1
+    assert e.place(add_req(), tree()).full_name == "root.static"
+
+
+# ------------------------------------------------------------------ filters
+
+@pytest.mark.parametrize("filt,user,groups,placed", [
+    # allow list: only listed users pass
+    ({"type": "allow", "users": ["alice"]}, "alice", ("dev",), True),
+    ({"type": "allow", "users": ["alice"]}, "bob", ("dev",), False),
+    # deny list: listed users are blocked
+    ({"type": "deny", "users": ["alice"]}, "alice", ("dev",), False),
+    ({"type": "deny", "users": ["alice"]}, "bob", ("dev",), True),
+    # group filters
+    ({"type": "allow", "groups": ["dev"]}, "zoe", ("dev",), True),
+    ({"type": "allow", "groups": ["dev"]}, "zoe", ("ops",), False),
+    # single regex entry (non-plain) matches the whole name
+    ({"type": "allow", "users": ["^data-.*$"]}, "data-eng", (), True),
+    ({"type": "allow", "users": ["^data-.*$"]}, "web-eng", (), False),
+    # empty filter matches everyone
+    ({}, "anyone", (), True),
+])
+def test_rule_filter_matrix(filt, user, groups, placed):
+    e = engine({"name": "fixed", "value": "root.static", "filter": filt})
+    leaf = e.place(add_req(user=user, groups=groups), tree())
+    assert (leaf is not None) is placed
+
+
+def test_filter_invalid_regex_never_matches():
+    f = RuleFilter(type="allow", users=["[invalid"])
+    assert not f.allows("anything", [])
+
+
+# ------------------------------------------------------------ nested parents
+
+def test_user_rule_under_tag_parent():
+    e = engine({"name": "user",
+                "parent": {"name": "tag", "value": "namespace"}})
+    leaf = e.place(add_req(user="alice",
+                           tags={constants.APP_TAG_NAMESPACE: "teams"}), tree())
+    assert leaf.full_name == "root.teams.alice"
+
+
+def test_parent_rule_failure_fails_the_whole_rule():
+    e = engine({"name": "user", "parent": {"name": "tag", "value": "missing"}},
+               {"name": "fixed", "value": "root.static"})
+    leaf = e.place(add_req(user="alice"), tree())
+    assert leaf.full_name == "root.static"      # fell through, not root.alice
+
+
+def test_qualified_leaf_cannot_be_reparented():
+    # provided gives a fully-qualified name; nesting it under a parent is
+    # ambiguous and must fail the rule
+    e = engine({"name": "provided",
+                "parent": {"name": "fixed", "value": "root.teams"}})
+    assert e.place(add_req(queue="root.static"), tree()) is None
+
+
+def test_parent_queue_must_yield_leaf():
+    # placing into a parent-type queue (root.teams has children) fails
+    e = engine({"name": "fixed", "value": "root.teams"})
+    assert e.place(add_req(), tree()) is None
+
+
+# ------------------------------------------------------- namespace annotations
+
+def test_namespace_quota_applied_to_dynamic_queue_only():
+    t = tree()
+    e = engine({"name": "tag", "value": "namespace"})
+    req = add_req(tags={
+        constants.APP_TAG_NAMESPACE: "quota-ns",
+        constants.NAMESPACE_QUOTA: '{"cpu": "2", "memory": "1Gi"}',
+        constants.NAMESPACE_MAX_APPS: "3",
+    })
+    leaf = e.place(req, t)
+    assert leaf.dynamic
+    apply_namespace_quota(leaf, req)
+    assert leaf.config.max_resource.get("cpu") == 2000
+    assert leaf.config.max_resource.get("memory") == 2**30
+    assert leaf.config.max_applications == 3
+    # static queues keep their yaml config untouched
+    static = t.resolve("root.static", create=False)
+    before = static.config.max_resource
+    apply_namespace_quota(static, req)
+    assert static.config.max_resource is before
+
+
+def test_namespace_quota_malformed_json_ignored():
+    t = tree()
+    e = engine({"name": "tag", "value": "namespace"})
+    req = add_req(tags={
+        constants.APP_TAG_NAMESPACE: "bad-ns",
+        constants.NAMESPACE_QUOTA: "not json",
+        constants.NAMESPACE_MAX_APPS: "many",
+    })
+    leaf = e.place(req, t)
+    apply_namespace_quota(leaf, req)            # must not raise
+    assert leaf.config.max_applications in (0, None)

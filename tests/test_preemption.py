@@ -138,3 +138,132 @@ def test_preemption_e2e_evicts_and_reschedules():
         assert len(remaining_low) == 1  # exactly one victim evicted
     finally:
         ms.stop()
+
+
+# ---------------------------------------------------------------------------
+# Planner breadth: cooldown, disjoint victims, priority fences, overlay
+# ---------------------------------------------------------------------------
+
+def hi_ask(cache, key, cpu=2000, priority=100):
+    pod = make_pod(key, cpu_milli=cpu, priority=priority)
+    cache.update_pod(pod)          # victim search resolves the pod via cache
+    return AllocationAsk(pod.uid, "hi-app", get_pod_resource(pod),
+                         priority=priority, pod=pod)
+
+
+def test_planner_two_asks_get_disjoint_victims():
+    """Two preempting asks in one cycle must not claim the same victim."""
+    cache = SchedulerCache()
+    for n in ("pa", "pb"):
+        cache.update_node(make_node(n, cpu_milli=4000, memory=8 * 2**30))
+    app_of_pod = {}
+    for n in ("pa", "pb"):
+        for i in range(2):
+            v = make_pod(f"{n}-v{i}", cpu_milli=2000, node_name=n,
+                         phase="Running", priority=0)
+            cache.update_pod(v)
+            app_of_pod[v.uid] = "victim-app"
+    plans, attempted = plan_preemptions(
+        cache, [hi_ask(cache, "h1"), hi_ask(cache, "h2")], app_of_pod)
+    assert len(plans) == 2 and len(attempted) == 2
+    sets = [{v.uid for v in p.victims} for p in plans]
+    assert not (sets[0] & sets[1])
+
+
+def test_planner_equal_priority_never_preempted():
+    """Victims at the SAME priority as the ask are fenced off — preemption
+    only flows strictly downhill."""
+    cache = SchedulerCache()
+    cache.update_node(make_node("eq", cpu_milli=2000, memory=8 * 2**30))
+    v = make_pod("peer", cpu_milli=2000, node_name="eq", phase="Running",
+                 priority=100)
+    cache.update_pod(v)
+    plans, _ = plan_preemptions(cache, [hi_ask(cache, "h1", priority=100)],
+                                {v.uid: "victim-app"})
+    assert plans == []
+
+
+def test_planner_inflight_overlay_blocks_eviction():
+    """Capacity already committed this cycle (inflight overlay) must not be
+    double-counted as freed by eviction: victims whose removal still leaves
+    the ask unfit are not planned."""
+    from yunikorn_tpu.common.resource import ResourceBuilder
+
+    cache = SchedulerCache()
+    cache.update_node(make_node("ov", cpu_milli=4000, memory=8 * 2**30))
+    v = make_pod("small-victim", cpu_milli=1000, node_name="ov",
+                 phase="Running", priority=0)
+    cache.update_pod(v)
+    app_of_pod = {v.uid: "victim-app"}
+    # without overlay: evicting the 1000m victim frees enough for 2000m
+    plans, _ = plan_preemptions(cache, [hi_ask(cache, "h1", cpu=2000)], app_of_pod)
+    assert len(plans) == 1
+    # with 3000m inflight on the node, eviction can never make 2000m fit
+    overlay = {"ov": ResourceBuilder().cpu(3000).build()}
+    blocked = hi_ask(cache, "h2", cpu=2000)
+    plans, attempted = plan_preemptions(cache, [blocked],
+                                        app_of_pod, inflight_by_node=overlay)
+    assert plans == []
+    assert attempted == [blocked.allocation_key]   # still reported for cooldown
+
+
+def test_preemption_cooldown_prevents_rescan(sched_factory=None):
+    """A failed preemption attempt puts the ask on cooldown: the next cycles
+    must not rescan the cluster for it (core _preempted_for gate)."""
+    ms = MockScheduler()
+    ms.init("")
+    ms.start()
+    try:
+        ms.add_node(make_node("cd0", cpu_milli=1000))
+        # an unplaceable high-priority pod (too big for the cluster)
+        big = make_pod("big", cpu_milli=4000, priority=100,
+                       labels={constants.LABEL_APPLICATION_ID: "cd-app"},
+                       scheduler_name=constants.SCHEDULER_NAME)
+        ms.add_pod(big)
+        deadline = time.time() + 10
+        while time.time() < deadline and "big" not in ms.core._preempted_for:
+            time.sleep(0.05)
+        assert any(k.startswith("big") or "big" in k
+                   for k in ms.core._preempted_for), "attempt not recorded"
+        stamp = dict(ms.core._preempted_for)
+        time.sleep(1.0)                  # several scheduling cycles
+        # cooldown entry unchanged: no rescan re-stamped it
+        for k, ts in stamp.items():
+            assert ms.core._preempted_for.get(k) == ts
+    finally:
+        ms.stop()
+
+
+def test_victims_released_with_accounting_intact():
+    """E2E: after eviction + reschedule, queue accounting matches live
+    allocations (release path + preemption interplay)."""
+    ms = MockScheduler()
+    ms.init("")
+    ms.start()
+    try:
+        ms.add_node(make_node("acct", cpu_milli=4000, memory=8 * 2**30))
+        low = [make_pod(f"low-{i}", cpu_milli=2000, priority=0,
+                        labels={constants.LABEL_APPLICATION_ID: "low-app"},
+                        scheduler_name=constants.SCHEDULER_NAME)
+               for i in range(2)]
+        for p in low:
+            ms.add_pod(p)
+        for p in low:
+            ms.wait_for_task_state("low-app", p.uid, task_mod.BOUND, timeout=15)
+        hi = make_pod("hi", cpu_milli=3000, priority=1000,
+                      labels={constants.LABEL_APPLICATION_ID: "hi-app"},
+                      scheduler_name=constants.SCHEDULER_NAME)
+        ms.add_pod(hi)
+        ms.wait_for_task_state("hi-app", hi.uid, task_mod.BOUND, timeout=30)
+        time.sleep(0.5)
+        total = {}
+        for app in ms.core.partition.applications.values():
+            for alloc in app.allocations.values():
+                for k, v in alloc.resource.resources.items():
+                    total[k] = total.get(k, 0) + v
+        root = ms.core.queues.root
+        for k in set(total) | set(root.allocated.resources):
+            assert root.allocated.get(k) == total.get(k, 0), (
+                k, root.allocated.get(k), total.get(k, 0))
+    finally:
+        ms.stop()

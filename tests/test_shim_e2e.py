@@ -471,3 +471,72 @@ def test_bind_pool_bounds_thread_count(sched):
     assert all(app.get_task(p.uid).state == task_mod.BOUND for p in pods)
     # 32 pool workers + harness threads; far below 200
     assert peak - before <= 40, f"thread spike: {peak - before}"
+
+
+MULTI_PART_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: default
+  - name: gpu
+    queues:
+      - name: root
+        queues:
+          - name: default
+"""
+
+
+def test_multipartition_through_shim_and_rest():
+    """Multi-partition end-to-end THROUGH the shim (extension beyond the
+    single-partition reference shim): node labels route nodes, the partition
+    annotation routes apps, pods bind only within their partition, and the
+    REST partition routes expose both."""
+    import json as _json
+    import urllib.request
+
+    from yunikorn_tpu.webapp.rest import RestServer
+
+    ms = MockScheduler()
+    ms.init(MULTI_PART_YAML)
+    ms.start()
+    rest = RestServer(ms.core, ms.context, port=0)
+    port = rest.start()
+    try:
+        cpu_node = make_node("cpu-n0", cpu_milli=8000)
+        gpu_node = make_node("gpu-n0", cpu_milli=8000,
+                             labels={constants.LABEL_NODE_PARTITION: "gpu"})
+        ms.add_nodes([cpu_node, gpu_node])
+        gpu_pods, cpu_pods = [], []
+        for i in range(4):
+            gp = make_pod(f"gpu-p{i}", cpu_milli=500,
+                          labels={constants.LABEL_APPLICATION_ID: "gpu-app"},
+                          annotations={constants.ANNOTATION_PARTITION: "gpu"},
+                          scheduler_name=constants.SCHEDULER_NAME)
+            cp = make_pod(f"cpu-p{i}", cpu_milli=500,
+                          labels={constants.LABEL_APPLICATION_ID: "cpu-app"},
+                          scheduler_name=constants.SCHEDULER_NAME)
+            gpu_pods.append(ms.add_pod(gp))
+            cpu_pods.append(ms.add_pod(cp))
+        for p in gpu_pods:
+            ms.wait_for_task_state("gpu-app", p.uid, task_mod.BOUND, timeout=20)
+            assert ms.get_pod_assignment(p) == "gpu-n0"
+        for p in cpu_pods:
+            ms.wait_for_task_state("cpu-app", p.uid, task_mod.BOUND, timeout=20)
+            assert ms.get_pod_assignment(p) == "cpu-n0"
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return _json.loads(r.read())
+
+        assert sorted(get("/ws/v1/partitions")) == ["default", "gpu"]
+        gpu_apps = get("/ws/v1/partition/gpu/applications")
+        assert "gpu-app" in gpu_apps
+        default_apps = get("/ws/v1/partition/default/applications")
+        assert "cpu-app" in default_apps and "gpu-app" not in default_apps
+        assert list(get("/ws/v1/partition/gpu/nodes")) == ["gpu-n0"]
+    finally:
+        rest.stop()
+        ms.stop()

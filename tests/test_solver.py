@@ -535,3 +535,58 @@ def test_water_fill_no_int32_overflow_at_cluster_scale():
     assert (np.asarray(res.free_after) >= 0).all()
     # water-fill (not 16 rounds of argmax fallback) must have done the work
     assert int(res.rounds) <= 4
+
+
+def test_intra_batch_host_port_exclusivity():
+    """Two pods in ONE batch wanting the same hostPort must land on different
+    nodes (caught by the differential fuzzer: the static port mask only sees
+    existing pods; the synthetic capacity-1 port columns enforce this)."""
+    cache, enc = make_env([make_node("pn1"), make_node("pn2"),
+                           make_node("pn3")])
+    pods = []
+    for i in range(3):
+        p = make_pod(f"web-{i}", cpu_milli=100)
+        p.spec.containers[0].ports = [{"hostPort": 8443, "protocol": "TCP"}]
+        pods.append(p)
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes)
+    got = names_of(enc, res, batch)
+    placed = [v for v in got.values() if v is not None]
+    assert len(placed) == 3                    # 3 ports, 3 nodes: all fit
+    assert len(set(placed)) == 3               # each on its own node
+
+    # a 4th same-port pod has nowhere to go
+    extra = make_pod("web-3", cpu_milli=100)
+    extra.spec.containers[0].ports = [{"hostPort": 8443, "protocol": "TCP"}]
+    batch2 = enc.build_batch([ask_for(p) for p in pods + [extra]])
+    res2 = solve_batch(batch2, enc.nodes)
+    got2 = names_of(enc, res2, batch2)
+    assert sum(1 for v in got2.values() if v is not None) == 3
+
+
+def test_cross_cycle_port_exclusivity_via_ports_delta():
+    """An in-flight allocation's hostPort (committed last cycle, assume not
+    yet visible in the cache) must block a same-port pod this cycle — the
+    ports_delta overlay, the port analog of free_delta."""
+    import numpy as np
+
+    cache, enc = make_env([make_node("cn1", cpu_milli=8000)])
+    from yunikorn_tpu.snapshot.vocab import port_bit
+
+    p1 = make_pod("held", cpu_milli=100)
+    p1.spec.containers[0].ports = [{"hostPort": 9090, "protocol": "TCP"}]
+    # cycle 1 encoded p1 (interns the port bit) and committed it to cn1
+    enc.build_batch([ask_for(p1)])
+    b = enc.vocabs.ports.lookup(port_bit("TCP", 9090))
+    assert b >= 0
+    delta = np.zeros((enc.nodes.capacity, enc.vocabs.ports.num_words), np.uint32)
+    idx = enc.nodes.index_of("cn1")
+    delta[idx, b // 32] |= np.uint32(1 << (b % 32))
+
+    p2 = make_pod("wants-same", cpu_milli=100)
+    p2.spec.containers[0].ports = [{"hostPort": 9090, "protocol": "TCP"}]
+    batch = enc.build_batch([ask_for(p2)])
+    res = solve_batch(batch, enc.nodes, ports_delta=delta)
+    assert names_of(enc, res, batch)[p2.uid] is None      # port held in-flight
+    res2 = solve_batch(batch, enc.nodes)                   # without the overlay
+    assert names_of(enc, res2, batch)[p2.uid] == "cn1"

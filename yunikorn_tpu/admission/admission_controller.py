@@ -34,6 +34,11 @@ logger = log("admission")
 WORKLOAD_KINDS = ("Deployment", "DaemonSet", "StatefulSet", "ReplicaSet", "Job", "CronJob")
 
 
+class AdmissionDenied(Exception):
+    """Raised inside mutation when the request must be REJECTED (external
+    authentication violations) — unlike internal errors, which fail open."""
+
+
 class AdmissionController:
     def __init__(self, conf: AdmissionConf,
                  namespace_cache: Optional[NamespaceCache] = None,
@@ -74,7 +79,20 @@ class AdmissionController:
                     if err:
                         return _review_response(uid, allowed=False, message=err)
             elif kind in WORKLOAD_KINDS and operation in ("CREATE", "UPDATE"):
-                patch = self._process_workload(obj, request, namespace, kind)
+                # ReplicaSet created BY a controller (system user): never
+                # touch the spec — patching it spawns a fresh ReplicaSet and
+                # loops forever (reference shouldProcessWorkload :330-344).
+                # Deliberately independent of trustControllers.
+                user = ((request.get("userInfo") or {}).get("username", ""))
+                if kind == "ReplicaSet" and self.conf.is_system_user(user):
+                    patch = []
+                else:
+                    old = (request.get("oldObject") or {}
+                           if operation == "UPDATE" else {})
+                    patch = self._process_workload(obj, request, namespace,
+                                                   kind, old)
+        except AdmissionDenied as e:
+            return _review_response(uid, allowed=False, message=str(e))
         except Exception as e:  # admission must fail open on internal errors
             logger.exception("mutation failed")
             return _review_response(uid, allowed=True, message=str(e))
@@ -150,11 +168,8 @@ class AdmissionController:
             return patch
         existing = annotations.get(constants.ANNOTATION_USER_INFO)
         if existing is not None:
-            # external users may set it themselves when allowed
-            if self.conf.is_external_user(username) or any(
-                    self.conf.is_external_group(g) for g in groups):
-                return patch
-            # otherwise overwrite with the authenticated identity
+            self._check_user_info_annotation(existing, username, groups)
+            return patch          # allowed external identity: keep as set
         new_annotations = dict(annotations)
         new_annotations[constants.ANNOTATION_USER_INFO] = json.dumps(
             {"user": username or constants.DEFAULT_USER, "groups": groups})
@@ -162,6 +177,28 @@ class AdmissionController:
                       "path": "/metadata/annotations",
                       "value": new_annotations})
         return patch
+
+    def _check_user_info_annotation(self, annotation: str, username: str,
+                                    groups: List[str]) -> None:
+        """A pre-set user-info annotation is only acceptable from an allowed
+        external identity, and must parse as valid user info (reference
+        checkUserInfoAnnotation :346-365 — deny, never silently overwrite)."""
+        allowed = (self.conf.is_external_user(username)
+                   or any(self.conf.is_external_group(g) for g in groups))
+        if not allowed:
+            raise AdmissionDenied(
+                f"user {username} with groups [{','.join(groups)}] is not "
+                f"allowed to set user annotation")
+        try:
+            info = json.loads(annotation)
+        except (TypeError, json.JSONDecodeError):
+            raise AdmissionDenied(
+                f"invalid user info annotation: {annotation!r}")
+        if (not isinstance(info, dict)
+                or not isinstance(info.get("user", ""), str)
+                or not isinstance(info.get("groups", []), list)):
+            raise AdmissionDenied(
+                f"invalid user info annotation: {annotation!r}")
 
     def _process_pod_update(self, new: Dict, old: Dict) -> Optional[str]:
         """User-info immutability (reference :282-321)."""
@@ -177,7 +214,7 @@ class AdmissionController:
 
     # ----------------------------------------------------- workload mutation
     def _process_workload(self, obj: Dict, request: Dict, namespace: str,
-                          kind: str) -> List[Dict]:
+                          kind: str, old: Optional[Dict] = None) -> List[Dict]:
         """Inject user info into pod templates (reference :218-281)."""
         meta = obj.get("metadata") or {}
         labels = dict(meta.get("labels") or {})
@@ -199,6 +236,18 @@ class AdmissionController:
             template = spec.get("template") or {}
         t_meta = template.get("metadata") or {}
         t_annotations = dict(t_meta.get("annotations") or {})
+        existing = t_annotations.get(constants.ANNOTATION_USER_INFO)
+        if existing is not None:
+            # an UNCHANGED annotation on UPDATE is the one this controller
+            # injected at CREATE — scale/apply by the original submitter must
+            # not be denied for "setting" it (reference compares old vs new)
+            if existing == self._old_template_user_info(old or {}, kind):
+                return []
+            # template (re)sets the identity: allowed externals keep it,
+            # everyone else is denied (same rule as bare pods)
+            self._check_user_info_annotation(
+                existing, username, list(user_info.get("groups") or []))
+            return []
         t_annotations[constants.ANNOTATION_USER_INFO] = json.dumps(
             {"user": username or constants.DEFAULT_USER,
              "groups": list(user_info.get("groups") or [])})
@@ -207,6 +256,17 @@ class AdmissionController:
             "path": f"{template_path}/metadata/annotations",
             "value": t_annotations,
         }]
+
+    @staticmethod
+    def _old_template_user_info(old: Dict, kind: str) -> Optional[str]:
+        spec = old.get("spec") or {}
+        if kind == "CronJob":
+            template = ((spec.get("jobTemplate") or {}).get("spec") or {}).get(
+                "template") or {}
+        else:
+            template = spec.get("template") or {}
+        return ((template.get("metadata") or {}).get("annotations") or {}).get(
+            constants.ANNOTATION_USER_INFO)
 
     # ------------------------------------------------------------- filtering
     def _should_process(self, namespace: str, labels: Dict, annotations: Dict) -> bool:

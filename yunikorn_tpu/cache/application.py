@@ -197,6 +197,7 @@ class Application:
             task_groups=list(self.metadata.task_groups),
             gang_scheduling_style=self.metadata.gang_scheduling_style,
             execution_timeout_seconds=self.metadata.placeholder_timeout,
+            partition=self.metadata.partition,
         )])
         self.context.scheduler_api.update_application(request)
 

@@ -347,14 +347,21 @@ class Context:
             node.status.allocatable[VOLUME_ATTACH] = csi_limit
         adopted = self.schedulers_cache.update_node(node)
         capacity = get_node_resource(node.status.allocatable)
+        attributes = {
+            constants.NODE_ATTRIBUTE_HOSTNAME: node.name,
+            constants.NODE_ATTRIBUTE_RACKNAME: constants.DEFAULT_RACK,
+            "instance-type": node.metadata.labels.get(self.conf.instance_type_node_label_key, ""),
+        }
+        # multi-partition routing: the node-partition label (an extension
+        # beyond the reference shim, which is single-partition) becomes the
+        # SI attribute the core's partition router reads
+        part = node.metadata.labels.get(constants.LABEL_NODE_PARTITION, "")
+        if part:
+            attributes[constants.SI_NODE_PARTITION] = part
         self.scheduler_api.update_node(NodeRequest(nodes=[NodeInfo(
             node_id=node.name,
             action=NodeAction.CREATE if self._initialized else NodeAction.CREATE_DRAIN,
-            attributes={
-                constants.NODE_ATTRIBUTE_HOSTNAME: node.name,
-                constants.NODE_ATTRIBUTE_RACKNAME: constants.DEFAULT_RACK,
-                "instance-type": node.metadata.labels.get(self.conf.instance_type_node_label_key, ""),
-            },
+            attributes=attributes,
             schedulable_resource=capacity,
             node=node,
         )]))

@@ -41,6 +41,7 @@ class ApplicationMetadata:
     creation_time: float
     placeholder_timeout: Optional[float] = None
     gang_scheduling_style: str = constants.GANG_STYLE_SOFT
+    partition: str = "default"
 
 
 def get_application_id(pod: Pod, generate_unique: bool = False) -> str:
@@ -178,6 +179,11 @@ def get_app_metadata(pod: Pod, generate_unique: bool = False) -> Optional[Applic
     parent_queue = pod.metadata.annotations.get(constants.ANNOTATION_PARENT_QUEUE)
     if parent_queue:
         tags[constants.APP_TAG_NAMESPACE_PARENT_QUEUE] = parent_queue
+    # multi-partition: annotation routes the app (extension; the reference
+    # shim is single-partition)
+    partition = (pod.metadata.annotations.get(constants.ANNOTATION_PARTITION)
+                 or pod.metadata.labels.get(constants.LABEL_NODE_PARTITION)
+                 or "default")
     return ApplicationMetadata(
         application_id=get_application_id(pod, generate_unique),
         queue_name=get_queue_name(pod),  # empty → the core's placement rules decide
@@ -191,6 +197,7 @@ def get_app_metadata(pod: Pod, generate_unique: bool = False) -> Optional[Applic
         creation_time=pod.metadata.creation_timestamp,
         placeholder_timeout=timeout,
         gang_scheduling_style=style,
+        partition=partition,
     )
 
 

@@ -27,6 +27,11 @@ LABEL_QUEUE_NAME = "queue"
 CANONICAL_LABEL_QUEUE_NAME = DOMAIN + "queue"
 ANNOTATION_QUEUE_NAME = DOMAIN + "queue"
 ANNOTATION_PARENT_QUEUE = DOMAIN + "parentqueue"
+# multi-partition routing (extension beyond the single-partition reference
+# shim): node label → SI node attribute → core partition router
+LABEL_NODE_PARTITION = DOMAIN + "node-partition"
+ANNOTATION_PARTITION = DOMAIN + "partition"
+SI_NODE_PARTITION = "si/node-partition"
 LABEL_SPARK_APP_ID = "spark-app-selector"
 
 ROOT_QUEUE = "root"

@@ -48,6 +48,10 @@ class CoreApplication:
     placeholder_ask: Optional[Resource] = None
     placeholder_timeout: Optional[float] = None
     reserving_since: Optional[float] = None
+    # a real (non-placeholder) allocation was committed at some point:
+    # distinguishes "gang done, placeholders left over" (release them on
+    # completion) from "gang still reserving" (placeholder timeout owns it)
+    had_real_allocation: bool = False
 
     def allocated_resource(self) -> Resource:
         out = Resource()

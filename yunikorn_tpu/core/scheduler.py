@@ -717,6 +717,7 @@ class CoreScheduler(SchedulerAPI):
             # twice (once in the overlay, once in synced free) — strictly
             # conservative, never over-committing
             overlay = self._inflight_overlay()
+            inflight_ports = self._inflight_ports()
             self.encoder.sync_nodes()
             # mask AFTER the sync: the encoder assigns node rows lazily
             node_mask = self._partition_node_mask() if restrict_nodes else None
@@ -734,12 +735,14 @@ class CoreScheduler(SchedulerAPI):
                 result = solve_sharded(batch, self.encoder.nodes, self._mesh,
                                        max_rounds=so.max_rounds, chunk=so.chunk,
                                        policy=policy, free_delta=overlay,
-                                       node_mask=node_mask)
+                                       node_mask=node_mask,
+                                       ports_delta=inflight_ports)
             else:
                 result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                      max_rounds=so.max_rounds, chunk=so.chunk,
                                      use_pallas=self._use_pallas,
-                                     free_delta=overlay, node_mask=node_mask)
+                                     free_delta=overlay, node_mask=node_mask,
+                                     ports_delta=inflight_ports)
             import numpy as np
 
             # materializing the result is the device sync point: everything
@@ -964,12 +967,14 @@ class CoreScheduler(SchedulerAPI):
             # mid-drain would drop its alloc from the overlay while the free
             # arrays still predate it — under-counting, over-commit.
             overlay = self._inflight_overlay()
+            inflight_ports = self._inflight_ports()
             self.encoder.sync_nodes()
             batch = self.encoder.build_batch(remaining, extra_placed=placements)
             result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                  max_rounds=so.max_rounds, chunk=so.chunk,
                                  use_pallas=self._use_pallas,
-                                 free_delta=overlay, node_mask=node_mask)
+                                 free_delta=overlay, node_mask=node_mask,
+                                 ports_delta=inflight_ports)
             assigned = np.asarray(result.assigned)[: batch.num_pods]
             progress = False
             next_remaining: List = []
@@ -1053,6 +1058,8 @@ class CoreScheduler(SchedulerAPI):
         app = self.partition.applications[alloc.application_id]
         app.allocations[alloc.allocation_key] = alloc
         app.pending_asks.pop(alloc.allocation_key, None)
+        if not alloc.placeholder:
+            app.had_real_allocation = True
         self._inflight[alloc.allocation_key] = alloc
         if app.state in (APP_ACCEPTED, APP_RESUMING):
             app.state = APP_RUNNING
@@ -1089,6 +1096,45 @@ class CoreScheduler(SchedulerAPI):
         cap = Resource(total)
         self._cap_cache[self.partition.name] = (gen, cap)
         return cap
+
+    def _inflight_ports(self):
+        """[capacity, Wp] u32 mask of host ports held by committed-but-not-
+        yet-assumed allocations — the port analog of _inflight_overlay.
+        Without it, consecutive cycles could each place a pod wanting the
+        same hostPort on one node (the synthetic port columns only see
+        cache-visible occupancy). Uses lookup(), not bit(): the pods'
+        ports were interned when their batch was encoded."""
+        import numpy as np
+
+        from yunikorn_tpu.snapshot.vocab import port_bit
+
+        if not self._inflight:
+            return None
+        out = None
+        pv = self.encoder.vocabs.ports
+        for key, alloc in self._inflight.items():
+            pod = self.cache.get_pod(key)
+            if pod is None:
+                continue
+            bits = []
+            for c in pod.spec.containers:
+                for p in c.ports:
+                    hp = p.get("hostPort")
+                    if hp:
+                        b = pv.lookup(port_bit(p.get("protocol", "TCP"), hp))
+                        if b >= 0:
+                            bits.append(b)
+            if not bits:
+                continue
+            idx = self.encoder.nodes.index_of(alloc.node_id)
+            if idx is None:
+                continue
+            if out is None:
+                out = np.zeros((self.encoder.nodes.capacity, pv.num_words),
+                               np.uint32)
+            for b in bits:
+                out[idx, b // 32] |= np.uint32(1 << (b % 32))
+        return out
 
     def _inflight_overlay(self):
         """[capacity, R] overlay of committed-but-not-yet-assumed allocations."""
@@ -1263,11 +1309,21 @@ class CoreScheduler(SchedulerAPI):
         for app in self.partition.applications.values():
             if app.state not in (APP_RUNNING, APP_COMPLETING, APP_RESUMING):
                 continue
-            if app.allocations or app.pending_asks:
+            real = any(not a.placeholder for a in app.allocations.values())
+            if real or app.pending_asks:
                 self._completing_since.pop(app.application_id, None)
                 if app.state == APP_COMPLETING:
                     app.state = APP_RUNNING
                 continue
+            if app.allocations and not app.had_real_allocation:
+                # gang still reserving (placeholders only, no real member ever
+                # committed): the placeholder timeout owns this state
+                continue
+            if app.allocations:
+                # workload finished; unreplaced placeholders remain — release
+                # them so the gang's reserved capacity frees with the app
+                # (reference application.go Completing transition)
+                self._release_leftover_placeholders(app)
             since = self._completing_since.setdefault(app.application_id, now)
             if app.state == APP_RUNNING:
                 app.state = APP_COMPLETING
@@ -1279,6 +1335,25 @@ class CoreScheduler(SchedulerAPI):
                     message="application completed"))
         if updates and self.callback is not None:
             self.callback.update_application(ApplicationResponse(updated=updates))
+
+    def _release_leftover_placeholders(self, app) -> None:
+        """Release an app's remaining placeholder allocations (workload done,
+        gang floor partially unreplaced) through the standard release path —
+        it owns the full bookkeeping (inflight, queue AND per-user usage);
+        the shim deletes the placeholder pods on the release event."""
+        leftovers = [a for a in app.allocations.values() if a.placeholder]
+        released = []
+        for ph in leftovers:
+            rel = self._release_allocation(AllocationRelease(
+                application_id=app.application_id,
+                allocation_key=ph.allocation_key,
+                termination_type=TerminationType.TIMEOUT,
+                message="unreplaced placeholder released on app completion",
+            ))
+            if rel is not None:
+                released.append(rel)
+        if released and self.callback is not None:
+            self.callback.update_allocation(AllocationResponse(released=released))
 
     def _check_placeholder_timeouts(self) -> None:
         """Placeholder timeout → release placeholders + app Resuming/Failing."""

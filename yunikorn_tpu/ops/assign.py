@@ -173,7 +173,8 @@ def _loc_soft_scores(gid_rows, dom_cols, loc, cnt, minc, contrib_rows):
 
 
 def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
-                        base_scores, chunk: int, policy: str):
+                        base_scores, chunk: int, policy: str,
+                        score_cols: int = 0):
     """For every pod: (best node, any feasible?) without materializing [N, M].
 
     Locality rules/scores arrive pre-folded into group_feas/group_soft (the
@@ -195,7 +196,9 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
         ok = cfeas & (margin >= 0)
         scores = jnp.broadcast_to(base_scores[None, :], (chunk, M)) + group_soft[cgid]
         if policy == "align":
-            scores = scores + alignment_scores(creq, free, capacity)
+            s = score_cols if score_cols > 0 else R
+            scores = scores + alignment_scores(
+                creq[:, :s], free[:, :s], capacity[:, :s])
         scores = jnp.where(ok, scores, NEG_INF)
         best = jnp.argmax(scores, axis=1).astype(jnp.int32)            # [C]
         feasible = jnp.any(ok, axis=1)                                 # [C]
@@ -441,7 +444,8 @@ def _segment_prefix_accept(snode, sreq, free_ext, M):
 @functools.partial(
     jax.jit,
     static_argnames=("max_rounds", "chunk", "policy", "use_pallas",
-                     "pallas_interpret", "has_loc_soft", "pallas_has_soft"),
+                     "pallas_interpret", "has_loc_soft", "pallas_has_soft",
+                     "score_cols"),
 )
 def solve(
     req,            # [N, R] int32
@@ -467,8 +471,16 @@ def solve(
     pallas_interpret: bool = False,
     has_loc_soft: bool = True,
     pallas_has_soft: bool = True,
+    score_cols: int = 0,
 ):
     """One batched solve. Returns (assigned [N] int32, free_after, rounds).
+
+    score_cols > 0 restricts SCORING to the first score_cols resource
+    columns; feasibility always uses all of them. prepare_solve_args appends
+    capacity-1 synthetic columns per requested host port beyond score_cols —
+    the round loop's free tracking then enforces intra-batch port
+    exclusivity (two batch pods cannot share a port on one node) without
+    ports distorting the packing score.
 
     has_loc_soft=False (static) skips the soft-locality scoring pass for
     batches whose locality slots are all hard (the common case) — the pass
@@ -557,10 +569,13 @@ def solve(
         # water-fill and argmax rounds alternate; only give up after both stall
         return (stalls < 2) & (rnd < max_rounds) & ~jnp.all(done)
 
+    sc = score_cols if score_cols > 0 else R
+
     def body(state):
         free_ext, done, assigned, rnd, stalls, cnt = state
         cur_free = free_ext[:M]
-        base_scores = node_base_scores(cur_free, capacity, policy)
+        base_scores = node_base_scores(cur_free[:, :sc], capacity[:, :sc],
+                                       policy)
         active = ~done
         if has_loc:
             minc, total = _loc_round_stats(loc, cnt)
@@ -597,7 +612,7 @@ def solve(
             else:
                 best, feasible = _best_nodes_chunked(
                     req, group_id, feas_round, soft_round, cur_free, capacity,
-                    base_scores, chunk, policy)
+                    base_scores, chunk, policy, score_cols)
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
 
@@ -656,7 +671,8 @@ def pad2d(arr, width, fill):
     return out
 
 
-def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
+def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
+                       ports_delta=None):
     """Assemble the positional numpy args + static kwargs for `solve`.
 
     Shared by solve_batch (single device) and parallel.mesh.solve_sharded
@@ -668,6 +684,8 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
     node_mask: optional [capacity] bool restricting candidate nodes (the
     multi-partition case: one encoder holds every cache node, each
     partition's solve sees only its own).
+    ports_delta: optional [capacity, Wp] u32 port mask OR-ed into node port
+    occupancy (in-flight allocations' host ports — see _inflight_ports).
     """
     import numpy as np
 
@@ -681,6 +699,48 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
         d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
         free_i = free_i - d
     cap_i = np.floor(na.capacity_arr).astype(np.int32)
+    req_i = batch.req.astype(np.int32)
+    score_cols = req_i.shape[1]
+    # node port occupancy = cache-visible pods + in-flight allocations (an
+    # allocation committed last cycle whose assume hasn't landed holds its
+    # ports just as firmly)
+    node_ports_u32 = na.ports.view(np.uint32)
+    if ports_delta is not None:
+        pd = np.zeros_like(node_ports_u32)
+        rows = min(pd.shape[0], ports_delta.shape[0])
+        cols = min(pd.shape[1], ports_delta.shape[1])
+        pd[:rows, :cols] = ports_delta[:rows, :cols]
+        node_ports_u32 = node_ports_u32 | pd
+    # intra-batch host-port exclusivity: each port bit any group requests
+    # becomes a capacity-1 synthetic resource column. The static group
+    # feasibility (g_ports vs node_ports) only sees EXISTING pods; without
+    # these columns two batch pods wanting one port could share a node.
+    # Bucketed column count (next power of two, min 4) bounds the number of
+    # compiled shape variants.
+    g_ports_u32 = batch.g_ports.view(np.uint32)
+    if g_ports_u32.any():
+        union = np.bitwise_or.reduce(g_ports_u32, axis=0)      # [Wp]
+        port_bits = [(w, b) for w in range(union.shape[0])
+                     for b in range(32) if (int(union[w]) >> b) & 1]
+        P = len(port_bits)
+        P_pad = max(4, 1 << (P - 1).bit_length())
+        Np, M_ = req_i.shape[0], free_i.shape[0]
+        req_ext = np.zeros((Np, P_pad), np.int32)
+        free_ext = np.zeros((M_, P_pad), np.int32)
+        cap_ext = np.zeros((M_, P_pad), np.int32)
+        gid = batch.group_id[:Np]
+        Wn = node_ports_u32.shape[1]
+        for j, (w, b) in enumerate(port_bits):
+            req_ext[:, j] = (g_ports_u32[gid, w] >> np.uint32(b)) & 1
+            if w < Wn:
+                occupied = (node_ports_u32[:, w] >> np.uint32(b)) & 1
+                free_ext[:, j] = 1 - occupied.astype(np.int32)
+            else:
+                free_ext[:, j] = 1
+            cap_ext[:, j] = 1
+        req_i = np.concatenate([req_i, req_ext], axis=1)
+        free_i = np.concatenate([free_i, free_ext], axis=1)
+        cap_i = np.concatenate([cap_i, cap_ext], axis=1)
     node_ok = na.valid & na.schedulable
     if node_mask is not None:
         node_ok = node_ok & node_mask[: node_ok.shape[0]]
@@ -696,7 +756,7 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
         loc = (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
                lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight)
     np_args = (
-        batch.req.astype(np.int32),
+        req_i,
         batch.group_id,
         batch.rank,
         batch.valid,
@@ -713,7 +773,7 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
         na.labels.view(np.uint32),
         na.taints_hard.view(np.uint32),
         na.taints_soft.view(np.uint32),
-        na.ports.view(np.uint32),
+        node_ports_u32,
         node_ok,
         free_i,
         cap_i,
@@ -728,13 +788,15 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None):
         pallas_has_soft=(bool(batch.g_pref_weight.any())
                          or host_soft is not None
                          or bool(np.any(na.taints_soft))),
+        # scoring ignores the synthetic port columns appended above
+        score_cols=score_cols,
     )
     return np_args, static_kwargs
 
 
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
-                device=None, node_mask=None,
+                device=None, node_mask=None, ports_delta=None,
                 compile_only=False) -> Optional[SolveResult]:
     """Convenience host wrapper: numpy in → SolveResult out.
 
@@ -744,7 +806,8 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     device time; returns None.
     """
     np_args, static_kwargs = prepare_solve_args(
-        batch, node_arrays, free_delta=free_delta, node_mask=node_mask)
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
+        ports_delta=ports_delta)
     solve_kwargs = dict(
         max_rounds=max_rounds,
         chunk=chunk,

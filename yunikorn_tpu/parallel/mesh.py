@@ -49,7 +49,7 @@ def _shardings(mesh: Mesh):
 
 def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                   chunk: int = 512, policy: str = "binpacking",
-                  free_delta=None, node_mask=None,
+                  free_delta=None, node_mask=None, ports_delta=None,
                   compile_only: bool = False) -> Optional[assign_mod.SolveResult]:
     """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
 
@@ -70,7 +70,8 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     group_node_s = NamedSharding(mesh, P(None, NODE_AXIS))
 
     np_args, static_kwargs = assign_mod.prepare_solve_args(
-        batch, node_arrays, free_delta=free_delta, node_mask=node_mask)
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
+        ports_delta=ports_delta)
     (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
      g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
      g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
@@ -102,6 +103,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     solve_kwargs = dict(
         max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
         policy=policy, has_loc_soft=static_kwargs["has_loc_soft"],
+        score_cols=static_kwargs["score_cols"],
     )
     with mesh:
         if compile_only:

@@ -169,15 +169,20 @@ def prewarm_buckets(spec: str, results: "list | None" = None,
     return t
 
 
+def compile_cache_dir() -> str:
+    """The persistent XLA compilation cache directory (single source of the
+    env-var name + default; bench.py counts entries here)."""
+    return os.environ.get("YUNIKORN_TPU_COMPILE_CACHE",
+                          os.path.expanduser("~/.cache/yunikorn_tpu_xla"))
+
+
 def ensure_compilation_cache(path: str | None = None) -> None:
     global _initialized
     if _initialized:
         return
     import jax
 
-    cache_dir = path or os.environ.get(
-        "YUNIKORN_TPU_COMPILE_CACHE", os.path.expanduser("~/.cache/yunikorn_tpu_xla")
-    )
+    cache_dir = path or compile_cache_dir()
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
