@@ -40,6 +40,9 @@ _COLLECTIONS = {
         "validatingwebhookconfigurations",
     "/apis/admissionregistration.k8s.io/v1/mutatingwebhookconfigurations":
         "mutatingwebhookconfigurations",
+    "/apis/storage.k8s.io/v1/csidrivers": "csidrivers",
+    "/apis/storage.k8s.io/v1/csistoragecapacities": "csistoragecapacities",
+    "/apis/storage.k8s.io/v1/volumeattachments": "volumeattachments",
 }
 
 # collection name → whether objects are namespaced (for object-path routing)
@@ -51,6 +54,10 @@ _NAMESPACED = {
     "storageclasses": False, "csinodes": False,
     "validatingwebhookconfigurations": False,
     "mutatingwebhookconfigurations": False,
+    "csidrivers": False, "volumeattachments": False,
+    # CSIStorageCapacity is namespaced upstream; the adapter lists it
+    # cluster-wide (all-namespaces), which the path map above serves
+    "csistoragecapacities": False,
 }
 
 def _coll_of(segment: str) -> Optional[str]:

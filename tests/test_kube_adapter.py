@@ -148,3 +148,54 @@ def test_namespaced_configmap_informer_path(api):
 
     inf = provider._informers[InformerType.CONFIGMAP]
     assert inf._list_path(False) == "/api/v1/namespaces/yunikorn/configmaps"
+
+
+def test_csi_informers_over_real_protocol():
+    """CSIDriver/CSIStorageCapacity/VolumeAttachment informers LIST+WATCH
+    over HTTP and land decoded in the stores (completes the reference's
+    storage informer set, apifactory.go:39-59)."""
+    import ssl
+
+    from tests.fake_apiserver import FakeAPIServer
+    from yunikorn_tpu.client.interfaces import InformerType
+    from yunikorn_tpu.client.kube import KubeConfig, RealAPIProvider
+
+    server = FakeAPIServer()
+    port = server.start()
+    try:
+        server.add("csidrivers", {
+            "metadata": {"name": "csi.x.io"},
+            "spec": {"attachRequired": True, "storageCapacity": True}})
+        server.add("csistoragecapacities", {
+            "metadata": {"name": "seg-1", "namespace": "default"},
+            "storageClassName": "fast",
+            "nodeTopology": {"matchLabels": {"zone": "a"}},
+            "capacity": "100Gi"})
+        server.add("volumeattachments", {
+            "metadata": {"name": "va-1"},
+            "spec": {"attacher": "csi.x.io", "nodeName": "n0",
+                     "source": {"persistentVolumeName": "pv-9"}},
+            "status": {"attached": True}})
+        cfg = KubeConfig(f"http://127.0.0.1:{port}", ssl.create_default_context())
+        provider = RealAPIProvider(cfg)
+        seen = {"drv": [], "cap": [], "va": []}
+        from yunikorn_tpu.client.interfaces import ResourceEventHandlers
+        provider.add_event_handler(InformerType.CSI_DRIVER,
+                                   ResourceEventHandlers(add_fn=seen["drv"].append))
+        provider.add_event_handler(InformerType.CSI_STORAGE_CAPACITY,
+                                   ResourceEventHandlers(add_fn=seen["cap"].append))
+        provider.add_event_handler(InformerType.VOLUME_ATTACHMENT,
+                                   ResourceEventHandlers(add_fn=seen["va"].append))
+        provider.start()
+        try:
+            provider.wait_for_sync(timeout=10)
+            assert seen["drv"][0].storage_capacity is True
+            cap = seen["cap"][0]
+            assert cap.storage_class == "fast" and cap.capacity == 100 * 2**30
+            assert cap.node_topology == {"zone": "a"}
+            va = seen["va"][0]
+            assert va.node_name == "n0" and va.pv_name == "pv-9" and va.attached
+        finally:
+            provider.stop()
+    finally:
+        server.stop()

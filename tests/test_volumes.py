@@ -319,3 +319,115 @@ def test_codec_roundtrip_preserves_unmodeled_fields():
     assert out["spec"]["volumeName"] == "pv-x"
     # encoding must not mutate the original raw document
     assert "volumeName" not in pvc_doc["spec"]
+
+
+def test_csi_storage_capacity_gates_provisioning(sched):
+    """A driver with storageCapacity=true: dynamic provisioning only counts
+    as feasible on nodes covered by a CSIStorageCapacity segment that fits
+    the claim (reference: volumebinding's CSIStorageCapacity checks)."""
+    from yunikorn_tpu.common.objects import (CSIDriverInfo,
+                                             CSIStorageCapacityInfo)
+
+    for i in range(3):
+        sched.add_node(make_node(f"cap-n{i}", cpu_milli=8000,
+                                 labels={"topology.kubernetes.io/zone":
+                                         f"z{i}"}))
+    sched.cluster.add_storage_class(StorageClass(
+        metadata=ObjectMeta(name="tracked"), provisioner="csi.tracked.io",
+        volume_binding_mode="WaitForFirstConsumer"))
+    sched.cluster.add_csi_driver(CSIDriverInfo(
+        metadata=ObjectMeta(name="csi.tracked.io"), storage_capacity=True))
+    # only zone z1 has provisionable capacity for 1Gi
+    sched.cluster.add_csi_capacity(CSIStorageCapacityInfo(
+        metadata=ObjectMeta(name="seg-z1", namespace="default"),
+        storage_class="tracked",
+        node_topology={"topology.kubernetes.io/zone": "z1"},
+        capacity=2**31))
+    sched.cluster.add_pvc(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="cap-claim", namespace="default"),
+        storage_class="tracked", requested_storage=2**30))
+    pod = sched.add_pod(vol_pod("cap-pod", "cap-claim"))
+    sched.wait_for_task_state("vol-app", pod.uid, task_mod.BOUND)
+    assert sched.get_pod_assignment(pod) == "cap-n1"     # the only covered node
+
+    # a claim bigger than every segment stays pending
+    sched.cluster.add_pvc(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="huge-claim", namespace="default"),
+        storage_class="tracked", requested_storage=2**33))
+    big = sched.add_pod(vol_pod("huge-pod", "huge-claim"))
+    time.sleep(1.2)
+    assert sched.get_pod_assignment(big) == ""
+
+
+def test_volume_attachment_counts_against_attach_limit(sched):
+    """VolumeAttachments from outside the scheduler occupy attach slots:
+    with limit 2 and one foreign attachment, only one PVC pod fits."""
+    from yunikorn_tpu.common.objects import VolumeAttachmentInfo
+
+    sched.add_node(make_node("va-n0", cpu_milli=16000))
+    sched.cluster.add_csinode(CSINodeInfo(
+        metadata=ObjectMeta(name="va-n0"),
+        driver_limits={"csi.example.com": 2}))
+    sched.cluster.add_volume_attachment(VolumeAttachmentInfo(
+        metadata=ObjectMeta(name="foreign-va"), attacher="csi.example.com",
+        node_name="va-n0", pv_name="someone-elses-pv", attached=True))
+    for i in range(2):
+        sched.cluster.add_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=f"va-c{i}", namespace="default"),
+            storage_class="any"))
+    pods = [sched.add_pod(vol_pod(f"va-p{i}", f"va-c{i}", cpu=100))
+            for i in range(2)]
+    sched.wait_for_bound_count(1)
+    time.sleep(0.8)
+    bound = [p for p in pods if sched.get_pod_assignment(p)]
+    assert len(bound) == 1        # 2-slot limit minus 1 foreign attachment
+    # the attachment is released -> the second pod fits
+    sched.cluster.delete_volume_attachment("foreign-va")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(sched.get_pod_assignment(p) for p in pods):
+            break
+        time.sleep(0.1)
+    assert all(sched.get_pod_assignment(p) for p in pods)
+
+
+def test_static_pv_satisfies_tracked_class_without_segments(sched):
+    """A pre-provisioned static PV serves a claim of a capacity-tracked
+    class even when NO CSIStorageCapacity segment exists (binder order:
+    static match first; encoder mask must agree)."""
+    from yunikorn_tpu.common.objects import CSIDriverInfo
+
+    sched.add_node(make_node("st-n0", cpu_milli=8000))
+    sched.cluster.add_storage_class(StorageClass(
+        metadata=ObjectMeta(name="tracked2"), provisioner="csi.t2.io"))
+    sched.cluster.add_csi_driver(CSIDriverInfo(
+        metadata=ObjectMeta(name="tracked2-drv"), storage_capacity=True))
+    sched.cluster.add_csi_driver(CSIDriverInfo(
+        metadata=ObjectMeta(name="csi.t2.io"), storage_capacity=True))
+    sched.cluster.add_pv(PersistentVolume(
+        metadata=ObjectMeta(name="restored-pv"), capacity=2**31,
+        storage_class="tracked2"))
+    sched.cluster.add_pvc(PersistentVolumeClaim(
+        metadata=ObjectMeta(name="restored-claim", namespace="default"),
+        storage_class="tracked2", requested_storage=2**30))
+    pod = sched.add_pod(vol_pod("restore-pod", "restored-claim"))
+    sched.wait_for_task_state("vol-app", pod.uid, task_mod.BOUND)
+    pvc = sched.cluster.get_pvc("default", "restored-claim")
+    assert pvc.bound and pvc.volume_name == "restored-pv"
+
+
+def test_unsupported_capacity_topology_fails_closed():
+    """A segment whose nodeTopology uses expressions the model can't
+    represent must NOT widen to all nodes."""
+    from yunikorn_tpu.client.k8s_codec import decode_csistoragecapacity
+    from yunikorn_tpu.common.objects import make_node as mk
+
+    cap = decode_csistoragecapacity({
+        "metadata": {"name": "multi", "namespace": "default"},
+        "storageClassName": "fast",
+        "nodeTopology": {"matchExpressions": [
+            {"key": "zone", "operator": "In", "values": ["a", "b"]}]},
+        "capacity": "10Gi"})
+    assert cap.topology_unsupported
+    assert not cap.covers_node(mk("anynode", labels={"zone": "c"}))
+    assert not cap.covers_node(mk("anode", labels={"zone": "a"}))

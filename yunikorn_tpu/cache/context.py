@@ -148,6 +148,10 @@ class VolumeBinder:
             sc = self.cache.get_storage_class_obj(pvc.storage_class)
             if sc is not None and not sc.provisioner:
                 return False                    # class exists, cannot provision
+            if sc is not None and not self.cache.csi_capacity_feasible(
+                    sc, node, pvc.requested_storage):
+                return False                    # capacity-tracked driver: no
+                                                # segment covering this node fits
             # class unknown (informer lag / legacy provider): optimistic —
             # dynamic provisioning is attempted and the 10-min bind timeout
             # is the enforcement, mirroring the reference's bind-time failure
@@ -316,6 +320,23 @@ class Context:
             add_fn=self._on_csinode,
             update_fn=lambda old, new: self._on_csinode(new),
             delete_fn=self._on_csinode_deleted))
+        # CSIDriver flags + CSIStorageCapacity segments (capacity-aware
+        # provisioning) + VolumeAttachment foreign occupancy (reference
+        # apifactory.go:39-59 informer set)
+        self.api_provider.add_event_handler(InformerType.CSI_DRIVER, ResourceEventHandlers(
+            add_fn=cache.update_csi_driver_obj,
+            update_fn=lambda old, new: cache.update_csi_driver_obj(new),
+            delete_fn=cache.remove_csi_driver_obj))
+        self.api_provider.add_event_handler(
+            InformerType.CSI_STORAGE_CAPACITY, ResourceEventHandlers(
+                add_fn=cache.update_csi_capacity_obj,
+                update_fn=lambda old, new: cache.update_csi_capacity_obj(new),
+                delete_fn=cache.remove_csi_capacity_obj))
+        self.api_provider.add_event_handler(
+            InformerType.VOLUME_ATTACHMENT, ResourceEventHandlers(
+                add_fn=cache.update_volume_attachment_obj,
+                update_fn=lambda old, new: cache.update_volume_attachment_obj(new),
+                delete_fn=cache.remove_volume_attachment_obj))
         self.api_provider.add_event_handler(InformerType.NAMESPACE, ResourceEventHandlers(
             add_fn=self._on_namespace,
             update_fn=lambda old, new: self._on_namespace(new),

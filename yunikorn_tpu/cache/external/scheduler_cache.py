@@ -33,6 +33,9 @@ class NodeInfo:
     pods: Dict[str, Pod] = dataclasses.field(default_factory=dict)
     requested: Resource = dataclasses.field(default_factory=Resource)
     allocatable: Resource = dataclasses.field(default_factory=Resource)
+    # attach-limit occupancy from VolumeAttachments whose PV no cache pod on
+    # this node mounts (out-of-scheduler attachers)
+    foreign_attach: int = 0
 
     def add_pod(self, pod: Pod) -> None:
         key = pod.uid
@@ -54,7 +57,12 @@ class NodeInfo:
         self.allocatable = get_node_resource(node.status.allocatable)
 
     def available(self) -> Resource:
-        return self.allocatable.sub(self.requested)
+        out = self.allocatable.sub(self.requested)
+        if self.foreign_attach:
+            from yunikorn_tpu.common.resource import VOLUME_ATTACH
+
+            out = out.sub(Resource({VOLUME_ATTACH: self.foreign_attach}))
+        return out
 
 
 class SchedulerCache:
@@ -77,6 +85,9 @@ class SchedulerCache:
         self.pvcs_map: Dict[str, object] = {}
         self.pvs_map: Dict[str, object] = {}
         self.storage_classes_map: Dict[str, object] = {}
+        self.csi_drivers_map: Dict[str, object] = {}
+        self.csi_capacities_map: Dict[str, object] = {}
+        self.volume_attachments_map: Dict[str, object] = {}
         # generation tracking for incremental snapshot encoding
         self._generation = 0
         # bumped only when node allocatable capacity changes (add/remove/update
@@ -272,10 +283,21 @@ class SchedulerCache:
     def update_pvc_obj(self, pvc) -> None:
         with self._lock:
             self.pvcs_map[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+            self._refresh_va_nodes_locked()
 
     def remove_pvc_obj(self, pvc) -> None:
         with self._lock:
             self.pvcs_map.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+            self._refresh_va_nodes_locked()
+
+    def _refresh_va_nodes_locked(self) -> None:
+        """PVC (volume_name) changes shift which attachments count as
+        foreign; VA-bearing nodes are few, so refresh them all."""
+        if not self.volume_attachments_map:
+            return
+        for n in {va.node_name for va in self.volume_attachments_map.values()
+                  if va.node_name}:
+            self._recompute_foreign_attach_locked(n)
 
     def get_pvc_obj(self, namespace: str, name: str):
         with self._lock.reader():
@@ -308,6 +330,88 @@ class SchedulerCache:
     def get_storage_class_obj(self, name: str):
         with self._lock.reader():
             return self.storage_classes_map.get(name)
+
+    # CSIDriver flags + CSIStorageCapacity segments: capacity-aware dynamic
+    # provisioning (reference: the volumebinding plugin's CSIStorageCapacity
+    # checks behind the driver's storageCapacity flag)
+    def update_csi_driver_obj(self, drv) -> None:
+        with self._lock:
+            self.csi_drivers_map[drv.metadata.name] = drv
+
+    def remove_csi_driver_obj(self, drv) -> None:
+        with self._lock:
+            self.csi_drivers_map.pop(drv.metadata.name, None)
+
+    def get_csi_driver_obj(self, name: str):
+        with self._lock.reader():
+            return self.csi_drivers_map.get(name)
+
+    def update_csi_capacity_obj(self, cap) -> None:
+        with self._lock:
+            key = f"{cap.metadata.namespace}/{cap.metadata.name}"
+            self.csi_capacities_map[key] = cap
+
+    def remove_csi_capacity_obj(self, cap) -> None:
+        with self._lock:
+            self.csi_capacities_map.pop(
+                f"{cap.metadata.namespace}/{cap.metadata.name}", None)
+
+    def csi_fitting_segments(self, storage_class, requested: int):
+        """None = the class's driver does not track capacity (provisionable
+        anywhere); else the list of CSIStorageCapacity segments of this class
+        that fit `requested` — callers check covers_node() lock-free per node
+        (one locked pass instead of M lock round-trips per snapshot build)."""
+        with self._lock.reader():
+            drv = self.csi_drivers_map.get(storage_class.provisioner)
+            if drv is None or not drv.storage_capacity:
+                return None
+            return [cap for cap in self.csi_capacities_map.values()
+                    if cap.storage_class == storage_class.metadata.name
+                    and cap.fits(requested)]
+
+    def csi_capacity_feasible(self, storage_class, node, requested: int) -> bool:
+        """Can `requested` bytes of `storage_class` be provisioned reachable
+        from `node`? True unless the class's driver opted into capacity
+        tracking (storageCapacity: true) and no covering segment fits."""
+        segments = self.csi_fitting_segments(storage_class, requested)
+        if segments is None:
+            return True
+        return any(node is None or cap.covers_node(node) for cap in segments)
+
+    # VolumeAttachment objects: attachments not backed by a cache pod on the
+    # node count as foreign occupancy against the attach limit
+    def update_volume_attachment_obj(self, va) -> None:
+        with self._lock:
+            self.volume_attachments_map[va.metadata.name] = va
+            if va.node_name:
+                self._recompute_foreign_attach_locked(va.node_name)
+
+    def remove_volume_attachment_obj(self, va) -> None:
+        with self._lock:
+            old = self.volume_attachments_map.pop(va.metadata.name, None)
+            node = (old.node_name if old is not None else "") or va.node_name
+            if node:
+                self._recompute_foreign_attach_locked(node)
+
+    def _recompute_foreign_attach_locked(self, node_name: str) -> None:
+        info = self.nodes_map.get(node_name)
+        if info is None:
+            return
+        # PVs mounted by pods the cache already counts on this node
+        counted_pvs = set()
+        for pod in info.pods.values():
+            for v in pod.spec.volumes:
+                if v.pvc_claim_name:
+                    pvc = self.pvcs_map.get(
+                        f"{pod.namespace}/{v.pvc_claim_name}")
+                    if pvc is not None and pvc.volume_name:
+                        counted_pvs.add(pvc.volume_name)
+        foreign = sum(
+            1 for va in self.volume_attachments_map.values()
+            if va.node_name == node_name and va.pv_name not in counted_pvs)
+        if foreign != info.foreign_attach:
+            info.foreign_attach = foreign
+            self._mark_dirty(node_name)
 
     # ------------------------------------------------------------------- DRA
     def update_resource_claim(self, claim) -> None:
@@ -476,6 +580,12 @@ class SchedulerCache:
     def _mark_dirty(self, node_name: str) -> None:
         self._generation += 1
         self._dirty_nodes.add(node_name)
+        # pod membership on the node shifted: a VolumeAttachment previously
+        # counted foreign may now be backed by a cache pod (or vice versa).
+        # No-op without VAs; self-terminating (the nested recompute only
+        # re-enters when the count CHANGED, and then finds it unchanged).
+        if self.volume_attachments_map:
+            self._recompute_foreign_attach_locked(node_name)
 
     def generation(self) -> int:
         with self._lock.reader():

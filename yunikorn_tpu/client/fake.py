@@ -123,6 +123,9 @@ class FakeCluster(APIProvider):
         self._pvs: Dict[str, object] = {}
         self._storage_classes: Dict[str, object] = {}
         self._csinodes: Dict[str, object] = {}
+        self._csi_drivers: Dict[str, object] = {}
+        self._csi_capacities: Dict[str, object] = {}
+        self._volume_attachments: Dict[str, object] = {}
         # built-in provisioner sim: see update_pvc
         self.auto_provision = True
         self._namespaces: Dict[str, Namespace] = {}
@@ -320,6 +323,28 @@ class FakeCluster(APIProvider):
             self._csinodes[csinode.metadata.name] = csinode
         self._fire(InformerType.CSINODE, "add", csinode)
 
+    def add_csi_driver(self, drv) -> None:
+        with self._lock:
+            self._csi_drivers[drv.metadata.name] = drv
+        self._fire(InformerType.CSI_DRIVER, "add", drv)
+
+    def add_csi_capacity(self, cap) -> None:
+        with self._lock:
+            key = f"{cap.metadata.namespace}/{cap.metadata.name}"
+            self._csi_capacities[key] = cap
+        self._fire(InformerType.CSI_STORAGE_CAPACITY, "add", cap)
+
+    def add_volume_attachment(self, va) -> None:
+        with self._lock:
+            self._volume_attachments[va.metadata.name] = va
+        self._fire(InformerType.VOLUME_ATTACHMENT, "add", va)
+
+    def delete_volume_attachment(self, name: str) -> None:
+        with self._lock:
+            va = self._volume_attachments.pop(name, None)
+        if va is not None:
+            self._fire(InformerType.VOLUME_ATTACHMENT, "delete", va)
+
     def update_pvc(self, pvc) -> None:
         """Replace a claim (binder writes volumeName/bound/annotations).
 
@@ -368,6 +393,12 @@ class FakeCluster(APIProvider):
             return list(self._storage_classes.values())
         if informer == InformerType.CSINODE:
             return list(self._csinodes.values())
+        if informer == InformerType.CSI_DRIVER:
+            return list(self._csi_drivers.values())
+        if informer == InformerType.CSI_STORAGE_CAPACITY:
+            return list(self._csi_capacities.values())
+        if informer == InformerType.VOLUME_ATTACHMENT:
+            return list(self._volume_attachments.values())
         return []
 
     def _fire(self, informer: InformerType, kind: str, obj, old=None) -> None:

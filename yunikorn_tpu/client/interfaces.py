@@ -33,6 +33,9 @@ class InformerType(enum.Enum):
     JOB = "job"
     CSINODE = "csinode"
     PV = "pv"
+    CSI_DRIVER = "csidriver"
+    CSI_STORAGE_CAPACITY = "csistoragecapacity"
+    VOLUME_ATTACHMENT = "volumeattachment"
     # DRA informers (reference apifactory.go:39-59 when the
     # DynamicResourceAllocation gate is on)
     RESOURCE_CLAIM = "resourceclaim"

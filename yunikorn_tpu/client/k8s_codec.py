@@ -480,6 +480,65 @@ def decode_storage_class(doc: Dict[str, Any]) -> "StorageClass":
     )
 
 
+def decode_csidriver(doc: Dict[str, Any]) -> "CSIDriverInfo":
+    from yunikorn_tpu.common.objects import CSIDriverInfo
+
+    spec = doc.get("spec") or {}
+    return CSIDriverInfo(
+        metadata=_meta(doc),
+        attach_required=bool(spec.get("attachRequired", True)),
+        storage_capacity=bool(spec.get("storageCapacity", False)),
+    )
+
+
+def decode_csistoragecapacity(doc: Dict[str, Any]) -> "CSIStorageCapacityInfo":
+    from yunikorn_tpu.common.objects import CSIStorageCapacityInfo
+    from yunikorn_tpu.common.resource import parse_quantity
+
+    def qty(key: str) -> int:
+        raw = doc.get(key)
+        if not raw:
+            return 0
+        try:
+            return parse_quantity(raw)
+        except ValueError:
+            return 0
+
+    topo: Dict[str, str] = {}
+    unsupported = False
+    nt = doc.get("nodeTopology") or {}
+    topo.update(nt.get("matchLabels") or {})
+    for e in nt.get("matchExpressions") or []:
+        vals = e.get("values") or []
+        if e.get("operator") == "In" and len(vals) == 1:
+            topo[e.get("key", "")] = vals[0]
+        else:
+            # can't represent it exactly → the segment fails closed
+            unsupported = True
+    return CSIStorageCapacityInfo(
+        metadata=_meta(doc),
+        storage_class=doc.get("storageClassName", "") or "",
+        node_topology=topo,
+        capacity=qty("capacity"),
+        maximum_volume_size=qty("maximumVolumeSize"),
+        topology_unsupported=unsupported,
+    )
+
+
+def decode_volumeattachment(doc: Dict[str, Any]) -> "VolumeAttachmentInfo":
+    from yunikorn_tpu.common.objects import VolumeAttachmentInfo
+
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    return VolumeAttachmentInfo(
+        metadata=_meta(doc),
+        attacher=spec.get("attacher", "") or "",
+        node_name=spec.get("nodeName", "") or "",
+        pv_name=((spec.get("source") or {}).get("persistentVolumeName")) or "",
+        attached=bool(status.get("attached", False)),
+    )
+
+
 def decode_csinode(doc: Dict[str, Any]) -> "CSINodeInfo":
     from yunikorn_tpu.common.objects import CSINodeInfo
 

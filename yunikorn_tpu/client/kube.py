@@ -63,6 +63,14 @@ _RESOURCES: Dict[InformerType, Tuple[str, Callable]] = {
     InformerType.STORAGE_CLASS: (
         "/apis/storage.k8s.io/v1/storageclasses", codec.decode_storage_class),
     InformerType.CSINODE: ("/apis/storage.k8s.io/v1/csinodes", codec.decode_csinode),
+    InformerType.CSI_DRIVER: (
+        "/apis/storage.k8s.io/v1/csidrivers", codec.decode_csidriver),
+    InformerType.CSI_STORAGE_CAPACITY: (
+        "/apis/storage.k8s.io/v1/csistoragecapacities",
+        codec.decode_csistoragecapacity),
+    InformerType.VOLUME_ATTACHMENT: (
+        "/apis/storage.k8s.io/v1/volumeattachments",
+        codec.decode_volumeattachment),
 }
 
 
@@ -437,7 +445,9 @@ class RealAPIProvider(APIProvider):
         types = [InformerType.POD, InformerType.NODE, InformerType.CONFIGMAP,
                  InformerType.PRIORITY_CLASS, InformerType.NAMESPACE,
                  InformerType.PVC, InformerType.PV,
-                 InformerType.STORAGE_CLASS, InformerType.CSINODE]
+                 InformerType.STORAGE_CLASS, InformerType.CSINODE,
+                 InformerType.CSI_DRIVER, InformerType.CSI_STORAGE_CAPACITY,
+                 InformerType.VOLUME_ATTACHMENT]
         if enable_dra:
             types += [InformerType.RESOURCE_CLAIM, InformerType.RESOURCE_SLICE]
         self._informers: Dict[InformerType, _Informer] = {

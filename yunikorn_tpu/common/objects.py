@@ -389,6 +389,63 @@ class CSINodeInfo:
         return min(self.driver_limits.values())
 
 
+@dataclasses.dataclass
+class CSIDriverInfo:
+    """storage.k8s.io/v1 CSIDriver: per-driver behavior flags. The
+    storage_capacity flag gates capacity-aware dynamic provisioning
+    (CSIStorageCapacity checks) in the volume binder."""
+    metadata: ObjectMeta                    # name == driver name
+    attach_required: bool = True
+    storage_capacity: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
+@dataclasses.dataclass
+class CSIStorageCapacityInfo:
+    """storage.k8s.io/v1 CSIStorageCapacity: provisionable capacity of one
+    storage class within a node topology segment (matchLabels simplified)."""
+    metadata: ObjectMeta
+    storage_class: str = ""
+    # node topology selector (required matchLabels; {} = all nodes)
+    node_topology: Dict[str, str] = dataclasses.field(default_factory=dict)
+    capacity: int = 0                       # provisionable bytes
+    maximum_volume_size: int = 0            # 0 = no per-volume bound
+    # the topology selector used an expression shape the simplified model
+    # cannot represent (NotIn / Exists / multi-value In): fail CLOSED —
+    # claiming wider coverage would place pods the driver can't serve
+    topology_unsupported: bool = False
+
+    def covers_node(self, node: Node) -> bool:
+        if self.topology_unsupported:
+            return False
+        labels = node.metadata.labels
+        return all(labels.get(k) == v for k, v in self.node_topology.items())
+
+    def fits(self, requested: int) -> bool:
+        if self.maximum_volume_size and requested > self.maximum_volume_size:
+            return False
+        return requested <= self.capacity
+
+
+@dataclasses.dataclass
+class VolumeAttachmentInfo:
+    """storage.k8s.io/v1 VolumeAttachment: a volume attached (or attaching)
+    to a node. Attachments whose PV no cache pod on the node mounts count as
+    foreign occupancy against the node's attach limit."""
+    metadata: ObjectMeta
+    attacher: str = ""
+    node_name: str = ""
+    pv_name: str = ""
+    attached: bool = False
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+
 def make_pod(
     name: str,
     namespace: str = "default",

@@ -736,12 +736,18 @@ class SnapshotEncoder:
                     mask = m if mask is None else (mask & m)
                 continue
             sc = self.cache.get_storage_class_obj(pvc.storage_class)
-            if sc is None or sc.provisioner:
-                continue                       # provisionable anywhere
-            # static-only claim: nodes covered by some compatible PV
-            # (matching semantics shared with the binder — common/volumes.py;
-            # assume-time reservations are deliberately ignored here: the
-            # mask is group-level, the binder re-checks exactly)
+            if sc is None:
+                continue                       # unknown class: optimistic
+            if sc.provisioner:
+                segments = self.cache.csi_fitting_segments(
+                    sc, pvc.requested_storage)
+                if segments is None:
+                    continue                   # untracked: provisionable anywhere
+            else:
+                segments = []                  # no provisioner: static PVs only
+            # static PVs first (same order as the binder: a pre-provisioned
+            # PV satisfies the claim even when no capacity segment covers the
+            # node), then capacity-tracked provisioning widens the mask
             allowed = np.zeros((M,), bool)
             unrestricted = False
             key = f"{ns}/{name}"
@@ -754,6 +760,11 @@ class SnapshotEncoder:
                 allowed |= label_mask(pv.node_affinity)
             if unrestricted:
                 continue
+            if segments:
+                for idx, info in rows:
+                    if info is not None and not allowed[idx] and any(
+                            cap.covers_node(info.node) for cap in segments):
+                        allowed[idx] = True
             mask = allowed if mask is None else (mask & allowed)
         return mask
 
