@@ -431,3 +431,19 @@ def test_unsupported_capacity_topology_fails_closed():
     assert cap.topology_unsupported
     assert not cap.covers_node(mk("anynode", labels={"zone": "c"}))
     assert not cap.covers_node(mk("anode", labels={"zone": "a"}))
+
+
+def test_nil_topology_segment_matches_no_nodes():
+    """Upstream semantics: a CSIStorageCapacity with NO nodeTopology matches
+    no node (nil selector = labels.Nothing), unlike an empty selector."""
+    from yunikorn_tpu.client.k8s_codec import decode_csistoragecapacity
+    from yunikorn_tpu.common.objects import make_node as mk
+
+    nil = decode_csistoragecapacity({
+        "metadata": {"name": "nil", "namespace": "default"},
+        "storageClassName": "fast", "capacity": "10Gi"})
+    assert not nil.covers_node(mk("n", labels={"zone": "a"}))
+    empty = decode_csistoragecapacity({
+        "metadata": {"name": "empty", "namespace": "default"},
+        "storageClassName": "fast", "nodeTopology": {}, "capacity": "10Gi"})
+    assert empty.covers_node(mk("n", labels={"zone": "a"}))

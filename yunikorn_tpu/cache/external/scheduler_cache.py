@@ -88,6 +88,9 @@ class SchedulerCache:
         self.csi_drivers_map: Dict[str, object] = {}
         self.csi_capacities_map: Dict[str, object] = {}
         self.volume_attachments_map: Dict[str, object] = {}
+        # node name -> {va name -> va}: per-node recompute without scanning
+        # every attachment (VA nodeName is immutable upstream)
+        self._vas_by_node: Dict[str, Dict[str, object]] = {}
         # generation tracking for incremental snapshot encoding
         self._generation = 0
         # bumped only when node allocatable capacity changes (add/remove/update
@@ -160,7 +163,8 @@ class SchedulerCache:
             if info is None:
                 return None
             return NodeInfo(node=info.node, pods=dict(info.pods),
-                            requested=info.requested, allocatable=info.allocatable)
+                            requested=info.requested, allocatable=info.allocatable,
+                            foreign_attach=info.foreign_attach)
 
     def node_names(self) -> List[str]:
         with self._lock.reader():
@@ -282,22 +286,29 @@ class SchedulerCache:
     # reads, apifactory.go:39-59).
     def update_pvc_obj(self, pvc) -> None:
         with self._lock:
-            self.pvcs_map[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
-            self._refresh_va_nodes_locked()
+            key = f"{pvc.metadata.namespace}/{pvc.metadata.name}"
+            old = self.pvcs_map.get(key)
+            self.pvcs_map[key] = pvc
+            self._refresh_va_nodes_locked(
+                {getattr(old, "volume_name", ""), pvc.volume_name})
 
     def remove_pvc_obj(self, pvc) -> None:
         with self._lock:
-            self.pvcs_map.pop(f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
-            self._refresh_va_nodes_locked()
+            old = self.pvcs_map.pop(
+                f"{pvc.metadata.namespace}/{pvc.metadata.name}", None)
+            self._refresh_va_nodes_locked(
+                {getattr(old, "volume_name", ""), pvc.volume_name})
 
-    def _refresh_va_nodes_locked(self) -> None:
-        """PVC (volume_name) changes shift which attachments count as
-        foreign; VA-bearing nodes are few, so refresh them all."""
-        if not self.volume_attachments_map:
+    def _refresh_va_nodes_locked(self, pv_names) -> None:
+        """A PVC binding change shifts which attachments of those PVs count
+        as foreign; refresh only nodes holding an attachment of an affected
+        volume (the common PVC event touches no VA at all)."""
+        pv_names.discard("")
+        if not pv_names or not self._vas_by_node:
             return
-        for n in {va.node_name for va in self.volume_attachments_map.values()
-                  if va.node_name}:
-            self._recompute_foreign_attach_locked(n)
+        for node, vas in self._vas_by_node.items():
+            if any(va.pv_name in pv_names for va in vas.values()):
+                self._recompute_foreign_attach_locked(node)
 
     def get_pvc_obj(self, namespace: str, name: str):
         with self._lock.reader():
@@ -384,6 +395,7 @@ class SchedulerCache:
         with self._lock:
             self.volume_attachments_map[va.metadata.name] = va
             if va.node_name:
+                self._vas_by_node.setdefault(va.node_name, {})[va.metadata.name] = va
                 self._recompute_foreign_attach_locked(va.node_name)
 
     def remove_volume_attachment_obj(self, va) -> None:
@@ -391,6 +403,11 @@ class SchedulerCache:
             old = self.volume_attachments_map.pop(va.metadata.name, None)
             node = (old.node_name if old is not None else "") or va.node_name
             if node:
+                per = self._vas_by_node.get(node)
+                if per is not None:
+                    per.pop(va.metadata.name, None)
+                    if not per:
+                        del self._vas_by_node[node]
                 self._recompute_foreign_attach_locked(node)
 
     def _recompute_foreign_attach_locked(self, node_name: str) -> None:
@@ -407,8 +424,8 @@ class SchedulerCache:
                     if pvc is not None and pvc.volume_name:
                         counted_pvs.add(pvc.volume_name)
         foreign = sum(
-            1 for va in self.volume_attachments_map.values()
-            if va.node_name == node_name and va.pv_name not in counted_pvs)
+            1 for va in self._vas_by_node.get(node_name, {}).values()
+            if va.pv_name not in counted_pvs)
         if foreign != info.foreign_attach:
             info.foreign_attach = foreign
             self._mark_dirty(node_name)

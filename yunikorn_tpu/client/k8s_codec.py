@@ -505,8 +505,11 @@ def decode_csistoragecapacity(doc: Dict[str, Any]) -> "CSIStorageCapacityInfo":
             return 0
 
     topo: Dict[str, str] = {}
-    unsupported = False
-    nt = doc.get("nodeTopology") or {}
+    nt = doc.get("nodeTopology")
+    # upstream: a NIL selector matches NO nodes (labels.Nothing()); only a
+    # present-but-empty selector matches everything
+    unsupported = nt is None
+    nt = nt or {}
     topo.update(nt.get("matchLabels") or {})
     for e in nt.get("matchExpressions") or []:
         vals = e.get("values") or []

@@ -55,12 +55,16 @@ class Mutex:
         if DETECTION_ENABLED:
             if not self._lock.acquire(timeout=TIMEOUT_SECONDS):
                 _on_timeout("Mutex", f"held by: {self._holder}")
+            # holder tracking is diagnostic-only; current_thread() per
+            # acquisition is measurable on the pump's hot path, so production
+            # (detection off) skips it
+            self._holder = threading.current_thread().name
         else:
             self._lock.acquire()
-        self._holder = threading.current_thread().name
 
     def release(self) -> None:
-        self._holder = None
+        if DETECTION_ENABLED:
+            self._holder = None
         self._lock.release()
 
     def __enter__(self):
