@@ -146,6 +146,10 @@ class Application:
         with self._lock:
             return list(self.tasks.values())
 
+    def has_tasks(self) -> bool:
+        with self._lock:
+            return bool(self.tasks)
+
     def pending_tasks(self) -> List[Task]:
         with self._lock:
             stale = [tid for tid, t in self._new_tasks.items()

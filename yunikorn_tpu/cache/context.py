@@ -524,7 +524,9 @@ class Context:
         task_meta = get_task_metadata(pod, self.conf.generate_unique_app_ids)
         task = app.get_task(task_meta.task_id)
         if task is None:
-            originator = not app.task_list() and not task_meta.placeholder
+            # first non-placeholder task is the originator; has_tasks avoids
+            # copying the (possibly 50k-entry) task dict per new pod
+            originator = not app.has_tasks() and not task_meta.placeholder
             task = Task(app, pod, self, placeholder=task_meta.placeholder,
                         task_group_name=task_meta.task_group_name, originator=originator)
             app.add_task(task)

@@ -94,13 +94,25 @@ class RMutex(Mutex):
 
 
 class RWMutex:
-    """Reader-writer lock (writer-preferring) with optional deadlock detection.
+    """Reader-writer lock with optional deadlock detection.
 
     Matches the usage pattern of the reference's locking.RWMutex: many informer /
     dispatcher threads take RLock, state mutation takes Lock.
+
+    Fast path (detection OFF, the production default): a single reentrant
+    lock for both sides. Under the GIL, pure-Python critical sections never
+    actually read in parallel, so the Condition-based writer-preferring
+    implementation buys nothing while costing ~µs per acquisition and
+    serializing readers behind writer pressure — profiled as the dominant
+    term of the 50k-pod shim benchmark (1.9M acquisitions). The RLock is
+    also strictly more permissive (reader-inside-writer nesting works).
+    Detection ON keeps the instrumented reader/writer implementation.
     """
 
     def __init__(self):
+        if not DETECTION_ENABLED:
+            self._rlock = threading.RLock()
+            return
         self._cond = threading.Condition(threading.Lock())
         self._readers = 0
         self._writer = False
@@ -108,12 +120,15 @@ class RWMutex:
 
     # -- write side --
     def acquire(self) -> None:
-        deadline = TIMEOUT_SECONDS if DETECTION_ENABLED else None
+        if not DETECTION_ENABLED:
+            self._rlock.acquire()
+            return
         with self._cond:
             self._writers_waiting += 1
             try:
                 if not self._cond.wait_for(
-                    lambda: not self._writer and self._readers == 0, timeout=deadline
+                    lambda: not self._writer and self._readers == 0,
+                    timeout=TIMEOUT_SECONDS,
                 ):
                     _on_timeout("RWMutex(write)", f"readers={self._readers} writer={self._writer}")
                 self._writer = True
@@ -121,21 +136,30 @@ class RWMutex:
                 self._writers_waiting -= 1
 
     def release(self) -> None:
+        if not DETECTION_ENABLED:
+            self._rlock.release()
+            return
         with self._cond:
             self._writer = False
             self._cond.notify_all()
 
     # -- read side --
     def r_acquire(self) -> None:
-        deadline = TIMEOUT_SECONDS if DETECTION_ENABLED else None
+        if not DETECTION_ENABLED:
+            self._rlock.acquire()
+            return
         with self._cond:
             if not self._cond.wait_for(
-                lambda: not self._writer and self._writers_waiting == 0, timeout=deadline
+                lambda: not self._writer and self._writers_waiting == 0,
+                timeout=TIMEOUT_SECONDS,
             ):
                 _on_timeout("RWMutex(read)", f"writer held={self._writer}")
             self._readers += 1
 
     def r_release(self) -> None:
+        if not DETECTION_ENABLED:
+            self._rlock.release()
+            return
         with self._cond:
             self._readers -= 1
             if self._readers == 0:
