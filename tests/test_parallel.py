@@ -188,3 +188,19 @@ def test_sharded_with_constraints(env):
     for idx in assigned:
         name = enc.nodes.name_of(int(idx))
         assert int(name[1:]) % 3 == 1  # zone z1 nodes only
+
+
+def test_sharded_chunked_matches_single_chunked(env):
+    """Chained chunk solves (max_batch < N) must be bit-identical between the
+    sharded and single-device paths — the chunk chaining (capacity carry,
+    locality-count carry) is layout-independent."""
+    enc, batch = env
+    single = solve_batch(batch, enc.nodes, chunk=128, max_batch=128)
+    mesh = make_mesh()
+    sharded = solve_sharded(batch, enc.nodes, mesh, chunk=128, max_batch=128)
+    a1 = np.asarray(single.assigned)[: batch.num_pods]
+    a2 = np.asarray(sharded.assigned)[: batch.num_pods]
+    assert (a1 >= 0).all()
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(np.asarray(single.free_after),
+                                  np.asarray(sharded.free_after))

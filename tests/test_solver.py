@@ -590,3 +590,90 @@ def test_cross_cycle_port_exclusivity_via_ports_delta():
     assert names_of(enc, res, batch)[p2.uid] is None      # port held in-flight
     res2 = solve_batch(batch, enc.nodes)                   # without the overlay
     assert names_of(enc, res2, batch)[p2.uid] == "cn1"
+
+
+# ---------------------------------------------------------------- chunk chain
+# Batches above solve_batch's max_batch run as chained fixed-shape chunk
+# solves (capacity + locality-count carry) so only the canonical bucket ever
+# compiles (the r3 TPU capture paid ~408s compiling the monolithic 65536-pod
+# shape through the relay — VERDICT r3 item 2). These tests force a tiny
+# max_batch so the chain is exercised at unit-test cost.
+
+def test_chunked_chain_matches_single_solve_commitments():
+    """Chained chunk solves must place everything a single solve places, with
+    no node oversubscription — capacity carried across chunks."""
+    nodes = [make_node(f"ch{i}", cpu_milli=4000, memory=8 * 2**30)
+             for i in range(8)]
+    cache, enc = make_env(nodes)
+    pods = [make_pod(f"cp{i}", cpu_milli=200, memory=2**28) for i in range(160)]
+    asks = [ask_for(p) for p in pods]
+    batch = enc.build_batch(asks)
+    single = solve_batch(batch, enc.nodes)
+    chained = solve_batch(batch, enc.nodes, max_batch=64)   # 256-pod bucket → 4 chunks
+    got_single = names_of(enc, single, batch)
+    got_chained = names_of(enc, chained, batch)
+    assert sum(1 for v in got_single.values() if v) == 160
+    assert sum(1 for v in got_chained.values() if v) == 160
+    assert (np.asarray(chained.free_after) >= 0).all()
+    # per-node totals stay within capacity (exact bookkeeping check)
+    used = {}
+    for key, node in got_chained.items():
+        used[node] = used.get(node, 0) + 200
+    assert all(v <= 4000 for v in used.values())
+
+
+def test_chunked_chain_respects_capacity_exhaustion():
+    """Later chunks must see capacity consumed by earlier chunks: 30 pods of
+    1000m against 2 nodes x 8000m → exactly 16 place, 14 stay unassigned."""
+    cache, enc = make_env([
+        make_node("cx1", cpu_milli=8000, memory=64 * 2**30),
+        make_node("cx2", cpu_milli=8000, memory=64 * 2**30),
+    ])
+    pods = [make_pod(f"xp{i}", cpu_milli=1000, memory=2**20) for i in range(30)]
+    batch = enc.build_batch([ask_for(p) for p in pods])
+    res = solve_batch(batch, enc.nodes, max_batch=64)  # N pads to 64 ≥ bucket
+    # force multiple chunks regardless of padding: re-run with the smallest cap
+    batch2 = enc.build_batch([ask_for(p) for p in pods], min_batch=128)
+    res2 = solve_batch(batch2, enc.nodes, max_batch=64)   # 128-pod bucket → 2 chunks
+    for r, b in ((res, batch), (res2, batch2)):
+        got = names_of(enc, r, b)
+        placed = sum(1 for v in got.values() if v)
+        assert placed == 16, placed
+        assert (np.asarray(r.free_after) >= 0).all()
+
+
+def test_chunked_chain_carries_locality_counts():
+    """A hard topology-spread group split across chunks must carry its domain
+    counts: without the carry, chunk 2 re-seeds counts from the (empty) cache
+    and the final zone skew would exceed maxSkew."""
+    from yunikorn_tpu.common.objects import TopologySpreadConstraint
+
+    nodes = []
+    for z in range(4):
+        for i in range(2):
+            n = make_node(f"z{z}n{i}", cpu_milli=64000, memory=64 * 2**30)
+            n.metadata.labels["zone"] = f"zone-{z}"
+            nodes.append(n)
+    cache, enc = make_env(nodes)
+    pods = []
+    for i in range(96):
+        p = make_pod(f"sp{i}", cpu_milli=100, memory=2**20)
+        p.metadata.labels["spread"] = "1"
+        p.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", when_unsatisfiable="DoNotSchedule",
+            label_selector={"matchLabels": {"spread": "1"}})]
+        pods.append(p)
+    batch = enc.build_batch([ask_for(p) for p in pods], min_batch=128)
+    res = solve_batch(batch, enc.nodes, max_batch=32)      # 128-bucket → 4 chunks
+    got = names_of(enc, res, batch)
+    by_zone = {}
+    node_zone = {n.name: n.metadata.labels["zone"] for n in nodes}
+    placed = 0
+    for key, node in got.items():
+        if node is None:
+            continue
+        placed += 1
+        by_zone[node_zone[node]] = by_zone.get(node_zone[node], 0) + 1
+    assert placed == 96, placed
+    counts = [by_zone.get(f"zone-{z}", 0) for z in range(4)]
+    assert max(counts) - min(counts) <= 1, counts

@@ -50,6 +50,7 @@ CM_KUBE_BURST = PREFIX_KUBERNETES + "burst"
 # solver.* keys (TPU-native additions)
 CM_SOLVER_MAX_ROUNDS = PREFIX_SOLVER + "maxAssignRounds"
 CM_SOLVER_POD_CHUNK = PREFIX_SOLVER + "podChunk"
+CM_SOLVER_MAX_BATCH = PREFIX_SOLVER + "maxBatch"
 CM_SOLVER_SCORING_POLICY = PREFIX_SOLVER + "scoringPolicy"
 CM_SOLVER_DEVICE_PLATFORM = PREFIX_SOLVER + "platform"
 CM_SOLVER_USE_PALLAS = PREFIX_SOLVER + "usePallas"     # auto | true | false
@@ -93,6 +94,9 @@ class SchedulerConf:
     # prewarm buckets and the production cycle share compiled variants)
     solver_max_rounds: int = 16
     solver_pod_chunk: int = 512
+    # canonical pod-bucket cap: batches above this run as chained fixed-shape
+    # chunk solves so only one shape ever compiles (ops.assign.MAX_SOLVE_PODS)
+    solver_max_batch: int = 8192
     solver_scoring_policy: str = "binpacking"  # binpacking | fair | spread
     solver_platform: str = ""                  # "" = jax default; "cpu" forces host
     # tri-state device-path gates: "auto" resolves against the live backend
@@ -209,6 +213,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
         conf.solver_max_rounds = _parse_int(data[CM_SOLVER_MAX_ROUNDS], conf.solver_max_rounds)
     if CM_SOLVER_POD_CHUNK in data:
         conf.solver_pod_chunk = _parse_int(data[CM_SOLVER_POD_CHUNK], conf.solver_pod_chunk)
+    if CM_SOLVER_MAX_BATCH in data:
+        conf.solver_max_batch = _parse_int(data[CM_SOLVER_MAX_BATCH], conf.solver_max_batch)
     if CM_SOLVER_FALLBACK_ROUNDS in data:
         conf.solver_fallback_rounds = _parse_int(
             data[CM_SOLVER_FALLBACK_ROUNDS], conf.solver_fallback_rounds)

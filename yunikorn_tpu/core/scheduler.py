@@ -104,6 +104,9 @@ class SolverOptions:
     # intra-cycle drain rounds for locality-fallback groups (0 = one pod per
     # group per cycle)
     fallback_rounds: int = 16
+    # canonical pod-bucket cap (ops.assign.MAX_SOLVE_PODS): larger batches
+    # run as chained chunk solves so only one shape ever compiles
+    max_batch: int = 8192
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -113,12 +116,15 @@ class SolverOptions:
         # solve()'s divisibility assert kill every scheduling cycle
         chunk = max(int(conf.solver_pod_chunk), 1)
         chunk = 1 << (chunk.bit_length() - 1)
+        max_batch = max(int(conf.solver_max_batch), 64)
+        max_batch = 1 << (max_batch.bit_length() - 1)
         return cls(
             max_rounds=max(int(conf.solver_max_rounds), 1),
             chunk=chunk,
             use_pallas=tri.get(conf.solver_use_pallas, None),
             shard=tri.get(conf.solver_shard, None),
             fallback_rounds=max(int(conf.solver_fallback_rounds), 0),
+            max_batch=max_batch,
         )
 
 
@@ -736,13 +742,15 @@ class CoreScheduler(SchedulerAPI):
                                        max_rounds=so.max_rounds, chunk=so.chunk,
                                        policy=policy, free_delta=overlay,
                                        node_mask=node_mask,
-                                       ports_delta=inflight_ports)
+                                       ports_delta=inflight_ports,
+                                       max_batch=so.max_batch)
             else:
                 result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                      max_rounds=so.max_rounds, chunk=so.chunk,
                                      use_pallas=self._use_pallas,
                                      free_delta=overlay, node_mask=node_mask,
-                                     ports_delta=inflight_ports)
+                                     ports_delta=inflight_ports,
+                                     max_batch=so.max_batch)
             import numpy as np
 
             # materializing the result is the device sync point: everything
@@ -974,7 +982,8 @@ class CoreScheduler(SchedulerAPI):
                                  max_rounds=so.max_rounds, chunk=so.chunk,
                                  use_pallas=self._use_pallas,
                                  free_delta=overlay, node_mask=node_mask,
-                                 ports_delta=inflight_ports)
+                                 ports_delta=inflight_ports,
+                                 max_batch=so.max_batch)
             assigned = np.asarray(result.assigned)[: batch.num_pods]
             progress = False
             next_remaining: List = []

@@ -654,8 +654,46 @@ def solve(
         stalls = jnp.where(progress, 0, stalls + 1)
         return free_ext, done, assigned, rnd + 1, stalls, cnt
 
-    free_ext, done, assigned, rounds, _, _ = lax.while_loop(cond, body, init)
-    return assigned, free_ext[:M], rounds
+    free_ext, done, assigned, rounds, _, cnt_final = lax.while_loop(cond, body, init)
+    # cnt_final rides out so chained chunk solves (solve_batch max_batch
+    # chunking) can carry locality domain counts across chunks
+    return assigned, free_ext[:M], rounds, cnt_final
+
+
+# Canonical pod-bucket cap: batches above this never compile their own shape —
+# solve_batch/solve_sharded split them into rank-ordered [MAX_SOLVE_PODS]-pod
+# chunks chained through carried free capacity + locality counts. The r3 TPU
+# capture paid ~408s compiling the monolithic 65536-pod program through the
+# relay's remote_compile (docs/PERF.md); capping the compiled shape makes cold
+# cost at ANY batch size the cost of the canonical bucket. Sequential chunks in
+# rank order match the reference's ordering semantics (its loop is fully
+# sequential, scheduler_callback.go:196-198) — a later chunk sees capacity net
+# of earlier chunks, exactly like later pods in the reference's cycle.
+MAX_SOLVE_PODS = 8192
+
+# positional indexes into prepare_solve_args' tuple (chunk slicing below)
+_ARG_FREE = 19
+_ARG_LOC = 23
+
+
+def _chunk_np_args(np_args, s, e, cnt=None, free=None):
+    """Pod-dimension slice [s:e) of prepared solve args.
+
+    cnt / free carry the locality domain counts and node free capacity from
+    the previous chunk of a chained solve (device arrays — no host sync)."""
+    out = list(np_args)
+    for i in range(4):  # req, group_id, rank, valid
+        out[i] = np_args[i][s:e]
+    if free is not None:
+        out[_ARG_FREE] = free
+    loc = np_args[_ARG_LOC]
+    if loc is not None:
+        l = list(loc)
+        l[3] = loc[3][s:e]          # contrib [N, L]
+        if cnt is not None:
+            l[1] = cnt              # carried domain counts [L, D]
+        out[_ARG_LOC] = tuple(l)
+    return tuple(out)
 
 
 def pad2d(arr, width, fill):
@@ -797,13 +835,16 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
                 device=None, node_mask=None, ports_delta=None,
-                compile_only=False) -> Optional[SolveResult]:
+                compile_only=False, max_batch=MAX_SOLVE_PODS) -> Optional[SolveResult]:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     See prepare_solve_args for free_delta / node_mask semantics.
     compile_only: AOT-lower and compile this shape/static-variant without
     executing (bucket prewarm) — fills the jit + persistent caches at zero
     device time; returns None.
+    max_batch: batches above this run as chained fixed-shape chunk solves
+    (rank order, capacity + locality-count carry) so only the canonical
+    bucket ever compiles — see MAX_SOLVE_PODS.
     """
     np_args, static_kwargs = prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
@@ -820,6 +861,27 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         pallas_interpret=pallas_interpret,
         **static_kwargs,
     )
+    N = np_args[0].shape[0]
+    mb = 1 << (max(int(max_batch), 64).bit_length() - 1)
+    if N > mb:
+        # N and mb are both powers of two (encoder bucket / rounding above)
+        np_args_0 = _chunk_np_args(np_args, 0, mb)
+        if compile_only:
+            specs = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args_0)
+            solve.lower(*specs, **solve_kwargs).compile()
+            return None
+        parts = []
+        free = cnt = rounds_total = None
+        for s in range(0, N, mb):
+            args_k = (np_args_0 if s == 0
+                      else _chunk_np_args(np_args, s, s + mb, cnt=cnt, free=free))
+            solve_args = jax.tree_util.tree_map(jnp.asarray, args_k)
+            a_k, free, r_k, cnt = solve(*solve_args, **solve_kwargs)
+            parts.append(a_k)
+            rounds_total = r_k if rounds_total is None else rounds_total + r_k
+        return SolveResult(assigned=jnp.concatenate(parts), free_after=free,
+                           rounds=rounds_total)
     if compile_only:
         # specs instead of arrays: no host->device transfer at all
         specs = jax.tree_util.tree_map(
@@ -827,5 +889,5 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         solve.lower(*specs, **solve_kwargs).compile()
         return None
     solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
-    assigned, free_after, rounds = solve(*solve_args, **solve_kwargs)
+    assigned, free_after, rounds, _ = solve(*solve_args, **solve_kwargs)
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

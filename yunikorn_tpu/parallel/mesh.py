@@ -50,7 +50,9 @@ def _shardings(mesh: Mesh):
 def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                   chunk: int = 512, policy: str = "binpacking",
                   free_delta=None, node_mask=None, ports_delta=None,
-                  compile_only: bool = False) -> Optional[assign_mod.SolveResult]:
+                  compile_only: bool = False,
+                  max_batch: int = assign_mod.MAX_SOLVE_PODS,
+                  ) -> Optional[assign_mod.SolveResult]:
     """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
 
     M must be divisible by the mesh size (NodeArrays capacities are powers of
@@ -72,44 +74,80 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     np_args, static_kwargs = assign_mod.prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
         ports_delta=ports_delta)
-    (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
-     g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
-     g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
-     free_i, cap_i, host_mask, host_soft, loc) = np_args
+
+    N = np_args[0].shape[0]
+    mb = 1 << (max(int(max_batch), 64).bit_length() - 1)
 
     if compile_only:
         # AOT-lower with sharded input specs (no transfer, no execution):
         # fills the jit + persistent caches with exactly the program the
-        # production sharded cycle runs (bucket prewarm)
+        # production sharded cycle runs (bucket prewarm). Oversize batches
+        # compile the canonical [mb]-pod chunk shape — the only shape the
+        # chained production path below ever runs.
         put = lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s)
     else:
         put = jax.device_put
-    args = (
-        put(req, repl), put(group_id, repl), put(rank, repl), put(valid, repl),
-        put(g_term_req, repl), put(g_term_forb, repl), put(g_term_valid, repl),
-        put(g_anyof, repl), put(g_anyof_valid, repl),
-        put(g_tol, repl), put(g_ports, repl),
-        put(g_pref_req, repl), put(g_pref_forb, repl), put(g_pref_weight, repl),
-        put(labels, node_s2), put(taints_hard, node_s2),
-        put(taints_soft, node_s2), put(ports, node_s2),
-        put(node_ok, node_s), put(free_i, node_s2), put(cap_i, node_s2),
-    )
-    mask_arg = put(host_mask, group_node_s) if host_mask is not None else None
-    soft_arg = put(host_soft, group_node_s) if host_soft is not None else None
-    # locality tables ride replicated: tiny relative to the node arrays,
-    # and the per-round count updates are global reductions anyway
-    loc_arg = tuple(put(a, repl) for a in loc) if loc is not None else None
+
+    def build_args(cargs):
+        (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
+         g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
+         g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
+         free_i, cap_i, host_mask, host_soft, loc) = cargs
+        args = (
+            put(req, repl), put(group_id, repl), put(rank, repl), put(valid, repl),
+            put(g_term_req, repl), put(g_term_forb, repl), put(g_term_valid, repl),
+            put(g_anyof, repl), put(g_anyof_valid, repl),
+            put(g_tol, repl), put(g_ports, repl),
+            put(g_pref_req, repl), put(g_pref_forb, repl), put(g_pref_weight, repl),
+            put(labels, node_s2), put(taints_hard, node_s2),
+            put(taints_soft, node_s2), put(ports, node_s2),
+            put(node_ok, node_s),
+            # carried free capacity from a previous chunk is already a device
+            # array with the computation's sharding — don't re-put it
+            free_i if isinstance(free_i, jax.Array) else put(free_i, node_s2),
+            put(cap_i, node_s2),
+        )
+        mask_arg = put(host_mask, group_node_s) if host_mask is not None else None
+        soft_arg = put(host_soft, group_node_s) if host_soft is not None else None
+        # locality tables ride replicated: tiny relative to the node arrays,
+        # and the per-round count updates are global reductions anyway
+        loc_arg = (tuple(a if isinstance(a, jax.Array) else put(a, repl)
+                         for a in loc) if loc is not None else None)
+        return args, mask_arg, soft_arg, loc_arg
 
     solve_kwargs = dict(
-        max_rounds=max_rounds, chunk=min(chunk, batch.req.shape[0]),
+        max_rounds=max_rounds, chunk=min(chunk, min(N, mb)),
         policy=policy, has_loc_soft=static_kwargs["has_loc_soft"],
         score_cols=static_kwargs["score_cols"],
     )
+    if N > mb:
+        np_args_0 = assign_mod._chunk_np_args(np_args, 0, mb)
+        if compile_only:
+            args, mask_arg, soft_arg, loc_arg = build_args(np_args_0)
+            with mesh:
+                assign_mod.solve.lower(
+                    *args, mask_arg, soft_arg, loc_arg, **solve_kwargs).compile()
+            return None
+        parts = []
+        free = cnt = rounds_total = None
+        with mesh:
+            for s in range(0, N, mb):
+                cargs = (np_args_0 if s == 0 else assign_mod._chunk_np_args(
+                    np_args, s, s + mb, cnt=cnt, free=free))
+                args, mask_arg, soft_arg, loc_arg = build_args(cargs)
+                a_k, free, r_k, cnt = assign_mod.solve(
+                    *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
+                parts.append(a_k)
+                rounds_total = r_k if rounds_total is None else rounds_total + r_k
+        return assign_mod.SolveResult(
+            assigned=jnp.concatenate(parts), free_after=free, rounds=rounds_total)
+
+    args, mask_arg, soft_arg, loc_arg = build_args(np_args)
     with mesh:
         if compile_only:
             assign_mod.solve.lower(
                 *args, mask_arg, soft_arg, loc_arg, **solve_kwargs).compile()
             return None
-        assigned, free_after, rounds = assign_mod.solve(
+        assigned, free_after, rounds, _ = assign_mod.solve(
             *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)

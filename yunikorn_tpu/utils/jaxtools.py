@@ -133,11 +133,13 @@ def prewarm_buckets(spec: str, results: "list | None" = None,
                     from yunikorn_tpu.parallel.mesh import solve_sharded
 
                     solve_sharded(b, enc.nodes, mesh, max_rounds=max_rounds,
-                                  chunk=chunk, policy=policy, compile_only=True)
+                                  chunk=chunk, policy=policy, compile_only=True,
+                                  max_batch=so.max_batch)
                 else:
                     solve_batch(b, enc.nodes, policy=policy,
                                 max_rounds=max_rounds, chunk=chunk,
-                                use_pallas=use_pallas, compile_only=True)
+                                use_pallas=use_pallas, compile_only=True,
+                                max_batch=so.max_batch)
 
     def run():
         ensure_compilation_cache()
