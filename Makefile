@@ -1,6 +1,28 @@
 # Developer entry points (reference Makefile analog).
 
-.PHONY: test bench lint run-scheduler run-admission dryrun clean
+.PHONY: test bench lint run-scheduler run-admission dryrun clean \
+	image sched_image adm_image webtest_image
+
+# container images (reference Makefile:409-435 image targets)
+REGISTRY ?= yunikorn-tpu
+VERSION ?= latest
+DOCKER ?= docker
+
+DOCKER_BUILD_ARGS ?=
+
+sched_image:  ## build the scheduler image
+	$(DOCKER) build $(DOCKER_BUILD_ARGS) -t $(REGISTRY)/scheduler:$(VERSION) \
+		-f docker/scheduler/Dockerfile .
+
+adm_image:  ## build the admission-controller image
+	$(DOCKER) build -t $(REGISTRY)/admission:$(VERSION) \
+		-f docker/admission/Dockerfile .
+
+webtest_image:  ## build the webtest image
+	$(DOCKER) build -t $(REGISTRY)/webtest:$(VERSION) \
+		-f docker/webtest/Dockerfile .
+
+image: sched_image adm_image webtest_image  ## build all three images
 
 test:
 	python -m pytest tests/ -q

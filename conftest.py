@@ -15,3 +15,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from yunikorn_tpu.utils.jaxtools import force_cpu_platform  # noqa: E402
 
 force_cpu_platform(8)
+
+# Bound the process's memory-map count: every LLVM-JIT'd XLA executable adds
+# mappings, the full suite compiles hundreds of programs, and once the process
+# nears vm.max_map_count (65530 here) further compiles SEGFAULT inside XLA
+# (observed at ~607/628 tests: >50k maps and climbing). Dropping JAX's
+# executable caches at each module boundary unmaps finished modules' programs;
+# cross-module recompiles are mostly avoided by the persistent compilation
+# cache (loads, not compiles).
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    import jax
+
+    jax.clear_caches()

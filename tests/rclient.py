@@ -68,6 +68,9 @@ class RClient:
     # -------------------------------------------------- wait-for combinators
     def wait_for(self, predicate: Callable[[], bool], timeout: float = 10.0,
                  interval: float = 0.1, what: str = "condition") -> None:
+        """Poll until predicate or timeout; on timeout, dump triage state
+        (reference test/e2e/framework/helpers wrappers.go:36-135 dumps the
+        cluster + scheduler state on every failure) before raising."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             try:
@@ -76,7 +79,22 @@ class RClient:
             except (urllib.error.URLError, ConnectionError, KeyError):
                 pass
             time.sleep(interval)
-        raise TimeoutError(f"timed out waiting for {what}")
+        raise TimeoutError(
+            f"timed out waiting for {what}; triage: {self.triage_dump()}")
+
+    def triage_dump(self, max_len: int = 4000) -> str:
+        """Best-effort state dump for failure triage: queues, apps, node
+        count, last events — truncated so assertion output stays readable."""
+        out = {}
+        for name, fn in (("queues", self.queues), ("apps", self.apps),
+                         ("nodes", lambda: len(self.nodes())),
+                         ("events", lambda: self.events(50))):
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001 — triage must never raise
+                out[name] = f"<{type(e).__name__}: {e}>"
+        s = json.dumps(out, default=str)
+        return s[:max_len] + ("…" if len(s) > max_len else "")
 
     def wait_for_health(self, timeout: float = 10.0) -> None:
         self.wait_for(self.health, timeout, what="scheduler health")

@@ -113,3 +113,322 @@ def test_solver_matches_host_predicates(seed):
 def get_node_free(cache, name):
     info = cache.get_node(name)
     return info.available()
+
+
+# --------------------------------------------------------------- locality fuzz
+# Randomized topology spread / pod affinity / anti-affinity against a host
+# re-simulation oracle: replay the solver's own accept order (accept_round,
+# exported per pod) and check every count-dependent decision against exact
+# K8s-semantics bookkeeping — the acceptance criterion is that each batch has
+# a legal sequentialization consistent with the solver's round order,
+# including across chained chunk boundaries (max_batch < N).
+
+from yunikorn_tpu.common.objects import PodAffinityTerm, TopologySpreadConstraint
+from yunikorn_tpu.snapshot.locality import (
+    HOSTNAME_KEY,
+    KIND_AFFINITY,
+    KIND_ANTI_AFFINITY,
+    KIND_SPREAD,
+    _pod_anti_terms,
+    _pod_constraints,
+)
+
+APPS = ["red", "blue", "green"]
+
+
+def _dom_of(node, topo_key):
+    v = node.metadata.labels.get(topo_key)
+    if topo_key == HOSTNAME_KEY and v is None:
+        v = node.name
+    return v
+
+
+class LocalityOracle:
+    """Exact host bookkeeping of locality state as placements replay."""
+
+    def __init__(self, nodes):
+        self.nodes = {n.name: n for n in nodes}
+        self.placed = []                     # [(pod, node_name)]
+
+    def domains(self, topo_key):
+        return {v for n in self.nodes.values()
+                if (v := _dom_of(n, topo_key)) is not None}
+
+    def counts(self, spec):
+        c = {}
+        for p, node_name in self.placed:
+            v = _dom_of(self.nodes[node_name], spec.topo_key)
+            if v is not None and spec.counts_pod(p):
+                c[v] = c.get(v, 0) + 1
+        return c
+
+    def check(self, pod, node_name):
+        """None if placing pod on node is legal under current state, else a
+        reason string."""
+        node = self.nodes[node_name]
+        for kind, spec, skew in _pod_constraints(pod):
+            v = _dom_of(node, spec.topo_key)
+            c = self.counts(spec)
+            doms = self.domains(spec.topo_key)
+            minc = min((c.get(d, 0) for d in doms), default=0)
+            total = sum(c.values())
+            if kind == KIND_SPREAD:
+                self_add = 1 if spec.counts_pod(pod) else 0
+                if v is None or c.get(v, 0) + self_add - minc > max(1, skew):
+                    return (f"spread violated: dom {v} count {c.get(v, 0)}"
+                            f"+{self_add} min {minc} skew {skew}")
+            elif kind == KIND_AFFINITY:
+                seed = spec.counts_pod(pod)
+                if v is None or not (c.get(v, 0) > 0 or (seed and total == 0)):
+                    return (f"affinity violated: dom {v} count {c.get(v, 0)} "
+                            f"total {total} seed {seed}")
+            elif kind == KIND_ANTI_AFFINITY:
+                if v is not None and c.get(v, 0) > 0:
+                    return f"anti-affinity violated: dom {v} count {c.get(v, 0)}"
+        # symmetry: placed pods' anti terms that match this pod block their
+        # holders' domains
+        for q, q_node in self.placed:
+            for t in _pod_anti_terms(q):
+                if not t.counts_pod(pod):
+                    continue
+                if _dom_of(self.nodes[q_node], t.topo_key) == \
+                        _dom_of(node, t.topo_key):
+                    return (f"symmetric anti violated: {q.name} on {q_node} "
+                            f"holds a term matching {pod.name}")
+        return None
+
+    def place(self, pod, node_name):
+        self.placed.append((pod, node_name))
+
+
+def _replay_phase(pod, node_name, oracle, all_final):
+    """Replay priority inside one round (lower = earlier):
+
+    0. affinity SEEDERS — pods whose required-affinity domain ends the batch
+       with no OTHER matching pod: their only legal slot is before any
+       contributor lands (total==0 seeding), so they must go first.
+    1. spread / anti pods — their checks are against counts at their own
+       placement time; the solver's joint accept (level fill) admits orders
+       that place them before the round's unconstrained contributors.
+    2. unconstrained contributors — always legal, but they shift counts.
+    3. affinity JOINERS — their domain does gain a matching pod, so placing
+       them after everything satisfies cnt>0 regardless of who provided it.
+    """
+    cons = _pod_constraints(pod)
+    kinds = [k for k, _, _ in cons]
+    if KIND_AFFINITY in kinds:
+        node = oracle.nodes[node_name]
+        for kind, spec, _ in cons:
+            if kind != KIND_AFFINITY:
+                continue
+            v = _dom_of(node, spec.topo_key)
+            others = sum(
+                1 for q, qn in all_final
+                if q is not pod and spec.counts_pod(q)
+                and _dom_of(oracle.nodes[qn], spec.topo_key) == v)
+            if others == 0:
+                return 0
+        return 3
+    if KIND_SPREAD in kinds or KIND_ANTI_AFFINITY in kinds:
+        return 1
+    return 2
+
+
+def _tightness(pod, node_name, oracle):
+    """How close this (currently legal) placement is to its own constraint
+    boundaries — lower places first. Spread: remaining headroom under the
+    skew. Anti: 0 (must precede any matcher). Others: +inf."""
+    node = oracle.nodes[node_name]
+    tight = 10**9
+    for kind, spec, skew in _pod_constraints(pod):
+        v = _dom_of(node, spec.topo_key)
+        if v is None:
+            continue
+        if kind == KIND_SPREAD:
+            c = oracle.counts(spec)
+            doms = oracle.domains(spec.topo_key)
+            minc = min((c.get(d, 0) for d in doms), default=0)
+            self_add = 1 if spec.counts_pod(pod) else 0
+            tight = min(tight,
+                        max(1, skew) - (c.get(v, 0) + self_add - minc))
+        elif kind == KIND_ANTI_AFFINITY:
+            tight = min(tight, 0)
+    return tight
+
+
+def replay_with_oracle(seed, oracle, placements):
+    """placements: [(pod, node_name, accept_round)] — verify a legal
+    sequentialization exists that is consistent with the solver's round
+    order. Within a round, pods are placed greedily: scan priority phases
+    and place the first currently-legal pod; a full pass with no progress
+    while pods remain = no legal order = solver made an illegal joint
+    decision."""
+    all_final = list(oracle.placed) + [(p, n) for p, n, _ in placements]
+    by_round = {}
+    for pod, node_name, rnd in placements:
+        by_round.setdefault(rnd, []).append((pod, node_name))
+    trace = []
+    for rnd in sorted(by_round):
+        pending = sorted(
+            by_round[rnd],
+            key=lambda pn: _replay_phase(pn[0], pn[1], oracle, all_final))
+        while pending:
+            # most-constrained-first among the currently-legal: a pod with
+            # little spread headroom must precede plain contributors that
+            # would consume it (a pod can be a plain CONTRIBUTOR for one
+            # locality tuple while constrained on another — ordering is per
+            # state, not per pod class)
+            best = None
+            last_reason = None
+            for i, (pod, node_name) in enumerate(pending):
+                reason = oracle.check(pod, node_name)
+                if reason is not None:
+                    last_reason = (pod.name, node_name, reason)
+                    continue
+                ph = _replay_phase(pod, node_name, oracle, all_final)
+                tight = _tightness(pod, node_name, oracle)
+                key = (ph, tight, i)
+                if best is None or key < best[0]:
+                    best = (key, i, pod, node_name)
+            if best is None:
+                raise AssertionError(
+                    f"seed {seed}: round {rnd} has no legal order for "
+                    f"{[p.name for p, _ in pending]}; e.g. {last_reason}; "
+                    f"replay trace: {trace}")
+            _, i, pod, node_name = best
+            oracle.place(pod, node_name)
+            trace.append((rnd, pod.name, node_name))
+            pending.pop(i)
+
+
+def random_loc_pod(rng, i):
+    app = rng.choice(APPS)
+    pod = make_pod(f"lp{i}", cpu_milli=rng.choice([100, 200, 400]),
+                   memory=2**20)
+    pod.metadata.labels["app"] = app
+    r = rng.random()
+    sel = {"matchLabels": {"app": rng.choice(APPS)}}
+    own_sel = {"matchLabels": {"app": app}}
+    if r < 0.25:
+        # hard topology spread (usually self-matching — the K8s idiom)
+        pod.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=rng.choice([1, 2]), topology_key="zone",
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=own_sel if rng.random() < 0.8 else sel)]
+    elif r < 0.45:
+        # required anti-affinity; selector may or may not match the pod
+        pod.spec.affinity = Affinity(pod_anti_affinity_required=[
+            PodAffinityTerm(
+                label_selector=sel if rng.random() < 0.5 else own_sel,
+                topology_key=rng.choice([HOSTNAME_KEY, "zone"]))])
+    elif r < 0.6:
+        # required affinity on zone; self-matching pods may seed
+        pod.spec.affinity = Affinity(pod_affinity_required=[
+            PodAffinityTerm(
+                label_selector=own_sel if rng.random() < 0.5 else sel,
+                topology_key="zone")])
+    # else: plain pod — but its app label may make it a contributor to
+    # someone else's selector (the hard case for in-batch counting)
+    return pod
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("max_batch", [65536, 16])
+def test_locality_solver_matches_replay_oracle(seed, max_batch):
+    """Every locality-bearing batch the solver commits must replay cleanly
+    through the host oracle in the solver's own accept order — max_batch=16
+    (solve_batch floors the chunk bucket at 64, and min_batch=128 makes
+    N=128 > 64) forces the chained solve_chunked path so cross-chunk count
+    carry is fuzzed too (VERDICT r4 item 5)."""
+    rng = random.Random(1000 + seed)
+    cache = SchedulerCache()
+    nodes = []
+    for i in range(rng.randint(6, 12)):
+        labels = {"zone": f"z{i % 3}"}
+        n = make_node(f"n{i}", cpu_milli=rng.choice([4000, 8000]),
+                      memory=8 * 2**30, labels=labels)
+        nodes.append(n)
+        cache.update_node(n)
+    # existing assigned pods: locality counts must seed from cluster state
+    existing = []
+    for i in range(rng.randint(0, 5)):
+        p = make_pod(f"ex{i}", cpu_milli=100, memory=2**20,
+                     node_name=rng.choice(nodes).name, phase="Running",
+                     labels={"app": rng.choice(APPS)})
+        if rng.random() < 0.3:
+            p.spec.affinity = Affinity(pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    label_selector={"matchLabels": {"app": rng.choice(APPS)}},
+                    topology_key=rng.choice([HOSTNAME_KEY, "zone"]))])
+        cache.update_pod(p)
+        existing.append(p)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [random_loc_pod(rng, i) for i in range(rng.randint(10, 40))]
+    # pending pods enter the cache before asks flow (context does this) so
+    # anti-affinity symmetry sees in-batch holders
+    for p in pods:
+        cache.update_pod(p)
+    asks = [AllocationAsk(p.uid, "loc-app", get_pod_resource(p), pod=p)
+            for p in pods]
+    if max_batch == 16:
+        batch = enc.build_batch(asks, min_batch=128)
+    else:
+        batch = enc.build_batch(asks)
+    result = solve_batch(batch, enc.nodes, max_batch=max_batch)
+    assigned = np.asarray(result.assigned)[: batch.num_pods]
+    around = np.asarray(result.accept_round)[: batch.num_pods]
+
+    # Groups whose constraints overflow the tensor encoding take the exact
+    # host-mask fallback and are serialized one-pod-per-solve (their own
+    # contract, tested in test_locality.py); the rest of the group's rows are
+    # parked (valid=False) for the core's drain loop, which solve_batch alone
+    # does not run. Exclude those pods from the replay/completeness here —
+    # the oracle fuzzes the TENSOR path's count decisions.
+    fb_gids = (set(batch.locality.fallback)
+               if batch.locality is not None and batch.locality.fallback
+               else set())
+    skip = [int(batch.group_id[i]) in fb_gids or not bool(batch.valid[i])
+            for i in range(len(pods))]
+
+    oracle = LocalityOracle(nodes)
+    for p in existing:
+        oracle.place(p, p.spec.node_name)
+    placements = []
+    fb_placements = []
+    for i, pod in enumerate(pods):
+        idx = int(assigned[i])
+        if idx < 0:
+            continue
+        if skip[i]:
+            fb_placements.append((pod, enc.nodes.name_of(idx)))
+            continue
+        placements.append((pod, enc.nodes.name_of(idx), int(around[i])))
+    # shown by pytest only on failure: the full placement set for triage
+    print(f"placements: {[(p.name, n, r) for p, n, r in placements]}")
+    replay_with_oracle(seed, oracle, placements)
+    # host-serialized placements enter the oracle state unchecked AFTER the
+    # replay (their round order vs the tensor path is not modeled) so the
+    # completeness check below still sees the true final state
+    for pod, node_name in fb_placements:
+        oracle.place(pod, node_name)
+
+    # completeness under the final state: an unassigned pod must have no node
+    # that fits it (resources + predicates + locality legal w.r.t. the final
+    # placed set) — catches cap-induced starvation of feasible pods
+    used = {}
+    for pod, node_name in [(p, n) for p, n, _ in placements] + fb_placements:
+        used[node_name] = used.get(node_name, 0) + \
+            get_pod_resource(pod).get("cpu")
+    for i, pod in enumerate(pods):
+        if int(assigned[i]) >= 0 or skip[i]:
+            continue
+        for n in nodes:
+            free_cpu = get_node_free(cache, n.name).get("cpu") - \
+                used.get(n.name, 0)
+            if get_pod_resource(pod).get("cpu") > free_cpu:
+                continue
+            if oracle.check(pod, n.name) is None:
+                raise AssertionError(
+                    f"seed {seed}: {pod.name} left unassigned but node "
+                    f"{n.name} is legal and has {free_cpu}m cpu free")

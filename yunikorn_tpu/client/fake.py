@@ -157,6 +157,14 @@ class FakeCluster(APIProvider):
     def stop(self) -> None:
         self._started = False
 
+    def clear_event_handlers(self) -> None:
+        """Drop every registered informer handler: a restarting scheduler's
+        watch connections die with its process while the API-server state
+        persists. The next shim re-registers and gets the standard initial
+        sync replay (add_event_handler late-registration path)."""
+        with self._lock:
+            self._handlers.clear()
+
     def wait_for_sync(self) -> None:
         return  # synchronous fan-out: always in sync
 

@@ -95,8 +95,12 @@ class SchedulerConf:
     solver_max_rounds: int = 16
     solver_pod_chunk: int = 512
     # canonical pod-bucket cap: batches above this run as chained fixed-shape
-    # chunk solves so only one shape ever compiles (ops.assign.MAX_SOLVE_PODS)
-    solver_max_batch: int = 8192
+    # chunk solves so only one shape ever compiles (ops.assign.MAX_SOLVE_PODS).
+    # Default = the north-star bucket: the monolithic program is the fastest
+    # warm path; lower it only when large-shape compiles are expensive in your
+    # environment (e.g. a remote_compile relay) — the chained path is a single
+    # lax.scan program, so the cost of lowering it is mild.
+    solver_max_batch: int = 65536
     solver_scoring_policy: str = "binpacking"  # binpacking | fair | spread
     solver_platform: str = ""                  # "" = jax default; "cpu" forces host
     # tri-state device-path gates: "auto" resolves against the live backend

@@ -57,6 +57,10 @@ class SolveResult:
     assigned: jnp.ndarray      # [N] int32: node row index, -1 if unassigned
     free_after: jnp.ndarray    # [M, R] int32
     rounds: jnp.ndarray        # int32 scalar
+    # [N] int32: solve round at which each pod was accepted (-1 unassigned);
+    # chained chunk solves offset later chunks so the order is global. The
+    # differential fuzzer replays this order against a host oracle.
+    accept_round: Optional[jnp.ndarray] = None
 
     def block_until_ready(self):
         self.assigned.block_until_ready()
@@ -338,27 +342,35 @@ def _loc_capped_flags(loc):
             jnp.stack(anti), jnp.stack(min_skew))
 
 
-def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, cnt, total,
-                    spread_l, aff_l, anti_l, min_skew_l, allowance_l):
-    """Cap accepted pods contributing to a locality group per (group, domain)
-    per round so that between-round count updates cannot overshoot.
+def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
+                    spread_l, aff_l, anti_l, min_skew_l, allowance_l,
+                    g_ref_masks, pair_l):
+    """Cap same-round accepts so every round has a legal sequentialization.
 
-    Contribution — not the pod's own constraint slots — is what changes the
-    counts, so the cap keys on contrib: a plain pod whose labels match another
-    pod's anti-affinity selector is capped alongside it (symmetry holds even
-    within one round). Per-kind caps:
+    Each cap binds only pods whose GROUP references the locality group with a
+    slot of the cap's kind (g_ref_masks): only those make count-dependent
+    decisions of that kind this round. Contributing pods without such a slot
+    (plain pods matching someone's selector, affinity pods sharing a spread
+    group's locality tuple) sequentialize after the constrained pods of the
+    round, so capping them could only starve them. Per-kind caps:
 
-    - anti-affinity: 1 per domain (a second pod in the same domain would see
-      cnt>0 only next round — exact).
-    - affinity while *seeding* (total==0): 1 per GROUP (one domain seeds per
-      round) so a self-affinitized group cannot split across domains.
-    - hard spread: LEVEL FILL — jointly choose per-domain accepts a_d from
-      the tentative counts t_d by the fixed point
+    - anti-affinity: 1 referencing pod per domain (a second one would see
+      cnt>0 only next round — exact), plus the holder↔matcher mutual
+      exclusion below.
+    - affinity while *seeding* (total==0): 1 seed-slot pod per locality
+      group per round (one domain seeds) so a self-affinitized cohort cannot
+      split across domains.
+    - hard spread: LEVEL FILL — from the tentative per-domain counts t_d of
+      spread-referencing accepts, compute the fixed point with the TIGHTEST
+      skew among referencing slots
           level = skew + min_valid_d(cnt_d + a_d),  a_d = min(t_d, level - cnt_d)
-      Final counts then satisfy max_d - min_d <= skew even if some domains
-      accept nothing (their cnt pins the min). A balanced batch fills in ONE
-      round instead of 1-per-domain-per-round — 18 pods / 3 zones / skew 1
-      lands in one round, not six (round-3 throughput fix).
+      then bound each ROW by its own slot's skew around the projected
+      post-fill minimum: within_d(r) <= skew_r + min_valid(cnt + a) - cnt_d.
+      For uniform skews this equals the plain level fill (one-round balanced
+      fill: 18 pods / 3 zones / skew 1 lands in one round, not six — round-3
+      throughput fix); heterogeneous skews get their own headroom, and every
+      joint accept is legal in ascending-count order because the projected
+      minimum only grows as the round's accepts land.
     - ScheduleAnyway spread: `allowance_l` (≈ remaining/domains) as before.
     """
     loc_dom, dom_valid = loc[0], loc[2]
@@ -368,33 +380,13 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, cnt, total,
     big = jnp.int32(2**30)
     idx = jnp.arange(N, dtype=jnp.int32)
     node_cl = jnp.clip(snode, 0, M - 1)
-    for l in range(L):
-        seeding = aff_l[l] & (total[l] == 0)
-        capped = spread_l[l] | anti_l[l] | seeding | (allowance_l[l] < N)
-        dom_i = loc_dom[l, node_cl]                                    # [N]
-        active = capped & scontrib[:, l] & (dom_i >= 0) & (snode < M) & accept_sorted
-        dom_cl = jnp.clip(dom_i, 0, D - 1)
-        # tentative per-domain accept counts for this group
-        t = jnp.zeros((D,), jnp.int32).at[dom_cl].add(active.astype(jnp.int32))
-        # hard-spread level fill (monotone fixed point; iterations bound the
-        # level from above, so early exit is safe-by-construction)
-        cl = cnt[l]
-        valid = dom_valid[l]
-        skew = jnp.where(min_skew_l[l] < big, min_skew_l[l], 0)
-        level = skew + jnp.min(jnp.where(valid, cl + t, big))
-        for _ in range(8):
-            a_sp = jnp.minimum(t, jnp.maximum(level - cl, 0))
-            level = skew + jnp.min(jnp.where(valid, cl + a_sp, big))
-        a_spread = jnp.minimum(t, jnp.maximum(level - cl, 0))          # [D]
-        limit_d = jnp.full((D,), N, jnp.int32)
-        limit_d = jnp.where(allowance_l[l] < N, allowance_l[l], limit_d)
-        limit_d = jnp.where(spread_l[l], jnp.minimum(limit_d, a_spread), limit_d)
-        limit_d = jnp.where(anti_l[l], jnp.minimum(limit_d, 1), limit_d)
-        # seeding caps per GROUP (key 0, limit 1); others per domain
-        key = jnp.where(active, jnp.where(seeding, 0, dom_i), (M + 2) + idx)
-        limit_row = jnp.where(seeding, 1, limit_d[dom_cl])             # [N]
-        order2 = jnp.argsort(key)                                      # stable
-        k2 = key[order2]
+    g_ref_spread, g_ref_anti, g_ref_seed, g_ref_soft, g_skew_l = g_ref_masks
+
+    def seg_keep(active, key, limit_row):
+        """Keep mask: within each key segment, at most limit_row active rows
+        (prefix rule in the caller's rank-sorted order)."""
+        order2 = jnp.argsort(jnp.where(active, key, (M + 2) + idx))    # stable
+        k2 = jnp.where(active, key, (M + 2) + idx)[order2]
         act2 = active[order2]
         seg_start = jnp.concatenate([jnp.array([True]), k2[1:] != k2[:-1]])
         c = jnp.cumsum(act2.astype(jnp.int32))
@@ -402,8 +394,76 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, loc, M, cnt, total,
         base = jnp.where(head > 0, c[jnp.maximum(head - 1, 0)], 0)
         within = c - base                                              # inclusive
         keep2 = (~act2) | (within <= limit_row[order2])
-        keep = jnp.zeros((N,), bool).at[order2].set(keep2)
-        accept_sorted = accept_sorted & keep
+        return jnp.zeros((N,), bool).at[order2].set(keep2)
+
+    for l in range(L):
+        dom_i = loc_dom[l, node_cl]                                    # [N]
+        dom_cl = jnp.clip(dom_i, 0, D - 1)
+        on_dom = (dom_i >= 0) & (snode < M)
+
+        # hard spread: level fill over the spread-referencing accepts
+        sp_active = (spread_l[l] & accept_sorted & scontrib[:, l]
+                     & g_ref_spread[sgid, l] & on_dom)
+        t = jnp.zeros((D,), jnp.int32).at[dom_cl].add(sp_active.astype(jnp.int32))
+        cl = cnt[l]
+        valid = dom_valid[l]
+        skew = jnp.where(min_skew_l[l] < big, min_skew_l[l], 0)
+        level = skew + jnp.min(jnp.where(valid, cl + t, big))
+        for _ in range(8):
+            # monotone fixed point; iterations bound the level from above,
+            # so early exit is safe-by-construction
+            a_sp = jnp.minimum(t, jnp.maximum(level - cl, 0))
+            level = skew + jnp.min(jnp.where(valid, cl + a_sp, big))
+        a_spread = jnp.minimum(t, jnp.maximum(level - cl, 0))          # [D]
+        minc_proj = jnp.min(jnp.where(valid, cl + a_spread, big))
+        # per-row bound: own skew around the projected post-fill minimum
+        # (== a_spread for rows at the tightest skew; extra headroom for
+        # larger-skew rows sequentialized after the level fill)
+        skew_row = jnp.minimum(g_skew_l[sgid, l], big - 1)
+        limit_row = jnp.maximum(
+            skew_row + minc_proj - cl[dom_cl],
+            jnp.minimum(a_spread[dom_cl], jnp.int32(2**30 - 1)))
+        accept_sorted = accept_sorted & seg_keep(sp_active, dom_i, limit_row)
+
+        # anti-affinity: 1 referencing pod per domain per round
+        an_active = (anti_l[l] & accept_sorted & scontrib[:, l]
+                     & g_ref_anti[sgid, l] & on_dom)
+        accept_sorted = accept_sorted & seg_keep(
+            an_active, dom_i, jnp.ones((N,), jnp.int32))
+
+        # affinity seeding: 1 seed-slot pod per locality group per round
+        seeding = aff_l[l] & (total[l] == 0)
+        se_active = (seeding & accept_sorted & scontrib[:, l]
+                     & g_ref_seed[sgid, l] & on_dom)
+        accept_sorted = accept_sorted & seg_keep(
+            se_active, jnp.zeros((N,), jnp.int32), jnp.ones((N,), jnp.int32))
+
+        # ScheduleAnyway spread: per-domain allowance for pacing (scoring
+        # constraint — balance across domains within a round, then re-score)
+        so_active = ((allowance_l[l] < N) & accept_sorted & scontrib[:, l]
+                     & g_ref_soft[sgid, l] & on_dom)
+        accept_sorted = accept_sorted & seg_keep(
+            so_active, dom_i, jnp.full((N,), allowance_l[l], jnp.int32))
+    # holder↔matcher mutual exclusion: for a holder group l (contrib = pods
+    # HOLDING anti term t) paired with primary group p (contrib = pods
+    # MATCHING t's selector), a holder may not be accepted into a domain
+    # where a matcher is accepted this same round (other than itself): the
+    # holder's own anti rule vs the matcher and the matcher's symmetry rule
+    # vs the holder each kill one of the two sequential orders. Blocked
+    # holders retry next round, where the updated counts separate them.
+    for l in range(L):
+        lp = pair_l[l]
+        has_pair = lp >= 0
+        lp_cl = jnp.clip(lp, 0, L - 1)
+        contrib_p = jnp.take(scontrib, lp_cl, axis=1)                  # [N]
+        dom_i = loc_dom[l, node_cl]
+        dom_cl = jnp.clip(dom_i, 0, D - 1)
+        on_node = (dom_i >= 0) & (snode < M) & accept_sorted
+        acc_p = on_node & contrib_p
+        t_p = jnp.zeros((D,), jnp.int32).at[dom_cl].add(acc_p.astype(jnp.int32))
+        others_p = t_p[dom_cl] - acc_p.astype(jnp.int32)
+        blocked = (has_pair & on_node & scontrib[:, l] & (others_p > 0))
+        accept_sorted = accept_sorted & ~blocked
     return accept_sorted
 
 
@@ -441,65 +501,16 @@ def _segment_prefix_accept(snode, sreq, free_ext, M):
     return fits & (snode < M)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("max_rounds", "chunk", "policy", "use_pallas",
-                     "pallas_interpret", "has_loc_soft", "pallas_has_soft",
-                     "score_cols"),
-)
-def solve(
-    req,            # [N, R] int32
-    group_id,       # [N] int32
-    rank,           # [N] float32 — lower schedules first
-    valid,          # [N] bool
-    g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
-    g_tol, g_ports,                                   # group tensors
-    g_pref_req, g_pref_forb, g_pref_weight,           # preferred-affinity scoring
-    node_labels, node_taints, node_taints_soft, node_ports, node_ok,  # node symbol state
-    free,           # [M, R] int32
-    capacity,       # [M, R] int32
-    host_group_mask=None,   # [G, M] bool or None
-    host_group_soft=None,   # [G, M] float32 or None (host-scored soft terms)
-    loc=None,       # locality tuple: (dom [L,M], cnt0 [L,D], dom_valid [L,D],
-                    #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed,
-                    #  g_weight [G,S] f32 — soft-slot score weights)
-    *,
-    max_rounds: int = 16,
-    chunk: int = 512,
-    policy: str = "binpacking",
-    use_pallas: bool = False,
-    pallas_interpret: bool = False,
-    has_loc_soft: bool = True,
-    pallas_has_soft: bool = True,
-    score_cols: int = 0,
-):
-    """One batched solve. Returns (assigned [N] int32, free_after, rounds).
+def _hoist_group_state(g_term_req, g_term_forb, g_term_valid, g_anyof,
+                       g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
+                       g_pref_weight, node_labels, node_taints,
+                       node_taints_soft, node_ports, node_ok,
+                       host_group_mask, host_group_soft):
+    """Pod-independent [G, M] feasibility mask + soft score adjustment.
 
-    score_cols > 0 restricts SCORING to the first score_cols resource
-    columns; feasibility always uses all of them. prepare_solve_args appends
-    capacity-1 synthetic columns per requested host port beyond score_cols —
-    the round loop's free tracking then enforces intra-batch port
-    exclusivity (two batch pods cannot share a port on one node) without
-    ports distorting the packing score.
-
-    has_loc_soft=False (static) skips the soft-locality scoring pass for
-    batches whose locality slots are all hard (the common case) — the pass
-    provably sums to zero when every g_weight is 0.
-
-    use_pallas routes the per-round best-node computation through the fused
-    Pallas kernel (ops/pallas_kernels.py). Locality batches work too: the
-    dynamic per-round rules/scores are hoisted to [G, M] adjustments (pods in
-    a group share locality state by construction — the constraint-group
-    signature folds pod labels in whenever locality applies,
-    snapshot/locality.py locality_signature) and folded into the kernel's
-    feasibility/soft inputs. Only the align policy (per-pod alignment scores)
-    stays on the XLA path.
-    """
-    N, R = req.shape
-    M = free.shape[0]
-    chunk = min(chunk, N)
-    assert N % chunk == 0, "batch size must be a multiple of the chunk size"
-
+    Shared by the monolithic solve and the chunked scan — in the chained
+    path this runs ONCE for the whole batch, not once per chunk (the per-chunk
+    recompute was the dominant cost of the round-4 host-side chain)."""
     group_feas = group_feasibility(
         g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
         g_tol, g_ports, node_labels, node_taints, node_ports, node_ok,
@@ -514,43 +525,100 @@ def solve(
         # preferred terms the tensor encoding can't express exactly
         # (multi-value In, slot overflow) — scored on the host, same scale
         group_soft = group_soft + host_group_soft
+    return group_feas, group_soft
 
+
+def _hoist_loc_state(loc, group_id_full, G):
+    """Chunk-invariant locality precomputation: per-group capped flags,
+    contribution flags, and round-robin domain rows for the water-fill.
+
+    group_id_full / loc[3] must cover the FULL batch (not one chunk): a
+    group's contribution flags are shared by all its pods, so computing them
+    from the whole batch is both correct per chunk and hoistable."""
+    (spread_l, aff_l, softspread_l, anti_l, min_skew_l) = _loc_capped_flags(loc)
+    L = loc[0].shape[0]
+    # per-group contribution flags (all pods in a group share them — the
+    # signature folds labels in whenever locality applies): locality
+    # rules/scores are evaluated once per round per GROUP, [G, L] → [G, M]
+    group_contrib = (jnp.zeros((G, L), jnp.int32)
+                     .at[group_id_full].max(loc[3].astype(jnp.int32))
+                     .astype(bool))
+    # per-group round-robin domain rows for the water-fill: the first
+    # hard-spread/anti slot's locality group defines the domain partition
+    # its proposals rotate across; -1 row = plain capacity fill
+    from yunikorn_tpu.snapshot.locality import (
+        KIND_ANTI_AFFINITY as _K_ANTI,
+        KIND_SPREAD as _K_SPREAD,
+    )
+
+    g_refs_t, g_kind_t = loc[4], loc[5]
+    S = g_refs_t.shape[1]
+    l_ref = jnp.full((G,), -1, jnp.int32)
+    for s in range(S - 1, -1, -1):  # first capped slot wins
+        is_capped_slot = (((g_kind_t[:, s] == _K_SPREAD) |
+                           (g_kind_t[:, s] == _K_ANTI)) &
+                          (g_refs_t[:, s] >= 0))
+        l_ref = jnp.where(is_capped_slot, g_refs_t[:, s], l_ref)
+    g_capped = l_ref >= 0
+    g_rr_dom = jnp.where(g_capped[:, None],
+                         loc[0][jnp.clip(l_ref, 0, L - 1)], -1)
+    # Per-kind [G, L] applicability masks for the accept caps: a cap binds
+    # only pods whose group references l with a slot of THAT kind. A pod that
+    # merely contributes (a plain pod matching someone's selector) or that
+    # references l through a different kind (an affinity pod sharing the
+    # spread group's locality tuple) makes no count-dependent decision of
+    # that kind — its same-round placements sequentialize after the
+    # constrained pods — so capping it could only starve it (fuzz findings:
+    # plain contributors starved at a saturated spread level; an affinity
+    # pod starved by the spread level of a group it never spread-references).
+    from yunikorn_tpu.snapshot.locality import (
+        KIND_AFFINITY as _K_AFF,
+        KIND_SOFT_SPREAD as _K_SOFT_SPREAD,
+    )
+
+    g_ref_spread = jnp.zeros((G, L), bool)
+    g_ref_anti = jnp.zeros((G, L), bool)
+    g_ref_seed = jnp.zeros((G, L), bool)
+    g_ref_soft = jnp.zeros((G, L), bool)
+    # per-(group, locality group) spread skew: groups sharing a locality
+    # tuple may carry DIFFERENT maxSkew values; the accept cap must bound
+    # each row by ITS OWN skew, not the tightest one (fuzz finding: a skew-2
+    # pod starved by a skew-1 group's level)
+    big = jnp.int32(2**30)
+    g_skew_l = jnp.full((G, L), big)
+    g_seed_t = loc[7]
+    g_skew_t = loc[6]
+    gidx = jnp.arange(G)
+    for s in range(S):
+        l_s = jnp.clip(g_refs_t[:, s], 0, L - 1)
+        k_s = g_kind_t[:, s]
+        has = g_refs_t[:, s] >= 0
+        is_sp = has & (k_s == _K_SPREAD)
+        g_ref_spread = g_ref_spread.at[gidx, l_s].max(is_sp)
+        g_ref_anti = g_ref_anti.at[gidx, l_s].max(has & (k_s == _K_ANTI))
+        g_ref_seed = g_ref_seed.at[gidx, l_s].max(
+            has & (k_s == _K_AFF) & g_seed_t[:, s])
+        g_ref_soft = g_ref_soft.at[gidx, l_s].max(has & (k_s == _K_SOFT_SPREAD))
+        g_skew_l = g_skew_l.at[gidx, l_s].min(jnp.where(is_sp, g_skew_t[:, s], big))
+    return (spread_l, aff_l, softspread_l, anti_l, min_skew_l,
+            group_contrib, g_capped, g_rr_dom,
+            (g_ref_spread, g_ref_anti, g_ref_seed, g_ref_soft, g_skew_l))
+
+
+def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
+                  free_ext0, cnt0, capacity, loc, loc_hoist, *,
+                  max_rounds, chunk, policy, use_pallas, pallas_interpret,
+                  has_loc_soft, pallas_soft, score_cols):
+    """The assignment round loop for one pod slice against hoisted group
+    state. free_ext0 [M+1, R] and cnt0 [L, D] carry across chained chunks;
+    the return keeps their shapes so a lax.scan can thread them."""
+    N, R = req.shape
+    M = free_ext0.shape[0] - 1
     has_loc = loc is not None
-    free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
-    cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
-    # the pallas kernel needs its soft input whenever the per-round hoist
-    # folds soft-locality scores into it (both flags are static)
-    pallas_soft = pallas_has_soft or has_loc_soft
     if has_loc:
         (loc_spread_l, loc_aff_l, loc_softspread_l, loc_anti_l,
-         loc_min_skew_l) = _loc_capped_flags(loc)
-        # per-group contribution flags (all pods in a group share them — the
-        # signature folds labels in whenever locality applies): locality
-        # rules/scores are evaluated once per round per GROUP, [G, L] → [G, M]
-        G = group_feas.shape[0]
-        L = loc[0].shape[0]
-        group_contrib = (jnp.zeros((G, L), jnp.int32)
-                         .at[group_id].max(loc[3].astype(jnp.int32))
-                         .astype(bool))
-        # per-group round-robin domain rows for the water-fill: the first
-        # hard-spread/anti slot's locality group defines the domain partition
-        # its proposals rotate across; -1 row = plain capacity fill
-        from yunikorn_tpu.snapshot.locality import (
-            KIND_ANTI_AFFINITY as _K_ANTI,
-            KIND_SPREAD as _K_SPREAD,
-        )
-
-        g_refs_t, g_kind_t = loc[4], loc[5]
-        S = g_refs_t.shape[1]
-        l_ref = jnp.full((G,), -1, jnp.int32)
-        for s in range(S - 1, -1, -1):  # first capped slot wins
-            is_capped_slot = (((g_kind_t[:, s] == _K_SPREAD) |
-                               (g_kind_t[:, s] == _K_ANTI)) &
-                              (g_refs_t[:, s] >= 0))
-            l_ref = jnp.where(is_capped_slot, g_refs_t[:, s], l_ref)
-        g_capped = l_ref >= 0
-        g_rr_dom = jnp.where(g_capped[:, None],
-                             loc[0][jnp.clip(l_ref, 0, L - 1)], -1)
+         loc_min_skew_l, group_contrib, g_capped, g_rr_dom,
+         g_ref_masks) = loc_hoist
     else:
         group_contrib = None
         g_capped = None
@@ -559,20 +627,21 @@ def solve(
         free_ext0,
         ~valid,                                     # "done" = assigned or invalid
         jnp.full((N,), -1, jnp.int32),              # assignment
+        jnp.full((N,), -1, jnp.int32),              # accept round per pod
         jnp.int32(0),                               # round counter
         jnp.int32(0),                               # consecutive no-progress rounds
         cnt0,                                       # locality domain counts
     )
 
     def cond(state):
-        _, done, _, rnd, stalls, _ = state
+        _, done, _, _, rnd, stalls, _ = state
         # water-fill and argmax rounds alternate; only give up after both stall
         return (stalls < 2) & (rnd < max_rounds) & ~jnp.all(done)
 
     sc = score_cols if score_cols > 0 else R
 
     def body(state):
-        free_ext, done, assigned, rnd, stalls, cnt = state
+        free_ext, done, assigned, around, rnd, stalls, cnt = state
         cur_free = free_ext[:M]
         base_scores = node_base_scores(cur_free[:, :sc], capacity[:, :sc],
                                        policy)
@@ -638,62 +707,268 @@ def solve(
             allowance_l = jnp.where(loc_spread_l | loc_anti_l, N,
                                     jnp.where(loc_softspread_l, soft_allow, N))
             accept_sorted = _loc_accept_cap(accept_sorted, snode, loc[3][order],
-                                            loc, M, cnt, total,
+                                            group_id[order], loc, M, cnt, total,
                                             loc_spread_l, loc_aff_l, loc_anti_l,
-                                            loc_min_skew_l, allowance_l)
+                                            loc_min_skew_l, allowance_l,
+                                            g_ref_masks, loc[9])
         # commit accepted capacity
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
         free_ext = free_ext.at[snode].add(-delta)
         free_ext = free_ext.at[M].set(0)
         accepted = jnp.zeros((N,), bool).at[order].set(accept_sorted)
         assigned = jnp.where(accepted, best, assigned)
+        around = jnp.where(accepted, rnd, around)
         if has_loc:
             cnt = _loc_update_counts(cnt, loc, accepted, best, M)
         done = done | accepted
         progress = jnp.any(accept_sorted)
         stalls = jnp.where(progress, 0, stalls + 1)
-        return free_ext, done, assigned, rnd + 1, stalls, cnt
+        return free_ext, done, assigned, around, rnd + 1, stalls, cnt
 
-    free_ext, done, assigned, rounds, _, cnt_final = lax.while_loop(cond, body, init)
-    # cnt_final rides out so chained chunk solves (solve_batch max_batch
-    # chunking) can carry locality domain counts across chunks
-    return assigned, free_ext[:M], rounds, cnt_final
-
-
-# Canonical pod-bucket cap: batches above this never compile their own shape —
-# solve_batch/solve_sharded split them into rank-ordered [MAX_SOLVE_PODS]-pod
-# chunks chained through carried free capacity + locality counts. The r3 TPU
-# capture paid ~408s compiling the monolithic 65536-pod program through the
-# relay's remote_compile (docs/PERF.md); capping the compiled shape makes cold
-# cost at ANY batch size the cost of the canonical bucket. Sequential chunks in
-# rank order match the reference's ordering semantics (its loop is fully
-# sequential, scheduler_callback.go:196-198) — a later chunk sees capacity net
-# of earlier chunks, exactly like later pods in the reference's cycle.
-MAX_SOLVE_PODS = 8192
-
-# positional indexes into prepare_solve_args' tuple (chunk slicing below)
-_ARG_FREE = 19
-_ARG_LOC = 23
+    (free_ext, done, assigned, around, rounds, _,
+     cnt_final) = lax.while_loop(cond, body, init)
+    return assigned, around, free_ext, rounds, cnt_final
 
 
-def _chunk_np_args(np_args, s, e, cnt=None, free=None):
-    """Pod-dimension slice [s:e) of prepared solve args.
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_rounds", "chunk", "policy", "use_pallas",
+                     "pallas_interpret", "has_loc_soft", "pallas_has_soft",
+                     "score_cols"),
+)
+def solve(
+    req,            # [N, R] int32
+    group_id,       # [N] int32
+    rank,           # [N] float32 — lower schedules first
+    valid,          # [N] bool
+    g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+    g_tol, g_ports,                                   # group tensors
+    g_pref_req, g_pref_forb, g_pref_weight,           # preferred-affinity scoring
+    node_labels, node_taints, node_taints_soft, node_ports, node_ok,  # node symbol state
+    free,           # [M, R] int32
+    capacity,       # [M, R] int32
+    host_group_mask=None,   # [G, M] bool or None
+    host_group_soft=None,   # [G, M] float32 or None (host-scored soft terms)
+    loc=None,       # locality tuple: (dom [L,M], cnt0 [L,D], dom_valid [L,D],
+                    #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed,
+                    #  g_weight [G,S] f32 — soft-slot score weights,
+                    #  pair [L] int32 — holder→primary group pairing)
+    *,
+    max_rounds: int = 16,
+    chunk: int = 512,
+    policy: str = "binpacking",
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    has_loc_soft: bool = True,
+    pallas_has_soft: bool = True,
+    score_cols: int = 0,
+):
+    """One batched solve. Returns (assigned [N] int32, accept_round [N]
+    int32, free_after [M, R], rounds, cnt_final).
 
-    cnt / free carry the locality domain counts and node free capacity from
-    the previous chunk of a chained solve (device arrays — no host sync)."""
+    score_cols > 0 restricts SCORING to the first score_cols resource
+    columns; feasibility always uses all of them. prepare_solve_args appends
+    capacity-1 synthetic columns per requested host port beyond score_cols —
+    the round loop's free tracking then enforces intra-batch port
+    exclusivity (two batch pods cannot share a port on one node) without
+    ports distorting the packing score.
+
+    has_loc_soft=False (static) skips the soft-locality scoring pass for
+    batches whose locality slots are all hard (the common case) — the pass
+    provably sums to zero when every g_weight is 0.
+
+    use_pallas routes the per-round best-node computation through the fused
+    Pallas kernel (ops/pallas_kernels.py). Locality batches work too: the
+    dynamic per-round rules/scores are hoisted to [G, M] adjustments (pods in
+    a group share locality state by construction — the constraint-group
+    signature folds pod labels in whenever locality applies,
+    snapshot/locality.py locality_signature) and folded into the kernel's
+    feasibility/soft inputs. Only the align policy (per-pod alignment scores)
+    stays on the XLA path.
+    """
+    N, R = req.shape
+    M = free.shape[0]
+    chunk = min(chunk, N)
+    assert N % chunk == 0, "batch size must be a multiple of the chunk size"
+
+    group_feas, group_soft = _hoist_group_state(
+        g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+        g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+        node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+        host_group_mask, host_group_soft)
+
+    has_loc = loc is not None
+    free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
+    cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
+    # the pallas kernel needs its soft input whenever the per-round hoist
+    # folds soft-locality scores into it (both flags are static)
+    pallas_soft = pallas_has_soft or has_loc_soft
+    loc_hoist = (_hoist_loc_state(loc, group_id, group_feas.shape[0])
+                 if has_loc else None)
+    assigned, around, free_ext, rounds, cnt_final = _solve_rounds(
+        req, group_id, rank, valid, group_feas, group_soft, free_ext0, cnt0,
+        capacity, loc, loc_hoist, max_rounds=max_rounds, chunk=chunk,
+        policy=policy, use_pallas=use_pallas, pallas_interpret=pallas_interpret,
+        has_loc_soft=has_loc_soft, pallas_soft=pallas_soft,
+        score_cols=score_cols)
+    # cnt_final rides out so the chunked scan path can reuse _solve_rounds
+    # with carried locality domain counts
+    return assigned, around, free_ext[:M], rounds, cnt_final
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("chunk_pods", "max_rounds", "chunk", "policy",
+                     "use_pallas", "pallas_interpret", "has_loc_soft",
+                     "pallas_has_soft", "score_cols"),
+)
+def solve_chunked(
+    req, group_id, rank, valid,
+    g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+    g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+    node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+    free, capacity, host_group_mask=None, host_group_soft=None, loc=None,
+    *,
+    chunk_pods: int,
+    max_rounds: int = 16,
+    chunk: int = 512,
+    policy: str = "binpacking",
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    has_loc_soft: bool = True,
+    pallas_has_soft: bool = True,
+    score_cols: int = 0,
+):
+    """Chained fixed-shape chunk solves inside ONE compiled program.
+
+    Batches above the configured `max_batch` cap run here: a `lax.scan` over
+    rank-ordered [chunk_pods]-pod slices, carrying (free capacity, locality
+    domain counts) chunk to chunk. A later chunk sees capacity net of earlier
+    chunks, exactly like later pods in the reference's sequential cycle
+    (reference scheduler_callback.go:196-198 — its loop is fully sequential).
+
+    vs the round-4 host-side chain this hoists the [G, M] group feasibility /
+    soft scoring and the locality precomputation OUT of the chain (computed
+    once, closed over by the scan body), transfers chunk-invariant node/group
+    tensors once, and dispatches one program instead of K — the three
+    regression sources the r4 chain measured at 5.4× warm-path cost.
+
+    PRECONDITION: pod rows must already be sorted by rank (solve_batch /
+    solve_sharded sort + unsort around this call) — chunk boundaries
+    supersede rank priority, so unsorted input would let a low-priority pod
+    in an early chunk take capacity from a high-priority pod in a later one.
+    """
+    N, R = req.shape
+    M = free.shape[0]
+    mb = chunk_pods
+    assert N % mb == 0, "batch size must be a multiple of chunk_pods"
+    K = N // mb
+    chunk = min(chunk, mb)
+    assert mb % chunk == 0, "chunk_pods must be a multiple of the chunk size"
+
+    group_feas, group_soft = _hoist_group_state(
+        g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+        g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+        node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+        host_group_mask, host_group_soft)
+
+    has_loc = loc is not None
+    pallas_soft = pallas_has_soft or has_loc_soft
+    loc_hoist = (_hoist_loc_state(loc, group_id, group_feas.shape[0])
+                 if has_loc else None)
+    free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
+    cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
+
+    xs = (req.reshape(K, mb, R), group_id.reshape(K, mb),
+          rank.reshape(K, mb), valid.reshape(K, mb))
+    if has_loc:
+        xs = xs + (loc[3].reshape(K, mb, loc[3].shape[1]),)
+
+    def scan_body(carry, x):
+        free_ext, cnt, round_base = carry
+        if has_loc:
+            creq, cgid, crank, cvalid, ccontrib = x
+            l = list(loc)
+            l[3] = ccontrib
+            loc_k = tuple(l)
+        else:
+            creq, cgid, crank, cvalid = x
+            loc_k = None
+        a_k, ar_k, free_ext, r_k, cnt = _solve_rounds(
+            creq, cgid, crank, cvalid, group_feas, group_soft, free_ext, cnt,
+            capacity, loc_k, loc_hoist, max_rounds=max_rounds, chunk=chunk,
+            policy=policy, use_pallas=use_pallas,
+            pallas_interpret=pallas_interpret, has_loc_soft=has_loc_soft,
+            pallas_soft=pallas_soft, score_cols=score_cols)
+        # offset accept rounds so the chain's order is globally monotone (a
+        # later chunk's round 0 happens after every earlier chunk's rounds)
+        ar_k = jnp.where(ar_k >= 0, ar_k + round_base, -1)
+        return (free_ext, cnt, round_base + r_k), (a_k, ar_k, r_k)
+
+    (free_ext, cnt, _), (assigned_k, around_k, rounds_k) = lax.scan(
+        scan_body, (free_ext0, cnt0, jnp.int32(0)), xs)
+    return (assigned_k.reshape(N), around_k.reshape(N), free_ext[:M],
+            jnp.sum(rounds_k), cnt)
+
+
+# Pod-bucket cap above which a batch runs as a chained chunk solve
+# (solve_chunked: one compiled lax.scan program over [max_batch]-pod slices
+# with carried free capacity + locality counts). Defaults to the north-star
+# bucket so the monolithic program — measurably the fastest warm path (r4
+# verdict: 3.38 s vs 18.2 s warm at 50k/10k on CPU) — is what production
+# runs; operators whose environment makes large-shape compiles expensive
+# (e.g. a remote_compile relay) can lower `solver.maxBatch` and pay only a
+# mild warm cost because the chain is a single program with group state
+# hoisted out (see solve_chunked).
+MAX_SOLVE_PODS = 65536
+
+# positional indexes into prepare_solve_args' tuple, derived from one named
+# list so a reorder/insertion in its return breaks loudly at import time
+SOLVE_ARG_NAMES = (
+    "req", "group_id", "rank", "valid",
+    "g_term_req", "g_term_forb", "g_term_valid", "g_anyof", "g_anyof_valid",
+    "g_tol", "g_ports", "g_pref_req", "g_pref_forb", "g_pref_weight",
+    "node_labels", "node_taints", "node_taints_soft", "node_ports", "node_ok",
+    "free", "capacity", "host_mask", "host_soft", "loc",
+)
+_ARG_RANK = SOLVE_ARG_NAMES.index("rank")
+_ARG_LOC = SOLVE_ARG_NAMES.index("loc")
+
+
+def _unsort(order, *arrays):
+    """Invert a _sort_pods_by_rank permutation on pod-dim result arrays
+    (device gather — stays async). Shared by solve_batch and solve_sharded."""
+    import numpy as np
+
+    inv = np.empty_like(order)
+    inv[order] = np.arange(order.shape[0])
+    inv_d = jnp.asarray(inv)
+    return tuple(a[inv_d] for a in arrays)
+
+
+def _sort_pods_by_rank(np_args):
+    """Stable host-side sort of the pod-dimension args by rank.
+
+    The chunked scan's chunk boundaries supersede rank priority (a later
+    chunk only sees leftover capacity), so the chained path sorts pod rows by
+    rank first and the caller unsorts `assigned` with the returned
+    permutation (None when already sorted — the CoreScheduler path, which
+    assigns ranks in sorted ask order)."""
+    import numpy as np
+
+    rank = np.asarray(np_args[_ARG_RANK])
+    order = np.argsort(rank, kind="stable")
+    if (order == np.arange(order.shape[0])).all():
+        return np_args, None
     out = list(np_args)
     for i in range(4):  # req, group_id, rank, valid
-        out[i] = np_args[i][s:e]
-    if free is not None:
-        out[_ARG_FREE] = free
+        out[i] = np.asarray(np_args[i])[order]
     loc = np_args[_ARG_LOC]
     if loc is not None:
         l = list(loc)
-        l[3] = loc[3][s:e]          # contrib [N, L]
-        if cnt is not None:
-            l[1] = cnt              # carried domain counts [L, D]
+        l[3] = np.asarray(loc[3])[order]          # contrib [N, L]
         out[_ARG_LOC] = tuple(l)
-    return tuple(out)
+    return tuple(out), order
 
 
 def pad2d(arr, width, fill):
@@ -792,7 +1067,8 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
     if batch.locality is not None:
         lb = batch.locality
         loc = (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
-               lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight)
+               lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight,
+               lb.pair)
     np_args = (
         req_i,
         batch.group_id,
@@ -819,6 +1095,7 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
         host_soft,
         loc,
     )
+    assert len(np_args) == len(SOLVE_ARG_NAMES)
     static_kwargs = dict(
         has_loc_soft=(batch.locality is not None
                       and bool(np.any(batch.locality.g_weight))),
@@ -842,9 +1119,9 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     compile_only: AOT-lower and compile this shape/static-variant without
     executing (bucket prewarm) — fills the jit + persistent caches at zero
     device time; returns None.
-    max_batch: batches above this run as chained fixed-shape chunk solves
-    (rank order, capacity + locality-count carry) so only the canonical
-    bucket ever compiles — see MAX_SOLVE_PODS.
+    max_batch: batches above this run as ONE compiled chained chunk program
+    (solve_chunked: lax.scan over rank-ordered [max_batch]-pod slices with
+    capacity + locality-count carry) — see MAX_SOLVE_PODS.
     """
     np_args, static_kwargs = prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
@@ -864,24 +1141,21 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     N = np_args[0].shape[0]
     mb = 1 << (max(int(max_batch), 64).bit_length() - 1)
     if N > mb:
-        # N and mb are both powers of two (encoder bucket / rounding above)
-        np_args_0 = _chunk_np_args(np_args, 0, mb)
+        # N and mb are both powers of two (encoder bucket / rounding above):
+        # one compiled lax.scan program over [mb]-pod rank-ordered slices
+        np_args_s, order = _sort_pods_by_rank(np_args)
         if compile_only:
             specs = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args_0)
-            solve.lower(*specs, **solve_kwargs).compile()
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args_s)
+            solve_chunked.lower(*specs, chunk_pods=mb, **solve_kwargs).compile()
             return None
-        parts = []
-        free = cnt = rounds_total = None
-        for s in range(0, N, mb):
-            args_k = (np_args_0 if s == 0
-                      else _chunk_np_args(np_args, s, s + mb, cnt=cnt, free=free))
-            solve_args = jax.tree_util.tree_map(jnp.asarray, args_k)
-            a_k, free, r_k, cnt = solve(*solve_args, **solve_kwargs)
-            parts.append(a_k)
-            rounds_total = r_k if rounds_total is None else rounds_total + r_k
-        return SolveResult(assigned=jnp.concatenate(parts), free_after=free,
-                           rounds=rounds_total)
+        solve_args = jax.tree_util.tree_map(jnp.asarray, np_args_s)
+        assigned, around, free_after, rounds, _ = solve_chunked(
+            *solve_args, chunk_pods=mb, **solve_kwargs)
+        if order is not None:
+            assigned, around = _unsort(order, assigned, around)
+        return SolveResult(assigned=assigned, free_after=free_after,
+                           rounds=rounds, accept_round=around)
     if compile_only:
         # specs instead of arrays: no host->device transfer at all
         specs = jax.tree_util.tree_map(
@@ -889,5 +1163,6 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         solve.lower(*specs, **solve_kwargs).compile()
         return None
     solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
-    assigned, free_after, rounds, _ = solve(*solve_args, **solve_kwargs)
-    return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)
+    assigned, around, free_after, rounds, _ = solve(*solve_args, **solve_kwargs)
+    return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds,
+                       accept_round=around)

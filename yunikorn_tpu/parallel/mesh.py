@@ -102,17 +102,15 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
             put(labels, node_s2), put(taints_hard, node_s2),
             put(taints_soft, node_s2), put(ports, node_s2),
             put(node_ok, node_s),
-            # carried free capacity from a previous chunk is already a device
-            # array with the computation's sharding — don't re-put it
-            free_i if isinstance(free_i, jax.Array) else put(free_i, node_s2),
+            put(free_i, node_s2),
             put(cap_i, node_s2),
         )
         mask_arg = put(host_mask, group_node_s) if host_mask is not None else None
         soft_arg = put(host_soft, group_node_s) if host_soft is not None else None
         # locality tables ride replicated: tiny relative to the node arrays,
         # and the per-round count updates are global reductions anyway
-        loc_arg = (tuple(a if isinstance(a, jax.Array) else put(a, repl)
-                         for a in loc) if loc is not None else None)
+        loc_arg = (tuple(put(a, repl) for a in loc)
+                   if loc is not None else None)
         return args, mask_arg, soft_arg, loc_arg
 
     solve_kwargs = dict(
@@ -121,26 +119,24 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         score_cols=static_kwargs["score_cols"],
     )
     if N > mb:
-        np_args_0 = assign_mod._chunk_np_args(np_args, 0, mb)
-        if compile_only:
-            args, mask_arg, soft_arg, loc_arg = build_args(np_args_0)
-            with mesh:
-                assign_mod.solve.lower(
-                    *args, mask_arg, soft_arg, loc_arg, **solve_kwargs).compile()
-            return None
-        parts = []
-        free = cnt = rounds_total = None
+        # one compiled lax.scan program over [mb]-pod rank-ordered slices
+        # (assign.solve_chunked) — same sharding layout, group state hoisted
+        np_args_s, order = assign_mod._sort_pods_by_rank(np_args)
+        args, mask_arg, soft_arg, loc_arg = build_args(np_args_s)
         with mesh:
-            for s in range(0, N, mb):
-                cargs = (np_args_0 if s == 0 else assign_mod._chunk_np_args(
-                    np_args, s, s + mb, cnt=cnt, free=free))
-                args, mask_arg, soft_arg, loc_arg = build_args(cargs)
-                a_k, free, r_k, cnt = assign_mod.solve(
-                    *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
-                parts.append(a_k)
-                rounds_total = r_k if rounds_total is None else rounds_total + r_k
+            if compile_only:
+                assign_mod.solve_chunked.lower(
+                    *args, mask_arg, soft_arg, loc_arg, chunk_pods=mb,
+                    **solve_kwargs).compile()
+                return None
+            assigned, around, free_after, rounds, _ = assign_mod.solve_chunked(
+                *args, mask_arg, soft_arg, loc_arg, chunk_pods=mb,
+                **solve_kwargs)
+        if order is not None:
+            assigned, around = assign_mod._unsort(order, assigned, around)
         return assign_mod.SolveResult(
-            assigned=jnp.concatenate(parts), free_after=free, rounds=rounds_total)
+            assigned=assigned, free_after=free_after, rounds=rounds,
+            accept_round=around)
 
     args, mask_arg, soft_arg, loc_arg = build_args(np_args)
     with mesh:
@@ -148,6 +144,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
             assign_mod.solve.lower(
                 *args, mask_arg, soft_arg, loc_arg, **solve_kwargs).compile()
             return None
-        assigned, free_after, rounds, _ = assign_mod.solve(
+        assigned, around, free_after, rounds, _ = assign_mod.solve(
             *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
-    return assign_mod.SolveResult(assigned=assigned, free_after=free_after, rounds=rounds)
+    return assign_mod.SolveResult(assigned=assigned, free_after=free_after,
+                                  rounds=rounds, accept_round=around)

@@ -31,9 +31,10 @@ class MockScheduler:
         self.context: Optional[Context] = None
 
     # ------------------------------------------------------------- lifecycle
-    def init(self, queues_yaml: str = "", interval: float = 0.05,
-             core_interval: float = 0.02, solver_policy: Optional[str] = None,
-             conf_extra: Optional[dict] = None) -> None:
+    def _boot(self, queues_yaml: str, interval: float, core_interval: float,
+              solver_policy: Optional[str], conf_extra: Optional[dict]) -> None:
+        """Shared conf/dispatcher/core/shim construction for init + restart
+        (self.cluster must already exist)."""
         reset_for_tests()
         holder = get_holder()
         cm = {"service.schedulingInterval": str(interval),
@@ -41,19 +42,42 @@ class MockScheduler:
         cm.update(conf_extra or {})
         holder.update_config_maps([cm], initial=True)
         dispatch_mod.reset_dispatcher()
-        self.cluster = FakeCluster()
         cache = SchedulerCache()
         from yunikorn_tpu.core.scheduler import SolverOptions
 
+        self._solver_policy = solver_policy
         self.core = CoreScheduler(
             cache, interval=core_interval, solver_policy=solver_policy,
             solver_options=SolverOptions.from_conf(holder.get()))
         self.context = Context(self.cluster, self.core, cache=cache)
         self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
 
+    def init(self, queues_yaml: str = "", interval: float = 0.05,
+             core_interval: float = 0.02, solver_policy: Optional[str] = None,
+             conf_extra: Optional[dict] = None) -> None:
+        self.cluster = FakeCluster()
+        self._boot(queues_yaml, interval, core_interval, solver_policy,
+                   conf_extra)
+
     def start(self) -> None:
         self.core.start()
         self.shim.run()
+
+    def restart(self, queues_yaml: str = "", interval: float = 0.05,
+                core_interval: float = 0.02, solver_policy: Optional[str] = None,
+                conf_extra: Optional[dict] = None) -> None:
+        """Simulate a scheduler-pod restart with (possibly changed) config:
+        tear down core+shim, keep the CLUSTER (pods/nodes/configmaps persist
+        in the API server), then boot a fresh core+shim that must recover the
+        existing state (reference e2e restart_changed_config suite: bound
+        pods survive recovery, the new config governs new pods).
+        solver_policy=None keeps the policy init() was given."""
+        self.stop()
+        self.cluster.clear_event_handlers()
+        self._boot(queues_yaml, interval, core_interval,
+                   solver_policy or getattr(self, "_solver_policy", None),
+                   conf_extra)
+        self.start()
 
     def stop(self) -> None:
         # core first: its solve thread must not fire callbacks into a stopped

@@ -425,7 +425,12 @@ class SnapshotEncoder:
             dirty, objects = names, names
         else:
             dirty, objects = self.cache.take_dirty_nodes()
-        for name in dirty:
+        # sorted: dirty/objects are SETS — hash-order iteration would make
+        # node row assignment (and every downstream tensor: label bitsets,
+        # locality domain ids, solve inputs) vary with PYTHONHASHSEED across
+        # processes. Deterministic encodings are load-bearing for the
+        # sharded-vs-single bit-identity contract and for differential tests.
+        for name in sorted(dirty):
             info = self.cache.get_node(name)
             if info is None:
                 self.nodes.remove_node(name)

@@ -267,7 +267,14 @@ class LocalityBatch:
     g_skew: np.ndarray       # [G, S] int32
     g_seed: np.ndarray       # [G, S] bool
     g_weight: np.ndarray     # [G, S] f32 scaled score weight (soft slots)
-    num_groups: int
+    # [L] int32: for a HOLDER group (contrib = pods holding anti term t), the
+    # index of the primary group with the same (topo_key, selector, ns)
+    # (contrib = pods MATCHING t), else -1. The solver's accept cap uses this
+    # to mutually exclude a holder and a matcher landing in one domain in the
+    # same round — illegal in either sequential order (the holder's own anti
+    # rule vs the matcher, or the matcher's symmetry rule vs the holder).
+    pair: np.ndarray = None  # type: ignore[assignment]
+    num_groups: int = 0
     # groups whose constraints overflow the tensor encoding, evaluated exactly
     # on the host instead: gid -> [M] feasibility mask against existing
     # cluster state. The encoder serializes these groups (one pod per solve)
@@ -586,16 +593,18 @@ def encode_locality(
         for d in table.values():
             dom_valid[l, d] = True
 
-    # existing pods per domain (assigned pods in the cache)
+    # existing pods per domain (assigned pods in the cache) + this cycle's
+    # in-flight placements (committed allocations whose assume has not landed
+    # in the cache yet — extra_placed, the locality-count analog of the
+    # free/ports overlays: without it a spread/anti decision in cycle N+1
+    # cannot see cycle N's still-in-flight pods)
     node_idx_of = node_arrays._name_to_idx
     specs = accum.specs
-    for pod in list(cache.pods_map.values()):
-        node_name = cache.assigned_pods.get(pod.uid)
-        if node_name is None:
-            continue
+
+    def count_assigned(pod, node_name):
         n_idx = node_idx_of.get(node_name)
         if n_idx is None:
-            continue
+            return
         pod_terms = None
         for l, (spec, holder) in enumerate(specs):
             d = dom[l, n_idx]
@@ -610,6 +619,17 @@ def encode_locality(
                 counts = spec.counts_pod(pod)
             if counts:
                 cnt0[l, d] += 1
+
+    for pod in list(cache.pods_map.values()):
+        node_name = cache.assigned_pods.get(pod.uid)
+        if node_name is not None:
+            count_assigned(pod, node_name)
+    if extra_placed:
+        in_cache = cache.assigned_pods
+        for pod, node_name in extra_placed:
+            if pod.uid in in_cache:
+                continue  # assume already landed; don't double count
+            count_assigned(pod, node_name)
 
     # batch-pod contributions
     contrib = np.zeros((batch_n, L_pad), bool)
@@ -626,10 +646,20 @@ def encode_locality(
             else:
                 contrib[i, l] = spec.counts_pod(ask.pod)
 
+    # holder → primary pairing for the same-round mutual exclusion (see the
+    # `pair` field docstring)
+    pair = np.full((L_pad,), -1, np.int32)
+    for l, (spec, holder) in enumerate(accum.specs):
+        if holder:
+            p = accum.keys.get(
+                (spec.topo_key, spec.selector_sig, spec.namespaces, False))
+            if p is not None:
+                pair[l] = p
+
     return LocalityBatch(
         dom=dom, cnt0=cnt0, dom_valid=dom_valid, contrib=contrib,
         g_refs=g_refs, g_kind=g_kind, g_skew=g_skew, g_seed=g_seed,
-        g_weight=g_weight,
+        g_weight=g_weight, pair=pair,
         num_groups=len(accum.specs),
         fallback=fallback or None,
         soft_static=soft_static or None,

@@ -74,8 +74,10 @@ def main(argv=None) -> int:
     parser.add_argument("--root", type=str, default=".")
     parser.add_argument("--api", type=str, default="http://127.0.0.1:9080")
     parser.add_argument("--port", type=int, default=9889)
+    parser.add_argument("--host", type=str, default="127.0.0.1",
+                        help="bind address (0.0.0.0 in a container)")
     args = parser.parse_args(argv)
-    server = WebTestServer(args.root, args.api, port=args.port)
+    server = WebTestServer(args.root, args.api, host=args.host, port=args.port)
     port = server.start()
     print(f"webtest on :{port}")
     import signal, threading as t
