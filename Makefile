@@ -15,11 +15,11 @@ sched_image:  ## build the scheduler image
 		-f docker/scheduler/Dockerfile .
 
 adm_image:  ## build the admission-controller image
-	$(DOCKER) build -t $(REGISTRY)/admission:$(VERSION) \
+	$(DOCKER) build $(DOCKER_BUILD_ARGS) -t $(REGISTRY)/admission:$(VERSION) \
 		-f docker/admission/Dockerfile .
 
 webtest_image:  ## build the webtest image
-	$(DOCKER) build -t $(REGISTRY)/webtest:$(VERSION) \
+	$(DOCKER) build $(DOCKER_BUILD_ARGS) -t $(REGISTRY)/webtest:$(VERSION) \
 		-f docker/webtest/Dockerfile .
 
 image: sched_image adm_image webtest_image  ## build all three images

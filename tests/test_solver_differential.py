@@ -315,6 +315,14 @@ def random_loc_pod(rng, i):
             max_skew=rng.choice([1, 2]), topology_key="zone",
             when_unsatisfiable="DoNotSchedule",
             label_selector=own_sel if rng.random() < 0.8 else sel)]
+        if rng.random() < 0.2:
+            # multi-constraint pod: spread + anti-affinity HOLDER — the
+            # combination where cap-removal ordering vs the spread level
+            # fill matters (pair exclusion must run before the fill)
+            pod.spec.affinity = Affinity(pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    label_selector=sel,
+                    topology_key=rng.choice([HOSTNAME_KEY, "zone"]))])
     elif r < 0.45:
         # required anti-affinity; selector may or may not match the pod
         pod.spec.affinity = Affinity(pod_anti_affinity_required=[

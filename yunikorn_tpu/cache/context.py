@@ -270,6 +270,12 @@ class Context:
         self._namespaces: Dict[str, Dict[str, str]] = {}
         # foreign pods already reported to the core: uid -> (node, resource)
         self._foreign_sent: Dict[str, tuple] = {}
+        # uid-keyed fast-path memos: a pod's YuniKorn adoption and its
+        # (app, task) identity are immutable per uid, but informers refire
+        # update_pod for every status change — at 50k binds that is 3-4 full
+        # metadata extractions per pod without these. Evicted on delete.
+        self._pod_kind_memo: Dict[str, bool] = {}
+        self._task_ref_memo: Dict[str, tuple] = {}
         self._lock = locking.RMutex()
         self._initialized = False
         # bounded bind workers: the reference spawns a goroutine per bind
@@ -424,7 +430,17 @@ class Context:
 
     def update_pod(self, _old: Optional[Pod], pod: Pod) -> None:
         """Pod add/update with YuniKorn/foreign split (reference :316-351)."""
-        if get_task_metadata(pod, self.conf.generate_unique_app_ids) is not None:
+        # memoize only the YuniKorn classification: app identity is immutable
+        # once adopted, but a FOREIGN pod can become YuniKorn-managed by a
+        # later label/annotation edit (metadata.py's label-based adoption),
+        # so the foreign verdict must be recomputed per delivery
+        is_yk = self._pod_kind_memo.get(pod.uid)
+        if is_yk is None:
+            is_yk = get_task_metadata(
+                pod, self.conf.generate_unique_app_ids) is not None
+            if is_yk:
+                self._pod_kind_memo[pod.uid] = True
+        if is_yk:
             self._update_yunikorn_pod(pod)
         else:
             self._update_foreign_pod(pod)
@@ -476,6 +492,8 @@ class Context:
                 ]))
 
     def delete_pod(self, pod: Pod) -> None:
+        self._pod_kind_memo.pop(pod.uid, None)
+        self._task_ref_memo.pop(pod.uid, None)
         if get_task_metadata(pod, self.conf.generate_unique_app_ids) is not None:
             self.schedulers_cache.remove_pod(pod)
             self._notify_task_complete(pod)
@@ -502,6 +520,13 @@ class Context:
     # ------------------------------------------------------------- app/task
     def _ensure_app_and_task(self, pod: Pod) -> None:
         """reference ensureAppAndTaskCreated (:976-1144)."""
+        ref = self._task_ref_memo.get(pod.uid)
+        if ref is not None:
+            # fast path: this uid's task already exists (informers refire on
+            # every status update; app/task identity is immutable per uid)
+            app = self._apps.get(ref[0])
+            if app is not None and app.get_task(ref[1]) is not None:
+                return
         app_meta = get_app_metadata(pod, self.conf.generate_unique_app_ids)
         if app_meta is None:
             return
@@ -534,6 +559,8 @@ class Context:
             # (reference context.go:1071-1114)
             if pod.is_assigned() and not pod.is_terminated():
                 task.mark_previously_allocated(pod.spec.node_name)
+        self._task_ref_memo[pod.uid] = (app_meta.application_id,
+                                        task_meta.task_id)
 
     def get_application(self, app_id: str) -> Optional[Application]:
         with self._lock:

@@ -195,6 +195,25 @@ class SchedulerCache:
         if self._has_anti_terms(pod) or self._has_anti_terms(self.pods_map.get(key)):
             self._anti_version += 1
         cur = self.pods_map.get(key)
+        # fast path for status-only refires (bind conditions, heartbeat
+        # updates): same assignment, same resources, still live → swap the
+        # stored object without the remove/add accounting cycle (two resource
+        # extractions + node dirty marks per informer event otherwise)
+        if cur is not None and not pod.is_terminated():
+            node_name = self.assigned_pods.get(key)
+            if (node_name is not None
+                    and (pod.spec.node_name or node_name) == node_name):
+                r_new = get_pod_resource(pod)
+                if r_new.resources == get_pod_resource(cur).resources:
+                    if not pod.spec.node_name:
+                        pod.spec.node_name = node_name
+                    info = self.nodes_map.get(node_name)
+                    if info is not None and key in info.pods:
+                        info.pods[key] = pod
+                    self.pods_map[key] = pod
+                    if pod.status.phase in ("Running", "Succeeded", "Failed"):
+                        self.assumed_pods.pop(key, None)
+                    return True
         if cur is not None:
             self.pods_map.pop(key, None)
             self.orphaned_pods.pop(key, None)

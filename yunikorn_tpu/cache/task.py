@@ -206,9 +206,16 @@ class Task:
 
             dispatch_mod.dispatch(TaskEventRecord(
                 self.application.application_id, "", app_mod.UPDATE_RESERVATION))
-        self.update_pod_condition(PodCondition(
+        cond = PodCondition(
             type="PodScheduled", status="True", reason="Scheduled",
-            message=f"bound to {self.node_name}"))
+            message=f"bound to {self.node_name}")
+        # the condition patch is an API write with an informer fan-out; run
+        # it on the bind pool so the single dispatcher consumer (which runs
+        # this hook) is not serialized behind 50k of them in a bind storm
+        pool = getattr(self.context, "bind_pool", None)
+        if pool is None or not pool.submit(
+                lambda: self.update_pod_condition(cond)):
+            self.update_pod_condition(cond)
 
     def _post_rejected(self, reason: str = "") -> None:
         self.terminated_reason = reason

@@ -6,6 +6,7 @@ ApplicationEvent / TaskEvent / SchedulerNodeEvent interfaces) and recorder.go:27
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import time
 from typing import Any, List, Optional, Tuple
@@ -123,8 +124,9 @@ class EventRecorder:
 
     def __init__(self, capacity: int = 100000):
         self._lock = locking.Mutex()
-        self._events: List[RecordedEvent] = []
-        self._capacity = capacity
+        # deque(maxlen): O(1) eviction — a bench cycle emits several events
+        # per pod, and list.pop(0) at capacity is O(capacity) each
+        self._events: collections.deque = collections.deque(maxlen=capacity)
 
     def eventf(self, object_kind: str, object_key: str, event_type: str, reason: str,
                message: str, *fmt_args) -> None:
@@ -134,8 +136,6 @@ class EventRecorder:
             except TypeError:
                 message = f"{message} {fmt_args}"
         with self._lock:
-            if len(self._events) >= self._capacity:
-                self._events.pop(0)
             self._events.append(RecordedEvent(object_kind, object_key, event_type, reason, message))
 
     def events(self, object_key: Optional[str] = None, reason: Optional[str] = None) -> List[RecordedEvent]:

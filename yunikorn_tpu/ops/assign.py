@@ -396,12 +396,58 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
         keep2 = (~act2) | (within <= limit_row[order2])
         return jnp.zeros((N,), bool).at[order2].set(keep2)
 
+    # holder↔matcher mutual exclusion FIRST: for a holder group l (contrib =
+    # pods HOLDING anti term t) paired with primary group p (contrib = pods
+    # MATCHING t's selector), a holder may not be accepted into a domain
+    # where a matcher is accepted this same round (other than itself): the
+    # holder's own anti rule vs the matcher and the matcher's symmetry rule
+    # vs the holder each kill one of the two sequential orders. Blocked
+    # holders retry next round, where the updated counts separate them.
+    # Running removal passes BEFORE the spread level fill matters: the fill's
+    # tentative counts must only include accepts that survive, or a domain's
+    # projected minimum could rest on rows a later pass removes (a
+    # spread+anti-holder pod blocked here would otherwise still prop up the
+    # level other domains were filled against).
+    for l in range(L):
+        lp = pair_l[l]
+        has_pair = lp >= 0
+        lp_cl = jnp.clip(lp, 0, L - 1)
+        contrib_p = jnp.take(scontrib, lp_cl, axis=1)                  # [N]
+        dom_i = loc_dom[l, node_cl]
+        dom_cl = jnp.clip(dom_i, 0, D - 1)
+        on_node = (dom_i >= 0) & (snode < M) & accept_sorted
+        acc_p = on_node & contrib_p
+        t_p = jnp.zeros((D,), jnp.int32).at[dom_cl].add(acc_p.astype(jnp.int32))
+        others_p = t_p[dom_cl] - acc_p.astype(jnp.int32)
+        blocked = (has_pair & on_node & scontrib[:, l] & (others_p > 0))
+        accept_sorted = accept_sorted & ~blocked
+
     for l in range(L):
         dom_i = loc_dom[l, node_cl]                                    # [N]
         dom_cl = jnp.clip(dom_i, 0, D - 1)
         on_dom = (dom_i >= 0) & (snode < M)
 
-        # hard spread: level fill over the spread-referencing accepts
+        # anti-affinity: 1 referencing pod per domain per round (before the
+        # spread fill, same reasoning as the pair exclusion above)
+        an_active = (anti_l[l] & accept_sorted & scontrib[:, l]
+                     & g_ref_anti[sgid, l] & on_dom)
+        accept_sorted = accept_sorted & seg_keep(
+            an_active, dom_i, jnp.ones((N,), jnp.int32))
+
+        # affinity seeding: 1 seed-slot pod per locality group per round
+        seeding = aff_l[l] & (total[l] == 0)
+        se_active = (seeding & accept_sorted & scontrib[:, l]
+                     & g_ref_seed[sgid, l] & on_dom)
+        accept_sorted = accept_sorted & seg_keep(
+            se_active, jnp.zeros((N,), jnp.int32), jnp.ones((N,), jnp.int32))
+
+    for l in range(L):
+        dom_i = loc_dom[l, node_cl]                                    # [N]
+        dom_cl = jnp.clip(dom_i, 0, D - 1)
+        on_dom = (dom_i >= 0) & (snode < M)
+
+        # hard spread: level fill over the spread-referencing accepts that
+        # survived the removal passes above
         sp_active = (spread_l[l] & accept_sorted & scontrib[:, l]
                      & g_ref_spread[sgid, l] & on_dom)
         t = jnp.zeros((D,), jnp.int32).at[dom_cl].add(sp_active.astype(jnp.int32))
@@ -425,45 +471,12 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
             jnp.minimum(a_spread[dom_cl], jnp.int32(2**30 - 1)))
         accept_sorted = accept_sorted & seg_keep(sp_active, dom_i, limit_row)
 
-        # anti-affinity: 1 referencing pod per domain per round
-        an_active = (anti_l[l] & accept_sorted & scontrib[:, l]
-                     & g_ref_anti[sgid, l] & on_dom)
-        accept_sorted = accept_sorted & seg_keep(
-            an_active, dom_i, jnp.ones((N,), jnp.int32))
-
-        # affinity seeding: 1 seed-slot pod per locality group per round
-        seeding = aff_l[l] & (total[l] == 0)
-        se_active = (seeding & accept_sorted & scontrib[:, l]
-                     & g_ref_seed[sgid, l] & on_dom)
-        accept_sorted = accept_sorted & seg_keep(
-            se_active, jnp.zeros((N,), jnp.int32), jnp.ones((N,), jnp.int32))
-
         # ScheduleAnyway spread: per-domain allowance for pacing (scoring
         # constraint — balance across domains within a round, then re-score)
         so_active = ((allowance_l[l] < N) & accept_sorted & scontrib[:, l]
                      & g_ref_soft[sgid, l] & on_dom)
         accept_sorted = accept_sorted & seg_keep(
             so_active, dom_i, jnp.full((N,), allowance_l[l], jnp.int32))
-    # holder↔matcher mutual exclusion: for a holder group l (contrib = pods
-    # HOLDING anti term t) paired with primary group p (contrib = pods
-    # MATCHING t's selector), a holder may not be accepted into a domain
-    # where a matcher is accepted this same round (other than itself): the
-    # holder's own anti rule vs the matcher and the matcher's symmetry rule
-    # vs the holder each kill one of the two sequential orders. Blocked
-    # holders retry next round, where the updated counts separate them.
-    for l in range(L):
-        lp = pair_l[l]
-        has_pair = lp >= 0
-        lp_cl = jnp.clip(lp, 0, L - 1)
-        contrib_p = jnp.take(scontrib, lp_cl, axis=1)                  # [N]
-        dom_i = loc_dom[l, node_cl]
-        dom_cl = jnp.clip(dom_i, 0, D - 1)
-        on_node = (dom_i >= 0) & (snode < M) & accept_sorted
-        acc_p = on_node & contrib_p
-        t_p = jnp.zeros((D,), jnp.int32).at[dom_cl].add(acc_p.astype(jnp.int32))
-        others_p = t_p[dom_cl] - acc_p.astype(jnp.int32)
-        blocked = (has_pair & on_node & scontrib[:, l] & (others_p > 0))
-        accept_sorted = accept_sorted & ~blocked
     return accept_sorted
 
 
