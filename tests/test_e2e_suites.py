@@ -1,0 +1,150 @@
+"""End-to-end suite breadth: in-process equivalents of reference ginkgo
+suites not yet covered by the other scenario files — user/group limits
+(reference test/e2e/user_group_limit) and concurrent Spark-style jobs over a
+hierarchical queue tree (reference test/e2e/spark_jobs_scheduling). Full
+scheduler (real core + real shim + FakeCluster), behavior + no-drift
+invariants.
+"""
+import json
+import time
+
+import pytest
+
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+
+from tests.test_context_storm import assert_no_drift, wait_bound
+
+
+LIMITS_CONF = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        submitacl: "*"
+        queues:
+          - name: limited
+            limits:
+              - users: [alice]
+                maxresources: {vcore: 1}
+          - name: open
+"""
+
+SPARK_CONF = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        submitacl: "*"
+        queues:
+          - name: spark
+            queues:
+              - name: team-a
+                resources:
+                  guaranteed: {vcore: 4}
+              - name: team-b
+                resources:
+                  guaranteed: {vcore: 4}
+"""
+
+
+def user_pod(name, app, queue, user, cpu=400):
+    p = make_pod(name, cpu_milli=cpu, memory=2**26,
+                 labels={"applicationId": app, "queue": queue},
+                 scheduler_name=constants.SCHEDULER_NAME)
+    p.metadata.annotations[constants.ANNOTATION_USER_INFO] = json.dumps(
+        {"user": user, "groups": [f"{user}-group"]})
+    return p
+
+
+def test_user_group_limit_e2e():
+    """A per-user maxresources limit on a queue caps ONE user's footprint
+    while other users keep scheduling (reference user_group_limit suite)."""
+    ms = MockScheduler()
+    ms.init(LIMITS_CONF)
+    try:
+        ms.add_node(make_node("ul-n0", cpu_milli=16000, memory=16 * 2**30))
+        ms.start()
+        # alice may hold at most 1 vcore (1000m) in root.limited → 2 of her
+        # 400m pods fit, the 3rd must stay pending
+        alice = [user_pod(f"al{i}", "alice-app", "root.limited", "alice")
+                 for i in range(3)]
+        ms.add_pods(alice)
+        assert wait_bound(ms, alice, timeout=20, expect=2) == 2
+        time.sleep(1.0)
+        bound_alice = [p for p in alice if ms.get_pod_assignment(p)]
+        assert len(bound_alice) == 2, "alice exceeded her user limit"
+        # bob is not limited: all his pods flow through the same queue
+        bob = [user_pod(f"bo{i}", "bob-app", "root.limited", "bob")
+               for i in range(4)]
+        ms.add_pods(bob)
+        assert wait_bound(ms, bob, timeout=20) == 4
+        # alice's third pod schedules once one of hers finishes
+        ms.succeed_pod(bound_alice[0])
+        pending_alice = [p for p in alice if not ms.get_pod_assignment(p)]
+        assert wait_bound(ms, pending_alice, timeout=20) == 1
+        assert_no_drift(ms)
+    finally:
+        ms.stop()
+
+
+def spark_job(app_id, queue, n_executors):
+    driver = make_pod(f"{app_id}-driver", cpu_milli=500, memory=2**27,
+                      labels={"applicationId": app_id, "queue": queue,
+                              "spark-role": "driver"},
+                      scheduler_name=constants.SCHEDULER_NAME)
+    executors = [
+        make_pod(f"{app_id}-exec-{i}", cpu_milli=250, memory=2**26,
+                 labels={"applicationId": app_id, "queue": queue,
+                         "spark-role": "executor"},
+                 scheduler_name=constants.SCHEDULER_NAME)
+        for i in range(n_executors)
+    ]
+    return driver, executors
+
+
+def test_spark_jobs_scheduling_e2e():
+    """Several concurrent Spark-style jobs (driver + executors per app) over
+    a hierarchical queue tree: every pod of every job binds, drivers are the
+    app originators, and queue accounting survives job completion
+    (reference spark_jobs_scheduling suite)."""
+    ms = MockScheduler()
+    ms.init(SPARK_CONF)
+    try:
+        ms.add_nodes([make_node(f"sp-n{i}", cpu_milli=8000, memory=16 * 2**30)
+                      for i in range(4)])
+        ms.start()
+        jobs = []
+        for j in range(4):
+            queue = "root.spark.team-a" if j % 2 == 0 else "root.spark.team-b"
+            driver, executors = spark_job(f"spark-{j}", queue, 6)
+            # driver submits first (the Spark operator's order), executors
+            # follow while other jobs' pods interleave
+            ms.add_pod(driver)
+            jobs.append((driver, executors))
+        for _, executors in jobs:
+            ms.add_pods(executors)
+        everything = [p for d, ex in jobs for p in [d] + ex]
+        assert wait_bound(ms, everything, timeout=60) == len(everything)
+        # drivers are the originators of their apps
+        for driver, _ in jobs:
+            app = ms.context.get_application(
+                driver.metadata.labels["applicationId"])
+            task = app.get_task(driver.uid)
+            assert task is not None and task.originator
+        # a finished job releases its queue usage
+        d0, ex0 = jobs[0]
+        for p in [d0] + ex0:
+            ms.succeed_pod(p)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            core_app = ms.core.partition.applications.get("spark-0")
+            if core_app is not None and not core_app.allocations:
+                break
+            time.sleep(0.1)
+        core_app = ms.core.partition.applications.get("spark-0")
+        assert core_app is not None and not core_app.allocations
+        assert_no_drift(ms)
+    finally:
+        ms.stop()

@@ -492,9 +492,14 @@ class Context:
                 ]))
 
     def delete_pod(self, pod: Pod) -> None:
-        self._pod_kind_memo.pop(pod.uid, None)
+        # the memo, not a fresh extraction, decides the branch: a label edit
+        # after adoption must not flip a scheduled pod to the foreign path on
+        # delete (the task would never see COMPLETE_TASK and the allocation
+        # would leak)
+        was_yk = self._pod_kind_memo.pop(pod.uid, None)
         self._task_ref_memo.pop(pod.uid, None)
-        if get_task_metadata(pod, self.conf.generate_unique_app_ids) is not None:
+        if was_yk or (was_yk is None and get_task_metadata(
+                pod, self.conf.generate_unique_app_ids) is not None):
             self.schedulers_cache.remove_pod(pod)
             self._notify_task_complete(pod)
         else:

@@ -211,7 +211,9 @@ class SchedulerCache:
                     if info is not None and key in info.pods:
                         info.pods[key] = pod
                     self.pods_map[key] = pod
-                    if pod.status.phase in ("Running", "Succeeded", "Failed"):
+                    if pod.status.phase == "Running":
+                        # terminated phases never reach this fast path
+                        # (is_terminated() guard above)
                         self.assumed_pods.pop(key, None)
                     return True
         if cur is not None:
