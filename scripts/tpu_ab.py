@@ -46,6 +46,35 @@ def main() -> int:
     args = ap.parse_args()
 
     t0 = time.time()
+    # bounded subprocess probes before the in-process dial (bench.py's
+    # hardened dialing): a wedged relay claim costs one bounded attempt, not
+    # an indefinite hang of the A/B run
+    from bench import _probe_backend
+
+    budget = float(os.environ.get("YK_AB_TPU_WAIT", 600))
+    dial_timeout = float(os.environ.get("YK_BENCH_TPU_DIAL_TIMEOUT", 150))
+    platform = None
+    attempt = 0
+    while time.time() - t0 < budget:
+        attempt += 1
+        remaining = budget - (time.time() - t0)
+        platform, n_dev, cause = _probe_backend(
+            max(min(dial_timeout, remaining), 10))
+        if platform == "tpu":
+            break
+        if platform is not None:
+            # a healthy non-TPU backend (the relay down, CPU up) is still a
+            # failed attempt for an A/B that NEEDS the chip — keep retrying
+            # the full budget instead of aborting on the first CPU probe
+            cause = f"backend up but platform={platform}, want tpu"
+            platform = None
+        emit({"phase": "dial", "attempt": attempt, "cause": cause,
+              "elapsed_s": round(time.time() - t0, 1)})
+        time.sleep(5)
+    if platform is None:
+        emit({"phase": "abort", "reason": f"no tpu after {attempt} dials"})
+        return 1
+
     import jax
     import jax.numpy as jnp
     import numpy as np

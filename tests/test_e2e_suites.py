@@ -80,9 +80,10 @@ def test_user_group_limit_e2e():
                for i in range(4)]
         ms.add_pods(bob)
         assert wait_bound(ms, bob, timeout=20) == 4
-        # alice's third pod schedules once one of hers finishes
-        ms.succeed_pod(bound_alice[0])
+        # alice's third pod schedules once one of hers finishes (snapshot the
+        # pending set BEFORE freeing quota — the scheduler races the release)
         pending_alice = [p for p in alice if not ms.get_pod_assignment(p)]
+        ms.succeed_pod(bound_alice[0])
         assert wait_bound(ms, pending_alice, timeout=20) == 1
         assert_no_drift(ms)
     finally:

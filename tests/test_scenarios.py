@@ -128,6 +128,60 @@ def queue_pod(name, app, queue, cpu=200):
     return p
 
 
+def test_pod_updates_racing_recovery():
+    """Pod UPDATE and DELETE events landing while InitializeState is still
+    replaying the pre-existing pod set (reference context_test.go
+    update-during-recovery class): updates for not-yet-replayed pods must not
+    duplicate tasks, deletes must not resurrect, and every surviving pod
+    binds exactly once."""
+    ms = MockScheduler()
+    ms.init("")
+    try:
+        ms.add_node(make_node("ur-n0", cpu_milli=16000, memory=16 * 2**30))
+        pods = [storm_pod(f"ur{i}", app="ur-app", cpu=100) for i in range(120)]
+        for p in pods:
+            ms.cluster.add_pod(p)          # present BEFORE the shim starts
+        ms.start()                          # recovery replays them
+        # immediately race the replay with updates (annotation churn) and
+        # deletes of a slice of the set
+        doomed = pods[::10]
+        for p in pods[1::3]:
+            cur = ms.cluster.get_pod(p.uid)
+            if cur is not None:
+                cur.metadata.annotations["touched"] = "1"
+                ms.cluster.update_pod(cur)
+        for p in doomed:
+            ms.cluster.delete_pod(p.uid)
+        survivors = [p for p in pods if p not in doomed]
+        assert wait_bound(ms, survivors, timeout=40) == len(survivors)
+        time.sleep(0.5)
+        # deleted pods hold no core allocations at quiescence (a doomed pod
+        # may legitimately have bound before its delete landed; the delete
+        # must then have released the allocation — checking the CLUSTER
+        # assignment would be vacuous, the pod object is gone)
+        core_app = ms.core.partition.applications.get("ur-app")
+        doomed_uids = {p.uid for p in doomed}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if core_app is None or not (doomed_uids & set(core_app.allocations)):
+                break
+            time.sleep(0.1)
+        if core_app is not None:
+            leaked = doomed_uids & set(core_app.allocations)
+            assert not leaked, f"deleted pods hold allocations: {leaked}"
+            for key in core_app.pending_asks:
+                assert key not in doomed_uids
+        app = ms.context.get_application("ur-app")
+        live = {p.uid for p in survivors}
+        for task_id in list(getattr(app, "tasks", {})):
+            if task_id not in live:
+                task = app.get_task(task_id)
+                assert task is None or task.is_terminated(), task_id
+        assert_no_drift(ms)
+    finally:
+        ms.stop()
+
+
 def test_config_hot_reload_mid_recovery():
     """A configmap update landing while InitializeState is still replaying
     pre-existing pods: the reload applies without wedging recovery and every

@@ -452,7 +452,7 @@ class Context:
             return
         if pod.is_terminated():
             self.schedulers_cache.update_pod(pod)
-            self._notify_task_complete(pod)
+            self._notify_task_complete(pod, self._task_ref_memo.get(pod.uid))
             return
         self.schedulers_cache.update_pod(pod)
         self._ensure_app_and_task(pod)
@@ -492,16 +492,18 @@ class Context:
                 ]))
 
     def delete_pod(self, pod: Pod) -> None:
-        # the memo, not a fresh extraction, decides the branch: a label edit
-        # after adoption must not flip a scheduled pod to the foreign path on
-        # delete (the task would never see COMPLETE_TASK and the allocation
-        # would leak)
+        # the memo, not a fresh extraction, decides the branch AND supplies
+        # the task identity: a label edit after adoption must not flip a
+        # scheduled pod to the foreign path on delete, and the completion
+        # notification must not depend on re-extracting the (possibly
+        # stripped) labels — either way the task would never see
+        # COMPLETE_TASK and the allocation would leak
         was_yk = self._pod_kind_memo.pop(pod.uid, None)
-        self._task_ref_memo.pop(pod.uid, None)
+        ref = self._task_ref_memo.pop(pod.uid, None)
         if was_yk or (was_yk is None and get_task_metadata(
                 pod, self.conf.generate_unique_app_ids) is not None):
             self.schedulers_cache.remove_pod(pod)
-            self._notify_task_complete(pod)
+            self._notify_task_complete(pod, ref)
         else:
             self.schedulers_cache.remove_pod(pod)
             if self._foreign_sent.pop(pod.uid, None) is not None:
@@ -510,17 +512,21 @@ class Context:
                                       termination_type=TerminationType.STOPPED_BY_RM)
                 ]))
 
-    def _notify_task_complete(self, pod: Pod) -> None:
-        meta = get_task_metadata(pod, self.conf.generate_unique_app_ids)
-        if meta is None:
-            return
-        app = self.get_application(meta.application_id)
+    def _notify_task_complete(self, pod: Pod, ref: Optional[tuple] = None) -> None:
+        if ref is not None:
+            app_id, task_id = ref
+        else:
+            meta = get_task_metadata(pod, self.conf.generate_unique_app_ids)
+            if meta is None:
+                return
+            app_id, task_id = meta.application_id, meta.task_id
+        app = self.get_application(app_id)
         if app is None:
             return
-        task = app.get_task(meta.task_id)
+        task = app.get_task(task_id)
         if task is not None and not task.is_terminated():
             dispatch_mod.dispatch(TaskEventRecord(
-                meta.application_id, meta.task_id, task_mod.COMPLETE_TASK))
+                app_id, task_id, task_mod.COMPLETE_TASK))
 
     # ------------------------------------------------------------- app/task
     def _ensure_app_and_task(self, pod: Pod) -> None:
