@@ -29,39 +29,15 @@ TARGET_PODS_PER_S = 50_000.0  # north star: 50k pods in 1s
 MODE = os.environ.get("YK_BENCH_MODE", "both")
 
 
-# What a dial probe runs: a fresh process dials the backend and reports the
-# platform it got. The PARENT never dials until a probe has succeeded, so a
-# wedged relay claim can only ever cost one bounded probe attempt — never the
-# whole retry budget (the r4 failure: one jax.devices() call blocked 1502 s
-# inside the relay claim and consumed the 600 s budget in a single attempt).
-_PROBE_SRC = (
-    "import jax\n"
-    "ds = jax.devices()\n"
-    "print(ds[0].platform, len(ds), flush=True)\n"
-)
-
-
+# The PARENT never dials until a subprocess probe has succeeded, so a wedged
+# relay claim can only ever cost one bounded probe attempt — never the whole
+# retry budget (the r4 failure: one jax.devices() call blocked 1502 s inside
+# the relay claim and consumed the 600 s budget in a single attempt). The
+# probe itself is shared infrastructure (jaxtools.probe_backend).
 def _probe_backend(timeout: float):
-    """Dial the JAX backend in a subprocess with its own deadline.
+    from yunikorn_tpu.utils.jaxtools import probe_backend
 
-    Returns (platform, n_devices, cause): platform is None when the dial
-    failed, with `cause` a one-line reason for the attempt log."""
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC], capture_output=True,
-            text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return None, 0, f"dial timed out after {timeout:.0f}s (relay claim wedged or queued)"
-    if r.returncode != 0:
-        tail = (r.stderr or r.stdout or "").strip().splitlines()
-        return None, 0, (tail[-1][:300] if tail else f"exit {r.returncode}")
-    try:
-        platform, n = r.stdout.split()[:2]
-        return platform, int(n), "ok"
-    except (ValueError, IndexError):
-        return None, 0, f"unparseable probe output: {r.stdout[:200]!r}"
+    return probe_backend(timeout)
 
 
 def _init_backend_or_die() -> str:

@@ -46,10 +46,9 @@ def main() -> int:
     args = ap.parse_args()
 
     t0 = time.time()
-    # bounded subprocess probes before the in-process dial (bench.py's
-    # hardened dialing): a wedged relay claim costs one bounded attempt, not
-    # an indefinite hang of the A/B run
-    from bench import _probe_backend
+    # bounded subprocess probes before the in-process dial: a wedged relay
+    # claim costs one bounded attempt, not an indefinite hang of the A/B run
+    from yunikorn_tpu.utils.jaxtools import probe_backend as _probe_backend
 
     budget = float(os.environ.get("YK_AB_TPU_WAIT", 600))
     dial_timeout = float(os.environ.get("YK_BENCH_TPU_DIAL_TIMEOUT", 150))

@@ -162,15 +162,18 @@ def test_pod_updates_racing_recovery():
         core_app = ms.core.partition.applications.get("ur-app")
         doomed_uids = {p.uid for p in doomed}
         deadline = time.time() + 10
-        while time.time() < deadline:
-            if core_app is None or not (doomed_uids & set(core_app.allocations)):
+        while core_app is not None and time.time() < deadline:
+            # both allocation release AND ask removal are async — the
+            # deadline must cover both or the assert below flakes
+            if not (doomed_uids & set(core_app.allocations)) and \
+                    not (doomed_uids & set(core_app.pending_asks)):
                 break
             time.sleep(0.1)
         if core_app is not None:
             leaked = doomed_uids & set(core_app.allocations)
             assert not leaked, f"deleted pods hold allocations: {leaked}"
-            for key in core_app.pending_asks:
-                assert key not in doomed_uids
+            asks = doomed_uids & set(core_app.pending_asks)
+            assert not asks, f"deleted pods hold asks: {asks}"
         app = ms.context.get_application("ur-app")
         live = {p.uid for p in survivors}
         for task_id in list(getattr(app, "tasks", {})):

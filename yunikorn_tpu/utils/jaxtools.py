@@ -39,13 +39,68 @@ def force_cpu_platform(n_devices: int = 8) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+# What a dial probe runs: a fresh process dials the backend and reports the
+# platform it got. Probing in a SUBPROCESS matters because a wedged TPU-relay
+# claim BLOCKS jax.devices() indefinitely (observed: a 1502 s hang inside the
+# claim) and cannot be interrupted in-process. Shared by bench.py,
+# scripts/tpu_ab.py, and backend_or_cpu below — one probe, one behavior.
+_PROBE_SRC = (
+    "import jax\n"
+    "ds = jax.devices()\n"
+    "print(ds[0].platform, len(ds), flush=True)\n"
+)
+
+
+def probe_backend(timeout: float):
+    """Dial the JAX backend in a subprocess with its own deadline.
+
+    Returns (platform, n_devices, cause): platform is None when the dial
+    failed, with `cause` a one-line reason for the attempt log."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], capture_output=True,
+            text=True, timeout=timeout)
+    except (subprocess.TimeoutExpired, OSError):
+        return None, 0, (f"dial timed out after {timeout:.0f}s "
+                         "(relay claim wedged or queued)")
+    if r.returncode != 0:
+        tail = (r.stderr or r.stdout or "").strip().splitlines()
+        return None, 0, (tail[-1][:300] if tail else f"exit {r.returncode}")
+    try:
+        platform, n = r.stdout.split()[:2]
+        return platform, int(n), "ok"
+    except (ValueError, IndexError):
+        return None, 0, f"unparseable probe output: {r.stdout[:200]!r}"
+
+
 def backend_or_cpu() -> str:
     """Initialize the default JAX backend; fall back to CPU when the TPU
-    relay is unavailable (UNAVAILABLE after its internal wait). Returns the
-    platform in use. Never kills or times out the init attempt — see the
-    relay-claim semantics in the repo docs."""
+    relay is unavailable. Returns the platform in use.
+
+    When a non-CPU platform might dial the relay, a probe_backend subprocess
+    with its own deadline (YK_BACKEND_PROBE_TIMEOUT, default 120 s) decides
+    whether the in-process dial is safe; on probe failure the process forces
+    CPU without ever dialing."""
     import jax
 
+    platforms = jax.config.jax_platforms or ""
+    if platforms.split(",")[0] != "cpu":
+        import os
+
+        timeout = float(os.environ.get("YK_BACKEND_PROBE_TIMEOUT", 120))
+        platform, _, cause = probe_backend(timeout)
+        if platform is None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "backend probe failed within %.0fs (%s); forcing CPU without "
+                "dialing — solves will run minutes-slow until the TPU "
+                "returns", timeout, cause)
+            jax.config.update("jax_platforms", "cpu")
+            return jax.devices("cpu")[0].platform
     try:
         return jax.devices()[0].platform
     except Exception as e:
