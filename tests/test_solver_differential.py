@@ -259,46 +259,72 @@ def _tightness(pod, node_name, oracle):
 def replay_with_oracle(seed, oracle, placements):
     """placements: [(pod, node_name, accept_round)] — verify a legal
     sequentialization exists that is consistent with the solver's round
-    order. Within a round, pods are placed greedily: scan priority phases
-    and place the first currently-legal pod; a full pass with no progress
-    while pods remain = no legal order = solver made an illegal joint
-    decision."""
+    order. Within a round, pods are placed greedily (most-constrained-first
+    among the currently-legal); when the greedy sticks on a pod, that pod is
+    PROMOTED to highest priority and the round replays — a legal order may
+    require a tight pod to precede same-label contributors that consume its
+    headroom, which no static priority can see. A round fails only when the
+    stuck pod is already promoted (no order places it first either)."""
     all_final = list(oracle.placed) + [(p, n) for p, n, _ in placements]
     by_round = {}
     for pod, node_name, rnd in placements:
         by_round.setdefault(rnd, []).append((pod, node_name))
     trace = []
-    for rnd in sorted(by_round):
-        pending = sorted(
-            by_round[rnd],
-            key=lambda pn: _replay_phase(pn[0], pn[1], oracle, all_final))
+
+    def run_greedy(pending, promoted_rank):
+        """Place all of pending if possible. Returns None on success, or
+        ((pod, node_name), reason) for the pod it stuck on. Mutates
+        oracle/trace. Promoted pods sort strictly before everything else,
+        ordered by promotion recency (most recent first) so the newest
+        promotion really is placed first when legal."""
+        pending = list(pending)
         while pending:
-            # most-constrained-first among the currently-legal: a pod with
-            # little spread headroom must precede plain contributors that
-            # would consume it (a pod can be a plain CONTRIBUTOR for one
-            # locality tuple while constrained on another — ordering is per
-            # state, not per pod class)
             best = None
-            last_reason = None
+            last = None
             for i, (pod, node_name) in enumerate(pending):
                 reason = oracle.check(pod, node_name)
                 if reason is not None:
-                    last_reason = (pod.name, node_name, reason)
+                    last = ((pod, node_name), reason)
                     continue
-                ph = _replay_phase(pod, node_name, oracle, all_final)
-                tight = _tightness(pod, node_name, oracle)
-                key = (ph, tight, i)
+                pr = promoted_rank.get(id(pod))
+                if pr is not None:
+                    key = (-1, pr, i)
+                else:
+                    key = (0, _replay_phase(pod, node_name, oracle, all_final),
+                           _tightness(pod, node_name, oracle), i)
                 if best is None or key < best[0]:
                     best = (key, i, pod, node_name)
             if best is None:
-                raise AssertionError(
-                    f"seed {seed}: round {rnd} has no legal order for "
-                    f"{[p.name for p, _ in pending]}; e.g. {last_reason}; "
-                    f"replay trace: {trace}")
+                return last
             _, i, pod, node_name = best
             oracle.place(pod, node_name)
-            trace.append((rnd, pod.name, node_name))
+            trace.append((pod.name, node_name))
             pending.pop(i)
+        return None
+
+    for rnd in sorted(by_round):
+        round_pods = sorted(
+            by_round[rnd],
+            key=lambda pn: _replay_phase(pn[0], pn[1], oracle, all_final))
+        base_len = len(oracle.placed)
+        base_trace = len(trace)
+        promoted: list = []
+        while True:
+            promoted_rank = {id(p): r for r, (p, _) in enumerate(promoted)}
+            stuck = run_greedy(promoted + [pn for pn in round_pods
+                                          if id(pn[0]) not in promoted_rank],
+                               promoted_rank)
+            if stuck is None:
+                break
+            (pod, node_name), reason = stuck
+            if id(pod) in promoted_rank:
+                raise AssertionError(
+                    f"seed {seed}: round {rnd} has no legal order; stuck on "
+                    f"({pod.name}, {node_name}, {reason}) even when placed "
+                    f"first; replay trace: {trace[base_trace:]}")
+            promoted.insert(0, (pod, node_name))
+            del oracle.placed[base_len:]
+            del trace[base_trace:]
 
 
 def random_loc_pod(rng, i):

@@ -396,18 +396,35 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
         keep2 = (~act2) | (within <= limit_row[order2])
         return jnp.zeros((N,), bool).at[order2].set(keep2)
 
-    # holder↔matcher mutual exclusion FIRST: for a holder group l (contrib =
-    # pods HOLDING anti term t) paired with primary group p (contrib = pods
+    # Removal passes run in a deliberate order, all BEFORE the spread level
+    # fill: the fill's tentative counts must only include accepts that
+    # survive, or a domain's projected minimum could rest on rows a later
+    # pass removes (a spread+anti-holder pod blocked by the pair exclusion
+    # would otherwise still prop up the level other domains were filled
+    # against). Within the removals, the per-domain anti CAP precedes the
+    # holder↔matcher pair EXCLUSION: the cap trims same-domain matchers to
+    # one, so a self-matching holder left alone in a domain survives the
+    # exclusion (others_p == 0). Exclusion-first would let two self-anti
+    # pods contesting one feasible node block EACH OTHER every round — a
+    # livelock the fuzzer hit (both pods feasible only on one green-free
+    # node, neither ever placed).
+    for l in range(L):
+        dom_i = loc_dom[l, node_cl]                                    # [N]
+        on_dom = (dom_i >= 0) & (snode < M)
+
+        # anti-affinity: 1 referencing pod per domain per round
+        an_active = (anti_l[l] & accept_sorted & scontrib[:, l]
+                     & g_ref_anti[sgid, l] & on_dom)
+        accept_sorted = accept_sorted & seg_keep(
+            an_active, dom_i, jnp.ones((N,), jnp.int32))
+
+    # holder↔matcher mutual exclusion: for a holder group l (contrib = pods
+    # HOLDING anti term t) paired with primary group p (contrib = pods
     # MATCHING t's selector), a holder may not be accepted into a domain
     # where a matcher is accepted this same round (other than itself): the
     # holder's own anti rule vs the matcher and the matcher's symmetry rule
     # vs the holder each kill one of the two sequential orders. Blocked
     # holders retry next round, where the updated counts separate them.
-    # Running removal passes BEFORE the spread level fill matters: the fill's
-    # tentative counts must only include accepts that survive, or a domain's
-    # projected minimum could rest on rows a later pass removes (a
-    # spread+anti-holder pod blocked here would otherwise still prop up the
-    # level other domains were filled against).
     for l in range(L):
         lp = pair_l[l]
         has_pair = lp >= 0
@@ -427,24 +444,15 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
         dom_cl = jnp.clip(dom_i, 0, D - 1)
         on_dom = (dom_i >= 0) & (snode < M)
 
-        # anti-affinity: 1 referencing pod per domain per round (before the
-        # spread fill, same reasoning as the pair exclusion above)
-        an_active = (anti_l[l] & accept_sorted & scontrib[:, l]
-                     & g_ref_anti[sgid, l] & on_dom)
-        accept_sorted = accept_sorted & seg_keep(
-            an_active, dom_i, jnp.ones((N,), jnp.int32))
-
-        # affinity seeding: 1 seed-slot pod per locality group per round
+        # affinity seeding: 1 seed-slot pod per locality group per round —
+        # AFTER the pair exclusion, so the single seed slot is never awarded
+        # to a pod the exclusion then removes (which would waste the group's
+        # seeding round while a clean candidate was trimmed)
         seeding = aff_l[l] & (total[l] == 0)
         se_active = (seeding & accept_sorted & scontrib[:, l]
                      & g_ref_seed[sgid, l] & on_dom)
         accept_sorted = accept_sorted & seg_keep(
             se_active, jnp.zeros((N,), jnp.int32), jnp.ones((N,), jnp.int32))
-
-    for l in range(L):
-        dom_i = loc_dom[l, node_cl]                                    # [N]
-        dom_cl = jnp.clip(dom_i, 0, D - 1)
-        on_dom = (dom_i >= 0) & (snode < M)
 
         # hard spread: level fill over the spread-referencing accepts that
         # survived the removal passes above
