@@ -256,6 +256,37 @@ def _tightness(pod, node_name, oracle):
     return tight
 
 
+def _random_order_exists(seed, rnd, oracle, round_pods, trace,
+                         restarts=40):
+    """Last-resort existence search: seeded random restarts of a plain
+    first-legal greedy. Returns True (with oracle/trace advanced) when some
+    order places the whole round."""
+    base_len = len(oracle.placed)
+    base_trace = len(trace)
+    rng = random.Random((seed << 8) ^ rnd)
+    for _ in range(restarts):
+        pending = list(round_pods)
+        rng.shuffle(pending)
+        ok = True
+        while pending:
+            placed_one = False
+            for i, (pod, node_name) in enumerate(pending):
+                if oracle.check(pod, node_name) is None:
+                    oracle.place(pod, node_name)
+                    trace.append((pod.name, node_name))
+                    pending.pop(i)
+                    placed_one = True
+                    break
+            if not placed_one:
+                ok = False
+                break
+        if ok:
+            return True
+        del oracle.placed[base_len:]
+        del trace[base_trace:]
+    return False
+
+
 def replay_with_oracle(seed, oracle, placements):
     """placements: [(pod, node_name, accept_round)] — verify a legal
     sequentialization exists that is consistent with the solver's round
@@ -309,7 +340,10 @@ def replay_with_oracle(seed, oracle, placements):
         base_len = len(oracle.placed)
         base_trace = len(trace)
         promoted: list = []
+        attempts = 0
+        max_attempts = 2 * len(round_pods) + 8
         while True:
+            attempts += 1
             promoted_rank = {id(p): r for r, (p, _) in enumerate(promoted)}
             stuck = run_greedy(promoted + [pn for pn in round_pods
                                           if id(pn[0]) not in promoted_rank],
@@ -317,12 +351,26 @@ def replay_with_oracle(seed, oracle, placements):
             if stuck is None:
                 break
             (pod, node_name), reason = stuck
-            if id(pod) in promoted_rank:
+            if promoted_rank.get(id(pod)) == 0 or attempts > max_attempts:
+                # the promoted-greedy search is exhausted; before declaring
+                # the joint accept illegal, try bounded random restarts — a
+                # legal order may need a specific interleaving of the OTHER
+                # pods (e.g. a min-domain contributor placed before the
+                # stuck pod) that no greedy priority finds
+                del oracle.placed[base_len:]
+                del trace[base_trace:]
+                if _random_order_exists(seed, rnd, oracle, by_round[rnd],
+                                        trace):
+                    break
                 raise AssertionError(
-                    f"seed {seed}: round {rnd} has no legal order; stuck on "
-                    f"({pod.name}, {node_name}, {reason}) even when placed "
-                    f"first; replay trace: {trace[base_trace:]}")
-            promoted.insert(0, (pod, node_name))
+                    f"seed {seed}: round {rnd} has no legal order found; "
+                    f"stuck on ({pod.name}, {node_name}, {reason}) "
+                    f"(promoted-greedy + random restarts); replay trace: "
+                    f"{trace[base_trace:]}")
+            # (re-)promote to the FRONT: a newer promotion may have displaced
+            # this pod from first place and consumed its headroom
+            promoted = ([(pod, node_name)]
+                        + [e for e in promoted if id(e[0]) != id(pod)])
             del oracle.placed[base_len:]
             del trace[base_trace:]
 
@@ -336,9 +384,11 @@ def random_loc_pod(rng, i):
     sel = {"matchLabels": {"app": rng.choice(APPS)}}
     own_sel = {"matchLabels": {"app": app}}
     if r < 0.25:
-        # hard topology spread (usually self-matching — the K8s idiom)
+        # hard topology spread (usually self-matching — the K8s idiom;
+        # hostname topology sometimes — per-node balance, many domains)
         pod.spec.topology_spread_constraints = [TopologySpreadConstraint(
-            max_skew=rng.choice([1, 2]), topology_key="zone",
+            max_skew=rng.choice([1, 2]),
+            topology_key="zone" if rng.random() < 0.8 else HOSTNAME_KEY,
             when_unsatisfiable="DoNotSchedule",
             label_selector=own_sel if rng.random() < 0.8 else sel)]
         if rng.random() < 0.2:
@@ -349,6 +399,13 @@ def random_loc_pod(rng, i):
                 PodAffinityTerm(
                     label_selector=sel,
                     topology_key=rng.choice([HOSTNAME_KEY, "zone"]))])
+    elif r < 0.3:
+        # ScheduleAnyway spread: scoring-only — must never block placement
+        # (the oracle checks hard rules; a soft constraint showing up as a
+        # hard block is exactly the class of encoding bug to catch)
+        pod.spec.topology_spread_constraints = [TopologySpreadConstraint(
+            max_skew=1, topology_key="zone",
+            when_unsatisfiable="ScheduleAnyway", label_selector=own_sel)]
     elif r < 0.45:
         # required anti-affinity; selector may or may not match the pod
         pod.spec.affinity = Affinity(pod_anti_affinity_required=[

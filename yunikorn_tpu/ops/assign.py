@@ -344,7 +344,7 @@ def _loc_capped_flags(loc):
 
 def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
                     spread_l, aff_l, anti_l, min_skew_l, allowance_l,
-                    g_ref_masks, pair_l):
+                    g_ref_masks, pair_l, g_capped):
     """Cap same-round accepts so every round has a legal sequentialization.
 
     Each cap binds only pods whose GROUP references the locality group with a
@@ -382,14 +382,22 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
     node_cl = jnp.clip(snode, 0, M - 1)
     g_ref_spread, g_ref_anti, g_ref_seed, g_ref_soft, g_skew_l = g_ref_masks
 
-    def seg_keep(active, key, limit_row):
-        """Keep mask: within each key segment, at most limit_row active rows
-        (prefix rule in the caller's rank-sorted order)."""
-        order2 = jnp.argsort(jnp.where(active, key, (M + 2) + idx))    # stable
-        k2 = jnp.where(active, key, (M + 2) + idx)[order2]
+    def seg_keep(active, key, limit_row, counted=None):
+        """Keep mask: within each key segment, each ACTIVE row must have its
+        inclusive prefix count of COUNTED rows within limit_row (prefix rule
+        in the caller's rank-sorted order). `counted` defaults to `active`;
+        a wider counted set charges rows the cap does not remove (same-round
+        contributors that are hard-constrained elsewhere and therefore
+        cannot be sequenced after the capped rows) against the budget."""
+        if counted is None:
+            counted = active
+        relevant = active | counted
+        order2 = jnp.argsort(jnp.where(relevant, key, (M + 2) + idx))  # stable
+        k2 = jnp.where(relevant, key, (M + 2) + idx)[order2]
         act2 = active[order2]
+        cnt2 = counted[order2]
         seg_start = jnp.concatenate([jnp.array([True]), k2[1:] != k2[:-1]])
-        c = jnp.cumsum(act2.astype(jnp.int32))
+        c = jnp.cumsum(cnt2.astype(jnp.int32))
         head = lax.cummax(jnp.where(seg_start, idx, 0))
         base = jnp.where(head > 0, c[jnp.maximum(head - 1, 0)], 0)
         within = c - base                                              # inclusive
@@ -412,11 +420,20 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
         dom_i = loc_dom[l, node_cl]                                    # [N]
         on_dom = (dom_i >= 0) & (snode < M)
 
-        # anti-affinity: 1 referencing pod per domain per round
+        # anti-affinity: 1 per domain per round, capping referencing pods.
+        # The budget also COUNTS same-round contributors that carry a hard
+        # constraint of their own (g_capped): such a pod may be pinned early
+        # in any sequentialization by its own rule, so an anti pod accepted
+        # after it in the same domain could be legal in NO order (fuzz
+        # finding: a zone-spread blue and a host-anti pod jointly accepted
+        # onto one node, each individually legal vs round-start counts).
+        counted_anti = (accept_sorted & scontrib[:, l] & on_dom
+                        & (g_ref_anti[sgid, l] | g_capped[sgid]))
         an_active = (anti_l[l] & accept_sorted & scontrib[:, l]
                      & g_ref_anti[sgid, l] & on_dom)
         accept_sorted = accept_sorted & seg_keep(
-            an_active, dom_i, jnp.ones((N,), jnp.int32))
+            an_active, dom_i, jnp.ones((N,), jnp.int32),
+            counted=counted_anti)
 
     # holder↔matcher mutual exclusion: for a holder group l (contrib = pods
     # HOLDING anti term t) paired with primary group p (contrib = pods
@@ -441,24 +458,34 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
 
     for l in range(L):
         dom_i = loc_dom[l, node_cl]                                    # [N]
-        dom_cl = jnp.clip(dom_i, 0, D - 1)
         on_dom = (dom_i >= 0) & (snode < M)
 
         # affinity seeding: 1 seed-slot pod per locality group per round —
-        # AFTER the pair exclusion, so the single seed slot is never awarded
-        # to a pod the exclusion then removes (which would waste the group's
-        # seeding round while a clean candidate was trimmed)
+        # AFTER the pair exclusion (so the single seed slot is never awarded
+        # to a pod the exclusion then removes) and, like every removal, in
+        # its own full pass BEFORE the spread fill loop below (the fill's
+        # projected minimum must only rest on surviving accepts)
         seeding = aff_l[l] & (total[l] == 0)
         se_active = (seeding & accept_sorted & scontrib[:, l]
                      & g_ref_seed[sgid, l] & on_dom)
         accept_sorted = accept_sorted & seg_keep(
             se_active, jnp.zeros((N,), jnp.int32), jnp.ones((N,), jnp.int32))
 
+    for l in range(L):
+        dom_i = loc_dom[l, node_cl]                                    # [N]
+        dom_cl = jnp.clip(dom_i, 0, D - 1)
+        on_dom = (dom_i >= 0) & (snode < M)
+
         # hard spread: level fill over the spread-referencing accepts that
-        # survived the removal passes above
+        # survived the removal passes above. As with the anti cap, the
+        # budget COUNTS same-round contributors that are hard-constrained
+        # anywhere (they may be pinned early in any legal order); plain
+        # contributors still sequentialize last and stay uncounted.
         sp_active = (spread_l[l] & accept_sorted & scontrib[:, l]
                      & g_ref_spread[sgid, l] & on_dom)
-        t = jnp.zeros((D,), jnp.int32).at[dom_cl].add(sp_active.astype(jnp.int32))
+        counted_sp = (spread_l[l] & accept_sorted & scontrib[:, l] & on_dom
+                      & (g_ref_spread[sgid, l] | g_capped[sgid]))
+        t = jnp.zeros((D,), jnp.int32).at[dom_cl].add(counted_sp.astype(jnp.int32))
         cl = cnt[l]
         valid = dom_valid[l]
         skew = jnp.where(min_skew_l[l] < big, min_skew_l[l], 0)
@@ -477,7 +504,8 @@ def _loc_accept_cap(accept_sorted, snode, scontrib, sgid, loc, M, cnt, total,
         limit_row = jnp.maximum(
             skew_row + minc_proj - cl[dom_cl],
             jnp.minimum(a_spread[dom_cl], jnp.int32(2**30 - 1)))
-        accept_sorted = accept_sorted & seg_keep(sp_active, dom_i, limit_row)
+        accept_sorted = accept_sorted & seg_keep(sp_active, dom_i, limit_row,
+                                                 counted=counted_sp)
 
         # ScheduleAnyway spread: per-domain allowance for pacing (scoring
         # constraint — balance across domains within a round, then re-score)
@@ -731,7 +759,7 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
                                             group_id[order], loc, M, cnt, total,
                                             loc_spread_l, loc_aff_l, loc_anti_l,
                                             loc_min_skew_l, allowance_l,
-                                            g_ref_masks, loc[9])
+                                            g_ref_masks, loc[9], g_capped)
         # commit accepted capacity
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
         free_ext = free_ext.at[snode].add(-delta)
