@@ -40,36 +40,79 @@ def _probe_backend(timeout: float):
     return probe_backend(timeout)
 
 
-def _init_backend_or_die() -> str:
+# One OVERALL wall-clock budget for the whole bench process. The r5 failure
+# mode: nine 150 s dial retries consumed the driver's entire window and the
+# process died rc=124 with parsed:null — the dial loop honored only its own
+# budget, not the process's. Now the dial window is derived from the total
+# budget minus a reserve big enough to run the CPU-fallback measurement, so
+# a wedged relay yields a parsed, self-labelled CPU result, never a timeout.
+TOTAL_BUDGET = float(os.environ.get("YK_BENCH_TOTAL_BUDGET", 1500))
+CPU_RESERVE = float(os.environ.get("YK_BENCH_CPU_RESERVE", 600))
+_T_START = time.time()
+_HARD_DEADLINE = _T_START + TOTAL_BUDGET
+
+
+def _cpu_fallback_platform() -> str:
+    """Force CPU before first backend init (the parent never dialed)."""
+    from yunikorn_tpu.utils.jaxtools import force_cpu_platform
+
+    force_cpu_platform(1)
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _downshift_for_cpu_fallback() -> None:
+    """A CPU fallback at the 10k×50k TPU bucket cannot finish inside the
+    reserve; drop to the documented CPU bucket (1k nodes × 10k pods) unless
+    the operator pinned sizes explicitly. The metric string carries both the
+    platform and the sizes, so the result stays self-labelled."""
+    global N_NODES, N_PODS
+    if "YK_BENCH_NODES" not in os.environ:
+        N_NODES = int(os.environ.get("YK_BENCH_CPU_NODES", 1000))
+    if "YK_BENCH_PODS" not in os.environ:
+        N_PODS = int(os.environ.get("YK_BENCH_CPU_PODS", 10000))
+
+
+def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
+                         cpu_fallback=None) -> str:
     """Initialize the JAX backend up front, retrying the TPU relay.
 
     Failure history: r1 died on a raw UNAVAILABLE; r2/r3 fell back to CPU on
     the FIRST exception from jax.devices() and published CPU numbers while
     the chip was reachable minutes later (VERDICT r3 item 1); r4's retry loop
     made exactly one attempt because a single blocking jax.devices() call
-    consumed the whole budget (VERDICT r4 item 2). Hence: every dial happens
-    in a SUBPROCESS with its own deadline (YK_BENCH_TPU_DIAL_TIMEOUT, default
-    150 s); the parent keeps its backend uninitialized until a probe reports
-    a live platform, retries with backoff up to YK_BENCH_TPU_WAIT seconds
-    (default 1800 — the driver round allows ≥30 min), and logs every
-    attempt's cause. Only after the full window does it concede to CPU — and
-    the metric string always carries the platform, so a CPU result can never
-    masquerade as the TPU north star.
+    consumed the whole budget (VERDICT r4 item 2); r5's retries were bounded
+    but their sum consumed the driver window (rc=124, parsed:null). Hence:
+    every dial happens in a SUBPROCESS with its own deadline
+    (YK_BENCH_TPU_DIAL_TIMEOUT, default 150 s); the retry window is the
+    OVERALL budget minus the CPU reserve (YK_BENCH_TPU_WAIT can shrink it
+    further, never extend past the reserve line); and after the window the
+    process concedes to CPU with enough budget left to produce a parsed
+    result — the metric string always carries the platform, so a CPU result
+    can never masquerade as the TPU north star.
+
+    probe_fn/clock/sleep/cpu_fallback are injectable for the wedged-relay
+    regression test (a fake dialer must drive this loop without a relay).
     """
+    if probe_fn is None:
+        probe_fn = _probe_backend
+    if cpu_fallback is None:
+        cpu_fallback = _cpu_fallback_platform
     if os.environ.get("YK_BENCH_FORCE_CPU"):
         # explicit CPU run (local testing): beat the axon plugin before any
-        # backend init — the env var alone cannot (plugin overrides it)
-        from yunikorn_tpu.utils.jaxtools import force_cpu_platform
-
-        force_cpu_platform(1)
-        import jax
-
-        return jax.devices()[0].platform
+        # backend init — the env var alone cannot (plugin overrides it).
+        # Same bucket downshift as every other CPU outcome (explicit sizes
+        # are honored): the TPU bucket cannot finish on CPU in the budget.
+        _downshift_for_cpu_fallback()
+        return cpu_fallback()
 
     import threading
 
-    t0 = time.time()
-    budget = float(os.environ.get("YK_BENCH_TPU_WAIT", 1800))
+    t0 = clock()
+    budget = max(TOTAL_BUDGET - CPU_RESERVE, 60.0)
+    if "YK_BENCH_TPU_WAIT" in os.environ:
+        budget = min(budget, float(os.environ["YK_BENCH_TPU_WAIT"]))
     dial_timeout = float(os.environ.get("YK_BENCH_TPU_DIAL_TIMEOUT", 150))
     attempt = 0
     backoff = 5.0
@@ -77,16 +120,18 @@ def _init_backend_or_die() -> str:
     devs = None
     while True:
         attempt += 1
-        remaining = budget - (time.time() - t0)
-        left = max(remaining, 30.0) if remaining > 0 else 0.0
-        if left <= 0:
+        remaining = budget - (clock() - t0)
+        if remaining <= 0:
             break
-        t_a = time.time()
-        platform, n, cause = _probe_backend(min(dial_timeout, left))
+        # the last attempt may not stretch past the budget: a wedged probe
+        # consumes min(dial_timeout, remaining), so the retries' SUM stays
+        # inside the window and the CPU reserve survives (r5 regression)
+        t_a = clock()
+        platform, n, cause = probe_fn(min(dial_timeout, remaining))
         if platform is not None:
             probed = (platform, n)
             print(f"# bench: dial attempt {attempt} ok in "
-                  f"{time.time() - t_a:.1f}s: {n}x {platform}",
+                  f"{clock() - t_a:.1f}s: {n}x {platform}",
                   file=sys.stderr, flush=True)
             # The probe just held and released a relay claim, so the parent's
             # own dial is expected to be fast — but it can still wedge (another
@@ -126,24 +171,22 @@ def _init_backend_or_die() -> str:
                 break
         else:
             print(f"# bench: dial attempt {attempt} failed after "
-                  f"{time.time() - t_a:.1f}s ({time.time() - t0:.0f}s total): "
+                  f"{clock() - t_a:.1f}s ({clock() - t0:.0f}s total): "
                   f"{cause}", file=sys.stderr, flush=True)
-        if time.time() - t0 >= budget:
+        if clock() - t0 >= budget:
             break
-        time.sleep(min(backoff, max(budget - (time.time() - t0), 1.0)))
+        sleep(min(backoff, max(budget - (clock() - t0), 1.0)))
         backoff = min(backoff * 2, 60.0)
     if probed is None or devs is None:
-        print(f"# bench: TPU retry budget ({budget:.0f}s) exhausted after "
-              f"{attempt} dial attempts; falling back to CPU (labeled)",
+        print(f"# bench: TPU dial window ({budget:.0f}s of the "
+              f"{TOTAL_BUDGET:.0f}s total budget) exhausted after {attempt} "
+              f"dial attempts; falling back to CPU (labeled)",
               file=sys.stderr, flush=True)
+        _downshift_for_cpu_fallback()
         try:
             # the parent never dialed, so its backend is still unset: force
             # CPU before first init rather than unwinding a failed TPU claim
-            from yunikorn_tpu.utils.jaxtools import force_cpu_platform
-
-            force_cpu_platform(1)
-            import jax
-            devs = jax.devices()
+            return cpu_fallback()
         except Exception as e2:  # no backend at all: one diagnostic JSON line
             print(json.dumps({
                 "metric": "backend-unavailable",
@@ -151,13 +194,18 @@ def _init_backend_or_die() -> str:
                 "unit": "pods/s",
                 "vs_baseline": 0.0,
                 "error": f"{type(e2).__name__}: {e2}"[:400],
-                "init_secs": round(time.time() - t0, 1),
+                "init_secs": round(clock() - t0, 1),
             }))
             sys.exit(1)
     platform = devs[0].platform
-    print(f"# bench: backend up in {time.time() - t0:.1f}s "
+    print(f"# bench: backend up in {clock() - t0:.1f}s "
           f"({attempt} dial attempts): {len(devs)}x {platform} ({devs[0]})",
           file=sys.stderr, flush=True)
+    if platform == "cpu":
+        # a dial that SUCCEEDS on a CPU backend (no relay configured) must
+        # take the same bucket downshift as the exhausted-window fallback:
+        # the 10k×50k TPU bucket cannot finish on CPU inside the budget
+        _downshift_for_cpu_fallback()
     return platform
 
 
@@ -192,6 +240,39 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
     try:
         for node in make_kwok_nodes(shim_nodes):
             ms.cluster.add_node(node)
+        # Prewarm the intermediate pod buckets the streaming waves will hit
+        # (the production deployment does this with --prewarm): informer
+        # waves land at arbitrary bucket sizes, and an unwarmed bucket pays
+        # jit trace+compile INSIDE the measured bind window (observed: a 4 s
+        # first-wave stall at the 4096 bucket). In "both" mode the core
+        # phase already warmed the 512 and top buckets, so only the middle
+        # ones are compiled here. Skipped when the overall budget is nearly
+        # spent — a late CPU fallback still publishes a parsed result.
+        if (os.environ.get("YK_BENCH_SHIM_PREWARM", "1") != "0"
+                and _HARD_DEADLINE - time.time() > 180):
+            from yunikorn_tpu.utils.jaxtools import prewarm_buckets
+
+            cap = 1 << max(shim_pods - 1, 511).bit_length()
+            buckets, b = [], 512
+            while b <= cap:
+                buckets.append(b)
+                b *= 2
+            if MODE == "both":
+                buckets = buckets[1:-1]  # core phase warmed the ends
+            if buckets:
+                t_pw = time.time()
+                t = prewarm_buckets(",".join(f"{shim_nodes}x{b}"
+                                             for b in buckets), core=ms.core)
+                # bounded join: a wedged compile must not consume the whole
+                # budget — the thread is a daemon, the measurement proceeds
+                # (merely unwarmed) and the result still parses
+                t.join(timeout=max(_HARD_DEADLINE - time.time() - 120, 1.0))
+                state = "timed out; continuing unwarmed" if t.is_alive() \
+                    else "done"
+                print(f"# shim bucket prewarm "
+                      f"({','.join(str(b) for b in buckets)} pods) {state} "
+                      f"after {time.time() - t_pw:.1f}s",
+                      file=sys.stderr, flush=True)
         pods = []
         for q in range(n_queues):
             pods.extend(make_sleep_pods(
@@ -203,7 +284,10 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
             ms.cluster.add_pod(p)
         t_start = time.time()
         ms.start()
-        deadline = t_start + float(os.environ.get("YK_BENCH_SHIM_TIMEOUT", 1800))
+        # clamped to the overall budget (minus teardown margin): a slow shim
+        # run publishes a partial, labelled count instead of dying rc=124
+        deadline = min(t_start + float(os.environ.get("YK_BENCH_SHIM_TIMEOUT", 1800)),
+                       _HARD_DEADLINE - 30)
         stats = ms.cluster.get_client().bind_stats
         while time.time() < deadline:
             if stats.success_count >= len(pods):

@@ -1,0 +1,169 @@
+"""Differential test: the incremental encoder (dirty-node sync + the
+device-resident NodeArrays mirror) must stay bit-identical to a cold full
+re-encode across node add/remove, schedulable flips, pod churn, and vocab
+growth — the invariant the pipelined cycle's O(changes) encode rests on.
+
+The cold reference shares the live encoder's Vocabs (all symbols are already
+interned, so lookups resolve to the same bits); rows are compared by node
+NAME because the two encoders may assign different row indices.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import Taint, make_node, make_pod
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.ops.assign import solve_batch
+from yunikorn_tpu.snapshot.encoder import DeviceNodeState, SnapshotEncoder
+
+ROW_ARRAYS = ("free", "capacity_arr", "labels", "taints_hard", "taints_soft",
+              "ports", "schedulable", "valid")
+
+
+def _rows_by_name(enc):
+    out = {}
+    for name, idx in enc.nodes._name_to_idx.items():
+        out[name] = {a: np.array(getattr(enc.nodes, a)[idx])
+                     for a in ROW_ARRAYS}
+    return out
+
+
+def _assert_bit_identical(live, cache, seed, rnd):
+    cold = SnapshotEncoder(cache, vocabs=live.vocabs)
+    cold.sync_nodes(full=True)
+    # carry the DRAIN/READY overrides — core state, not cache state
+    for name, sched in live._unschedulable_overrides.items():
+        cold.set_node_schedulable(name, sched)
+    a, b = _rows_by_name(live), _rows_by_name(cold)
+    assert set(a) == set(b), (seed, rnd, set(a) ^ set(b))
+    for name in a:
+        for arr in ROW_ARRAYS:
+            av, bv = a[name][arr], b[name][arr]
+            # the live encoder's row may be wider (stale padding beyond the
+            # cold one never holds set bits for live symbols)
+            w = min(av.shape[0], bv.shape[0]) if av.ndim else None
+            if av.ndim == 0:
+                assert av == bv, (seed, rnd, name, arr)
+            else:
+                assert (av[:w] == bv[:w]).all(), (seed, rnd, name, arr)
+                assert not av[w:].any() and not bv[w:].any(), \
+                    (seed, rnd, name, arr)
+    return cold
+
+
+def _assert_device_mirror(enc, seed, rnd):
+    dev = enc.device_arrays()
+    host = DeviceNodeState(enc.nodes)._host_views()
+    for k, v in host.items():
+        got = np.asarray(dev[k])
+        assert got.shape == v.shape, (seed, rnd, k)
+        assert (got == v).all(), (seed, rnd, k)
+
+
+def _random_event(rng, cache, enc, nodes, pods, i):
+    r = rng.random()
+    if r < 0.25 or not nodes:
+        # node add — sometimes with fresh label/taint symbols (vocab growth)
+        labels = {"zone": rng.choice(["z0", "z1", "z2"])}
+        if rng.random() < 0.3:
+            labels[f"grow-{i}"] = f"v{i}"
+        node = make_node(f"inc-n{i}", cpu_milli=rng.choice([2000, 4000]),
+                         memory=8 * 2**30, labels=labels)
+        if rng.random() < 0.3:
+            node.spec.taints = [Taint(key=f"tk{i % 5}", value="x",
+                                      effect="NoSchedule")]
+        cache.update_node(node)
+        nodes.append(node)
+    elif r < 0.4:
+        # schedulable flip through the core-facing API
+        node = rng.choice(nodes)
+        enc.set_node_schedulable(node.name, rng.random() < 0.5)
+    elif r < 0.55 and len(nodes) > 2:
+        node = nodes.pop(rng.randrange(len(nodes)))
+        cache.remove_node(node.name)
+        pods[:] = [p for p in pods if p.spec.node_name != node.name]
+    elif r < 0.8:
+        # pod churn: assigned pod lands (free-row refresh path)
+        node = rng.choice(nodes)
+        pod = make_pod(f"inc-p{i}", cpu_milli=rng.choice([100, 300, 700]),
+                       memory=2**20, node_name=node.name, phase="Running")
+        if rng.random() < 0.2:
+            pod.spec.containers[0].ports = [
+                {"hostPort": 9000 + rng.randint(0, 3), "protocol": "TCP"}]
+        cache.update_pod(pod)
+        pods.append(pod)
+    elif pods:
+        pod = pods.pop(rng.randrange(len(pods)))
+        cache.remove_pod(pod)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_incremental_encoder_matches_cold_reencode(seed):
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    enc = SnapshotEncoder(cache)
+    nodes, pods = [], []
+    for rnd in range(6):
+        for i in range(rng.randint(2, 8)):
+            _random_event(rng, cache, enc, nodes, pods, rnd * 100 + i)
+        enc.sync_nodes()   # incremental: only dirty nodes re-encode
+        _assert_bit_identical(enc, cache, seed, rnd)
+        _assert_device_mirror(enc, seed, rnd)
+
+
+def test_incremental_and_cold_solve_identically():
+    rng = random.Random(99)
+    cache = SchedulerCache()
+    enc = SnapshotEncoder(cache)
+    nodes, pods = [], []
+    for i in range(24):
+        _random_event(rng, cache, enc, nodes, pods, i)
+    enc.sync_nodes()
+    cold = _assert_bit_identical(enc, cache, 99, -1)
+    ask_pods = [make_pod(f"solve-p{i}", cpu_milli=300, memory=2**20)
+                for i in range(12)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p)
+            for p in ask_pods]
+    res_live = solve_batch(enc.build_batch(asks), enc.nodes,
+                           device_state=enc.device_arrays())
+    res_cold = solve_batch(cold.build_batch(asks), cold.nodes)
+    a_live = np.asarray(res_live.assigned)[: len(asks)]
+    a_cold = np.asarray(res_cold.assigned)[: len(asks)]
+    names_live = [enc.nodes.name_of(int(i)) if i >= 0 else None for i in a_live]
+    names_cold = [cold.nodes.name_of(int(i)) if i >= 0 else None for i in a_cold]
+    assert names_live == names_cold
+
+
+def test_device_mirror_refresh_modes():
+    """Clean cycles reuse the buffers outright; pod churn re-uploads only
+    the free/ports arrays (never the wide symbol bitsets); shape growth
+    re-uploads everything — the transfer-cost contract of the pipelined
+    cycle."""
+    cache = SchedulerCache()
+    enc = SnapshotEncoder(cache)
+    for i in range(4):
+        cache.update_node(make_node(f"m{i}", cpu_milli=2000, memory=2**30))
+    enc.sync_nodes()
+    enc.device_arrays()
+    assert enc.device.last_refresh == "full"
+    enc.device_arrays()
+    assert enc.device.last_refresh == "clean"
+    pod = make_pod("mp0", cpu_milli=500, memory=2**20, node_name="m0",
+                   phase="Running")
+    cache.update_pod(pod)
+    enc.sync_nodes()
+    enc.device_arrays()
+    assert enc.device.last_refresh == "fields"
+    assert enc.device.last_fields == ("free_i", "ports")
+    _assert_device_mirror(enc, 0, 0)
+    # capacity growth (row count doubles past the 128-row floor) changes the
+    # array shapes -> full re-upload, still bit-identical
+    for i in range(130):
+        cache.update_node(make_node(f"grow-{i}", cpu_milli=1000, memory=2**30))
+    enc.sync_nodes()
+    enc.device_arrays()
+    assert enc.device.last_refresh == "full"
+    _assert_device_mirror(enc, 0, 1)

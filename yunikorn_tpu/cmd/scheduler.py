@@ -44,7 +44,10 @@ def main(argv=None) -> int:
                         help="compile standard solve buckets at startup in "
                              "the background, e.g. '1024x4096,16384x65536' "
                              "(nodes x pods); removes the first-cycle XLA "
-                             "compile stall (persistent cache fills too)")
+                             "compile stall (persistent cache fills too). "
+                             "Covers the resolved runtime variant: policy x "
+                             "mesh x pallas gate x the pipelined cycle's "
+                             "persistent device-resident node buffers")
     args = parser.parse_args(argv)
 
     ensure_compilation_cache()

@@ -56,6 +56,7 @@ CM_SOLVER_DEVICE_PLATFORM = PREFIX_SOLVER + "platform"
 CM_SOLVER_USE_PALLAS = PREFIX_SOLVER + "usePallas"     # auto | true | false
 CM_SOLVER_SHARD = PREFIX_SOLVER + "shardSolve"         # auto | true | false
 CM_SOLVER_FALLBACK_ROUNDS = PREFIX_SOLVER + "localityFallbackRounds"
+CM_SOLVER_PIPELINE = PREFIX_SOLVER + "pipeline"         # auto | true | false
 
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
@@ -110,6 +111,9 @@ class SchedulerConf:
     # intra-cycle drain rounds for locality groups that overflow the tensor
     # encoding (0 disables: one pod per group per cycle, round-2 behavior)
     solver_fallback_rounds: int = 16
+    # two-stage pipelined cycle: overlap host encode/commit/publish with the
+    # async device solve ("auto" = on; single-partition mode only)
+    solver_pipeline: str = "auto"
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -223,7 +227,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
         conf.solver_fallback_rounds = _parse_int(
             data[CM_SOLVER_FALLBACK_ROUNDS], conf.solver_fallback_rounds)
     for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
-                      (CM_SOLVER_SHARD, "solver_shard")):
+                      (CM_SOLVER_SHARD, "solver_shard"),
+                      (CM_SOLVER_PIPELINE, "solver_pipeline")):
         if key in data:
             v = data[key].strip().lower()
             if v in ("auto", "true", "false"):

@@ -110,6 +110,11 @@ class SolverOptions:
     # fastest warm path (r4: chunking at 8192 cost 5.4× warm for zero CPU
     # compile saving)
     max_batch: int = 65536
+    # two-stage pipelined cycle (solver.pipeline): overlap the host
+    # encode/commit/publish with the async device solve. Tri-state: None =
+    # "auto" = on; the pipeline engages only in single-partition mode and
+    # falls back to the sequential cycle otherwise.
+    pipeline: Optional[bool] = None
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -128,7 +133,29 @@ class SolverOptions:
             shard=tri.get(conf.solver_shard, None),
             fallback_rounds=max(int(conf.solver_fallback_rounds), 0),
             max_batch=max_batch,
+            pipeline=tri.get(getattr(conf, "solver_pipeline", "auto"), None),
         )
+
+
+@dataclasses.dataclass
+class _PipelineCycle:
+    """One in-flight pipelined cycle: the prepared batch, its async solve
+    handle, and the stage timestamps the finish stage turns into metrics."""
+    cycle_id: int
+    admitted: List
+    ranks: List[int]
+    batch: object
+    extra_fp: tuple            # in-flight placements baked into the encode
+    encode_cached: bool
+    overlapped: bool           # encode ran while a solve was in flight
+    t_prepare_start: float = 0.0
+    t_gate: float = 0.0
+    t_encode_end: float = 0.0
+    t_dispatched: float = 0.0
+    policy: str = "binpacking"
+    result: Optional[object] = None
+    # row→name mapping snapshotted at dispatch (commit-time remap guard)
+    node_names: Optional[Dict[int, str]] = None
 
 
 class CoreScheduler(SchedulerAPI):
@@ -186,6 +213,20 @@ class CoreScheduler(SchedulerAPI):
         self._wake = threading.Condition()
         self._dirty = False
         self._thread: Optional[threading.Thread] = None
+        # ---- pipelined cycle state (see _pipeline_tick) ----
+        # serializes pipeline ticks against direct schedule_once() callers
+        self._pipeline_mu = threading.Lock()
+        self._pipeline_inflight: Optional[_PipelineCycle] = None
+        # asks admitted into the in-flight batch: excluded from the next
+        # gate (their commit is pending) and counted against quota as
+        # in-cycle admissions (conservative — exactly what the sequential
+        # order would have charged)
+        self._inflight_ask_keys: set = set()
+        self._inflight_gate_seed: List[tuple] = []  # (queue, res, user, groups)
+        self._cycle_seq = 0
+        # stage-event trace for tests / the bench smoke: (event, cycle_id, t0, t1)
+        import collections
+        self._pipeline_trace = collections.deque(maxlen=256)
         # metrics (Prometheus-counter analogs, reference perf test samples
         # yunikorn_scheduler_container_allocation_attempt_total; last_cycle
         # holds the most recent cycle's per-stage timing breakdown)
@@ -594,6 +635,10 @@ class CoreScheduler(SchedulerAPI):
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        # drain any still-in-flight cycle: its allocations must commit and
+        # publish before the dispatcher/shim shut down behind us
+        with self._pipeline_mu:
+            self._drain_pipeline()
 
     def trigger(self) -> None:
         with self._wake:
@@ -603,19 +648,26 @@ class CoreScheduler(SchedulerAPI):
     def _run_loop(self) -> None:
         while self._running.is_set():
             with self._wake:
-                if not self._dirty:
+                if not self._dirty and self._pipeline_inflight is None:
                     self._wake.wait(timeout=self._interval)
                 self._dirty = False
             try:
-                # adaptive accumulation: while asks are still streaming in
-                # from the FSM pipeline, give them a tick to land so one
-                # cycle solves one big batch instead of many fragment waves
-                # (each wave pays full encode+solve overhead). Bounded: at
-                # most ~10 intervals (cap 0.5s), stops the moment the
-                # arrival counter goes quiet, and skipped entirely on idle
-                # cycles (no asks since the last cycle) so node/config wakes
-                # pay no extra latency.
-                if self._ask_seq != self._seq_at_cycle:
+                # adaptive accumulation (SEQUENTIAL mode only): while asks
+                # are still streaming in from the FSM pipeline, give them a
+                # tick to land so one cycle solves one big batch instead of
+                # many fragment waves (each wave pays full encode+solve
+                # overhead). Bounded: at most ~10 intervals (cap 0.5s),
+                # stops the moment the arrival counter goes quiet, and
+                # skipped entirely on idle cycles. The PIPELINED cycle skips
+                # it altogether: its overlap IS the accumulation window —
+                # asks arriving during cycle N's solve+publish form cycle
+                # N+1's wave, and gluing the whole burst into one giant
+                # batch would serialize solve → commit → publish with
+                # nothing left to overlap (measured: a single 5k-pod wave
+                # binds STRICTLY later than three pipelined waves).
+                if (self._ask_seq != self._seq_at_cycle
+                        and not self._pipeline_enabled()
+                        and self._pipeline_inflight is None):
                     deadline = time.time() + min(0.5, 10 * self._interval)
                     prev = -1
                     while self._running.is_set() and time.time() < deadline:
@@ -625,23 +677,39 @@ class CoreScheduler(SchedulerAPI):
                         prev = cur
                         time.sleep(min(self._interval / 2, 0.02))
                 self._seq_at_cycle = self._ask_seq
-                self.schedule_once()
+                if self._pipeline_enabled():
+                    self._pipeline_tick()
+                else:
+                    self.schedule_once()
             except Exception:
                 logger.exception("scheduling cycle failed")
 
+    def _pipeline_enabled(self) -> bool:
+        """The two-stage pipeline engages for the single-partition case (the
+        production shape); multi-partition cycles run sequentially. A cycle
+        already in flight is always drained regardless (schedule_once drains
+        before cycling)."""
+        so = self.solver
+        on = True if so.pipeline is None else so.pipeline
+        return on and len(self.partitions) == 1
+
     def schedule_once(self) -> int:
-        """One full scheduling cycle over every partition."""
+        """One full SEQUENTIAL scheduling cycle over every partition (the
+        pipelined driver lives in _pipeline_tick; a pipelined cycle still in
+        flight is finished first so direct callers observe its results)."""
         total = 0
         payloads = []
-        with self._lock:
-            multi = len(self.partitions) > 1
-            for pname in list(self.partitions):
-                if getattr(self.partitions[pname], "draining", False):
-                    continue  # removed from config; no new scheduling
-                self._use_partition(pname)
-                n, payload = self._schedule_partition(restrict_nodes=multi)
-                total += n
-                payloads.append(payload)
+        with self._pipeline_mu:
+            self._drain_pipeline()
+            with self._lock:
+                multi = len(self.partitions) > 1
+                for pname in list(self.partitions):
+                    if getattr(self.partitions[pname], "draining", False):
+                        continue  # removed from config; no new scheduling
+                    self._use_partition(pname)
+                    n, payload = self._schedule_partition(restrict_nodes=multi)
+                    total += n
+                    payloads.append(payload)
         for payload in payloads:
             self._publish_cycle(payload)
         return total
@@ -706,9 +774,205 @@ class CoreScheduler(SchedulerAPI):
                 mask[idx] = True
         return mask
 
+    def _inflight_placements(self) -> Optional[List[Tuple[object, str]]]:
+        """[(pod, node)] for committed-but-not-yet-assumed allocations —
+        the locality-count analog of the free/ports overlays (extra_placed
+        input of the encoder)."""
+        if not self._inflight:
+            return None
+        out = []
+        for infl in self._inflight.values():
+            pod = self.cache.get_pod(infl.allocation_key)
+            if pod is not None:
+                out.append((pod, infl.node_id))
+        return out or None
+
+    def _policy_for_partition(self) -> str:
+        return (self._policy if self._policy_forced or
+                self.partition.name == "default"
+                else self._partition_policy.get(self.partition.name, self._policy))
+
+    def _dispatch_solve(self, batch, policy, overlay, node_mask,
+                        inflight_ports):
+        """Route one batch to the resolved solve path (sharded or single),
+        threading the persistent device-resident node tensors through so the
+        chunk-invariant node state transfers O(changes), not O(M), per cycle.
+        The returned SolveResult is an ASYNC handle — materializing
+        `.assigned` is the device sync point."""
+        so = self.solver
+        use_mesh = (self._mesh is not None
+                    and self.encoder.nodes.capacity % self._mesh.devices.size == 0)
+        device_state = None
+        try:
+            device_state = self.encoder.device_arrays(
+                mesh=self._mesh if use_mesh else None)
+        except Exception:
+            logger.exception("device node-state refresh failed; "
+                             "falling back to per-cycle upload")
+        if use_mesh:
+            from yunikorn_tpu.parallel.mesh import solve_sharded
+
+            return solve_sharded(batch, self.encoder.nodes, self._mesh,
+                                 max_rounds=so.max_rounds, chunk=so.chunk,
+                                 policy=policy, free_delta=overlay,
+                                 node_mask=node_mask,
+                                 ports_delta=inflight_ports,
+                                 max_batch=so.max_batch,
+                                 device_state=device_state)
+        return solve_batch(batch, self.encoder.nodes, policy=policy,
+                           max_rounds=so.max_rounds, chunk=so.chunk,
+                           use_pallas=self._use_pallas,
+                           free_delta=overlay, node_mask=node_mask,
+                           ports_delta=inflight_ports,
+                           max_batch=so.max_batch,
+                           device_state=device_state)
+
+    def _ask_pending(self, ask) -> bool:
+        app = self.partition.applications.get(ask.application_id)
+        return app is not None and ask.allocation_key in app.pending_asks
+
+    def _commit_solve(self, admitted, batch, assigned, policy, node_mask,
+                      node_names=None):
+        """Commit one materialized solve (core lock held): allocation
+        records, batched queue accounting, locality-fallback drain. Returns
+        (new_allocs, skipped_keys, unplaced_asks, fallback_keys, fb_rounds).
+
+        Asks that stopped being pending between encode and commit (released,
+        placeholder-replaced or pinned mid-flight — pipelined cycles only;
+        sequentially the whole cycle holds the lock) are dropped: their rows
+        were invalidated at dispatch, and a stale placement must not commit
+        over a consumed ask.
+
+        node_names: the dispatch-time row→name snapshot (pipelined cycles).
+        A row remapped mid-flight (node removed, row reused by a NEW node)
+        must not receive the placement — the solve validated a different
+        node's capacity/labels; the ask stays pending and retries next
+        cycle. Sequential cycles hold the lock across solve+commit, so they
+        pass None and use the live mapping."""
+        new_allocs: List[Allocation] = []
+        skipped_keys: List[Tuple[str, str]] = []
+        unplaced_asks: List = []
+        fallback_keys: List[str] = []
+        fb_rounds = 0
+        # commit with batched queue accounting: one ancestor walk per
+        # leaf, not per allocation (matters at 50k allocations/cycle)
+        # plain dict-of-int accumulators: Resource.add per alloc
+        # costs a dict copy each — at 50k allocs that is measurable
+        leaf_totals: Dict[str, Dict[str, int]] = {}
+        # qname -> (user, groups-tuple) -> accumulator
+        user_totals: Dict[str, Dict[Tuple[str, tuple], Dict[str, int]]] = {}
+        limits_exist = self.queues.any_limits()
+        # asks parked by locality-fallback serialization: drained in
+        # intra-cycle rounds below instead of waiting a cycle per pod
+        deferred_set = set(batch.deferred) if self.solver.fallback_rounds > 0 else set()
+        fallback_placed: List[Tuple[object, str]] = []
+        for i, ask in enumerate(admitted):
+            if not self._ask_pending(ask):
+                continue  # consumed mid-flight; row was invalidated
+            idx = int(assigned[i])
+            if idx < 0:
+                if i in deferred_set:
+                    continue  # retried below, same cycle
+                skipped_keys.append((ask.application_id, ask.allocation_key))
+                unplaced_asks.append(ask)
+                continue
+            node_name = self.encoder.nodes.name_of(idx)
+            if node_names is not None and node_names.get(idx) != node_name:
+                # row remapped since dispatch: what the solve placed on no
+                # longer exists at this index — leave the ask pending
+                continue
+            if node_name is None:
+                continue
+            alloc = Allocation(
+                allocation_key=ask.allocation_key,
+                application_id=ask.application_id,
+                node_id=node_name,
+                resource=ask.resource,
+                priority=ask.priority,
+                placeholder=ask.placeholder,
+                task_group_name=ask.task_group_name,
+                tags=dict(ask.tags),
+            )
+            app = self._commit_allocation(alloc, credit_queue=False)
+            _acc_resource(leaf_totals.setdefault(app.queue_name, {}),
+                          alloc.resource)
+            if limits_exist:
+                _acc_resource(
+                    user_totals.setdefault(app.queue_name, {}).setdefault(
+                        (app.user.user, tuple(app.user.groups)), {}),
+                    alloc.resource)
+            if deferred_set and ask.pod is not None:
+                fallback_placed.append((ask.pod, node_name))
+            new_allocs.append(alloc)
+        for qname, total in leaf_totals.items():
+            leaf = self.queues.resolve(qname, create=False)
+            if leaf is not None:
+                leaf.add_allocated(Resource(total))
+                if limits_exist and leaf.has_limits_in_chain():
+                    for (user, groups), ut in user_totals.get(qname, {}).items():
+                        leaf.add_user_allocated(user, Resource(ut), list(groups))
+        if batch.locality is not None and batch.locality.fallback:
+            self.metrics["locality_fallback_groups_total"] = (
+                self.metrics.get("locality_fallback_groups_total", 0)
+                + len(batch.locality.fallback))
+        if deferred_set:
+            self.metrics["locality_fallback_deferred_total"] = (
+                self.metrics.get("locality_fallback_deferred_total", 0)
+                + len(deferred_set))
+            remaining = [admitted[i] for i in sorted(deferred_set)
+                         if self._ask_pending(admitted[i])]
+            drained, still_blocked, fb_rounds = self._drain_locality_fallback(
+                remaining, fallback_placed, node_mask, policy)
+            new_allocs.extend(drained)
+            fallback_keys.extend(a.allocation_key for a in drained)
+            for ask in still_blocked:
+                skipped_keys.append((ask.application_id, ask.allocation_key))
+                unplaced_asks.append(ask)
+        return new_allocs, skipped_keys, unplaced_asks, fallback_keys, fb_rounds
+
+    def _plan_preemption(self, unplaced_asks) -> List[AllocationRelease]:
+        """Preemption planning for unplaced high-priority asks (lock held)."""
+        preempt_releases: List[AllocationRelease] = []
+        if not (self._preemption_enabled and unplaced_asks):
+            return preempt_releases
+        from yunikorn_tpu.core.preemption import plan_preemptions
+
+        now = time.time()
+        cooldown = 30.0
+        self._preempted_for = {
+            k: ts for k, ts in self._preempted_for.items() if now - ts < cooldown
+        }
+        eligible = [a for a in unplaced_asks
+                    if a.allocation_key not in self._preempted_for]
+        app_of_pod = {
+            key: app.application_id
+            for app in self.partition.applications.values()
+            for key in app.allocations
+        }
+        # the same overlay the solver used, grouped per node
+        inflight_by_node: Dict[str, Resource] = {}
+        for alloc in self._inflight.values():
+            cur = inflight_by_node.get(alloc.node_id)
+            inflight_by_node[alloc.node_id] = (
+                alloc.resource if cur is None else cur.add(alloc.resource))
+        plans, attempted = plan_preemptions(
+            self.cache, eligible, app_of_pod, inflight_by_node)
+        for key in attempted:
+            # cooldown failed attempts too: an unplaceable ask must not
+            # rescan the cluster every cycle
+            self._preempted_for[key] = now
+        for plan in plans:
+            for rel in plan.releases(app_of_pod):
+                confirmed = self._release_allocation(rel)
+                if confirmed is not None:
+                    preempt_releases.append(confirmed)
+        self.metrics["preempted_total"] = (
+            self.metrics.get("preempted_total", 0) + len(preempt_releases))
+        return preempt_releases
+
     def _schedule_partition(self, restrict_nodes: bool = False) -> Tuple[int, tuple]:
-        """One cycle for the ACTIVE partition (core lock held); returns
-        (allocation count, publish payload for _publish_cycle)."""
+        """One SEQUENTIAL cycle for the ACTIVE partition (core lock held);
+        returns (allocation count, publish payload for _publish_cycle)."""
         t0 = time.time()
         self._check_app_completion()
         self._check_placeholder_timeouts()
@@ -733,111 +997,23 @@ class CoreScheduler(SchedulerAPI):
             # locality counts must see in-flight allocations (committed last
             # cycle, assume not yet landed in the cache) — the locality-count
             # analog of the free/ports overlays above
-            inflight_placed = None
-            if self._inflight:
-                inflight_placed = []
-                for infl in self._inflight.values():
-                    pod = self.cache.get_pod(infl.allocation_key)
-                    if pod is not None:
-                        inflight_placed.append((pod, infl.node_id))
-            batch = self.encoder.build_batch(admitted, ranks=ranks,
-                                             extra_placed=inflight_placed)
+            inflight_placed = self._inflight_placements()
+            batch = self.encoder.build_batch_cached(admitted, ranks=ranks,
+                                                    extra_placed=inflight_placed)
             t_encode = time.time()
-            policy = (self._policy if self._policy_forced or
-                      self.partition.name == "default"
-                      else self._partition_policy.get(self.partition.name, self._policy))
+            policy = self._policy_for_partition()
             self._resolve_solver_runtime()
-            so = self.solver
-            if (self._mesh is not None
-                    and self.encoder.nodes.capacity % self._mesh.devices.size == 0):
-                from yunikorn_tpu.parallel.mesh import solve_sharded
-
-                result = solve_sharded(batch, self.encoder.nodes, self._mesh,
-                                       max_rounds=so.max_rounds, chunk=so.chunk,
-                                       policy=policy, free_delta=overlay,
-                                       node_mask=node_mask,
-                                       ports_delta=inflight_ports,
-                                       max_batch=so.max_batch)
-            else:
-                result = solve_batch(batch, self.encoder.nodes, policy=policy,
-                                     max_rounds=so.max_rounds, chunk=so.chunk,
-                                     use_pallas=self._use_pallas,
-                                     free_delta=overlay, node_mask=node_mask,
-                                     ports_delta=inflight_ports,
-                                     max_batch=so.max_batch)
+            result = self._dispatch_solve(batch, policy, overlay, node_mask,
+                                          inflight_ports)
             import numpy as np
 
             # materializing the result is the device sync point: everything
             # up to here was async dispatch
             assigned = np.asarray(result.assigned)[: batch.num_pods]
             t_solve = time.time()
-            # commit with batched queue accounting: one ancestor walk per
-            # leaf, not per allocation (matters at 50k allocations/cycle)
-            # plain dict-of-int accumulators: Resource.add per alloc
-            # costs a dict copy each — at 50k allocs that is measurable
-            leaf_totals: Dict[str, Dict[str, int]] = {}
-            # qname -> (user, groups-tuple) -> accumulator
-            user_totals: Dict[str, Dict[Tuple[str, tuple], Dict[str, int]]] = {}
-            limits_exist = self.queues.any_limits()
-            # asks parked by locality-fallback serialization: drained in
-            # intra-cycle rounds below instead of waiting a cycle per pod
-            deferred_set = set(batch.deferred) if self.solver.fallback_rounds > 0 else set()
-            fallback_placed: List[Tuple[object, str]] = []
-            for i, ask in enumerate(admitted):
-                idx = int(assigned[i])
-                if idx < 0:
-                    if i in deferred_set:
-                        continue  # retried below, same cycle
-                    skipped_keys.append((ask.application_id, ask.allocation_key))
-                    unplaced_asks.append(ask)
-                    continue
-                node_name = self.encoder.nodes.name_of(idx)
-                if node_name is None:
-                    continue
-                alloc = Allocation(
-                    allocation_key=ask.allocation_key,
-                    application_id=ask.application_id,
-                    node_id=node_name,
-                    resource=ask.resource,
-                    priority=ask.priority,
-                    placeholder=ask.placeholder,
-                    task_group_name=ask.task_group_name,
-                    tags=dict(ask.tags),
-                )
-                app = self._commit_allocation(alloc, credit_queue=False)
-                _acc_resource(leaf_totals.setdefault(app.queue_name, {}),
-                              alloc.resource)
-                if limits_exist:
-                    _acc_resource(
-                        user_totals.setdefault(app.queue_name, {}).setdefault(
-                            (app.user.user, tuple(app.user.groups)), {}),
-                        alloc.resource)
-                if deferred_set and ask.pod is not None:
-                    fallback_placed.append((ask.pod, node_name))
-                new_allocs.append(alloc)
-            for qname, total in leaf_totals.items():
-                leaf = self.queues.resolve(qname, create=False)
-                if leaf is not None:
-                    leaf.add_allocated(Resource(total))
-                    if limits_exist and leaf.has_limits_in_chain():
-                        for (user, groups), ut in user_totals.get(qname, {}).items():
-                            leaf.add_user_allocated(user, Resource(ut), list(groups))
-            if batch.locality is not None and batch.locality.fallback:
-                self.metrics["locality_fallback_groups_total"] = (
-                    self.metrics.get("locality_fallback_groups_total", 0)
-                    + len(batch.locality.fallback))
-            if deferred_set:
-                self.metrics["locality_fallback_deferred_total"] = (
-                    self.metrics.get("locality_fallback_deferred_total", 0)
-                    + len(deferred_set))
-                drained, still_blocked, fb_rounds = self._drain_locality_fallback(
-                    [admitted[i] for i in sorted(deferred_set)],
-                    fallback_placed, node_mask, policy)
-                new_allocs.extend(drained)
-                fallback_keys.extend(a.allocation_key for a in drained)
-                for ask in still_blocked:
-                    skipped_keys.append((ask.application_id, ask.allocation_key))
-                    unplaced_asks.append(ask)
+            (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
+             fb_rounds) = self._commit_solve(admitted, batch, assigned,
+                                             policy, node_mask)
         self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
         self.metrics["allocation_attempt_failed"] += len(skipped_keys)
         self.metrics["solve_count"] += 1
@@ -845,41 +1021,7 @@ class CoreScheduler(SchedulerAPI):
         t_commit = time.time()
 
         # preemption: try to make room for unplaced high-priority asks
-        preempt_releases: List[AllocationRelease] = []
-        if self._preemption_enabled and unplaced_asks:
-            from yunikorn_tpu.core.preemption import plan_preemptions
-
-            now = time.time()
-            cooldown = 30.0
-            self._preempted_for = {
-                k: ts for k, ts in self._preempted_for.items() if now - ts < cooldown
-            }
-            eligible = [a for a in unplaced_asks
-                        if a.allocation_key not in self._preempted_for]
-            app_of_pod = {
-                key: app.application_id
-                for app in self.partition.applications.values()
-                for key in app.allocations
-            }
-            # the same overlay the solver used, grouped per node
-            inflight_by_node: Dict[str, Resource] = {}
-            for alloc in self._inflight.values():
-                cur = inflight_by_node.get(alloc.node_id)
-                inflight_by_node[alloc.node_id] = (
-                    alloc.resource if cur is None else cur.add(alloc.resource))
-            plans, attempted = plan_preemptions(
-                self.cache, eligible, app_of_pod, inflight_by_node)
-            for key in attempted:
-                # cooldown failed attempts too: an unplaceable ask must not
-                # rescan the cluster every cycle
-                self._preempted_for[key] = now
-            for plan in plans:
-                for rel in plan.releases(app_of_pod):
-                    confirmed = self._release_allocation(rel)
-                    if confirmed is not None:
-                        preempt_releases.append(confirmed)
-            self.metrics["preempted_total"] = (
-                self.metrics.get("preempted_total", 0) + len(preempt_releases))
+        preempt_releases = self._plan_preemption(unplaced_asks)
 
         # the publish payload is delivered by schedule_once AFTER the core
         # lock is released (callbacks may re-enter the core from other
@@ -900,6 +1042,8 @@ class CoreScheduler(SchedulerAPI):
                 "commit_ms": round((t_commit - t_solve) * 1000, 2),
                 "post_ms": round((end - t_commit) * 1000, 2),
                 "total_ms": round((end - t0) * 1000, 2),
+                "pipelined": 0,
+                "encode_cached": int(self.encoder.last_encode_cached),
             }
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -913,6 +1057,236 @@ class CoreScheduler(SchedulerAPI):
             }
         return len(new_allocs), (pinned, replaced, new_allocs,
                                  preempt_releases, skipped_keys, fallback_keys)
+
+    # ------------------------------------------------------ pipelined cycle
+    # Two-stage pipeline over the same stage functions the sequential cycle
+    # uses. Tick k (single scheduler thread):
+    #
+    #   prepare(k):   gate + encode of the NEXT batch — runs while solve k-1
+    #                 is still in flight on the device (the expensive host
+    #                 encode hides under the device solve)
+    #   finish(k-1):  materialize (the single block_until_ready point) +
+    #                 commit + preemption planning
+    #   housekeeping: completion / placeholder timeouts / replacement /
+    #                 pinned asks — at their sequential position (after the
+    #                 previous commit, before the next dispatch)
+    #   dispatch(k):  replay allocations committed since prepare(k) as a
+    #                 delta (refresh_batch + the free/ports overlays),
+    #                 invalidate consumed rows, async-dispatch the solve
+    #   publish(k-1): RM-callback traffic (assume → bind drain) delivered
+    #                 after dispatch(k), overlapping solve k's device
+    #                 execution on this same thread
+    #
+    # Result-equivalence with the sequential cycle: the batch's pod/group
+    # tensors are placement-invariant, and every placement-dependent input
+    # (free capacity, ports, locality counts, fallback masks, DRA
+    # serialization) is recomputed at dispatch time — i.e. strictly after
+    # commit k-1, exactly the state the sequential cycle would have solved
+    # against. The gate runs early with the in-flight batch charged against
+    # quota (conservative: an over-held ask is re-admitted next cycle).
+
+    def _pipeline_tick(self) -> int:
+        with self._pipeline_mu:
+            prep = self._pipeline_prepare()
+            prev, self._pipeline_inflight = self._pipeline_inflight, None
+            finished, n_prev = None, 0
+            if prev is not None:
+                finished, n_prev = self._pipeline_finish(prev)
+            extra = None
+            try:
+                extra = self._pipeline_housekeeping()
+                if prep is not None:
+                    self._pipeline_dispatch(prep)
+                    self._pipeline_inflight = prep
+            finally:
+                # publish AFTER the next solve is dispatched: the assume/
+                # bind drain then runs while the device (or XLA's native
+                # thread pool, which holds no GIL) executes solve k — still
+                # on the scheduler thread. A separate publisher thread was
+                # measured strictly worse here: the drain is Python-heavy,
+                # so it fought the next cycle's encode for the GIL (2.1 s
+                # encodes at 5k pods) instead of overlapping anything.
+                # try/finally: cycle k-1 is already COMMITTED — a
+                # housekeeping/dispatch error must not swallow its RM
+                # callbacks, or the shim would never assume/bind those pods
+                # (a failed dispatch leaves prep's asks pending; the next
+                # gate re-admits them).
+                if finished is not None:
+                    self._publish_cycle(finished)
+                if extra is not None:
+                    self._publish_cycle(extra)
+            return n_prev
+
+    def _drain_pipeline(self) -> None:
+        """Finish a still-in-flight cycle (pipeline mutex held)."""
+        prev, self._pipeline_inflight = self._pipeline_inflight, None
+        if prev is None:
+            return
+        finished, _ = self._pipeline_finish(prev)
+        if finished is not None:
+            self._publish_cycle(finished)
+
+    def _pipeline_prepare(self) -> Optional["_PipelineCycle"]:
+        """Gate + encode the next batch (overlaps the in-flight solve)."""
+        t0 = time.time()
+        with self._lock:
+            self._use_partition("default")
+            if getattr(self.partition, "draining", False):
+                return None
+            admitted, ranks, held = self._collect_and_gate(
+                exclude_keys=self._inflight_ask_keys or None,
+                seed_admissions=self._inflight_gate_seed or None)
+            if not admitted:
+                return None
+            t_gate = time.time()
+            inflight_placed = self._inflight_placements()
+            self.encoder.sync_nodes()
+            batch = self.encoder.build_batch_cached(
+                admitted, ranks=ranks, extra_placed=inflight_placed)
+            self._cycle_seq += 1
+            cyc = _PipelineCycle(
+                cycle_id=self._cycle_seq, admitted=admitted, ranks=ranks,
+                batch=batch,
+                extra_fp=self.encoder.placed_fingerprint(inflight_placed),
+                encode_cached=self.encoder.last_encode_cached,
+                overlapped=self._pipeline_inflight is not None,
+                t_prepare_start=t0, t_gate=t_gate, t_encode_end=time.time())
+            self._pipeline_trace.append(
+                ("encode", cyc.cycle_id, t0, cyc.t_encode_end))
+            return cyc
+
+    def _pipeline_housekeeping(self) -> Optional[tuple]:
+        """Commit-sensitive host work at its sequential position (post
+        previous commit, pre next dispatch). Asks it consumes that are rows
+        in the prepared batch are invalidated at dispatch via the
+        pending-check, so nothing double-allocates."""
+        with self._lock:
+            self._use_partition("default")
+            self._check_app_completion()
+            self._check_placeholder_timeouts()
+            replaced = self._replace_placeholders()
+            pinned = self._allocate_required_node_asks()
+            if replaced.new:
+                self.metrics["allocation_attempt_allocated"] = (
+                    self.metrics.get("allocation_attempt_allocated", 0)
+                    + len(replaced.new))
+        if pinned or replaced.new or replaced.released:
+            return (pinned, replaced, [], [], [], [])
+        return None
+
+    def _pipeline_dispatch(self, cyc: "_PipelineCycle") -> None:
+        """Async-dispatch the prepared batch against post-commit state."""
+        with self._lock:
+            self._use_partition("default")
+            batch = cyc.batch
+            # delta replay: allocations committed while this batch was being
+            # encoded (previous cycle's commit, housekeeping) must reach the
+            # placement-dependent state — locality counts, fallback masks,
+            # DRA serialization (the free/ports overlays below carry the
+            # capacity side)
+            placed_now = self._inflight_placements()
+            if (batch.placement_dependent
+                    and self.encoder.placed_fingerprint(placed_now) != cyc.extra_fp):
+                batch = self.encoder.refresh_batch(batch, cyc.admitted,
+                                                   extra_placed=placed_now)
+            # rows whose asks were consumed mid-encode (released, placeholder
+            # replaced, pinned) leave the solve entirely
+            dead = [i for i, ask in enumerate(cyc.admitted)
+                    if not self._ask_pending(ask)]
+            if dead:
+                valid = batch.valid.copy()
+                for i in dead:
+                    valid[i] = False
+                batch = dataclasses.replace(batch, valid=valid)
+            cyc.batch = batch
+            # same ordering invariant as the sequential cycle: overlay BEFORE
+            # sync (conservative, never over-committing)
+            overlay = self._inflight_overlay()
+            inflight_ports = self._inflight_ports()
+            self.encoder.sync_nodes()
+            cyc.policy = self._policy_for_partition()
+            self._resolve_solver_runtime_locked()
+            cyc.result = self._dispatch_solve(batch, cyc.policy, overlay,
+                                              None, inflight_ports)
+            # row→name snapshot for the commit: a row remapped while the
+            # solve is in flight must not receive its placement
+            cyc.node_names = dict(self.encoder.nodes._idx_to_name)
+            cyc.t_dispatched = time.time()
+            self._pipeline_trace.append(
+                ("dispatch", cyc.cycle_id, cyc.t_dispatched, cyc.t_dispatched))
+            # mark the batch in flight: the next gate excludes these asks and
+            # charges them against quota as in-cycle admissions
+            self._inflight_ask_keys = {a.allocation_key for a in cyc.admitted}
+            seed = []
+            for ask in cyc.admitted:
+                app = self.partition.applications.get(ask.application_id)
+                if app is not None:
+                    seed.append((app.queue_name, ask.resource,
+                                 app.user.user, tuple(app.user.groups)))
+            self._inflight_gate_seed = seed
+
+    def _pipeline_finish(self, cyc: "_PipelineCycle") -> Tuple[Optional[tuple], int]:
+        """Materialize + commit one in-flight cycle; returns (payload, n)."""
+        import numpy as np
+
+        batch = cyc.batch
+        t_mat0 = time.time()
+        # the device sync point — deliberately OUTSIDE the core lock so
+        # informer/API threads are never stalled on device latency
+        assigned = np.asarray(cyc.result.assigned)[: batch.num_pods]
+        t_mat1 = time.time()
+        self._pipeline_trace.append(("materialize", cyc.cycle_id, t_mat0, t_mat1))
+        with self._lock:
+            self._use_partition("default")
+            self._inflight_ask_keys = set()
+            self._inflight_gate_seed = []
+            (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
+             fb_rounds) = self._commit_solve(cyc.admitted, batch, assigned,
+                                             cyc.policy, None,
+                                             node_names=cyc.node_names)
+            self.metrics["allocation_attempt_allocated"] += len(new_allocs)
+            self.metrics["allocation_attempt_failed"] += len(skipped_keys)
+            self.metrics["solve_count"] += 1
+            self.metrics["solve_time_ms_total"] += int(
+                (time.time() - cyc.t_prepare_start) * 1000)
+            t_commit = time.time()
+            preempt_releases = self._plan_preemption(unplaced_asks)
+            end = time.time()
+            solve_ms = (t_mat1 - cyc.t_dispatched) * 1000
+            # host time between dispatch and materialization = the next
+            # cycle's gate+encode (+ publish drain) hidden under this solve
+            overlap_ms = max((t_mat0 - cyc.t_dispatched) * 1000, 0.0)
+            entry = {
+                "at": round(end, 3),
+                "pods": len(cyc.admitted),
+                "gate_ms": round((cyc.t_gate - cyc.t_prepare_start) * 1000, 2),
+                "encode_ms": round((cyc.t_encode_end - cyc.t_gate) * 1000, 2),
+                "solve_ms": round(solve_ms, 2),
+                "commit_ms": round((t_commit - t_mat1) * 1000, 2),
+                "post_ms": round((end - t_commit) * 1000, 2),
+                "total_ms": round((end - cyc.t_prepare_start) * 1000, 2),
+                "pipelined": 1,
+                "encode_cached": int(cyc.encode_cached),
+                "overlap_ms": round(overlap_ms, 2),
+                "overlap_ratio": round(overlap_ms / max(solve_ms, 1e-6), 3),
+            }
+            if fb_rounds:
+                entry["fallback_rounds"] = fb_rounds
+                entry["fallback_placed"] = len(fallback_keys)
+            self.metrics["last_cycle"] = {
+                **(self.metrics.get("last_cycle") or {}),
+                self.partition.name: entry,
+            }
+            self.metrics["pipeline_cycles_total"] = (
+                self.metrics.get("pipeline_cycles_total", 0) + 1)
+            self.metrics["pipeline_overlap_ratio"] = entry["overlap_ratio"]
+            self.metrics["pipeline_overlap_ms"] = entry["overlap_ms"]
+            self.metrics["pipeline_encode_ms"] = entry["encode_ms"]
+            self.metrics["pipeline_solve_ms"] = entry["solve_ms"]
+            self.metrics["pipeline_commit_ms"] = entry["commit_ms"]
+        payload = ([], AllocationResponse(), new_allocs, preempt_releases,
+                   skipped_keys, fallback_keys)
+        return payload, len(new_allocs)
 
     def _publish_cycle(self, payload) -> None:
         """Deliver one partition cycle's RM-callback traffic (lock NOT held)."""
@@ -992,12 +1366,17 @@ class CoreScheduler(SchedulerAPI):
             inflight_ports = self._inflight_ports()
             self.encoder.sync_nodes()
             batch = self.encoder.build_batch(remaining, extra_placed=placements)
+            # device-resident node tensors only off the mesh path: the drain
+            # always solves single-device, and refreshing the shared mirror
+            # with a different sharding would thrash the main cycle's buffers
+            ds = (self.encoder.device_arrays(mesh=None)
+                  if self._mesh is None else None)
             result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                  max_rounds=so.max_rounds, chunk=so.chunk,
                                  use_pallas=self._use_pallas,
                                  free_delta=overlay, node_mask=node_mask,
                                  ports_delta=inflight_ports,
-                                 max_batch=so.max_batch)
+                                 max_batch=so.max_batch, device_state=ds)
             assigned = np.asarray(result.assigned)[: batch.num_pods]
             progress = False
             next_remaining: List = []
@@ -1178,12 +1557,19 @@ class CoreScheduler(SchedulerAPI):
                 overlay[idx, : row.shape[0]] += row
         return overlay
 
-    def _collect_and_gate(self):
+    def _collect_and_gate(self, exclude_keys=None, seed_admissions=None):
         """Collect pending asks, enforce quotas, produce the global rank order.
 
         Ordering: queues by DRF dominant share ascending (fair share), then
         priority descending, then app submit time, then ask sequence (FIFO) —
         replicating the core's fair/fifo sort policies.
+
+        exclude_keys: allocation keys to skip entirely — the pipelined gate
+        runs while the previous batch is still in flight, and those asks'
+        commits are pending. seed_admissions: [(queue, resource, user,
+        groups)] of the in-flight batch, charged against quota/user limits as
+        in-cycle admissions — conservatively reproducing the queue usage the
+        sequential order would have committed before this gate.
         """
         cluster_cap = self._cluster_capacity()
 
@@ -1192,6 +1578,8 @@ class CoreScheduler(SchedulerAPI):
             if app.state not in (APP_ACCEPTED, APP_RUNNING, APP_RESUMING):
                 continue
             for ask in app.pending_asks.values():
+                if exclude_keys is not None and ask.allocation_key in exclude_keys:
+                    continue
                 by_queue.setdefault(app.queue_name, []).append((app, ask))
 
         queue_shares = []
@@ -1215,6 +1603,19 @@ class CoreScheduler(SchedulerAPI):
         # "<queue>|u|<user>" / "<queue>|g|<group>"), so sibling leaves under a
         # limited parent are jointly capped
         limit_cycle_extra: Dict[str, Resource] = {}
+        if seed_admissions:
+            any_limits = self.queues.any_limits()
+            for qname, res, user, groups in seed_admissions:
+                leaf = self.queues.resolve(qname, create=False)
+                if leaf is None:
+                    continue
+                for q in leaf.ancestors_and_self():
+                    if q.config.max_resource is not None:
+                        cycle_extra[q.full_name] = cycle_extra.get(
+                            q.full_name, Resource()).add(res)
+                if any_limits and leaf.has_limits_in_chain():
+                    leaf.record_cycle_admission(user, list(groups), res,
+                                                limit_cycle_extra)
         for _neg_prio, share, qname in queue_shares:
             leaf = self.queues.resolve(qname, create=False)
             entries = by_queue[qname]

@@ -1034,7 +1034,7 @@ def pad2d(arr, width, fill):
 
 
 def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
-                       ports_delta=None):
+                       ports_delta=None, device_state=None):
     """Assemble the positional numpy args + static kwargs for `solve`.
 
     Shared by solve_batch (single device) and parallel.mesh.solve_sharded
@@ -1048,10 +1048,45 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
     partition's solve sees only its own).
     ports_delta: optional [capacity, Wp] u32 port mask OR-ed into node port
     occupancy (in-flight allocations' host ports — see _inflight_ports).
+    device_state: optional dict of persistent device-resident node tensors
+    (SnapshotEncoder.device_arrays, refreshed to match node_arrays): the
+    chunk-invariant node-side inputs then transfer O(changed rows) per cycle
+    instead of O(M), with the overlays applied as (async-dispatched) device
+    ops. Batches requesting host ports bypass it — the synthetic port
+    columns reshape free/capacity per batch.
     """
     import numpy as np
 
     na = node_arrays
+    g_ports_u32 = batch.g_ports.view(np.uint32)
+    use_device = device_state is not None and not g_ports_u32.any()
+    req_i = batch.req.astype(np.int32)
+    score_cols = req_i.shape[1]
+    if use_device:
+        import jax.numpy as jnp
+
+        dev = device_state
+        free_i = dev["free_i"]
+        M, R = free_i.shape
+        if free_delta is not None:
+            d = np.zeros((M, R), np.int32)
+            rows, cols = min(M, free_delta.shape[0]), min(R, free_delta.shape[1])
+            d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
+            free_i = free_i - jnp.asarray(d)
+        cap_i = dev["cap_i"]
+        node_ports_u32 = dev["ports"]
+        if ports_delta is not None:
+            pd = np.zeros(node_ports_u32.shape, np.uint32)
+            rows = min(pd.shape[0], ports_delta.shape[0])
+            cols = min(pd.shape[1], ports_delta.shape[1])
+            pd[:rows, :cols] = ports_delta[:rows, :cols]
+            node_ports_u32 = node_ports_u32 | jnp.asarray(pd)
+        node_ok = dev["node_ok"]
+        if node_mask is not None:
+            node_ok = node_ok & jnp.asarray(node_mask[:M])
+        return _finish_solve_args(batch, req_i, score_cols, dev["labels"],
+                                  dev["taints_hard"], dev["taints_soft"],
+                                  node_ports_u32, node_ok, free_i, cap_i, na)
     free_i = np.floor(na.free).astype(np.int32)
     if free_delta is not None:
         # overlay may be narrower/shorter than the (possibly grown) node arrays
@@ -1061,8 +1096,6 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
         d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
         free_i = free_i - d
     cap_i = np.floor(na.capacity_arr).astype(np.int32)
-    req_i = batch.req.astype(np.int32)
-    score_cols = req_i.shape[1]
     # node port occupancy = cache-visible pods + in-flight allocations (an
     # allocation committed last cycle whose assume hasn't landed holds its
     # ports just as firmly)
@@ -1079,7 +1112,6 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
     # these columns two batch pods wanting one port could share a node.
     # Bucketed column count (next power of two, min 4) bounds the number of
     # compiled shape variants.
-    g_ports_u32 = batch.g_ports.view(np.uint32)
     if g_ports_u32.any():
         union = np.bitwise_or.reduce(g_ports_u32, axis=0)      # [Wp]
         port_bits = [(w, b) for w in range(union.shape[0])
@@ -1106,6 +1138,20 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
     node_ok = na.valid & na.schedulable
     if node_mask is not None:
         node_ok = node_ok & node_mask[: node_ok.shape[0]]
+    return _finish_solve_args(batch, req_i, score_cols,
+                              na.labels.view(np.uint32),
+                              na.taints_hard.view(np.uint32),
+                              na.taints_soft.view(np.uint32),
+                              node_ports_u32, node_ok, free_i, cap_i, na)
+
+
+def _finish_solve_args(batch, req_i, score_cols, labels, taints_hard,
+                       taints_soft, node_ports, node_ok, free_i, cap_i, na):
+    """Common tail of prepare_solve_args: pod/group args + static kwargs.
+    Node-side inputs may be host numpy or persistent device arrays — the two
+    variants produce identical avals, so they share one compiled program."""
+    import numpy as np
+
     host_mask = batch.g_host_mask
     if host_mask is not None:
         host_mask = pad2d(host_mask, na.capacity, False)
@@ -1133,10 +1179,10 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
         batch.g_pref_req.view(np.uint32),
         batch.g_pref_forb.view(np.uint32),
         batch.g_pref_weight,
-        na.labels.view(np.uint32),
-        na.taints_hard.view(np.uint32),
-        na.taints_soft.view(np.uint32),
-        node_ports_u32,
+        labels,
+        taints_hard,
+        taints_soft,
+        node_ports,
         node_ok,
         free_i,
         cap_i,
@@ -1161,10 +1207,14 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
                 device=None, node_mask=None, ports_delta=None,
-                compile_only=False, max_batch=MAX_SOLVE_PODS) -> Optional[SolveResult]:
+                compile_only=False, max_batch=MAX_SOLVE_PODS,
+                device_state=None) -> Optional[SolveResult]:
     """Convenience host wrapper: numpy in → SolveResult out.
 
-    See prepare_solve_args for free_delta / node_mask semantics.
+    See prepare_solve_args for free_delta / node_mask / device_state
+    semantics (device_state = persistent device-resident node tensors; the
+    pipelined core threads them through so node state transfers once per
+    change, not once per cycle).
     compile_only: AOT-lower and compile this shape/static-variant without
     executing (bucket prewarm) — fills the jit + persistent caches at zero
     device time; returns None.
@@ -1174,7 +1224,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     """
     np_args, static_kwargs = prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
-        ports_delta=ports_delta)
+        ports_delta=ports_delta, device_state=device_state)
     solve_kwargs = dict(
         max_rounds=max_rounds,
         chunk=chunk,

@@ -52,6 +52,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                   free_delta=None, node_mask=None, ports_delta=None,
                   compile_only: bool = False,
                   max_batch: int = assign_mod.MAX_SOLVE_PODS,
+                  device_state=None,
                   ) -> Optional[assign_mod.SolveResult]:
     """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
 
@@ -71,9 +72,14 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     node_s, node_s2, repl = _shardings(mesh)
     group_node_s = NamedSharding(mesh, P(None, NODE_AXIS))
 
+    # device_state: persistent node tensors already committed with this
+    # mesh's shardings (SnapshotEncoder.device_arrays(mesh=...)); device_put
+    # below then recognizes the matching sharding and skips the transfer, so
+    # chunk-invariant node state moves across the ICI once per change, not
+    # once per cycle.
     np_args, static_kwargs = assign_mod.prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
-        ports_delta=ports_delta)
+        ports_delta=ports_delta, device_state=device_state)
 
     N = np_args[0].shape[0]
     mb = 1 << (max(int(max_batch), 64).bit_length() - 1)

@@ -5,6 +5,16 @@ Role-equivalent to pkg/shim/scheduler.go: struct :46-54, NewShimScheduler
 placeholder manager → informers → register RM → initialize state → scheduling
 pump — schedule() :175-189 (per tick: drive every app's Schedule(), remove
 Failed apps whose tasks all terminated :178-182), registerShimLayer :137-172.
+
+Commit/bind drain vs the pipelined core: the core delivers cycle N's
+AllocationResponses (assume → TASK_ALLOCATED → dispatcher → bind pool)
+AFTER dispatching cycle N+1's solve, so the drain runs while the device (or
+XLA's native thread pool) executes the next solve — off the critical path
+without a second Python thread contending for the GIL. The shutdown
+ordering that keeps this safe is the one every caller already uses
+(cmd/scheduler.py, MockScheduler.stop): stop the CORE first — it drains any
+in-flight pipelined cycle — then stop the shim, so no callback ever lands
+in a stopped dispatcher.
 """
 from __future__ import annotations
 

@@ -131,7 +131,8 @@ def prewarm_buckets(spec: str, results: "list | None" = None,
 
     core: the production CoreScheduler, when available — prewarm then
     compiles the VARIANT production will run (conf-driven max_rounds/chunk,
-    sharded over the resolved mesh, pallas gate) instead of solve_batch
+    sharded over the resolved mesh, pallas gate, and the pipelined cycle's
+    persistent device-resident node tensors) instead of solve_batch
     defaults, so the warmed cache entries actually match the first cycle's
     program."""
     import threading
@@ -179,12 +180,18 @@ def prewarm_buckets(spec: str, results: "list | None" = None,
             so = core.solver
             use_pallas, mesh = core._use_pallas, core._mesh
         max_rounds, chunk = so.max_rounds, so.chunk
+        use_mesh = (mesh is not None
+                    and enc.nodes.capacity % mesh.devices.size == 0)
         # AOT compile (no execution): both nodesort policies × plain and
-        # soft/locality variants — the static combinations production uses
+        # soft/locality variants — the static combinations production uses.
+        # This also covers the pipelined cycle's persistent-device-buffer
+        # path with no extra work: device-resident and host node inputs have
+        # identical avals (ops.assign._finish_solve_args), so they share one
+        # compiled program — there is no separate variant to warm, and
+        # production's own DeviceNodeState does its first upload lazily.
         for policy in ("binpacking", "spread"):
             for b in (plain, rich_batch):
-                if (mesh is not None
-                        and enc.nodes.capacity % mesh.devices.size == 0):
+                if use_mesh:
                     from yunikorn_tpu.parallel.mesh import solve_sharded
 
                     solve_sharded(b, enc.nodes, mesh, max_rounds=max_rounds,

@@ -50,8 +50,11 @@ def _usage_dao(core, partition: str, kind: str) -> list:
 
 def _prometheus_text(metrics: dict) -> str:
     """Flatten the core's metrics dict into Prometheus exposition format:
-    numeric top-level entries become `yunikorn_<name>` counters/gauges; the
-    per-partition last_cycle stage timings become
+    numeric top-level entries become `yunikorn_<name>` counters/gauges
+    (including the pipeline stage gauges the pipelined cycle publishes:
+    pipeline_encode_ms / pipeline_solve_ms / pipeline_commit_ms /
+    pipeline_overlap_ms / pipeline_overlap_ratio); the per-partition
+    last_cycle stage timings become
     `yunikorn_cycle_<stage>{partition="..."}` gauges."""
     lines = []
     for key, val in sorted(metrics.items()):
@@ -62,11 +65,15 @@ def _prometheus_text(metrics: dict) -> str:
             or key.startswith("allocation_") else "gauge"
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {val}")
+    typed: set = set()
     for pname, entry in sorted((metrics.get("last_cycle") or {}).items()):
         for stage, v in sorted(entry.items()):
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 continue
             name = f"yunikorn_cycle_{stage}"
+            if name not in typed:
+                typed.add(name)
+                lines.append(f"# TYPE {name} gauge")
             lines.append(f'{name}{{partition="{pname}"}} {v}')
     return "\n".join(lines) + "\n"
 
