@@ -1,7 +1,7 @@
 # Developer entry points (reference Makefile analog).
 
-.PHONY: test bench bench-small bench-smoke lint run-scheduler run-admission \
-	dryrun clean image sched_image adm_image webtest_image
+.PHONY: test bench bench-small bench-smoke obs-smoke lint run-scheduler \
+	run-admission dryrun clean image sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -41,6 +41,9 @@ bench-small:  ## CPU-friendly smoke of the bench harness
 bench-smoke:  ## fast pipelined-cycle benchmark (tier-1; asserts the overlap engages + prints stage timings)
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu YK_SMOKE_NODES=256 YK_SMOKE_PODS=2000 \
 		python -m pytest tests/test_pipeline.py::test_pipeline_overlap_smoke -q -s
+
+obs-smoke:  ## boot scheduler vs the synthetic client, scrape /metrics, validate the exposition + trace export (fails on unregistered-metric emission)
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""obs-smoke: end-to-end observability check (`make obs-smoke`).
+
+Boots the full scheduler (real core + real shim) against the synthetic
+client, binds a pod wave plus one deliberately unschedulable ask, then:
+
+  1. scrapes `/metrics` and validates the whole exposition with the mini
+     Prometheus parser (obs/promtext): every sample must belong to a
+     `# TYPE`-declared family — any unregistered-metric emission fails —
+     histogram buckets must be cumulative/monotone with +Inf == _count,
+     and the required families (pod e2e latency histogram, labelled
+     unschedulable_total, dispatcher counters) must be present;
+  2. checks `/debug/traces` serves Chrome trace-event JSON containing the
+     cycle-stage spans;
+  3. checks the JSON twin `/ws/v1/metrics` renders from the same registry.
+
+Exit status is the CI contract: 0 = all green, 1 = printed failures.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _get(port: int, path: str) -> bytes:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=10) as r:
+        return r.read()
+
+
+def main() -> int:
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.obs.promtext import (parse_exposition,
+                                           validate_exposition)
+    from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+    from yunikorn_tpu.webapp.rest import RestServer
+
+    n_nodes = int(os.environ.get("YK_OBS_SMOKE_NODES", 32))
+    n_pods = int(os.environ.get("YK_OBS_SMOKE_PODS", 200))
+    errors = []
+    t0 = time.time()
+    ms = MockScheduler()
+    ms.init(interval=0.05, core_interval=0.02,
+            conf_extra={"log.level": "WARN"})
+    rest = None
+    text, trace_names = "", set()
+    try:
+        for node in make_kwok_nodes(n_nodes):
+            ms.cluster.add_node(node)
+        pods = make_sleep_pods(n_pods, "obs-app", queue="root.obs",
+                               name_prefix="obs")
+        # one ask no node can ever hold: must surface as a labelled
+        # unschedulable_total{reason="capacity"} count, not vanish
+        giant = make_sleep_pods(1, "obs-app", queue="root.obs",
+                                name_prefix="obs-giant", cpu_milli=10**9)
+        for p in pods + giant:
+            ms.cluster.add_pod(p)
+        ms.start()
+        ms.wait_for_bound_count(n_pods, timeout=120)
+        rest = RestServer(ms.core, ms.context, port=0)
+        port = rest.start()
+
+        text = _get(port, "/metrics").decode()
+        errors += validate_exposition(text, required=(
+            "yunikorn_allocation_attempt_allocated",
+            "yunikorn_solve_count",
+            "yunikorn_pod_e2e_latency_seconds",
+            "yunikorn_pod_stage_latency_seconds",
+            "yunikorn_cycle_stage_ms",
+            "yunikorn_unschedulable_total",
+            "yunikorn_dispatcher_events_total",
+        ))
+        fams = parse_exposition(text)
+        e2e = fams.get("yunikorn_pod_e2e_latency_seconds")
+        bound_obs = next(
+            (s.value for s in (e2e.samples if e2e else [])
+             if s.name.endswith("_count")), 0)
+        if bound_obs < n_pods:
+            errors.append(f"pod_e2e_latency_seconds_count {bound_obs} < "
+                          f"bound pods {n_pods}")
+        uns = fams.get("yunikorn_unschedulable_total")
+        if not uns or not any(s.labels.get("reason") for s in uns.samples):
+            errors.append("unschedulable_total has no reason-labelled samples")
+
+        trace = json.loads(_get(port, "/debug/traces"))
+        trace_names = {e.get("name") for e in trace.get("traceEvents", [])}
+        for need in ("encode", "solve", "commit"):
+            if need not in trace_names:
+                errors.append(f"/debug/traces missing {need!r} spans "
+                              f"(got {sorted(trace_names)})")
+
+        mjson = json.loads(_get(port, "/ws/v1/metrics"))
+        if mjson.get("allocation_attempt_allocated", 0) < n_pods:
+            errors.append("/ws/v1/metrics allocation count below bound pods")
+        if "pod_e2e_latency_seconds" not in mjson:
+            errors.append("/ws/v1/metrics missing the e2e histogram family")
+    finally:
+        if rest is not None:
+            rest.stop()
+        ms.stop()
+    if errors:
+        print("obs-smoke FAILED:")
+        for e in errors:
+            print(f" - {e}")
+        return 1
+    print(f"obs-smoke OK in {time.time() - t0:.1f}s: {n_pods} pods bound "
+          f"over {n_nodes} nodes; exposition valid "
+          f"({len(text.splitlines())} lines, {len(parse_exposition(text))} "
+          f"families); trace spans: {sorted(trace_names)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,6 +173,17 @@ class Task:
             try:
                 self.context.bind_pod_volumes(self.pod, self.node_name)
                 self.context.api_provider.get_client().bind(self.pod, self.node_name)
+                # close the pod's end-to-end latency span in the core's
+                # observability registry (submit→…→commit happened core-side;
+                # the bind completes the span) — duck-typed so minimal test
+                # scheduler_api fakes need no observability surface
+                observe = getattr(self.context.scheduler_api,
+                                  "observe_pod_bound", None)
+                if observe is not None:
+                    try:
+                        observe(self.task_id)
+                    except Exception:
+                        logger.exception("pod-bound span observation failed")
                 get_recorder().eventf("Pod", self.alias, "Normal", "PodBindSuccessful",
                                       "Pod %s is successfully bound to node %s",
                                       self.alias, self.node_name)

@@ -48,6 +48,10 @@ def main(argv=None) -> int:
                              "Covers the resolved runtime variant: policy x "
                              "mesh x pallas gate x the pipelined cycle's "
                              "persistent device-resident node buffers")
+    parser.add_argument("--trace-out", type=str, default="",
+                        help="dump the cycle tracer as Chrome trace-event "
+                             "JSON to this path at shutdown (the live ring "
+                             "is always available at /debug/traces)")
     args = parser.parse_args(argv)
 
     ensure_compilation_cache()
@@ -92,7 +96,8 @@ def main(argv=None) -> int:
 
     cache = SchedulerCache()
     core = CoreScheduler(cache,
-                         solver_options=SolverOptions.from_conf(holder.get()))
+                         solver_options=SolverOptions.from_conf(holder.get()),
+                         trace_spans=holder.get().obs_trace_spans)
     context = Context(cluster, core, cache=cache)
     shim = KubernetesShim(cluster, core, context=context)
     rest = RestServer(core, context, port=args.rest_port)
@@ -119,6 +124,12 @@ def main(argv=None) -> int:
     rest.stop()
     core.stop()   # before the shim: no callbacks into a stopped dispatcher
     shim.stop()
+    if args.trace_out:
+        import json
+
+        with open(args.trace_out, "w") as f:
+            json.dump(core.tracer.chrome_trace(), f)
+        logger.info("cycle trace written to %s", args.trace_out)
     return 0
 
 

@@ -25,6 +25,7 @@ PREFIX_SERVICE = "service."
 PREFIX_KUBERNETES = "kubernetes."
 PREFIX_LOG = "log."
 PREFIX_SOLVER = "solver."
+PREFIX_OBS = "observability."
 
 # service.* keys
 CM_SVC_CLUSTER_ID = PREFIX_SERVICE + "clusterId"
@@ -57,6 +58,9 @@ CM_SOLVER_USE_PALLAS = PREFIX_SOLVER + "usePallas"     # auto | true | false
 CM_SOLVER_SHARD = PREFIX_SOLVER + "shardSolve"         # auto | true | false
 CM_SOLVER_FALLBACK_ROUNDS = PREFIX_SOLVER + "localityFallbackRounds"
 CM_SOLVER_PIPELINE = PREFIX_SOLVER + "pipeline"         # auto | true | false
+
+# observability.* keys (the obs/ registry + tracer)
+CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
 
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
@@ -114,6 +118,9 @@ class SchedulerConf:
     # two-stage pipelined cycle: overlap host encode/commit/publish with the
     # async device solve ("auto" = on; single-partition mode only)
     solver_pipeline: str = "auto"
+    # ring capacity of the cycle tracer (spans kept for /debug/traces and
+    # bench --trace-out; per-pod bind spans ride a separate fixed ring)
+    obs_trace_spans: int = 4096
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -226,6 +233,9 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     if CM_SOLVER_FALLBACK_ROUNDS in data:
         conf.solver_fallback_rounds = _parse_int(
             data[CM_SOLVER_FALLBACK_ROUNDS], conf.solver_fallback_rounds)
+    if CM_OBS_TRACE_SPANS in data:
+        conf.obs_trace_spans = _parse_int(
+            data[CM_OBS_TRACE_SPANS], conf.obs_trace_spans)
     for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
                       (CM_SOLVER_SHARD, "solver_shard"),
                       (CM_SOLVER_PIPELINE, "solver_pipeline")):

@@ -70,6 +70,14 @@ from yunikorn_tpu.core.partition import (
 )
 from yunikorn_tpu.core.queues import QueueTree, parse_queues_yaml
 from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.obs.metrics import (
+    COUNT_BUCKETS,
+    LATENCY_BUCKETS_S,
+    MS_BUCKETS,
+    MetricsRegistry,
+)
+from yunikorn_tpu.obs.trace import CycleTracer
+from yunikorn_tpu.ops import assign as assign_mod
 from yunikorn_tpu.ops.assign import solve_batch
 from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
 
@@ -163,7 +171,8 @@ class CoreScheduler(SchedulerAPI):
 
     def __init__(self, cache: SchedulerCache, interval: float = 0.1,
                  solver_policy: Optional[str] = None,
-                 solver_options: Optional[SolverOptions] = None):
+                 solver_options: Optional[SolverOptions] = None,
+                 trace_spans: int = 4096):
         self._lock = locking.RMutex()
         self.cache = cache
         self.encoder = SnapshotEncoder(cache)
@@ -224,18 +233,82 @@ class CoreScheduler(SchedulerAPI):
         self._inflight_ask_keys: set = set()
         self._inflight_gate_seed: List[tuple] = []  # (queue, res, user, groups)
         self._cycle_seq = 0
-        # stage-event trace for tests / the bench smoke: (event, cycle_id, t0, t1)
-        import collections
-        self._pipeline_trace = collections.deque(maxlen=256)
-        # metrics (Prometheus-counter analogs, reference perf test samples
-        # yunikorn_scheduler_container_allocation_attempt_total; last_cycle
-        # holds the most recent cycle's per-stage timing breakdown)
-        self.metrics: Dict[str, object] = {
-            "allocation_attempt_allocated": 0,
-            "allocation_attempt_failed": 0,
-            "solve_count": 0,
-            "solve_time_ms_total": 0,
-        }
+        # ---- observability (obs/): declared metrics + structured tracer ----
+        # Replaces the pre-round-7 flat metrics dict and the 256-tuple
+        # _pipeline_trace deque. The registry is per-core (tests build many
+        # cores per process; shared counters would cross-talk); the shim and
+        # dispatcher attach to it through `self.obs`.
+        self.obs = MetricsRegistry()
+        self.tracer = CycleTracer(capacity=max(int(trace_spans), 64))
+        m = self.obs
+        # reference perf test samples
+        # yunikorn_scheduler_container_allocation_attempt_total; these keep
+        # the established names so dashboards/tests carry over
+        self._m_allocated = m.counter(
+            "allocation_attempt_allocated",
+            "pods allocated (batched solve + gang replacement + pinned asks)")
+        self._m_failed = m.counter(
+            "allocation_attempt_failed",
+            "asks that finished a cycle unplaced")
+        self._m_solve_cycles = m.counter("solve_count",
+                                         "scheduling cycles completed")
+        self._m_solve_ms = m.counter("solve_time_ms_total",
+                                     "cumulative cycle wall time in ms")
+        self._m_preempted = m.counter(
+            "preempted_total", "allocations released by preemption planning")
+        self._m_fb_groups = m.counter(
+            "locality_fallback_groups_total",
+            "locality groups that overflowed the tensor encoding")
+        self._m_fb_deferred = m.counter(
+            "locality_fallback_deferred_total",
+            "pods drained through the exact host-path fallback")
+        self._m_pipeline_cycles = m.counter(
+            "pipeline_cycles_total", "pipelined (two-stage) cycles finished")
+        self._m_unschedulable = m.counter(
+            "unschedulable_total",
+            "unplaced-ask attempts by reason (one count per cycle the ask "
+            "stays unplaced)", labelnames=("reason",))
+        self._m_transfer_bytes = m.counter(
+            "device_transfer_bytes_total",
+            "host->device bytes: persistent node-mirror uploads + sharded "
+            "replicated pod args")
+        self._m_compiles = m.counter(
+            "solve_compile_total",
+            "solve dispatches that traced+compiled a new program variant")
+        self._m_compile_hits = m.counter(
+            "solve_compile_cache_hit_total",
+            "solve dispatches served entirely from the jit cache")
+        self._m_pod_e2e = m.histogram(
+            "pod_e2e_latency_seconds",
+            "per-pod end-to-end latency: ask submitted to core -> pod bound",
+            buckets=LATENCY_BUCKETS_S)
+        self._m_pod_stage = m.histogram(
+            "pod_stage_latency_seconds",
+            "per-pod span stages: schedule = submit->commit, "
+            "bind = commit->bound", labelnames=("stage",),
+            buckets=LATENCY_BUCKETS_S)
+        self._m_cycle_stage = m.histogram(
+            "cycle_stage_ms",
+            "per-cycle stage latency distribution",
+            labelnames=("stage",), buckets=MS_BUCKETS)
+        self._m_batch_pods = m.histogram(
+            "solve_batch_pods", "pods per dispatched solve batch",
+            buckets=COUNT_BUCKETS)
+        self._g_pipeline = {
+            k: m.gauge("pipeline_" + k,
+                       "last pipelined cycle: " + k.replace("_", " "))
+            for k in ("overlap_ratio", "overlap_ms", "encode_ms",
+                      "solve_ms", "commit_ms")}
+        # per-partition last-cycle stage breakdown (DAO / JSON surface;
+        # the cycle_* gauges mirror it for Prometheus)
+        self._last_cycle: Dict[str, dict] = {}
+        # per-pod latency spans: allocation_key -> [t_submit, t_commit,
+        # cycle_id]; own mutex so bind worker threads never touch the core
+        # lock (observe_pod_bound)
+        self._pod_spans: Dict[str, list] = {}
+        self._span_mu = threading.Lock()
+        # filled by _dispatch_solve for the cycle's trace span
+        self._last_solve_stats: dict = {}
 
     # ------------------------------------------------------------ SchedulerAPI
     def register_resource_manager(self, request: RegisterResourceManagerRequest,
@@ -470,6 +543,8 @@ class CoreScheduler(SchedulerAPI):
         app = self.partition.applications.pop(app_id, None)
         if app is None:
             return
+        for key in list(app.pending_asks) + list(app.allocations):
+            self._span_discard(key)
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.app_ids.discard(app_id)
@@ -481,6 +556,7 @@ class CoreScheduler(SchedulerAPI):
 
     def update_allocation(self, request: AllocationRequest) -> None:
         resp = AllocationResponse()
+        accepted_keys: List[str] = []
         with self._lock:
             for ask in request.asks:
                 self._use_partition(self._app_partition.get(ask.application_id, "default"))
@@ -492,6 +568,7 @@ class CoreScheduler(SchedulerAPI):
                 self._ask_seq += 1
                 ask.seq = self._ask_seq
                 app.pending_asks[ask.allocation_key] = ask
+                accepted_keys.append(ask.allocation_key)
             for alloc in request.allocations:
                 if alloc.foreign:
                     self._use_partition(self._node_partition_of(alloc.node_id))
@@ -508,6 +585,12 @@ class CoreScheduler(SchedulerAPI):
                 if rel is not None:
                     resp.released.append(rel)
             self._apply_release_accounting(rel_totals, rel_user_totals)
+            # inside the lock: the scheduler thread gates under this same
+            # lock, so a pod can never be admitted (or even bound) before
+            # its submit timestamp exists — a post-release _span_submit
+            # could land AFTER observe_pod_bound's pop and leak the entry
+            if accepted_keys:
+                self._span_submit(accepted_keys)
         if (resp.new or resp.released or resp.rejected) and self.callback is not None:
             self.callback.update_allocation(resp)
         self.trigger()
@@ -563,6 +646,7 @@ class CoreScheduler(SchedulerAPI):
         queue-accounting walk is deferred and accumulated — a 50k-pod mass
         release pays one ancestor walk per leaf instead of one per pod
         (_apply_release_accounting applies the sums)."""
+        self._span_discard(release.allocation_key)
         # foreign release (carries no app id; search the partitions)
         for part in self.partitions.values():
             foreign = part.foreign_allocations.pop(release.allocation_key, None)
@@ -798,7 +882,13 @@ class CoreScheduler(SchedulerAPI):
         threading the persistent device-resident node tensors through so the
         chunk-invariant node state transfers O(changes), not O(M), per cycle.
         The returned SolveResult is an ASYNC handle — materializing
-        `.assigned` is the device sync point."""
+        `.assigned` is the device sync point.
+
+        Side channel: fills self._last_solve_stats (transfer bytes, refresh
+        granularity, compile-vs-cache-hit) for the cycle's trace span, and
+        feeds the matching counters — reading jit cache sizes and the
+        device mirror's upload tally costs microseconds, so the clean hot
+        path stays clean."""
         so = self.solver
         use_mesh = (self._mesh is not None
                     and self.encoder.nodes.capacity % self._mesh.devices.size == 0)
@@ -809,30 +899,58 @@ class CoreScheduler(SchedulerAPI):
         except Exception:
             logger.exception("device node-state refresh failed; "
                              "falling back to per-cycle upload")
+        jc0 = assign_mod.jit_cache_entries()
         if use_mesh:
             from yunikorn_tpu.parallel.mesh import solve_sharded
 
-            return solve_sharded(batch, self.encoder.nodes, self._mesh,
+            result = solve_sharded(batch, self.encoder.nodes, self._mesh,
+                                   max_rounds=so.max_rounds, chunk=so.chunk,
+                                   policy=policy, free_delta=overlay,
+                                   node_mask=node_mask,
+                                   ports_delta=inflight_ports,
+                                   max_batch=so.max_batch,
+                                   device_state=device_state)
+        else:
+            result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                  max_rounds=so.max_rounds, chunk=so.chunk,
-                                 policy=policy, free_delta=overlay,
-                                 node_mask=node_mask,
+                                 use_pallas=self._use_pallas,
+                                 free_delta=overlay, node_mask=node_mask,
                                  ports_delta=inflight_ports,
                                  max_batch=so.max_batch,
                                  device_state=device_state)
-        return solve_batch(batch, self.encoder.nodes, policy=policy,
-                           max_rounds=so.max_rounds, chunk=so.chunk,
-                           use_pallas=self._use_pallas,
-                           free_delta=overlay, node_mask=node_mask,
-                           ports_delta=inflight_ports,
-                           max_batch=so.max_batch,
-                           device_state=device_state)
+        jc1 = assign_mod.jit_cache_entries()
+        stats = {"pods": int(batch.num_pods)}
+        if jc0 >= 0 and jc1 >= 0:
+            compiled = jc1 > jc0
+            (self._m_compiles if compiled else self._m_compile_hits).inc()
+            stats["compiled"] = compiled
+        # else: jit internals don't expose cache sizes — leave both
+        # counters untouched rather than mislabel every dispatch as a hit
+        dev = self.encoder.device
+        if dev is not None:
+            b = dev.take_upload_bytes()
+            if b:
+                stats["node_upload_bytes"] = b
+            if dev.last_refresh != "none":
+                stats["node_refresh"] = dev.last_refresh
+        if use_mesh:
+            from yunikorn_tpu.parallel import mesh as mesh_mod
+
+            stats["replicated_bytes"] = mesh_mod.last_replicated_bytes
+        total = (stats.get("node_upload_bytes", 0)
+                 + stats.get("replicated_bytes", 0))
+        if total:
+            self._m_transfer_bytes.inc(total)
+        self._m_batch_pods.observe(batch.num_pods)
+        self._last_solve_stats = stats
+        return result
 
     def _ask_pending(self, ask) -> bool:
         app = self.partition.applications.get(ask.application_id)
         return app is not None and ask.allocation_key in app.pending_asks
 
     def _commit_solve(self, admitted, batch, assigned, policy, node_mask,
-                      node_names=None):
+                      node_names=None, cycle_id=None):
         """Commit one materialized solve (core lock held): allocation
         records, batched queue accounting, locality-fallback drain. Returns
         (new_allocs, skipped_keys, unplaced_asks, fallback_keys, fb_rounds).
@@ -912,13 +1030,9 @@ class CoreScheduler(SchedulerAPI):
                     for (user, groups), ut in user_totals.get(qname, {}).items():
                         leaf.add_user_allocated(user, Resource(ut), list(groups))
         if batch.locality is not None and batch.locality.fallback:
-            self.metrics["locality_fallback_groups_total"] = (
-                self.metrics.get("locality_fallback_groups_total", 0)
-                + len(batch.locality.fallback))
+            self._m_fb_groups.inc(len(batch.locality.fallback))
         if deferred_set:
-            self.metrics["locality_fallback_deferred_total"] = (
-                self.metrics.get("locality_fallback_deferred_total", 0)
-                + len(deferred_set))
+            self._m_fb_deferred.inc(len(deferred_set))
             remaining = [admitted[i] for i in sorted(deferred_set)
                          if self._ask_pending(admitted[i])]
             drained, still_blocked, fb_rounds = self._drain_locality_fallback(
@@ -928,6 +1042,9 @@ class CoreScheduler(SchedulerAPI):
             for ask in still_blocked:
                 skipped_keys.append((ask.application_id, ask.allocation_key))
                 unplaced_asks.append(ask)
+        self._record_committed_spans([a.allocation_key for a in new_allocs],
+                                     cycle_id=cycle_id)
+        self._account_unschedulable(unplaced_asks)
         return new_allocs, skipped_keys, unplaced_asks, fallback_keys, fb_rounds
 
     def _plan_preemption(self, unplaced_asks) -> List[AllocationRelease]:
@@ -966,19 +1083,29 @@ class CoreScheduler(SchedulerAPI):
                 confirmed = self._release_allocation(rel)
                 if confirmed is not None:
                     preempt_releases.append(confirmed)
-        self.metrics["preempted_total"] = (
-            self.metrics.get("preempted_total", 0) + len(preempt_releases))
+        if preempt_releases:
+            self._m_preempted.inc(len(preempt_releases))
         return preempt_releases
 
     def _schedule_partition(self, restrict_nodes: bool = False) -> Tuple[int, tuple]:
         """One SEQUENTIAL cycle for the ACTIVE partition (core lock held);
         returns (allocation count, publish payload for _publish_cycle)."""
         t0 = time.time()
+        self._cycle_seq += 1
+        cid = self._cycle_seq
         self._check_app_completion()
         self._check_placeholder_timeouts()
         replaced = self._replace_placeholders()
         pinned = self._allocate_required_node_asks()
+        if pinned or replaced.new:
+            # pinned/gang-replaced pods commit outside _commit_solve: close
+            # their schedule spans here so their bind/e2e latency still lands
+            self._record_committed_spans(
+                [a.allocation_key for a in pinned]
+                + [a.allocation_key for a in replaced.new])
         admitted, ranks, held = self._collect_and_gate()
+        if held:
+            self._m_unschedulable.inc(held, reason="quota_held")
         new_allocs: List[Allocation] = []
         skipped_keys: List[Tuple[str, str]] = []
         unplaced_asks: List = []
@@ -1013,11 +1140,13 @@ class CoreScheduler(SchedulerAPI):
             t_solve = time.time()
             (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
              fb_rounds) = self._commit_solve(admitted, batch, assigned,
-                                             policy, node_mask)
-        self.metrics["allocation_attempt_allocated"] += len(new_allocs) + len(replaced.new)
-        self.metrics["allocation_attempt_failed"] += len(skipped_keys)
-        self.metrics["solve_count"] += 1
-        self.metrics["solve_time_ms_total"] += int((time.time() - t0) * 1000)
+                                             policy, node_mask, cycle_id=cid)
+        if new_allocs or replaced.new:
+            self._m_allocated.inc(len(new_allocs) + len(replaced.new))
+        if skipped_keys:
+            self._m_failed.inc(len(skipped_keys))
+        self._m_solve_cycles.inc()
+        self._m_solve_ms.inc(int((time.time() - t0) * 1000))
         t_commit = time.time()
 
         # preemption: try to make room for unplaced high-priority asks
@@ -1048,13 +1177,15 @@ class CoreScheduler(SchedulerAPI):
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
-            # copy-on-write, published fully built: get_partition_dao's
-            # shallow metrics copy may be serialized outside the lock; never
-            # mutate a dict a reader could be iterating
-            self.metrics["last_cycle"] = {
-                **(self.metrics.get("last_cycle") or {}),
-                self.partition.name: entry,
-            }
+            self._record_cycle_entry(self.partition.name, entry)
+            tr = self.tracer
+            pname = self.partition.name
+            tr.add("gate", cid, t0, t_gate, pods=len(admitted),
+                   partition=pname)
+            tr.add("encode", cid, t_gate, t_encode,
+                   cached=int(self.encoder.last_encode_cached))
+            tr.add("solve", cid, t_encode, t_solve, **self._last_solve_stats)
+            tr.add("commit", cid, t_solve, t_commit, allocs=len(new_allocs))
         return len(new_allocs), (pinned, replaced, new_allocs,
                                  preempt_releases, skipped_keys, fallback_keys)
 
@@ -1112,7 +1243,10 @@ class CoreScheduler(SchedulerAPI):
                 # (a failed dispatch leaves prep's asks pending; the next
                 # gate re-admits them).
                 if finished is not None:
+                    t_pub0 = time.time()
                     self._publish_cycle(finished)
+                    self.tracer.add("publish", prev.cycle_id, t_pub0,
+                                    time.time(), allocs=n_prev)
                 if extra is not None:
                     self._publish_cycle(extra)
             return n_prev
@@ -1136,6 +1270,8 @@ class CoreScheduler(SchedulerAPI):
             admitted, ranks, held = self._collect_and_gate(
                 exclude_keys=self._inflight_ask_keys or None,
                 seed_admissions=self._inflight_gate_seed or None)
+            if held:
+                self._m_unschedulable.inc(held, reason="quota_held")
             if not admitted:
                 return None
             t_gate = time.time()
@@ -1151,8 +1287,11 @@ class CoreScheduler(SchedulerAPI):
                 encode_cached=self.encoder.last_encode_cached,
                 overlapped=self._pipeline_inflight is not None,
                 t_prepare_start=t0, t_gate=t_gate, t_encode_end=time.time())
-            self._pipeline_trace.append(
-                ("encode", cyc.cycle_id, t0, cyc.t_encode_end))
+            self.tracer.add("gate", cyc.cycle_id, t0, t_gate,
+                            pods=len(admitted))
+            self.tracer.add("encode", cyc.cycle_id, t_gate, cyc.t_encode_end,
+                            cached=int(cyc.encode_cached),
+                            overlapped=int(cyc.overlapped))
             return cyc
 
     def _pipeline_housekeeping(self) -> Optional[tuple]:
@@ -1167,15 +1306,18 @@ class CoreScheduler(SchedulerAPI):
             replaced = self._replace_placeholders()
             pinned = self._allocate_required_node_asks()
             if replaced.new:
-                self.metrics["allocation_attempt_allocated"] = (
-                    self.metrics.get("allocation_attempt_allocated", 0)
-                    + len(replaced.new))
+                self._m_allocated.inc(len(replaced.new))
+            if pinned or replaced.new:
+                self._record_committed_spans(
+                    [a.allocation_key for a in pinned]
+                    + [a.allocation_key for a in replaced.new])
         if pinned or replaced.new or replaced.released:
             return (pinned, replaced, [], [], [], [])
         return None
 
     def _pipeline_dispatch(self, cyc: "_PipelineCycle") -> None:
         """Async-dispatch the prepared batch against post-commit state."""
+        t_disp0 = time.time()
         with self._lock:
             self._use_partition("default")
             batch = cyc.batch
@@ -1212,8 +1354,8 @@ class CoreScheduler(SchedulerAPI):
             # solve is in flight must not receive its placement
             cyc.node_names = dict(self.encoder.nodes._idx_to_name)
             cyc.t_dispatched = time.time()
-            self._pipeline_trace.append(
-                ("dispatch", cyc.cycle_id, cyc.t_dispatched, cyc.t_dispatched))
+            self.tracer.add("dispatch", cyc.cycle_id, t_disp0,
+                            cyc.t_dispatched, **self._last_solve_stats)
             # mark the batch in flight: the next gate excludes these asks and
             # charges them against quota as in-cycle admissions
             self._inflight_ask_keys = {a.allocation_key for a in cyc.admitted}
@@ -1235,7 +1377,8 @@ class CoreScheduler(SchedulerAPI):
         # informer/API threads are never stalled on device latency
         assigned = np.asarray(cyc.result.assigned)[: batch.num_pods]
         t_mat1 = time.time()
-        self._pipeline_trace.append(("materialize", cyc.cycle_id, t_mat0, t_mat1))
+        self.tracer.add("solve", cyc.cycle_id, cyc.t_dispatched, t_mat0)
+        self.tracer.add("materialize", cyc.cycle_id, t_mat0, t_mat1)
         with self._lock:
             self._use_partition("default")
             self._inflight_ask_keys = set()
@@ -1243,12 +1386,15 @@ class CoreScheduler(SchedulerAPI):
             (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
              fb_rounds) = self._commit_solve(cyc.admitted, batch, assigned,
                                              cyc.policy, None,
-                                             node_names=cyc.node_names)
-            self.metrics["allocation_attempt_allocated"] += len(new_allocs)
-            self.metrics["allocation_attempt_failed"] += len(skipped_keys)
-            self.metrics["solve_count"] += 1
-            self.metrics["solve_time_ms_total"] += int(
-                (time.time() - cyc.t_prepare_start) * 1000)
+                                             node_names=cyc.node_names,
+                                             cycle_id=cyc.cycle_id)
+            if new_allocs:
+                self._m_allocated.inc(len(new_allocs))
+            if skipped_keys:
+                self._m_failed.inc(len(skipped_keys))
+            self._m_solve_cycles.inc()
+            self._m_solve_ms.inc(int(
+                (time.time() - cyc.t_prepare_start) * 1000))
             t_commit = time.time()
             preempt_releases = self._plan_preemption(unplaced_asks)
             end = time.time()
@@ -1273,17 +1419,12 @@ class CoreScheduler(SchedulerAPI):
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
-            self.metrics["last_cycle"] = {
-                **(self.metrics.get("last_cycle") or {}),
-                self.partition.name: entry,
-            }
-            self.metrics["pipeline_cycles_total"] = (
-                self.metrics.get("pipeline_cycles_total", 0) + 1)
-            self.metrics["pipeline_overlap_ratio"] = entry["overlap_ratio"]
-            self.metrics["pipeline_overlap_ms"] = entry["overlap_ms"]
-            self.metrics["pipeline_encode_ms"] = entry["encode_ms"]
-            self.metrics["pipeline_solve_ms"] = entry["solve_ms"]
-            self.metrics["pipeline_commit_ms"] = entry["commit_ms"]
+            self._record_cycle_entry(self.partition.name, entry)
+            self._m_pipeline_cycles.inc()
+            for k, g in self._g_pipeline.items():
+                g.set(entry[k])
+            self.tracer.add("commit", cyc.cycle_id, t_mat1, t_commit,
+                            allocs=len(new_allocs))
         payload = ([], AllocationResponse(), new_allocs, preempt_releases,
                    skipped_keys, fallback_keys)
         return payload, len(new_allocs)
@@ -1827,21 +1968,162 @@ class CoreScheduler(SchedulerAPI):
         if updates and self.callback is not None:
             self.callback.update_application(ApplicationResponse(updated=updates))
 
-    # ------------------------------------------------------------- inspection
-    def metrics_snapshot(self) -> dict:
-        """Shallow metrics copy for hot read paths (/metrics scrapes): values
-        are scalars or copy-on-write dicts (last_cycle), so a shallow copy
-        under the lock is race-free without the full-DAO serialization."""
-        with self._lock:
-            return dict(self.metrics)
+    # ---------------------------------------------------------- observability
+    @property
+    def metrics(self) -> dict:
+        """Legacy read surface (tests, bench, DAO): a merged snapshot of the
+        registry plus the per-partition last-cycle breakdown. Read-only —
+        writers go through the declared metrics on `self.obs`."""
+        return self.metrics_snapshot()
 
+    @property
+    def _pipeline_trace(self):
+        """Legacy tuple view of the tracer's cycle spans: the pipeline tests
+        assert stage ordering on (name, cycle_id, t0, t1) tuples."""
+        return [(s.name, s.cycle_id, s.t0, s.t1)
+                for s in self.tracer.spans()]
+
+    def metrics_snapshot(self) -> dict:
+        """Metrics snapshot for serialization. last_cycle entries are copied
+        UNDER the core lock (deep enough: the entries are flat scalar dicts),
+        so a cycle publishing concurrently can never mutate a sub-dict a
+        serializer is iterating — the race the old shallow `dict(metrics)`
+        copy left open."""
+        with self._lock:
+            last = {p: dict(e) for p, e in self._last_cycle.items()}
+        snap = self.obs.snapshot()
+        if last:
+            snap["last_cycle"] = last
+        return snap
+
+    def _record_cycle_entry(self, pname: str, entry: dict) -> None:
+        """Publish one cycle's stage breakdown (core lock held): the
+        last_cycle dict (DAO/JSON surface), the per-partition cycle_* gauges
+        (Prometheus), and the stage-latency histograms (tail behavior —
+        single-number gauges can't show a pipelined stage's distribution)."""
+        self._last_cycle = {**self._last_cycle, pname: entry}
+        for k, v in entry.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self.obs.gauge("cycle_" + k,
+                           "most recent cycle's " + k + " (per partition)",
+                           labelnames=("partition",)).set(v, partition=pname)
+        for k in ("gate_ms", "encode_ms", "solve_ms", "commit_ms", "post_ms",
+                  "total_ms"):
+            v = entry.get(k)
+            if v is not None:
+                self._m_cycle_stage.observe(v, stage=k[:-3])
+
+    # per-cycle cap on exact unplaced-ask diagnosis (a vectorized all-nodes
+    # fit check per ask; the remainder is counted but not classified)
+    UNSCHED_DIAG_CAP = 512
+    # pod-span tracking cap: entries are popped at bind/release; the cap
+    # bounds leakage from pods that never reach either (callback-less tests)
+    POD_SPAN_CAP = 262144
+
+    def _account_unschedulable(self, unplaced_asks) -> None:
+        """Labelled unschedulable accounting fed from the solve's unplaced
+        set (core lock held). `capacity`: no schedulable node currently has
+        the free resources at all; `constraints`: capacity exists somewhere
+        but predicates/conflict resolution still left the ask unplaced
+        (affinity/taints/ports/locality, or it lost every accept round).
+        `quota_held` asks are counted at the gate, not here."""
+        if not unplaced_asks:
+            return
+        import numpy as np
+
+        na = self.encoder.nodes
+        ok = na.valid & na.schedulable
+        free = np.floor(na.free[ok]).astype(np.int64)
+        n_cap = n_con = 0
+        # dedupe by quantized request row: a saturated cluster's unplaced
+        # set is typically a few request SHAPES repeated thousands of times,
+        # so the all-nodes fit check runs once per shape, not per ask
+        shape_counts: Dict[bytes, int] = {}
+        shape_rows: Dict[bytes, object] = {}
+        for ask in unplaced_asks[: self.UNSCHED_DIAG_CAP]:
+            row = np.ceil(self.encoder.quantize_request(
+                ask.resource)).astype(np.int64)
+            key = row.tobytes()
+            shape_counts[key] = shape_counts.get(key, 0) + 1
+            shape_rows[key] = row
+        for key, n in shape_counts.items():
+            row = shape_rows[key]
+            if free.size and bool(
+                    (free[:, : row.shape[0]] >= row).all(axis=1).any()):
+                n_con += n
+            else:
+                n_cap += n
+        if n_cap:
+            self._m_unschedulable.inc(n_cap, reason="capacity")
+        if n_con:
+            self._m_unschedulable.inc(n_con, reason="constraints")
+        rest = len(unplaced_asks) - min(len(unplaced_asks),
+                                        self.UNSCHED_DIAG_CAP)
+        if rest:
+            self._m_unschedulable.inc(rest, reason="undiagnosed")
+
+    def _span_submit(self, keys) -> None:
+        """Open per-pod latency spans at ask arrival (submit timestamp)."""
+        now = time.time()
+        with self._span_mu:
+            spans = self._pod_spans
+            for k in keys:
+                if k not in spans and len(spans) < self.POD_SPAN_CAP:
+                    spans[k] = [now, 0.0, 0]
+
+    def _span_discard(self, key: str) -> None:
+        with self._span_mu:
+            self._pod_spans.pop(key, None)
+
+    def _record_committed_spans(self, keys, cycle_id: Optional[int] = None) -> None:
+        """Close the schedule half of the pod spans (submit->commit) in one
+        lock round-trip + one batched histogram observation — at 50k
+        allocations per cycle, per-pod locking would be measurable.
+
+        cycle_id: the COMMITTING cycle (pipelined finish runs after prepare
+        already bumped _cycle_seq, so the live counter would mis-attribute
+        bind spans to the next cycle)."""
+        if not keys:
+            return
+        cid = self._cycle_seq if cycle_id is None else cycle_id
+        now = time.time()
+        lats = []
+        with self._span_mu:
+            for k in keys:
+                rec = self._pod_spans.get(k)
+                if rec is not None and rec[1] == 0.0:
+                    rec[1] = now
+                    rec[2] = cid
+                    lats.append(now - rec[0])
+        if lats:
+            self._m_pod_stage.observe_batch(lats, stage="schedule")
+
+    def observe_pod_bound(self, allocation_key: str) -> None:
+        """Shim bind-path upcall: close the pod's end-to-end span (the bind
+        is the shim's half of submit→gate→encode→solve→commit→bind). Runs on
+        bind worker threads — touches the span mutex and the registry only,
+        never the core lock."""
+        now = time.time()
+        with self._span_mu:
+            rec = self._pod_spans.pop(allocation_key, None)
+        if rec is None:
+            return
+        t_submit, t_commit, cyc = rec
+        if t_commit:
+            self._m_pod_stage.observe(now - t_commit, stage="bind")
+            self.tracer.add_pod("bind", cyc, t_commit, now,
+                                key=allocation_key)
+        self._m_pod_e2e.observe(now - t_submit)
+
+    # ------------------------------------------------------------- inspection
     def get_partition_dao(self) -> dict:
         with self._lock:
             default = self.partitions["default"]
             dao = {
                 "partition": default.dao(),
                 "queues": self.queue_trees["default"].dao(),
-                "metrics": dict(self.metrics),
+                "metrics": self.metrics_snapshot(),
             }
             if len(self.partitions) > 1:
                 dao["partitions"] = {

@@ -1,0 +1,13 @@
+"""Observability: metrics registry, cycle tracer, exposition tooling.
+
+The reference ships first-class scheduler observability (Prometheus metrics
+via yunikorn-core's metrics package, K8s events, pprof) — SURVEY.md lists it
+on the capability bar. This package is the TPU port's equivalent grown into a
+real subsystem instead of the ad-hoc flat dict it started as:
+
+  metrics.py   — declared counters / gauges / fixed-bucket histograms with
+                 labels; correct Prometheus text exposition
+  trace.py     — ring-buffered cycle/stage spans + Chrome trace-event export
+                 (loads in Perfetto / chrome://tracing)
+  promtext.py  — mini exposition parser/validator (tests + `make obs-smoke`)
+"""

@@ -1,0 +1,116 @@
+"""Structured cycle tracer: ring-buffered stage spans + Chrome trace export.
+
+Subsumes the old `CoreScheduler._pipeline_trace` deque (a tuple log readable
+only from tests): every scheduling-cycle stage — gate / encode / dispatch /
+solve / materialize / commit / publish — records a span with its cycle id and
+stage-specific args (device-transfer bytes, compile-cache outcome, batch
+size), and per-pod bind spans ride in a separate ring so a 50k-pod bind storm
+cannot evict the cycle skeleton. Export is Chrome trace-event JSON
+(`chrome_trace()`): complete events ("ph":"X", microsecond ts/dur) on named
+lanes, loadable in Perfetto / chrome://tracing — the pipelined cycle's
+overlap (encode of cycle N+1 under solve N) is directly visible as
+overlapping spans on the prepare and device lanes.
+
+Lock-cheap: one mutex guarding two bounded deques; a span append is a tuple
+build + deque.append.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+
+class Span(NamedTuple):
+    name: str
+    cycle_id: int
+    t0: float
+    t1: float
+    args: tuple  # ((key, value), ...) — hashable, built once
+
+
+# stage → (lane title, tid). Lanes separate the pipeline's concurrent actors
+# so overlap renders as parallel tracks, not stacked self-overlap.
+LANES: Dict[str, Tuple[str, int]] = {
+    "gate": ("host: gate+encode", 1),
+    "encode": ("host: gate+encode", 1),
+    "dispatch": ("host: gate+encode", 1),
+    "solve": ("device: solve", 2),
+    "materialize": ("host: commit+publish", 3),
+    "commit": ("host: commit+publish", 3),
+    "housekeeping": ("host: commit+publish", 3),
+    "publish": ("host: commit+publish", 3),
+    "bind": ("shim: bind", 4),
+}
+_DEFAULT_LANE = ("host: other", 5)
+
+
+class CycleTracer:
+    def __init__(self, capacity: int = 4096, pod_capacity: int = 2048):
+        self._lock = threading.Lock()
+        self._spans: collections.deque = collections.deque(maxlen=capacity)
+        self._pod_spans: collections.deque = collections.deque(
+            maxlen=pod_capacity)
+
+    def add(self, name: str, cycle_id: int, t0: float, t1: float,
+            **args) -> None:
+        span = Span(name, cycle_id, t0, t1, tuple(sorted(args.items())))
+        with self._lock:
+            self._spans.append(span)
+
+    def add_pod(self, name: str, cycle_id: int, t0: float, t1: float,
+                **args) -> None:
+        """Per-pod span (own ring: bind storms must not evict cycle spans)."""
+        span = Span(name, cycle_id, t0, t1, tuple(sorted(args.items())))
+        with self._lock:
+            self._pod_spans.append(span)
+
+    def spans(self, pods: bool = False) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+            if pods:
+                out.extend(self._pod_spans)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._pod_spans.clear()
+
+    # --------------------------------------------------------------- export
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (the `traceEvents` array format)."""
+        spans = self.spans(pods=True)
+        events: List[dict] = []
+        if spans:
+            epoch = min(s.t0 for s in spans)
+            seen_lanes = {}
+            for s in spans:
+                title, tid = LANES.get(s.name, _DEFAULT_LANE)
+                seen_lanes[tid] = title
+                args = {"cycle": s.cycle_id}
+                args.update(dict(s.args))
+                # dur from the ROUNDED endpoints: rounding ts and dur
+                # independently lets ts+dur exceed the next span's ts by a
+                # ulp, breaking contiguity checks on back-to-back spans
+                ts = round((s.t0 - epoch) * 1e6, 3)
+                te = round((s.t1 - epoch) * 1e6, 3)
+                events.append({
+                    "name": s.name,
+                    "cat": "scheduler",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": tid,
+                    "ts": ts,
+                    "dur": round(max(te - ts, 0.0), 3),
+                    "args": args,
+                })
+            meta = [{"name": "process_name", "ph": "M", "pid": 1,
+                     "args": {"name": "yunikorn-tpu scheduler"}}]
+            for tid in sorted(seen_lanes):
+                meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                             "tid": tid, "args": {"name": seen_lanes[tid]}})
+            meta.append({"name": "thread_sort_index", "ph": "M", "pid": 1,
+                         "tid": 1, "args": {"sort_index": 1}})
+            events = meta + events
+        return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1204,6 +1204,25 @@ def _finish_solve_args(batch, req_i, score_cols, labels, taints_hard,
     return np_args, static_kwargs
 
 
+def jit_cache_entries() -> int:
+    """Compiled-variant count across the solve entry points (the in-process
+    jit caches; the persistent on-disk XLA cache is jaxtools' concern).
+
+    The scheduler reads this around each dispatch to tell a compile-cache
+    hit from a fresh trace+compile — a production cycle landing on an
+    unwarmed bucket shows up as a `solve_compile_total` increment plus a
+    `compiled: true` arg on its trace span instead of an anonymous stall.
+    Returns -1 when the jit internals don't expose cache sizes.
+    """
+    total = 0
+    for fn in (solve, solve_chunked):
+        try:
+            total += fn._cache_size()
+        except Exception:
+            return -1
+    return total
+
+
 def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpacking",
                 free_delta=None, use_pallas=False, pallas_interpret=False,
                 device=None, node_mask=None, ports_delta=None,

@@ -34,6 +34,14 @@ from yunikorn_tpu.ops import assign as assign_mod
 
 NODE_AXIS = "nodes"
 
+# Host bytes of the pod-side (replicated) solve args assembled by the LAST
+# solve_sharded call. Node-side tensors ride the persistent device mirror
+# (DeviceNodeState tracks those uploads); the replicated pod batch re-ships
+# every cycle, and at 64k pods that is the sharded path's dominant per-cycle
+# transfer — the core folds this into device_transfer_bytes_total and the
+# cycle's trace span. Single writer (the scheduler thread owns dispatch).
+last_replicated_bytes = 0
+
 
 def make_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
@@ -80,6 +88,14 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     np_args, static_kwargs = assign_mod.prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
         ports_delta=ports_delta, device_state=device_state)
+
+    if not compile_only:
+        global last_replicated_bytes
+        # pod-side args only (indexes 0..13 of SOLVE_ARG_NAMES order): the
+        # node-side tensors either live on device already (device_state) or
+        # are counted by DeviceNodeState on their own refresh
+        last_replicated_bytes = sum(
+            a.nbytes for a in np_args[:14] if hasattr(a, "nbytes"))
 
     N = np_args[0].shape[0]
     mb = 1 << (max(int(max_batch), 64).bit_length() - 1)

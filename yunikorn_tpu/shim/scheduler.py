@@ -48,6 +48,12 @@ class KubernetesShim:
         self.outstanding_apps_logged = 0
 
         dispatcher = dispatch_mod.get_dispatcher()
+        # shim-side observability joins the core's registry: dispatcher
+        # throughput/backlog counters land next to the cycle metrics so one
+        # /metrics scrape covers the whole submit→bind path
+        obs = getattr(scheduler_api, "obs", None)
+        if obs is not None:
+            dispatcher.attach_metrics(obs)
         dispatcher.register_event_handler(
             "AppHandler", EventType.APPLICATION, self.context.application_event_handler())
         dispatcher.register_event_handler(

@@ -476,6 +476,15 @@ class DeviceNodeState:
         # statistics for tests / the bench smoke: how the last refresh ran
         self.last_refresh = "none"   # none | clean | fields | full
         self.last_fields: tuple = ()
+        # host bytes handed to device_put since the last take_upload_bytes()
+        # (accumulates across refreshes; the core's tracer/metrics consume it
+        # per dispatch — a clean cycle reads 0, the observability contract
+        # "near-zero transfer when nothing changed" becomes measurable)
+        self.upload_bytes = 0
+
+    def take_upload_bytes(self) -> int:
+        b, self.upload_bytes = self.upload_bytes, 0
+        return b
 
     def _host_view(self, field):
         na = self.nodes
@@ -520,21 +529,26 @@ class DeviceNodeState:
         dims = (na.capacity, na._R, na._W, na._Wt, na._Wp)
         if (self._arrays is None or full or dims != self._dims
                 or mesh is not self._mesh):
-            self._arrays = {k: self._put(v, mesh)
-                            for k, v in self._host_views().items()}
+            views = self._host_views()
+            self._arrays = {k: self._put(v, mesh) for k, v in views.items()}
             self._dims = dims
             self._mesh = mesh
             self.last_refresh, self.last_fields = "full", tuple(self.FIELDS)
+            self.upload_bytes += sum(v.nbytes for v in views.values())
             return self._arrays
         if not fields:
             self.last_refresh, self.last_fields = "clean", ()
             return self._arrays
         fresh = dict(self._arrays)
+        uploaded = 0
         for f in sorted(fields):
-            fresh[f] = self._put(self._host_view(f), mesh)
+            view = self._host_view(f)
+            fresh[f] = self._put(view, mesh)
+            uploaded += view.nbytes
         # swap in only after every upload succeeded (no partial mirror)
         self._arrays = fresh
         self.last_refresh, self.last_fields = "fields", tuple(sorted(fields))
+        self.upload_bytes += uploaded
         return self._arrays
 
 

@@ -48,34 +48,12 @@ def _usage_dao(core, partition: str, kind: str) -> list:
     return sorted(out.values(), key=lambda e: e["name"])
 
 
-def _prometheus_text(metrics: dict) -> str:
-    """Flatten the core's metrics dict into Prometheus exposition format:
-    numeric top-level entries become `yunikorn_<name>` counters/gauges
-    (including the pipeline stage gauges the pipelined cycle publishes:
-    pipeline_encode_ms / pipeline_solve_ms / pipeline_commit_ms /
-    pipeline_overlap_ms / pipeline_overlap_ratio); the per-partition
-    last_cycle stage timings become
-    `yunikorn_cycle_<stage>{partition="..."}` gauges."""
-    lines = []
-    for key, val in sorted(metrics.items()):
-        if isinstance(val, bool) or not isinstance(val, (int, float)):
-            continue
-        name = f"yunikorn_{key}"
-        kind = "counter" if key.endswith("_total") or key.endswith("_count") \
-            or key.startswith("allocation_") else "gauge"
-        lines.append(f"# TYPE {name} {kind}")
-        lines.append(f"{name} {val}")
-    typed: set = set()
-    for pname, entry in sorted((metrics.get("last_cycle") or {}).items()):
-        for stage, v in sorted(entry.items()):
-            if not isinstance(v, (int, float)) or isinstance(v, bool):
-                continue
-            name = f"yunikorn_cycle_{stage}"
-            if name not in typed:
-                typed.add(name)
-                lines.append(f"# TYPE {name} gauge")
-            lines.append(f'{name}{{partition="{pname}"}} {v}')
-    return "\n".join(lines) + "\n"
+# NOTE: the old `_prometheus_text` flattener (counter-vs-gauge guessed from
+# name suffixes) is gone — both metrics surfaces now render from the SAME
+# declared registry (core.obs): `/metrics` via MetricsRegistry.expose()
+# (correct # TYPE lines, histogram _bucket/_sum/_count series, label
+# escaping) and `/ws/v1/metrics` via core.metrics_snapshot() (the JSON view
+# of the identical families, plus the per-partition last_cycle breakdown).
 
 
 class RestServer:
@@ -106,14 +84,14 @@ class RestServer:
                 parsed = urlparse(self.path)
                 path = parsed.path.rstrip("/")
 
-                # hot endpoints first: /health (probes) and /metrics
-                # (Prometheus scrapes every few seconds) must not build the
-                # full partition DAO — serializing 10k nodes under the core
-                # lock per scrape would stall scheduling cycles
+                # hot endpoints first: /health (probes), /metrics (Prometheus
+                # scrapes every few seconds) and /debug/traces must not build
+                # the full partition DAO — serializing 10k nodes under the
+                # core lock per scrape would stall scheduling cycles
                 if path in ("/ws/v1/health", "/health"):
                     return self._reply(200, {"Healthy": True})
                 if path == "/metrics":
-                    body = _prometheus_text(core.metrics_snapshot()).encode()
+                    body = core.obs.expose().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4; charset=utf-8")
@@ -121,6 +99,32 @@ class RestServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                if path in ("/debug/traces", "/ws/v1/traces"):
+                    # Chrome trace-event JSON of the ring-buffered cycle
+                    # spans (open in Perfetto / chrome://tracing): the
+                    # pipelined overlap renders as parallel lanes
+                    return self._reply(200, core.tracer.chrome_trace())
+                if path == "/ws/v1/metrics":
+                    # same registry snapshot that backs /metrics, as JSON
+                    return self._reply(200, core.metrics_snapshot())
+                if path == "/ws/v1/events":
+                    # filtered event tail (failure triage without a
+                    # debugger): ?objectKey=ns/name&reason=R&count=N
+                    from yunikorn_tpu.common.events import get_recorder
+
+                    q = parse_qs(parsed.query)
+                    try:
+                        count = max(1, int(q.get("count", ["1000"])[0]))
+                    except ValueError:
+                        return self._reply(400, {"error": "invalid count"})
+                    events = get_recorder().events(
+                        object_key=q.get("objectKey", [None])[0],
+                        reason=q.get("reason", [None])[0])[-count:]
+                    return self._reply(200, {"EventRecords": [
+                        {"objectKind": e.object_kind, "objectID": e.object_key,
+                         "type": e.event_type, "reason": e.reason,
+                         "message": e.message, "timestamp": e.timestamp}
+                        for e in events]})
 
                 dao = core.get_partition_dao()
 
@@ -154,8 +158,6 @@ class RestServer:
                     self._reply(200, dao["partition"]["applications"])
                 elif path == "/ws/v1/nodes":
                     self._reply(200, dao["partition"]["nodes"])
-                elif path == "/ws/v1/metrics":
-                    self._reply(200, dao["metrics"])
                 elif path == "/ws/v1/events/batch":
                     # K8s-event stream analog (reference RClient events API);
                     # ?count=N bounds the tail
