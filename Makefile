@@ -1,7 +1,8 @@
 # Developer entry points (reference Makefile analog).
 
-.PHONY: test bench bench-small bench-smoke obs-smoke lint run-scheduler \
-	run-admission dryrun clean image sched_image adm_image webtest_image
+.PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke smoke \
+	lint run-scheduler run-admission dryrun clean image sched_image \
+	adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -44,6 +45,14 @@ bench-smoke:  ## fast pipelined-cycle benchmark (tier-1; asserts the overlap eng
 
 obs-smoke:  ## boot scheduler vs the synthetic client, scrape /metrics, validate the exposition + trace export (fails on unregistered-metric emission)
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+preempt-smoke:  ## batched preemption planner: differential suite (device plan == host oracle) + microbench asserting the device planner beats the host above the node-count threshold on CPU
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_preempt_solve.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/preempt_bench.py --sizes 512,4096 --assert-speedup 4096
+
+smoke: bench-smoke obs-smoke preempt-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -53,13 +53,26 @@ def main() -> int:
         pods = make_sleep_pods(n_pods, "obs-app", queue="root.obs",
                                name_prefix="obs")
         # one ask no node can ever hold: must surface as a labelled
-        # unschedulable_total{reason="capacity"} count, not vanish
+        # unschedulable_total{reason="capacity"} count, not vanish. High
+        # priority makes it preemption-ELIGIBLE too, so the batched victim
+        # planner runs a (necessarily fruitless) pass and the preemption
+        # plan-latency histogram gets a sample — declared-but-never-emitted
+        # histograms fail validation below.
         giant = make_sleep_pods(1, "obs-app", queue="root.obs",
                                 name_prefix="obs-giant", cpu_milli=10**9)
+        giant[0].spec.priority = 100
         for p in pods + giant:
             ms.cluster.add_pod(p)
         ms.start()
         ms.wait_for_bound_count(n_pods, timeout=120)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            hist = ms.core.obs.get("preemption_plan_ms")
+            if hist is not None and any(
+                    hist.child_state(planner=pl)[0]
+                    for pl in ("device", "host")):
+                break
+            time.sleep(0.2)
         rest = RestServer(ms.core, ms.context, port=0)
         port = rest.start()
 
@@ -72,6 +85,7 @@ def main() -> int:
             "yunikorn_cycle_stage_ms",
             "yunikorn_unschedulable_total",
             "yunikorn_dispatcher_events_total",
+            "yunikorn_preemption_plan_ms",
         ))
         fams = parse_exposition(text)
         e2e = fams.get("yunikorn_pod_e2e_latency_seconds")
@@ -87,7 +101,7 @@ def main() -> int:
 
         trace = json.loads(_get(port, "/debug/traces"))
         trace_names = {e.get("name") for e in trace.get("traceEvents", [])}
-        for need in ("encode", "solve", "commit"):
+        for need in ("encode", "solve", "commit", "preempt"):
             if need not in trace_names:
                 errors.append(f"/debug/traces missing {need!r} spans "
                               f"(got {sorted(trace_names)})")

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Preemption-planner microbench: batched device solve vs host oracle.
+
+Builds a pressure scenario that is representative of real preemption waves —
+victims are SPARSE (only the tail ~2% of nodes hold preemptable pods), so the
+host planner's per-ask candidate walk traverses nearly the whole node table
+before finding its 32 searchable nodes, while the device planner evaluates
+every node in one jitted dispatch. This is exactly the shape where the
+per-entity host loop collapses at cluster scale (PAPERS.md: CvxCluster, POP).
+
+Per size prints one JSON line:
+  {"nodes": N, "asks": A, "host_ms": ..., "device_cold_ms": ...,
+   "device_warm_ms": ..., "speedup_warm": ...}
+
+--sizes 1024,5120,20480   node counts (default "512,4096")
+--assert-speedup N        exit 1 unless device_warm < host at every size >= N
+                          (the preempt-smoke CI gate)
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(n_nodes: int, n_asks: int, victim_frac: float = 0.02):
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    rng = random.Random(1234)
+    cache = SchedulerCache()
+    app_of_pod = {}
+    victim_nodes = max(int(n_nodes * victim_frac), 4)
+    for i in range(n_nodes):
+        cache.update_node(make_node(f"n{i:05d}", cpu_milli=4000,
+                                    memory=8 * 2**30))
+        if i >= n_nodes - victim_nodes:
+            for j in range(4):
+                v = make_pod(f"v-{i}-{j}", cpu_milli=1000, memory=2**28,
+                             node_name=f"n{i:05d}", phase="Running",
+                             priority=rng.choice([0, 1, 2]))
+                v.metadata.creation_timestamp = 1000.0 + rng.random() * 100
+                cache.update_pod(v)
+                app_of_pod[v.uid] = "victim-app"
+    asks = []
+    for k in range(n_asks):
+        p = make_pod(f"hi-{k}", cpu_milli=2000, memory=2**28, priority=100)
+        cache.update_pod(p)
+        asks.append(AllocationAsk(p.uid, "hi-app", get_pod_resource(p),
+                                  priority=100, pod=p))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return cache, enc, asks, app_of_pod
+
+
+def run_size(n_nodes: int, n_asks: int) -> dict:
+    from yunikorn_tpu.core.preemption import (
+        plan_preemptions,
+        plan_preemptions_batched,
+    )
+
+    cache, enc, asks, app_of_pod = build(n_nodes, n_asks)
+    cands = list(cache.node_names())
+
+    t0 = time.time()
+    host_plans, _ = plan_preemptions(cache, asks, app_of_pod,
+                                     candidate_nodes=cands)
+    host_ms = (time.time() - t0) * 1000
+
+    # cold: full victim-table sync + kernel trace/compile at this bucket
+    t0 = time.time()
+    dev_plans, _, _ = plan_preemptions_batched(cache, enc, asks, app_of_pod,
+                                               candidate_nodes=cands)
+    cold_ms = (time.time() - t0) * 1000
+    # warm: tables synced, program compiled — the steady-state pressure cycle
+    t0 = time.time()
+    dev_plans, _, stats = plan_preemptions_batched(cache, enc, asks,
+                                                   app_of_pod,
+                                                   candidate_nodes=cands)
+    warm_ms = (time.time() - t0) * 1000
+
+    hk = [(p.ask.allocation_key, p.node_id, [v.uid for v in p.victims])
+          for p in host_plans]
+    dk = [(p.ask.allocation_key, p.node_id, [v.uid for v in p.victims])
+          for p in dev_plans]
+    assert hk == dk, f"planner divergence at {n_nodes} nodes"
+    return {
+        "nodes": n_nodes,
+        "asks": n_asks,
+        "plans": len(dev_plans),
+        "host_ms": round(host_ms, 1),
+        "device_cold_ms": round(cold_ms, 1),
+        "device_warm_ms": round(warm_ms, 1),
+        "speedup_warm": round(host_ms / max(warm_ms, 1e-6), 1),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="512,4096")
+    ap.add_argument("--asks", type=int, default=16)
+    ap.add_argument("--assert-speedup", type=int, default=0, metavar="N",
+                    help="fail unless device_warm < host at sizes >= N")
+    args = ap.parse_args()
+
+    failures = []
+    for size in [int(s) for s in args.sizes.split(",") if s]:
+        row = run_size(size, args.asks)
+        print(json.dumps(row), flush=True)
+        if (args.assert_speedup and size >= args.assert_speedup
+                and row["device_warm_ms"] >= row["host_ms"]):
+            failures.append(row)
+    if failures:
+        print(f"# FAIL: device planner slower than host oracle at "
+              f"{[r['nodes'] for r in failures]} nodes", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

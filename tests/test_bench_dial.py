@@ -30,9 +30,11 @@ class FakeClock:
 def test_wedged_relay_concedes_within_dial_window(monkeypatch):
     """Every probe wedges (consumes its full timeout). The loop must stop
     dialing once the dial window — total budget minus the CPU reserve — is
-    spent, and fall back to CPU."""
+    spent, and fall back to CPU. (Attempt cap raised so this test pins the
+    WINDOW bound, not the cap.)"""
     monkeypatch.setattr(bench, "TOTAL_BUDGET", 1500.0)
     monkeypatch.setattr(bench, "CPU_RESERVE", 600.0)
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_ATTEMPTS", "99")
     monkeypatch.delenv("YK_BENCH_TPU_WAIT", raising=False)
     monkeypatch.delenv("YK_BENCH_FORCE_CPU", raising=False)
     clock = FakeClock()
@@ -80,9 +82,43 @@ def test_wedged_relay_downshifts_cpu_bucket(monkeypatch):
     assert bench.N_NODES == 123      # operator-pinned size is kept
 
 
+def test_dial_attempt_cap_concedes_early(monkeypatch):
+    """The r01–r05 regression: 9+ dial retries consumed the driver window.
+    The default attempt cap (2) must stop the loop LONG before the window
+    math would, leaving the CPU reserve untouched."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 1500.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE", 600.0)
+    monkeypatch.delenv("YK_BENCH_TPU_DIAL_ATTEMPTS", raising=False)
+    monkeypatch.delenv("YK_BENCH_TPU_WAIT", raising=False)
+    monkeypatch.delenv("YK_BENCH_FORCE_CPU", raising=False)
+    clock = FakeClock()
+    attempts = []
+
+    def wedged_probe(timeout):
+        attempts.append(timeout)
+        clock.sleep(timeout)
+        return None, 0, "dial timed out (fake wedge)"
+
+    fellback = []
+
+    def cpu_fallback():
+        fellback.append(True)
+        return "cpu"
+
+    t0 = clock()
+    platform = bench._init_backend_or_die(
+        probe_fn=wedged_probe, clock=clock, sleep=clock.sleep,
+        cpu_fallback=cpu_fallback)
+    assert platform == "cpu" and fellback
+    assert len(attempts) == 2          # the default cap, not the 9+ of r05
+    # two 150 s probes + two backoffs — far inside the 900 s window
+    assert clock() - t0 <= 2 * 150.0 + 20.0
+
+
 def test_probe_failure_then_success(monkeypatch):
     """A relay that comes back mid-window is still picked up (the fallback
     only fires after the window)."""
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_ATTEMPTS", "5")
     clock = FakeClock()
     calls = []
 
@@ -125,3 +161,6 @@ def test_bench_exits_zero_with_parsed_result_on_cpu():
     assert parsed["unit"] == "pods/s"
     assert parsed["value"] > 0
     assert "cpu" in parsed["metric"]
+    # the pressure-cycle plan latency rides every bench result (round 8)
+    assert "preempt_plan_ms" in parsed
+    assert parsed["preempt_plan_ms"] > 0

@@ -1,0 +1,410 @@
+"""Differential tests for the batched device preemption planner.
+
+The device plan (ops/preempt_solve.py, one jitted victim-selection dispatch)
+must match the host planner (core/preemption.plan_preemptions — the oracle)
+victim-for-victim and in the same order on randomized clusters: plain,
+gang-flavored, and quota-held traces. Plus: the ordered-subset start_index
+contract holds for every device plan, and the incremental victim-table
+uploads are idempotent (incremental sync == cold rebuild, bit-identical; a
+clean sync uploads nothing).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import ObjectMeta, PriorityClass, make_node, make_pod
+from yunikorn_tpu.common.resource import ResourceBuilder, get_pod_resource
+from yunikorn_tpu.common.si import (
+    AllocationAsk,
+    PreemptionPredicatesArgs,
+    TerminationType,
+)
+from yunikorn_tpu.core.preemption import (
+    plan_preemptions,
+    plan_preemptions_batched,
+)
+from yunikorn_tpu.ops.preempt import preemption_victim_search
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+
+def build_cluster(seed: int, n_nodes: int = 12, gang: bool = False):
+    """Randomized cluster: nodes with bound victim pods at mixed priorities
+    and sizes (exact in device units), a managed-app map, and an encoder
+    synced to it."""
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    app_of_pod = {}
+    for i in range(n_nodes):
+        cache.update_node(make_node(
+            f"n{i:03d}", cpu_milli=4000, memory=8 * 2**30,
+            labels={"zone": f"z{i % 3}"}))
+        for j in range(rng.randint(0, 6)):
+            kwargs = {}
+            if gang and rng.random() < 0.5:
+                kwargs = {"labels": {"placeholder": "true"}}
+            v = make_pod(f"v-{i}-{j}", cpu_milli=rng.choice([250, 500, 1000, 1500]),
+                         memory=rng.choice([2**28, 2**29]), node_name=f"n{i:03d}",
+                         phase="Running", priority=rng.choice([0, 1, 1, 2, 5]),
+                         **kwargs)
+            # deterministic, distinct timestamps: the (priority asc, newest
+            # first) ordering must not depend on construction wall time
+            v.metadata.creation_timestamp = 1000.0 + rng.random() * 100
+            cache.update_pod(v)
+            app_of_pod[v.uid] = f"victim-app-{i % 4}"
+    asks = []
+    for k in range(rng.randint(2, 8)):
+        p = make_pod(f"hi-{seed}-{k}",
+                     cpu_milli=rng.choice([1000, 2000, 3000]),
+                     memory=2**28,
+                     priority=rng.choice([10, 50, 100]))
+        if gang and k % 2 == 0:
+            tg = "workers"
+        else:
+            tg = ""
+        cache.update_pod(p)
+        asks.append(AllocationAsk(p.uid, f"hi-app-{k % 2}",
+                                  get_pod_resource(p), priority=p.spec.priority,
+                                  pod=p, task_group_name=tg))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return cache, enc, asks, app_of_pod
+
+
+def plans_key(plans):
+    return [(p.ask.allocation_key, p.node_id, [v.uid for v in p.victims])
+            for p in plans]
+
+
+def both_planners(cache, enc, asks, app_of_pod, inflight=None):
+    cands = list(cache.node_names())
+    host, att_h = plan_preemptions(cache, asks, app_of_pod,
+                                   inflight_by_node=inflight,
+                                   candidate_nodes=cands)
+    dev, att_d, stats = plan_preemptions_batched(
+        cache, enc, asks, app_of_pod, inflight_by_node=inflight,
+        candidate_nodes=cands)
+    return host, dev, att_h, att_d, stats
+
+
+# ---------------------------------------------------------------- plain trace
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_plain_random(seed):
+    cache, enc, asks, app_of_pod = build_cluster(seed)
+    host, dev, att_h, att_d, stats = both_planners(cache, enc, asks, app_of_pod)
+    assert plans_key(host) == plans_key(dev), (seed, stats)
+    assert att_h == att_d
+    assert stats["fallbacks"] == 0
+
+
+@pytest.mark.parametrize("seed", (3, 7))
+def test_differential_with_inflight_overlay(seed):
+    """Capacity committed this cycle (inflight overlay) must gate both
+    planners identically — victims are never evicted for capacity the
+    cycle's own allocations will consume."""
+    cache, enc, asks, app_of_pod = build_cluster(seed)
+    names = cache.node_names()
+    inflight = {names[0]: ResourceBuilder().cpu(3000).build(),
+                names[1]: ResourceBuilder().cpu(1000).build()}
+    host, dev, att_h, att_d, stats = both_planners(cache, enc, asks,
+                                                   app_of_pod, inflight)
+    assert plans_key(host) == plans_key(dev), (seed, stats)
+    assert att_h == att_d
+
+
+# ----------------------------------------------------------------- gang trace
+
+@pytest.mark.parametrize("seed", range(4))
+def test_differential_gang(seed):
+    """Gang-flavored: placeholder-labelled victims, task-grouped asks."""
+    cache, enc, asks, app_of_pod = build_cluster(seed + 100, gang=True)
+    host, dev, att_h, att_d, stats = both_planners(cache, enc, asks, app_of_pod)
+    assert plans_key(host) == plans_key(dev), (seed, stats)
+    assert att_h == att_d
+
+
+# ------------------------------------------------------------ protected pods
+
+def test_differential_allow_preemption_optout():
+    """PriorityClass opt-out filters the same victims from both tables."""
+    cache, enc, asks, app_of_pod = build_cluster(42)
+    pc = PriorityClass(metadata=ObjectMeta(
+        name="protected",
+        annotations={constants.ANNOTATION_ALLOW_PREEMPTION: "false"}))
+    cache.update_priority_class(pc)
+    protected = 0
+    for uid in sorted(app_of_pod):
+        if protected >= 5:
+            break
+        v = cache.get_pod(uid)
+        if v is not None:
+            v.spec.priority_class_name = "protected"
+            cache.update_pod(v)
+            protected += 1
+    enc.sync_nodes()
+    host, dev, att_h, att_d, stats = both_planners(cache, enc, asks, app_of_pod)
+    assert plans_key(host) == plans_key(dev)
+    chosen = {u for _, _, us in plans_key(dev) for u in us}
+    for uid in chosen:
+        assert cache.get_pod(uid).spec.priority_class_name != "protected"
+
+
+# -------------------------------------------------- host-constrained asks
+
+def test_constrained_asks_take_host_fallback_and_still_match():
+    """Asks the device cannot model (host ports here) are re-planned on the
+    host at finish; the combined result still matches the pure-host oracle
+    when every ask is host-bound."""
+    cache = SchedulerCache()
+    cache.update_node(make_node("hn0", cpu_milli=4000, memory=8 * 2**30))
+    app_of_pod = {}
+    for j in range(3):
+        v = make_pod(f"pv-{j}", cpu_milli=1500, node_name="hn0",
+                     phase="Running", priority=0)
+        v.metadata.creation_timestamp = 1000.0 + j
+        cache.update_pod(v)
+        app_of_pod[v.uid] = "victim-app"
+    p = make_pod("hi-ported", cpu_milli=2000, priority=100)
+    p.spec.containers[0].ports = [{"hostPort": 8080, "protocol": "TCP"}]
+    cache.update_pod(p)
+    ask = AllocationAsk(p.uid, "hi-app", get_pod_resource(p), priority=100,
+                        pod=p)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    host, dev, att_h, att_d, stats = both_planners(cache, enc, [ask],
+                                                   app_of_pod)
+    assert stats["device_asks"] == 0        # the group is host-only
+    assert plans_key(host) == plans_key(dev)
+    assert len(dev) == 1 and dev[0].node_id == "hn0"
+
+
+# ------------------------------------------------------------- quota-held
+
+def make_core(cache, preempt_device):
+    from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+    from yunikorn_tpu.common.si import RegisterResourceManagerRequest
+
+    released = []
+
+    class Callback:
+        def update_allocation(self, response):
+            for rel in response.released:
+                if rel.termination_type == TerminationType.PREEMPTED_BY_SCHEDULER:
+                    released.append(rel.allocation_key)
+
+        def update_application(self, response):
+            pass
+
+        def update_node(self, response):
+            pass
+
+        def send_event(self, events):
+            pass
+
+        def update_container_scheduling_state(self, request):
+            pass
+
+    config = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: qv
+          - name: qhi
+            resources:
+              max: {vcore: 3}
+"""
+    core = CoreScheduler(cache, solver_options=SolverOptions(
+        preempt_device=preempt_device, pipeline=False))
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues",
+                                       config=config), Callback())
+    return core, released
+
+
+def run_quota_held_trace(preempt_device: bool):
+    """Full-core trace: victims restored as existing allocations, a wave of
+    high-priority asks partially held by queue quota; the unheld leftovers
+    preempt. Returns the PREEMPTED_BY_SCHEDULER release keys in emit order."""
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest,
+        Allocation,
+        AllocationRequest,
+        ApplicationRequest,
+        NodeAction,
+        NodeInfo,
+        NodeRequest,
+        UserGroupInfo,
+    )
+
+    cache = SchedulerCache()
+    victims = []
+    for i in range(4):
+        cache.update_node(make_node(f"qn{i}", cpu_milli=2000, memory=8 * 2**30))
+        v = make_pod(f"qv-{i}", cpu_milli=2000, memory=2**28,
+                     node_name=f"qn{i}", phase="Running", priority=0)
+        v.metadata.creation_timestamp = 1000.0 + i
+        cache.update_pod(v)
+        victims.append(v)
+    core, released = make_core(cache, preempt_device)
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="victim-app", queue_name="root.qv",
+                              user=UserGroupInfo(user="v")),
+        AddApplicationRequest(application_id="hi-app", queue_name="root.qhi",
+                              user=UserGroupInfo(user="h")),
+    ]))
+    infos = []
+    for i, v in enumerate(victims):
+        infos.append(NodeInfo(
+            node_id=f"qn{i}", action=NodeAction.CREATE,
+            existing_allocations=[Allocation(
+                allocation_key=v.uid, application_id="victim-app",
+                node_id=f"qn{i}", resource=get_pod_resource(v))]))
+    core.update_node(NodeRequest(nodes=infos))
+    asks = []
+    for k in range(6):   # quota (3 vcore) holds all but ~1 of these 2-vcore asks
+        p = make_pod(f"qhi-{k}", cpu_milli=2000, memory=2**28, priority=100)
+        p.metadata.creation_timestamp = 2000.0 + k
+        cache.update_pod(p)
+        asks.append(AllocationAsk(p.uid, "hi-app", get_pod_resource(p),
+                                  priority=100, pod=p))
+    core.update_allocation(AllocationRequest(asks=asks))
+    core.schedule_once()
+    held = core.obs.get("unschedulable_total").value(reason="quota_held")
+    return released, held
+
+
+def test_differential_quota_held_trace():
+    """Device-planned and host-planned cores must evict the same victims in
+    the same order on a quota-held trace (some asks gated, the admitted
+    leftover preempting)."""
+    rel_host, held_host = run_quota_held_trace(preempt_device=False)
+    rel_dev, held_dev = run_quota_held_trace(preempt_device=True)
+    assert held_host == held_dev and held_host > 0
+    # uids carry a process-global counter; compare by stable pod name
+    names = lambda rels: [k.rsplit("-", 1)[0] for k in rels]
+    assert names(rel_host) == names(rel_dev)
+    assert rel_host, "the trace must actually preempt"
+
+
+# ------------------------------------------- residue host-planning params
+
+def test_host_planner_honors_seeded_claims_and_budget():
+    """The core's residue pass (asks the device handle never saw) host-plans
+    with the device plans' victims pre-claimed and a reduced ask budget —
+    seeded victims must never be claimed twice, and max_asks must cap the
+    attempts."""
+    cache = SchedulerCache()
+    cache.update_node(make_node("rn0", cpu_milli=4000, memory=8 * 2**30))
+    app_of_pod = {}
+    vs = []
+    for j in range(4):
+        v = make_pod(f"rv-{j}", cpu_milli=1000, node_name="rn0",
+                     phase="Running", priority=0)
+        v.metadata.creation_timestamp = 1000.0 + j
+        cache.update_pod(v)
+        app_of_pod[v.uid] = "victim-app"
+        vs.append(v)
+    asks = []
+    for k in range(3):
+        p = make_pod(f"rhi-{k}", cpu_milli=1000, priority=100)
+        cache.update_pod(p)
+        asks.append(AllocationAsk(p.uid, "hi", get_pod_resource(p),
+                                  priority=100, pod=p))
+    # table order is (prio asc, newest first) = rv-3, rv-2, rv-1, rv-0;
+    # pre-claim the two the device would have chosen first
+    seeded = {vs[3].uid, vs[2].uid}
+    plans, att = plan_preemptions(cache, asks, app_of_pod,
+                                  already_victim=set(seeded), max_asks=2)
+    assert len(att) == 2                  # budget, not the full 3 asks
+    chosen = {v.uid for p in plans for v in p.victims}
+    assert not (chosen & seeded)          # seeded claims respected
+
+
+# ---------------------------------------------------------- sharded parity
+
+def test_sharded_preempt_matches_single_device():
+    """Node-dimension sharding over the virtual 8-device CPU mesh must not
+    change a single victim choice (same algorithm, different layout)."""
+    from yunikorn_tpu.parallel.mesh import make_mesh
+
+    cache, enc, asks, app_of_pod = build_cluster(11)
+    cands = list(cache.node_names())
+    single, _, _ = plan_preemptions_batched(
+        cache, enc, asks, app_of_pod, candidate_nodes=cands)
+    sharded, _, stats = plan_preemptions_batched(
+        cache, enc, asks, app_of_pod, candidate_nodes=cands,
+        mesh=make_mesh())
+    assert stats["sharded"] is True
+    assert plans_key(single) == plans_key(sharded)
+    assert single, "scenario must produce plans"
+
+
+# --------------------------------------------------- start_index contract
+
+def test_device_plans_honor_start_index_contract():
+    """Every device plan is the minimal ordered prefix: the exact victim-
+    subset search over the plan's victims succeeds at the LAST index."""
+    for seed in range(4):
+        cache, enc, asks, app_of_pod = build_cluster(seed + 200)
+        _, dev, _, _, _ = both_planners(cache, enc, asks, app_of_pod)
+        for p in dev:
+            resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+                allocation_key=p.ask.pod.uid, node_id=p.node_id,
+                preempt_allocation_keys=[v.uid for v in p.victims],
+                start_index=0))
+            assert resp.success and resp.index == len(p.victims) - 1
+
+
+# ------------------------------------------- incremental upload idempotence
+
+def test_incremental_victim_tables_match_cold_rebuild():
+    """Pod churn + incremental sync must produce BIT-IDENTICAL victim
+    tables to a cold rebuild on a fresh encoder, and a no-change sync must
+    not mark the device mirror dirty."""
+    cache, enc, asks, app_of_pod = build_cluster(7)
+    pc = cache.get_priority_class
+    enc.sync_nodes()   # drain the cache's construction-time dirty set
+    enc.sync_victims(app_of_pod, pc)
+
+    # churn: delete one victim, add two new ones on other nodes
+    gone = sorted(app_of_pod)[0]
+    pod = cache.get_pod(gone)
+    cache.remove_pod(pod)
+    del app_of_pod[gone]
+    names = cache.node_names()
+    for t, nn in enumerate((names[1], names[-1])):
+        v = make_pod(f"late-{t}", cpu_milli=500, node_name=nn,
+                     phase="Running", priority=1)
+        v.metadata.creation_timestamp = 3000.0 + t
+        cache.update_pod(v)
+        app_of_pod[v.uid] = "victim-app-9"
+    enc.sync_nodes()                       # consumes the cache dirty set
+    synced = enc.sync_victims(app_of_pod, pc)
+    assert 0 < synced < len(names)         # incremental, not a full rebuild
+
+    cold = SnapshotEncoder(cache)
+    cold.sync_nodes(full=True)
+    cold.sync_victims(app_of_pod, pc)
+
+    a, b = enc.nodes, cold.nodes
+    for name in names:
+        ia, ib = a.index_of(name), b.index_of(name)
+        np.testing.assert_array_equal(a.victim_req[ia], b.victim_req[ib])
+        np.testing.assert_array_equal(a.victim_prio[ia], b.victim_prio[ib])
+        np.testing.assert_array_equal(a.victim_valid[ia], b.victim_valid[ib])
+        assert a.victim_uids.get(ia, ()) == b.victim_uids.get(ib, ())
+
+    # idempotence: a second sync with no churn re-encodes nothing and
+    # leaves the device-mirror dirty flag clear
+    a.take_victim_dirty()
+    assert enc.sync_victims(app_of_pod, pc) == 0
+    assert a.take_victim_dirty() is False
+
+    # and the plans on the churned cluster still agree
+    host, dev, att_h, att_d, _ = both_planners(cache, enc, asks, app_of_pod)
+    assert plans_key(host) == plans_key(dev)

@@ -52,6 +52,51 @@ def test_victim_search_start_index_contract():
     assert resp.success and resp.index == 2
 
 
+def test_victim_search_duplicate_keys_no_double_count():
+    """A key appearing twice in preempt_allocation_keys must not re-add the
+    victim's resources (the free.add is guarded on ACTUAL removal): the
+    duplicate frees nothing, so a pod needing more than the real evictions
+    provide must not be reported as fitting."""
+    cache, victims = setup_node_with_victims()
+    # node full (4x1000m); pod needs 3000m. Keys list the SAME two victims
+    # twice: only 2000m can actually free — success would double-count.
+    pod = make_pod("dup-preemptor", cpu_milli=3000, priority=100)
+    cache.update_pod(pod)
+    keys = [victims[0].uid, victims[1].uid, victims[0].uid, victims[1].uid]
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=keys, start_index=0))
+    assert not resp.success and resp.index == -1
+    # duplicates across the start_index boundary double-count the same way
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=keys, start_index=2))
+    assert not resp.success and resp.index == -1
+    # sanity: with three DISTINCT victims the same pod does fit
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=[v.uid for v in victims[:3]], start_index=0))
+    assert resp.success and resp.index == 2
+
+
+def test_victim_search_foreign_node_key_frees_nothing():
+    """A key resolving to a pod on a DIFFERENT node (cache fallback lookup)
+    must not credit that pod's resources to this node."""
+    cache, victims = setup_node_with_victims()
+    cache.update_node(make_node("n2", cpu_milli=4000, memory=8 * 2**30))
+    elsewhere = make_pod("other-node-pod", cpu_milli=4000, node_name="n2",
+                         phase="Running", priority=0)
+    cache.update_pod(elsewhere)
+    pod = make_pod("xn-preemptor", cpu_milli=3000, priority=100)
+    cache.update_pod(pod)
+    # the foreign pod's 4000m would "fit" the ask if it were credited
+    resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+        allocation_key=pod.uid, node_id="n1",
+        preempt_allocation_keys=[elsewhere.uid, victims[0].uid],
+        start_index=0))
+    assert not resp.success
+
+
 def test_victim_search_no_fit():
     cache, victims = setup_node_with_victims()
     pod = make_pod("preemptor", cpu_milli=16000, priority=100)

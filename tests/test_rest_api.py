@@ -62,6 +62,10 @@ def test_apps_nodes_statedump(stack):
     assert "core" in dump and "shim" in dump
     metrics = get(port, "/ws/v1/metrics")
     assert metrics["allocation_attempt_allocated"] >= 1
+    # recent-preemptions surface: present and well-formed (empty here —
+    # nothing preempted in this stack)
+    pre = get(port, "/ws/v1/preemptions")
+    assert isinstance(pre["Preemptions"], list)
 
 
 def test_validate_conf_endpoint(stack):

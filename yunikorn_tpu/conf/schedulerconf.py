@@ -58,6 +58,7 @@ CM_SOLVER_USE_PALLAS = PREFIX_SOLVER + "usePallas"     # auto | true | false
 CM_SOLVER_SHARD = PREFIX_SOLVER + "shardSolve"         # auto | true | false
 CM_SOLVER_FALLBACK_ROUNDS = PREFIX_SOLVER + "localityFallbackRounds"
 CM_SOLVER_PIPELINE = PREFIX_SOLVER + "pipeline"         # auto | true | false
+CM_SOLVER_PREEMPT_DEVICE = PREFIX_SOLVER + "preemptDevice"  # auto | true | false
 
 # observability.* keys (the obs/ registry + tracer)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
@@ -118,6 +119,10 @@ class SchedulerConf:
     # two-stage pipelined cycle: overlap host encode/commit/publish with the
     # async device solve ("auto" = on; single-partition mode only)
     solver_pipeline: str = "auto"
+    # batched device preemption planner ("auto" = on): one jitted
+    # victim-selection solve per pressure cycle, host planner as oracle/
+    # fallback
+    solver_preempt_device: str = "auto"
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -238,7 +243,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             data[CM_OBS_TRACE_SPANS], conf.obs_trace_spans)
     for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
                       (CM_SOLVER_SHARD, "solver_shard"),
-                      (CM_SOLVER_PIPELINE, "solver_pipeline")):
+                      (CM_SOLVER_PIPELINE, "solver_pipeline"),
+                      (CM_SOLVER_PREEMPT_DEVICE, "solver_preempt_device")):
         if key in data:
             v = data[key].strip().lower()
             if v in ("auto", "true", "false"):

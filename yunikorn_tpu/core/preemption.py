@@ -4,18 +4,34 @@ Role-equivalent to yunikorn-core's preemption logic, which the reference shim
 serves via the PreemptionPredicates upcall (reference pkg/cache/
 scheduler_callback.go:200-209 → Context.IsPodFitNodeViaPreemption
 context.go:718-746 → PredicateManager.PreemptionPredicates
-predicate_manager.go:137-188). The per-(ask,node) ordered-victim-subset check
-with the startIndex contract lives in ops/preempt.py; this module is the
-planner that decides WHICH asks preempt WHERE:
+predicate_manager.go:137-188). The per-(pod,node) ordered-victim-subset check
+with the startIndex contract lives in ops/preempt.py; this module holds TWO
+planners deciding WHICH asks preempt WHERE:
 
-  for each unplaced ask (priority order, bounded per cycle):
-    candidate nodes   = feasible nodes for the ask's constraint group
-    victims per node  = lower-priority, preemptable pods, ordered by
-                        (priority asc, newest first) — cheapest evictions first
-    chosen node       = feasible node minimizing (victim count, victim
-                        priority sum), validated through the exact
-                        victim-subset search
-    emit releases     = TerminationType.PREEMPTED_BY_SCHEDULER
+  HOST (plan_preemptions) — the reference-shaped loop, kept as the
+  differential-testing oracle and the fallback for asks whose constraints
+  the device cannot model (host-evaluated affinity, host ports, DRA/volume
+  restrictions):
+    for each unplaced ask (priority order, bounded per cycle):
+      candidate nodes   = feasible nodes for the ask's constraint group
+      victims per node  = the node's shared victim table
+                          (ops.preempt.victim_table: managed, preemptable,
+                          ordered (priority asc, newest first), truncated)
+                          filtered to strictly-lower priority, unclaimed
+      chosen node       = feasible node minimizing (victim count, victim
+                          priority sum), validated through the exact
+                          victim-subset search
+      emit releases     = TerminationType.PREEMPTED_BY_SCHEDULER
+
+  DEVICE (dispatch/finish_preemption_solve) — the same decision procedure as
+  ONE jitted dispatch over all asks × all nodes × all victim slots
+  (ops/preempt_solve.py), reading victim tables encoded into the persistent
+  device node mirror. Both planners consume ops.preempt.victim_table and the
+  clamped priority-sum helper, so their choices are identical whenever the
+  device models the ask (pinned by tests/test_preempt_solve.py); every
+  device plan is confirmed through preemption_victim_search before any
+  release is emitted, so a stale table can only cost a fallback, never an
+  invalid eviction.
 
 The shim reacts to the releases by deleting the victim pods (reference
 handleReleaseAppAllocationEvent); the freed capacity is observed through the
@@ -32,9 +48,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Tuple
 
-from yunikorn_tpu.common import constants
 from yunikorn_tpu.common.objects import Pod
-from yunikorn_tpu.common.resource import get_pod_resource
 from yunikorn_tpu.common.si import (
     AllocationAsk,
     AllocationRelease,
@@ -43,13 +57,16 @@ from yunikorn_tpu.common.si import (
 )
 from yunikorn_tpu.log.logger import log
 from yunikorn_tpu.ops.host_predicates import pod_fits_node
-from yunikorn_tpu.ops.preempt import preemption_victim_search
+from yunikorn_tpu.ops.preempt import (
+    MAX_CANDIDATE_NODES,
+    MAX_PREEMPTING_ASKS_PER_CYCLE,
+    clamped_prio_sum,
+    pod_priority,
+    preemption_victim_search,
+    victim_table,
+)
 
 logger = log("core.scheduler")
-
-MAX_PREEMPTING_ASKS_PER_CYCLE = 32
-MAX_CANDIDATE_NODES = 32
-MAX_VICTIMS_PER_NODE = 16
 
 
 @dataclasses.dataclass
@@ -57,6 +74,11 @@ class PreemptionPlan:
     ask: AllocationAsk
     node_id: str
     victims: List[Pod]
+    # which planner actually produced this plan ("host" | "device") — a
+    # device-branch pass can still emit host plans (unsupported groups,
+    # confirmation fallbacks, the residue pass), and the metrics/REST
+    # surfaces attribute per plan
+    planner: str = "host"
 
     def releases(self, victim_app_ids: Dict[str, str]) -> List[AllocationRelease]:
         return [
@@ -70,25 +92,6 @@ class PreemptionPlan:
         ]
 
 
-def _pod_priority(pod: Optional[Pod]) -> int:
-    if pod is None or pod.spec.priority is None:
-        return 0
-    return pod.spec.priority
-
-
-def _is_preemptable(pod: Pod, pc_lookup) -> bool:
-    if pod.spec.priority_class_name:
-        pc = pc_lookup(pod.spec.priority_class_name)
-        if pc is not None:
-            if pc.metadata.annotations.get(constants.ANNOTATION_ALLOW_PREEMPTION) == constants.FALSE:
-                return False
-            if getattr(pc, "preemption_policy", "") == "Never":
-                # PriorityClass-level Never only blocks the preemptOR side;
-                # keep victims eligible (K8s semantics)
-                pass
-    return True
-
-
 def _may_preempt(ask: AllocationAsk) -> bool:
     pod = ask.pod
     if pod is not None and pod.spec.preemption_policy == "Never":
@@ -96,33 +99,71 @@ def _may_preempt(ask: AllocationAsk) -> bool:
     return True
 
 
+class _NodeTables:
+    """Per-planning-call cache of node snapshots + shared victim tables:
+    one snapshot and one table build per node per call, shared across asks
+    (the pre-round-8 planner recomputed both per (ask, node))."""
+
+    def __init__(self, cache, app_of_pod):
+        self.cache = cache
+        self.managed = app_of_pod.__contains__
+        self.pc_lookup = cache.get_priority_class
+        self._snapshots: Dict[str, object] = {}
+        self._tables: Dict[str, List[Pod]] = {}
+
+    def snapshot(self, name: str):
+        if name not in self._snapshots:
+            self._snapshots[name] = self.cache.snapshot_node(name)
+        return self._snapshots[name]
+
+    def table(self, name: str) -> List[Pod]:
+        t = self._tables.get(name)
+        if t is None:
+            info = self.snapshot(name)
+            t = (victim_table(info, self.pc_lookup, self.managed)
+                 if info is not None else [])
+            self._tables[name] = t
+        return t
+
+
 def plan_preemptions(
     cache,
     unplaced_asks: List[AllocationAsk],
     app_of_pod: Dict[str, str],
     inflight_by_node: Optional[Dict[str, object]] = None,
+    candidate_nodes: Optional[List[str]] = None,
+    already_victim: Optional[set] = None,
+    max_asks: int = MAX_PREEMPTING_ASKS_PER_CYCLE,
 ) -> Tuple[List[PreemptionPlan], List[str]]:
-    """Compute preemption plans for unplaced asks.
+    """Compute preemption plans for unplaced asks (HOST planner).
 
     `cache` is the shared external SchedulerCache (provides pods, nodes and
     PriorityClass lookups); app_of_pod maps victim pod uid -> application id;
     inflight_by_node carries the core's committed-but-not-yet-assumed usage
     per node (same overlay the solver applies), so victims are never evicted
-    for capacity this cycle's own allocations will consume.
+    for capacity this cycle's own allocations will consume. candidate_nodes
+    restricts (and orders) the nodes searched — the core passes its
+    schedulable node list so both planners see identical candidates.
+    already_victim seeds the claimed set (the core's residue pass after the
+    device planner: victims chosen there must not be claimed twice);
+    max_asks caps the asks considered (the per-cycle budget remainder).
 
     Returns (plans, attempted_ask_keys) — attempted includes failed plans so
     the caller can put them on cooldown too.
     """
     plans: List[PreemptionPlan] = []
     attempted: List[str] = []
-    already_victim: set = set()
+    already_victim = set() if already_victim is None else already_victim
+    node_list = (candidate_nodes if candidate_nodes is not None
+                 else cache.node_names())
+    tables = _NodeTables(cache, app_of_pod)
     candidates = sorted(unplaced_asks, key=lambda a: -(a.priority or 0))
-    for ask in candidates[:MAX_PREEMPTING_ASKS_PER_CYCLE]:
+    for ask in candidates[:max(max_asks, 0)]:
         if (ask.priority or 0) <= 0 or not _may_preempt(ask) or ask.pod is None:
             continue
         attempted.append(ask.allocation_key)
-        plan = _plan_for_ask(cache, ask, already_victim, app_of_pod,
-                             inflight_by_node or {})
+        plan = _plan_for_ask(cache, ask, already_victim,
+                             inflight_by_node or {}, node_list, tables)
         if plan is not None:
             for v in plan.victims:
                 already_victim.add(v.uid)
@@ -131,37 +172,35 @@ def plan_preemptions(
 
 
 def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
-                  app_of_pod: Dict[str, str],
-                  inflight_by_node: Dict[str, object]) -> Optional[PreemptionPlan]:
+                  inflight_by_node: Dict[str, object],
+                  node_list: List[str],
+                  tables: _NodeTables) -> Optional[PreemptionPlan]:
     pod = ask.pod
     best: Optional[Tuple[int, int, str, List[Pod]]] = None  # (count, prio_sum, node, victims)
-    pc_lookup = cache.get_priority_class
 
-    node_names = cache.node_names()
     searched = 0
-    for name in node_names:
+    for name in node_list:
         if searched >= MAX_CANDIDATE_NODES:
             break  # hard budget on victim-subset searches per ask
-        info = cache.snapshot_node(name)
+        info = tables.snapshot(name)
         if info is None:
             continue
         # quick feasibility screen ignoring capacity (host predicates)
         err = pod_fits_node(pod, info.node, info.allocatable, info.pods.values())
         if err is not None and err != "insufficient resources" and err != "host port conflict":
             continue
-        # victims: lower priority, preemptable, not already claimed
+        # victims: the node's shared table (managed, preemptable, eviction
+        # order, truncated to MAX_VICTIMS_PER_NODE) filtered to strictly
+        # lower priority and not already claimed this cycle. The priority
+        # filter removes a sorted SUFFIX and the claim filter only removes
+        # rows, so this equals the device kernel's slot masking exactly.
         victims = [
-            v for v in info.pods.values()
-            if _pod_priority(v) < (ask.priority or 0)
+            v for v in tables.table(name)
+            if pod_priority(v) < (ask.priority or 0)
             and v.uid not in already_victim
-            and v.uid in app_of_pod          # only yunikorn-managed allocations
-            and _is_preemptable(v, pc_lookup)
         ]
         if not victims:
             continue
-        # cheapest evictions first: lowest priority, then youngest
-        victims.sort(key=lambda v: (_pod_priority(v), -v.metadata.creation_timestamp))
-        victims = victims[:MAX_VICTIMS_PER_NODE]
         searched += 1
         resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
             allocation_key=pod.uid,
@@ -172,7 +211,7 @@ def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
         if not resp.success:
             continue
         chosen = victims[: resp.index + 1]
-        prio_sum = sum(_pod_priority(v) for v in chosen)
+        prio_sum = clamped_prio_sum(pod_priority(v) for v in chosen)
         key = (len(chosen), prio_sum)
         if best is None or key < (best[0], best[1]):
             best = (len(chosen), prio_sum, name, chosen)
@@ -182,3 +221,230 @@ def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
     logger.info("preemption: ask %s evicts %d pods on node %s",
                 ask.allocation_key, len(chosen), node_id)
     return PreemptionPlan(ask=ask, node_id=node_id, victims=chosen)
+
+
+# --------------------------------------------------------------------------
+# Device planner: one jitted victim-selection solve for all asks
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PreemptSolveHandle:
+    """An in-flight batched preemption solve: dispatch is async, the arrays
+    materialize at finish — the core overlaps the commit/bind host work with
+    the device computation."""
+    asks: List[AllocationAsk]          # candidate order (priority desc)
+    device_rows: List[bool]            # per ask: modeled on device?
+    node_idx: object                   # [A] device array (async)
+    victim_mask: object                # [A, V] device array (async)
+    cache: object
+    encoder: object
+    app_of_pod: Dict[str, str]
+    inflight_by_node: Dict[str, object]
+    node_list: List[str]
+    stats: Dict[str, object]
+
+
+def dispatch_preemption_solve(
+    cache,
+    encoder,
+    unplaced_asks: List[AllocationAsk],
+    app_of_pod: Dict[str, str],
+    inflight_by_node: Optional[Dict[str, object]] = None,
+    candidate_nodes: Optional[List[str]] = None,
+    mesh=None,
+) -> Optional[PreemptSolveHandle]:
+    """Encode + async-dispatch the batched victim-selection solve.
+
+    Returns None when nothing is eligible (the caller should skip planning
+    entirely) — asks in groups the device cannot model still ride the handle
+    and are re-planned on the host at finish, sharing the claimed-victim set.
+    """
+    import numpy as np
+
+    from yunikorn_tpu.ops import preempt_solve as ps_mod
+
+    candidates = sorted(unplaced_asks, key=lambda a: -(a.priority or 0))
+    asks = [a for a in candidates[:MAX_PREEMPTING_ASKS_PER_CYCLE]
+            if (a.priority or 0) > 0 and _may_preempt(a) and a.pod is not None]
+    if not asks:
+        return None
+    inflight_by_node = inflight_by_node or {}
+    node_list = (candidate_nodes if candidate_nodes is not None
+                 else cache.node_names())
+
+    batch = encoder.build_batch(asks)
+    gph = batch.g_preempt_host
+    device_rows = []
+    for i in range(len(asks)):
+        gid = int(batch.group_id[i])
+        device_rows.append(not bool(gph[gid]) if gph is not None else True)
+    if not any(device_rows):
+        # every ask exceeds the device model: the caller's plain host path
+        # covers them all — skip the victim sync/upload and the dispatch
+        return None
+
+    synced = encoder.sync_victims(app_of_pod, cache.get_priority_class)
+    na = encoder.nodes
+    node_order = np.full((na.capacity,), ps_mod.NODE_ORDER_EXCLUDED, np.int32)
+    for pos, name in enumerate(node_list):
+        idx = na.index_of(name)
+        if idx is not None:
+            node_order[idx] = pos
+
+    free_delta = None
+    if inflight_by_node:
+        free_delta = np.zeros((na.capacity, encoder.vocabs.resources.num_slots),
+                              np.float32)
+        for name, res in inflight_by_node.items():
+            idx = na.index_of(name)
+            if idx is not None:
+                row = encoder.quantize_request(res)
+                free_delta[idx, : row.shape[0]] += row
+
+    device_state = None
+    try:
+        device_state = encoder.victim_arrays(mesh=mesh)
+    except Exception:
+        logger.exception("victim-table device refresh failed; "
+                         "falling back to per-call upload")
+
+    np_args = ps_mod.prepare_preempt_args(
+        batch, len(asks), [(a.priority or 0) for a in asks], na, node_order,
+        free_delta=free_delta, device_state=device_state)
+    # rows the device cannot model leave the solve (their claims would skew
+    # later asks' eligibility against the host re-plan at finish)
+    if not all(device_rows):
+        a_valid = np_args[3].copy()
+        for i, ok in enumerate(device_rows):
+            if not ok:
+                a_valid[i] = False
+        np_args = np_args[:3] + (a_valid,) + np_args[4:]
+
+    jc0 = ps_mod.preempt_jit_cache_entries()
+    if mesh is not None:
+        from yunikorn_tpu.parallel.mesh import preempt_solve_sharded
+
+        node_idx, victim_mask = preempt_solve_sharded(
+            np_args, mesh, max_candidates=MAX_CANDIDATE_NODES)
+    else:
+        node_idx, victim_mask = ps_mod.preempt_solve(
+            *np_args, max_candidates=MAX_CANDIDATE_NODES)
+    jc1 = ps_mod.preempt_jit_cache_entries()
+    stats = {
+        "asks": len(asks),
+        "device_asks": sum(device_rows),
+        "victim_nodes_synced": synced,
+        "sharded": mesh is not None,
+    }
+    if jc0 >= 0 and jc1 >= 0:
+        stats["compiled"] = jc1 > jc0
+    return PreemptSolveHandle(
+        asks=asks, device_rows=device_rows, node_idx=node_idx,
+        victim_mask=victim_mask, cache=cache, encoder=encoder,
+        app_of_pod=app_of_pod, inflight_by_node=inflight_by_node,
+        node_list=node_list, stats=stats)
+
+
+def finish_preemption_solve(
+    handle: PreemptSolveHandle,
+    only_keys: Optional[set] = None,
+) -> Tuple[List[PreemptionPlan], List[str], Dict[str, object]]:
+    """Materialize the solve, confirm every plan through the exact victim-
+    subset search, and host-re-plan anything the device missed or that fails
+    confirmation. only_keys restricts to asks still worth planning (the
+    core passes the post-commit unplaced set: an ask placed since dispatch —
+    e.g. by the locality-fallback drain — must neither claim victims nor pay
+    a confirmation search). Returns (plans, attempted_ask_keys, stats)."""
+    import numpy as np
+
+    cache = handle.cache
+    na = handle.encoder.nodes
+    node_idx = np.asarray(handle.node_idx)
+    victim_mask = np.asarray(handle.victim_mask)
+    tables = _NodeTables(cache, handle.app_of_pod)
+    plans: List[PreemptionPlan] = []
+    attempted: List[str] = []
+    already: set = set()
+    fallbacks = 0
+    for k, ask in enumerate(handle.asks):
+        if only_keys is not None and ask.allocation_key not in only_keys:
+            continue
+        attempted.append(ask.allocation_key)
+        plan: Optional[PreemptionPlan] = None
+        confirmed = False
+        if handle.device_rows[k] and int(node_idx[k]) >= 0:
+            row = int(node_idx[k])
+            name = na.name_of(row)
+            uids = na.victim_uids.get(row, ())
+            chosen = [uids[j] for j in range(min(len(uids), victim_mask.shape[1]))
+                      if victim_mask[k, j]]
+            if name is not None and chosen and not (set(chosen) & already):
+                resp = preemption_victim_search(cache, PreemptionPredicatesArgs(
+                    allocation_key=ask.pod.uid,
+                    node_id=name,
+                    preempt_allocation_keys=chosen,
+                    start_index=0,
+                ), extra_used=handle.inflight_by_node.get(name))
+                if resp.success:
+                    # state drift since encode can only shrink the prefix;
+                    # the confirmed subset is still minimal-in-order
+                    chosen = chosen[: resp.index + 1]
+                    victims = [v for v in (cache.get_pod(u) for u in chosen)
+                               if v is not None]
+                    if len(victims) == len(chosen):
+                        plan = PreemptionPlan(ask=ask, node_id=name,
+                                              victims=victims,
+                                              planner="device")
+                        confirmed = True
+        if not confirmed:
+            # Exact host re-plan against the shared claimed set, for: an
+            # unsupported group, a stale-table confirmation failure, a
+            # victim collision with an earlier plan — AND a device miss
+            # (node_idx == -1): the device's freed-capacity arithmetic is
+            # deliberately conservative (floored victim rows, truncated
+            # tables), so a miss is not proof the host's exact search
+            # would miss. The re-scan costs one pre-round-8 host pass per
+            # ask, bounded by the caller's cooldown; device false
+            # negatives therefore never silently suppress an eviction the
+            # host planner would have made.
+            if plan is None:
+                plan = _plan_for_ask(cache, ask, already,
+                                     handle.inflight_by_node,
+                                     handle.node_list, tables)
+                if plan is not None and handle.device_rows[k]:
+                    fallbacks += 1
+        if plan is not None:
+            for v in plan.victims:
+                already.add(v.uid)
+            plans.append(plan)
+    stats = dict(handle.stats)
+    stats["fallbacks"] = fallbacks
+    stats["plans"] = len(plans)
+    return plans, attempted, stats
+
+
+def plan_preemptions_batched(
+    cache,
+    encoder,
+    unplaced_asks: List[AllocationAsk],
+    app_of_pod: Dict[str, str],
+    inflight_by_node: Optional[Dict[str, object]] = None,
+    candidate_nodes: Optional[List[str]] = None,
+    mesh=None,
+) -> Tuple[List[PreemptionPlan], List[str], Dict[str, object]]:
+    """Convenience wrapper: dispatch + finish in one call (tests, scripts).
+    The core splits the two so the device solve overlaps commit host work.
+    A declined dispatch (nothing eligible, or no ask the device can model)
+    falls back to the host planner outright — same behavior as the core."""
+    handle = dispatch_preemption_solve(
+        cache, encoder, unplaced_asks, app_of_pod,
+        inflight_by_node=inflight_by_node, candidate_nodes=candidate_nodes,
+        mesh=mesh)
+    if handle is None:
+        plans, attempted = plan_preemptions(
+            cache, unplaced_asks, app_of_pod,
+            inflight_by_node=inflight_by_node,
+            candidate_nodes=candidate_nodes)
+        return plans, attempted, {"asks": len(attempted), "device_asks": 0,
+                                  "plans": len(plans), "fallbacks": 0}
+    return finish_preemption_solve(handle)

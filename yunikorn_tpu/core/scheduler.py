@@ -123,6 +123,12 @@ class SolverOptions:
     # "auto" = on; the pipeline engages only in single-partition mode and
     # falls back to the sequential cycle otherwise.
     pipeline: Optional[bool] = None
+    # batched device preemption planner (solver.preemptDevice): victim
+    # selection for all unplaced asks in one jitted dispatch, overlapped
+    # with the commit; the host planner stays as the confirmation oracle
+    # and the fallback for constraints the device can't model. Tri-state:
+    # None = "auto" = on.
+    preempt_device: Optional[bool] = None
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -142,6 +148,8 @@ class SolverOptions:
             fallback_rounds=max(int(conf.solver_fallback_rounds), 0),
             max_batch=max_batch,
             pipeline=tri.get(getattr(conf, "solver_pipeline", "auto"), None),
+            preempt_device=tri.get(
+                getattr(conf, "solver_preempt_device", "auto"), None),
         )
 
 
@@ -256,6 +264,23 @@ class CoreScheduler(SchedulerAPI):
                                      "cumulative cycle wall time in ms")
         self._m_preempted = m.counter(
             "preempted_total", "allocations released by preemption planning")
+        # ---- batched preemption planner (round 8) ----
+        self._m_preempt_plans = m.counter(
+            "preemption_plans_total",
+            "preemption plans emitted, by planner (device = batched jitted "
+            "victim-selection solve, host = reference-shaped loop)",
+            labelnames=("planner",))
+        self._m_preempt_victims = m.counter(
+            "preemption_victims_total",
+            "victims released by preemption, by trigger reason",
+            labelnames=("reason",))
+        self._m_preempt_fallback = m.counter(
+            "preemption_device_fallback_total",
+            "device plans re-planned on the host (stale victim table, "
+            "confirmation failure, or victim collision)")
+        self._g_preempt_last_ms = m.gauge(
+            "preemption_last_plan_ms",
+            "planning latency of the most recent preemption pass (ms)")
         self._m_fb_groups = m.counter(
             "locality_fallback_groups_total",
             "locality groups that overflowed the tensor encoding")
@@ -309,6 +334,10 @@ class CoreScheduler(SchedulerAPI):
         self._span_mu = threading.Lock()
         # filled by _dispatch_solve for the cycle's trace span
         self._last_solve_stats: dict = {}
+        # recent preemption plans (operator surface: /ws/v1/preemptions)
+        from collections import deque
+
+        self._recent_preemptions = deque(maxlen=128)
 
     # ------------------------------------------------------------ SchedulerAPI
     def register_resource_manager(self, request: RegisterResourceManagerRequest,
@@ -607,6 +636,9 @@ class CoreScheduler(SchedulerAPI):
             return
         app.allocations[alloc.allocation_key] = alloc
         app.pending_asks.pop(alloc.allocation_key, None)
+        # the pod just became yunikorn-managed (a preemption candidate)
+        # with no cache-side pod event — the node's victim table is stale
+        self.encoder.mark_victims_stale(alloc.node_id)
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.add_allocated(alloc.resource)
@@ -669,6 +701,9 @@ class CoreScheduler(SchedulerAPI):
         alloc = app.allocations.pop(release.allocation_key, None)
         if alloc is None:
             return None
+        # no longer managed: the node's victim table is stale until the
+        # shim's pod deletion lands in the cache
+        self.encoder.mark_victims_stale(alloc.node_id)
         if batch_acc is not None:
             totals, user_totals = batch_acc
             qname = (self.partition.name, app.queue_name)
@@ -1047,33 +1082,169 @@ class CoreScheduler(SchedulerAPI):
         self._account_unschedulable(unplaced_asks)
         return new_allocs, skipped_keys, unplaced_asks, fallback_keys, fb_rounds
 
-    def _plan_preemption(self, unplaced_asks) -> List[AllocationRelease]:
-        """Preemption planning for unplaced high-priority asks (lock held)."""
-        preempt_releases: List[AllocationRelease] = []
-        if not (self._preemption_enabled and unplaced_asks):
-            return preempt_releases
-        from yunikorn_tpu.core.preemption import plan_preemptions
+    PREEMPT_COOLDOWN_S = 30.0
 
-        now = time.time()
-        cooldown = 30.0
+    def _purge_preempt_cooldown(self, now: float) -> None:
         self._preempted_for = {
-            k: ts for k, ts in self._preempted_for.items() if now - ts < cooldown
+            k: ts for k, ts in self._preempted_for.items()
+            if now - ts < self.PREEMPT_COOLDOWN_S
         }
-        eligible = [a for a in unplaced_asks
-                    if a.allocation_key not in self._preempted_for]
-        app_of_pod = {
+
+    def _app_of_pod(self) -> Dict[str, str]:
+        return {
             key: app.application_id
             for app in self.partition.applications.values()
             for key in app.allocations
         }
-        # the same overlay the solver used, grouped per node
-        inflight_by_node: Dict[str, Resource] = {}
+
+    def _inflight_by_node(self) -> Dict[str, Resource]:
+        """The solver's in-flight overlay, grouped per node (the preemption
+        planners' extra_used input)."""
+        out: Dict[str, Resource] = {}
         for alloc in self._inflight.values():
-            cur = inflight_by_node.get(alloc.node_id)
-            inflight_by_node[alloc.node_id] = (
-                alloc.resource if cur is None else cur.add(alloc.resource))
-        plans, attempted = plan_preemptions(
-            self.cache, eligible, app_of_pod, inflight_by_node)
+            cur = out.get(alloc.node_id)
+            out[alloc.node_id] = (alloc.resource if cur is None
+                                  else cur.add(alloc.resource))
+        return out
+
+    def _preempt_candidate_nodes(self) -> List[str]:
+        """Candidate nodes in cache order, restricted to rows the encoder
+        holds as schedulable — passed to BOTH planners so the device's
+        node_order ranking and the host loop walk identical lists."""
+        na = self.encoder.nodes
+        out = []
+        for name in self.cache.node_names():
+            idx = na.index_of(name)
+            if idx is not None and na.valid[idx] and na.schedulable[idx]:
+                out.append(name)
+        return out
+
+    def _preempt_device_enabled(self) -> bool:
+        so = self.solver
+        return True if so.preempt_device is None else so.preempt_device
+
+    def _preempt_dispatch(self, admitted, batch, assigned):
+        """Async-dispatch the batched victim-selection solve for the rows
+        the just-materialized assignment left unplaced (core lock held).
+        Runs BEFORE the commit so the device computes victim prefixes while
+        the host does commit bookkeeping; _plan_preemption finishes the
+        handle after the commit. Returns None when preemption or the device
+        planner is off, or nothing is eligible."""
+        if not (self._preemption_enabled and self._preempt_device_enabled()):
+            return None
+        import numpy as np
+
+        # fast path: nothing unplaced (the overwhelmingly common cycle)
+        unassigned = np.flatnonzero(
+            np.asarray(assigned) < 0)
+        if unassigned.size == 0:
+            return None
+        now = time.time()
+        self._purge_preempt_cooldown(now)
+        # deferred rows only "might still place" when the fallback drain
+        # will actually run — same condition _commit_solve uses; with the
+        # drain disabled they are ordinary unplaced asks and must ride the
+        # dispatch (the residue budget cannot be allowed to starve them)
+        deferred = (set(batch.deferred)
+                    if self.solver.fallback_rounds > 0 else set())
+        prospective = []
+        for i in unassigned.tolist():
+            if i >= len(admitted) or i in deferred:
+                continue
+            ask = admitted[i]
+            if not batch.valid[i] or not self._ask_pending(ask):
+                continue
+            if (ask.priority or 0) <= 0:
+                continue
+            if ask.allocation_key in self._preempted_for:
+                continue
+            prospective.append(ask)
+        if not prospective:
+            return None
+        from yunikorn_tpu.core.preemption import dispatch_preemption_solve
+
+        use_mesh = (self._mesh is not None
+                    and self.encoder.nodes.capacity % self._mesh.devices.size == 0)
+        t0 = time.time()
+        try:
+            handle = dispatch_preemption_solve(
+                self.cache, self.encoder, prospective, self._app_of_pod(),
+                inflight_by_node=self._inflight_by_node(),
+                candidate_nodes=self._preempt_candidate_nodes(),
+                mesh=self._mesh if use_mesh else None)
+        except Exception:
+            logger.exception("batched preemption dispatch failed; "
+                             "host planner will cover this cycle")
+            return None
+        if handle is not None:
+            handle.stats["dispatch_ms"] = (time.time() - t0) * 1000
+        return handle
+
+    def _plan_preemption(self, unplaced_asks, handle=None,
+                         cycle_id=None) -> List[AllocationRelease]:
+        """Preemption planning for unplaced high-priority asks (lock held).
+
+        With a handle from _preempt_dispatch, finishes the overlapped device
+        solve (every plan confirmed through the exact victim-subset search
+        against the POST-commit in-flight overlay); otherwise runs the host
+        planner. Plans for asks that got placed after dispatch (the
+        locality-fallback drain) are dropped, not released."""
+        preempt_releases: List[AllocationRelease] = []
+        if not (self._preemption_enabled and unplaced_asks):
+            return preempt_releases
+        from yunikorn_tpu.core.preemption import (
+            finish_preemption_solve,
+            plan_preemptions,
+        )
+
+        t0 = time.time()
+        now = t0
+        self._purge_preempt_cooldown(now)
+        app_of_pod = self._app_of_pod()
+        inflight_by_node = self._inflight_by_node()
+        stats: Dict[str, object] = {}
+        if handle is not None:
+            planner = "device"
+            # confirmation must see capacity this cycle's commit just
+            # consumed — refresh the overlay the handle captured at
+            # dispatch; asks placed since dispatch (locality-fallback
+            # drain) are excluded outright, so their stale plans neither
+            # claim victims nor pay confirmation searches
+            handle.inflight_by_node = inflight_by_node
+            handle.app_of_pod = app_of_pod
+            unplaced_keys = {a.allocation_key for a in unplaced_asks}
+            plans, attempted, stats = finish_preemption_solve(
+                handle, only_keys=unplaced_keys)
+            if stats.get("fallbacks"):
+                self._m_preempt_fallback.inc(stats["fallbacks"])
+            # residue: unplaced asks the dispatch never saw — locality-
+            # deferred rows that failed the same-cycle drain (excluded at
+            # dispatch because they might still place). Host-plan them
+            # against the device plans' claimed victims, inside the
+            # remaining per-cycle ask budget, so a handle full of other
+            # asks can never starve them of preemption.
+            from yunikorn_tpu.ops.preempt import MAX_PREEMPTING_ASKS_PER_CYCLE
+
+            handled = {a.allocation_key for a in handle.asks}
+            budget = MAX_PREEMPTING_ASKS_PER_CYCLE - len(handle.asks)
+            residue = [a for a in unplaced_asks
+                       if a.allocation_key not in handled
+                       and a.allocation_key not in self._preempted_for]
+            if residue and budget > 0:
+                claimed = {v.uid for p in plans for v in p.victims}
+                r_plans, r_att = plan_preemptions(
+                    self.cache, residue, app_of_pod, inflight_by_node,
+                    candidate_nodes=handle.node_list,
+                    already_victim=claimed, max_asks=budget)
+                plans += r_plans
+                attempted += r_att
+        else:
+            planner = "host"
+            eligible = [a for a in unplaced_asks
+                        if a.allocation_key not in self._preempted_for]
+            plans, attempted = plan_preemptions(
+                self.cache, eligible, app_of_pod, inflight_by_node,
+                candidate_nodes=self._preempt_candidate_nodes())
         for key in attempted:
             # cooldown failed attempts too: an unplaceable ask must not
             # rescan the cluster every cycle
@@ -1083,9 +1254,51 @@ class CoreScheduler(SchedulerAPI):
                 confirmed = self._release_allocation(rel)
                 if confirmed is not None:
                     preempt_releases.append(confirmed)
+        plan_ms = (time.time() - t0) * 1000 + float(stats.get("dispatch_ms", 0.0))
+        if attempted or plans:
+            # declared lazily at first pressure cycle: a histogram family
+            # with zero children fails the exposition validator, and most
+            # deployments never preempt
+            self.obs.histogram(
+                "preemption_plan_ms",
+                "host-side preemption planning latency per pressure cycle "
+                "(device = victim sync + encode + dispatch + confirm; the "
+                "device solve itself overlaps the commit)",
+                labelnames=("planner",), buckets=MS_BUCKETS,
+            ).observe(plan_ms, planner=planner)
+            self._g_preempt_last_ms.set(round(plan_ms, 3))
+            # per-plan provenance: a device-branch pass can still emit
+            # host plans (unsupported groups, confirmation fallbacks, the
+            # residue pass) — attribute each plan by who actually made it
+            for p in ("device", "host"):
+                n = sum(1 for plan in plans if plan.planner == p)
+                if n:
+                    self._m_preempt_plans.inc(n, planner=p)
+            if cycle_id is not None:
+                extra = ({"compiled": stats["compiled"]}
+                         if "compiled" in stats else {})
+                self.tracer.add("preempt", cycle_id, t0, time.time(),
+                                planner=planner, plans=len(plans),
+                                victims=len(preempt_releases), **extra)
+            for plan in plans:
+                self._recent_preemptions.append({
+                    "at": round(now, 3),
+                    "cycle": cycle_id,
+                    "planner": plan.planner,
+                    "ask": plan.ask.allocation_key,
+                    "node": plan.node_id,
+                    "victims": [v.uid for v in plan.victims],
+                })
         if preempt_releases:
             self._m_preempted.inc(len(preempt_releases))
+            self._m_preempt_victims.inc(len(preempt_releases),
+                                        reason="priority")
         return preempt_releases
+
+    def recent_preemptions(self) -> List[dict]:
+        """Last preemption plans, newest last (REST surface)."""
+        with self._lock:
+            return list(self._recent_preemptions)
 
     def _schedule_partition(self, restrict_nodes: bool = False) -> Tuple[int, tuple]:
         """One SEQUENTIAL cycle for the ACTIVE partition (core lock held);
@@ -1111,6 +1324,7 @@ class CoreScheduler(SchedulerAPI):
         unplaced_asks: List = []
         fallback_keys: List[str] = []   # allocs placed by the fallback drain
         fb_rounds = 0
+        preempt_handle = None
         t_gate = time.time()
         if admitted:
             # overlay BEFORE sync: an assume landing in between then counts
@@ -1138,6 +1352,10 @@ class CoreScheduler(SchedulerAPI):
             # up to here was async dispatch
             assigned = np.asarray(result.assigned)[: batch.num_pods]
             t_solve = time.time()
+            # second-stage dispatch: the batched victim-selection solve for
+            # the rows the assignment left unplaced runs on device while the
+            # commit does host bookkeeping below
+            preempt_handle = self._preempt_dispatch(admitted, batch, assigned)
             (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
              fb_rounds) = self._commit_solve(admitted, batch, assigned,
                                              policy, node_mask, cycle_id=cid)
@@ -1150,7 +1368,10 @@ class CoreScheduler(SchedulerAPI):
         t_commit = time.time()
 
         # preemption: try to make room for unplaced high-priority asks
-        preempt_releases = self._plan_preemption(unplaced_asks)
+        # (the batched victim solve was dispatched before the commit and
+        # overlapped it; this finishes and confirms it)
+        preempt_releases = self._plan_preemption(unplaced_asks,
+                                                 preempt_handle, cycle_id=cid)
 
         # the publish payload is delivered by schedule_once AFTER the core
         # lock is released (callbacks may re-enter the core from other
@@ -1383,6 +1604,13 @@ class CoreScheduler(SchedulerAPI):
             self._use_partition("default")
             self._inflight_ask_keys = set()
             self._inflight_gate_seed = []
+            # second pipeline stage: dispatch the batched victim-selection
+            # solve for the unplaced rows BEFORE the commit's host
+            # bookkeeping — the device plans preemptions while the host
+            # commits; _plan_preemption below confirms against post-commit
+            # state
+            preempt_handle = self._preempt_dispatch(cyc.admitted, batch,
+                                                    assigned)
             (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
              fb_rounds) = self._commit_solve(cyc.admitted, batch, assigned,
                                              cyc.policy, None,
@@ -1396,7 +1624,8 @@ class CoreScheduler(SchedulerAPI):
             self._m_solve_ms.inc(int(
                 (time.time() - cyc.t_prepare_start) * 1000))
             t_commit = time.time()
-            preempt_releases = self._plan_preemption(unplaced_asks)
+            preempt_releases = self._plan_preemption(
+                unplaced_asks, preempt_handle, cycle_id=cyc.cycle_id)
             end = time.time()
             solve_ms = (t_mat1 - cyc.t_dispatched) * 1000
             # host time between dispatch and materialization = the next

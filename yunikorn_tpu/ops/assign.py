@@ -1020,6 +1020,29 @@ def _sort_pods_by_rank(np_args):
     return tuple(out), order
 
 
+def apply_free_delta(free_i, free_delta):
+    """Subtract the core's in-flight overlay from integer free capacity.
+
+    Single source for the overlay arithmetic (ceil to device units, clip to
+    the possibly-differing shapes) shared by the allocation solve's host and
+    device-mirror paths AND the preemption planner's arg prep — the
+    planners' view of free capacity must never drift from the solver's.
+    free_i may be host numpy or a committed device array.
+    """
+    import numpy as np
+
+    M, R = free_i.shape
+    d = np.zeros((M, R), np.int32)
+    rows = min(M, free_delta.shape[0])
+    cols = min(R, free_delta.shape[1])
+    d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
+    if isinstance(free_i, np.ndarray):
+        return free_i - d
+    import jax.numpy as jnp_mod
+
+    return free_i - jnp_mod.asarray(d)
+
+
 def pad2d(arr, width, fill):
     """Pad or clamp the second dim of a [G, m] host array to `width` — the
     node capacity may have grown (or a sharded view may be narrower) since
@@ -1067,12 +1090,8 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
 
         dev = device_state
         free_i = dev["free_i"]
-        M, R = free_i.shape
         if free_delta is not None:
-            d = np.zeros((M, R), np.int32)
-            rows, cols = min(M, free_delta.shape[0]), min(R, free_delta.shape[1])
-            d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
-            free_i = free_i - jnp.asarray(d)
+            free_i = apply_free_delta(free_i, free_delta)
         cap_i = dev["cap_i"]
         node_ports_u32 = dev["ports"]
         if ports_delta is not None:
@@ -1090,11 +1109,7 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
     free_i = np.floor(na.free).astype(np.int32)
     if free_delta is not None:
         # overlay may be narrower/shorter than the (possibly grown) node arrays
-        d = np.zeros_like(free_i)
-        rows = min(free_i.shape[0], free_delta.shape[0])
-        cols = min(free_i.shape[1], free_delta.shape[1])
-        d[:rows, :cols] = np.ceil(free_delta[:rows, :cols]).astype(np.int32)
-        free_i = free_i - d
+        free_i = apply_free_delta(free_i, free_delta)
     cap_i = np.floor(na.capacity_arr).astype(np.int32)
     # node port occupancy = cache-visible pods + in-flight allocations (an
     # allocation committed last cycle whose assume hasn't landed holds its

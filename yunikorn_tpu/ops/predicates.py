@@ -26,24 +26,27 @@ import jax
 import jax.numpy as jnp
 
 
-def group_feasibility(
+def group_screen(
     g_term_req,    # [G, T, W] uint32
     g_term_forb,   # [G, T, W] uint32
     g_term_valid,  # [G, T] bool
     g_anyof,       # [G, T, E, W] uint32
     g_anyof_valid, # [G, T, E] bool
     g_tol,         # [G, Wt] uint32
-    g_ports,       # [G, Wp] uint32
     node_labels,   # [M, W] uint32
     node_taints,   # [M, Wt] uint32 (hard effects only)
-    node_ports,    # [M, Wp] uint32
     node_ok,       # [M] bool (valid & schedulable)
 ) -> jnp.ndarray:  # [G, M] bool
+    """Selector/affinity + taints + schedulable — the port-free subset of
+    group_feasibility. This is exactly the preemption planner's candidate
+    screen: host ports and capacity are deliberately absent (evicting victims
+    can free both, so they are tested against the post-eviction state by the
+    victim-subset search, not here — the host planner's screen passes
+    "insufficient resources" and "host port conflict" the same way)."""
     G, T, W = g_term_req.shape
     E = g_anyof.shape[2]
     M = node_labels.shape[0]
     Wt = g_tol.shape[1]
-    Wp = g_ports.shape[1]
 
     # --- selector / affinity terms ---
     term_ok = jnp.ones((G, T, M), bool)
@@ -63,12 +66,36 @@ def group_feasibility(
     for w in range(Wt):
         taint_bad |= (node_taints[:, w][None, :] & ~g_tol[:, w][:, None]) != 0
 
+    return sel_ok & ~taint_bad & node_ok[None, :]
+
+
+def group_feasibility(
+    g_term_req,    # [G, T, W] uint32
+    g_term_forb,   # [G, T, W] uint32
+    g_term_valid,  # [G, T] bool
+    g_anyof,       # [G, T, E, W] uint32
+    g_anyof_valid, # [G, T, E] bool
+    g_tol,         # [G, Wt] uint32
+    g_ports,       # [G, Wp] uint32
+    node_labels,   # [M, W] uint32
+    node_taints,   # [M, Wt] uint32 (hard effects only)
+    node_ports,    # [M, Wp] uint32
+    node_ok,       # [M] bool (valid & schedulable)
+) -> jnp.ndarray:  # [G, M] bool
+    G = g_term_req.shape[0]
+    M = node_labels.shape[0]
+    Wp = g_ports.shape[1]
+
+    base_ok = group_screen(g_term_req, g_term_forb, g_term_valid, g_anyof,
+                           g_anyof_valid, g_tol, node_labels, node_taints,
+                           node_ok)
+
     # --- host-port conflicts ---
     port_bad = jnp.zeros((G, M), bool)
     for w in range(Wp):
         port_bad |= (g_ports[:, w][:, None] & node_ports[:, w][None, :]) != 0
 
-    return sel_ok & ~taint_bad & ~port_bad & node_ok[None, :]
+    return base_ok & ~port_bad
 
 
 def group_preferred_bonus(

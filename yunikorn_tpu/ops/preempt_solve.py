@@ -1,0 +1,247 @@
+"""Batched preemption victim-selection on device.
+
+Replaces the host planner's triple loop — asks × candidate nodes × victims,
+one `preemption_victim_search` per (ask, node) (core/preemption.py) — with ONE
+jitted dispatch that plans for every unplaced ask against every node at once.
+This is the preemption analog of what ops/assign.py did to the allocation
+cycle: the per-entity sequential pattern (CvxCluster / POP, PAPERS.md) turned
+into a dense batched solve.
+
+Data model (encoded by snapshot/encoder.py with the same incremental-upload
+discipline as free/ports):
+
+  victim_req   [M, V, R] int32  per-node victim freed-resource rows, already
+                                in eviction order (priority asc, newest first
+                                — ops.preempt.victim_table is the single
+                                source; the sort happens at encode, so the
+                                device consumes pre-ordered tables)
+  victim_prio  [M, V]    int32  victim priorities (pad slots = 2^30)
+  victim_valid [M, V]    bool   slot holds a managed, preemptable victim
+  victim_app   [M, V]    int32  interned app/gang id (host-side bookkeeping;
+                                rides the table for future gang-aware logic)
+
+Per ask (processed in priority order inside one fori_loop, carrying the
+cross-ask claimed-victim mask — the device equivalent of the host planner's
+`already_victim` set):
+
+  1. eligibility: valid slot, victim priority strictly below the ask's,
+     not claimed by an earlier ask this cycle
+  2. prefix-scan the eligible victims' freed capacity per node with the
+     saturating-add idiom from ops/assign._water_fill_proposals
+  3. fit test: free + prefix >= ask request at every resource column — the
+     ordered-subset contract of ops/preempt.preemption_victim_search: the
+     first eligible slot whose cumulative removal fits is the chosen prefix
+     (the zero-removals case is never tested, matching the reference)
+  4. candidate screen: the port-free predicate mask (selector/affinity +
+     taints + schedulable — ops.predicates.group_screen), nodes with at
+     least one eligible victim, capped to the first MAX_CANDIDATE_NODES such
+     nodes in cache order (the host planner's search budget, applied
+     arithmetically for exact parity)
+  5. choose the node minimizing (victim count, victim priority sum, cache
+     order) lexicographically — the host planner's strict-< tie-breaking
+
+Resource arithmetic is int32 in device units: ask requests ceil, freed victim
+capacity floor — both conservative, and exact whenever quantities are integral
+in device units (the vocab scales are chosen for that). Priority sums clamp
+each victim's contribution to ±PRIO_SUM_CLAMP on BOTH planners (shared
+helper), so the int32 sum cannot wrap; the comparison stays exact for any
+realistic priority band.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from yunikorn_tpu.ops.predicates import group_screen
+from yunikorn_tpu.ops.preempt import (
+    MAX_CANDIDATE_NODES,
+    MAX_PREEMPTING_ASKS_PER_CYCLE,
+    PRIO_SUM_CLAMP,
+)
+
+# node_order sentinel: rows at/above this are not candidates (padded rows,
+# nodes the core excluded). Also the masked-key sentinel for the argmin.
+_BIG = jnp.int32(2**30)
+NODE_ORDER_EXCLUDED = 2**30
+
+
+@functools.partial(jax.jit, static_argnames=("max_candidates",))
+def preempt_solve(
+    a_req,          # [A, R] int32 ask requests (priority-desc order)
+    a_gid,          # [A] int32 constraint-group ids
+    a_prio,         # [A] int32 ask priorities
+    a_valid,        # [A] bool
+    g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid, g_tol,
+    node_labels,    # [M, W] uint32
+    node_taints,    # [M, Wt] uint32 (hard effects)
+    node_ok,        # [M] bool (valid & schedulable)
+    node_order,     # [M] int32 position in cache node order; big = excluded
+    free,           # [M, R] int32 (available minus in-flight overlay)
+    victim_req,     # [M, V, R] int32
+    victim_prio,    # [M, V] int32
+    victim_valid,   # [M, V] bool
+    *,
+    max_candidates: int = MAX_CANDIDATE_NODES,
+):
+    """Returns (node_idx [A] int32 — chosen node row or -1, victim_mask
+    [A, V] bool — chosen slots of that node's victim table)."""
+    A, R = a_req.shape
+    M, V, _ = victim_req.shape
+    CAP = jnp.int32(2**30 - 1)
+    slot_idx = jnp.arange(V, dtype=jnp.int32)
+    row_idx = jnp.arange(M, dtype=jnp.int32)
+
+    # hoisted across asks: the candidate screen and the cache-order ranking
+    screen = group_screen(g_term_req, g_term_forb, g_term_valid, g_anyof,
+                          g_anyof_valid, g_tol, node_labels, node_taints,
+                          node_ok)                                   # [G, M]
+    order_perm = jnp.argsort(node_order)                             # [M]
+    free_c = jnp.minimum(free, CAP)                                  # [M, R]
+    prio_clamped = jnp.clip(victim_prio, -PRIO_SUM_CLAMP, PRIO_SUM_CLAMP)
+
+    sat_add = lambda a, b: jnp.minimum(a + b, CAP)
+
+    def plan_one(i, claimed):
+        elig = victim_valid & (victim_prio < a_prio[i]) & ~claimed   # [M, V]
+        vreq = jnp.where(elig[:, :, None],
+                         jnp.minimum(victim_req, CAP), 0)            # [M, V, R]
+        cum = lax.associative_scan(sat_add, vreq, axis=1)            # inclusive
+        fits = jnp.all(free_c[:, None, :] + cum >= a_req[i][None, None, :],
+                       axis=-1) & elig                               # [M, V]
+        # ordered-subset contract: first eligible slot whose cumulative
+        # removal fits (ineligible slots free nothing and are never tested —
+        # they are simply absent from the host kernel's victim list)
+        first = jnp.min(jnp.where(fits, slot_idx[None, :], V), axis=1)  # [M]
+        success = first < V
+        prefix = elig & (slot_idx[None, :] <= first[:, None])        # [M, V]
+        nvic = jnp.sum(prefix.astype(jnp.int32), axis=1)             # [M]
+        psum = jnp.sum(jnp.where(prefix, prio_clamped, 0), axis=1)   # [M]
+        # candidate screen + the host planner's search budget: only the
+        # first max_candidates nodes (cache order) with a non-empty filtered
+        # victim list and a passing screen are searched
+        searchable = (screen[a_gid[i]] & jnp.any(elig, axis=1)
+                      & (node_order < _BIG))
+        rank_sorted = jnp.cumsum(searchable[order_perm].astype(jnp.int32)) - 1
+        rank = jnp.zeros((M,), jnp.int32).at[order_perm].set(rank_sorted)
+        cand = searchable & (rank < max_candidates) & success
+        # lexicographic argmin (victims, prio sum, cache order) — the host
+        # planner's strict-< keeps the first node in iteration order on
+        # ties. Staged min-reductions instead of a lexsort: a full sort
+        # network at M inside the ask loop measured ~20x the compile cost
+        # on CPU for an argmin three reductions deliver exactly.
+        nvic_k = jnp.where(cand, nvic, _BIG)
+        tie1 = cand & (nvic_k == jnp.min(nvic_k))
+        psum_k = jnp.where(tie1, psum, _BIG)
+        tie2 = tie1 & (psum_k == jnp.min(psum_k))
+        order_k = jnp.where(tie2, node_order, _BIG)
+        best = jnp.argmin(order_k)
+        found = jnp.any(cand)
+        chosen_mask = jnp.where(found, prefix[best], False)          # [V]
+        node = jnp.where(found, best, -1)
+        claimed = claimed | (chosen_mask[None, :] & (row_idx == best)[:, None]
+                             & found)
+        return node.astype(jnp.int32), chosen_mask, claimed
+
+    def body(i, state):
+        claimed, out_node, out_mask = state
+
+        def do_plan(operand):
+            claimed_in, out_node_in, out_mask_in = operand
+            node, mask, claimed_out = plan_one(i, claimed_in)
+            return (claimed_out, out_node_in.at[i].set(node),
+                    out_mask_in.at[i].set(mask))
+
+        def skip(operand):
+            return operand
+
+        # padded ask rows skip the whole [M, V, R] scan, so the fixed A
+        # shape costs nothing when few asks preempt
+        return lax.cond(a_valid[i], do_plan, skip,
+                        (claimed, out_node, out_mask))
+
+    init = (
+        jnp.zeros((M, V), bool),
+        jnp.full((A,), -1, jnp.int32),
+        jnp.zeros((A, V), bool),
+    )
+    _, out_node, out_mask = lax.fori_loop(0, A, body, init)
+    return out_node, out_mask
+
+
+def prepare_preempt_args(batch, n_asks, prios, node_arrays, node_order, *,
+                         free_delta=None, device_state=None):
+    """Assemble preempt_solve's positional args.
+
+    batch: a PodBatch encoding the preempting asks (rows 0..n_asks-1, already
+    in priority-desc order) — batch.req rows are quantize_request outputs,
+    i.e. already ceil'd to integers, so the int32 view below is the exact
+    ceil the kernel contract requires; prios: their int priorities. node_order: [M]
+    int32 cache-order ranks (big = not a candidate). device_state: the
+    persistent device mirror INCLUDING victim fields
+    (SnapshotEncoder.victim_arrays) — node-side tensors then transfer
+    O(what changed); without it, host numpy views upload per call.
+    free_delta: the core's in-flight allocation overlay ([capacity, R] float).
+    """
+    import numpy as np
+
+    na = node_arrays
+    A = MAX_PREEMPTING_ASKS_PER_CYCLE
+    R = batch.req.shape[1]
+    a_req = np.zeros((A, R), np.int32)
+    a_gid = np.zeros((A,), np.int32)
+    a_prio = np.zeros((A,), np.int32)
+    a_valid = np.zeros((A,), bool)
+    n = min(n_asks, A)
+    a_req[:n] = batch.req[:n].astype(np.int32)
+    a_gid[:n] = batch.group_id[:n]
+    a_prio[:n] = np.asarray(list(prios[:n]), np.int32)
+    a_valid[:n] = True
+
+    from yunikorn_tpu.ops.assign import apply_free_delta
+
+    if device_state is not None:
+        free_i = device_state["free_i"]
+        if free_delta is not None:
+            free_i = apply_free_delta(free_i, free_delta)
+        labels = device_state["labels"]
+        taints = device_state["taints_hard"]
+        node_ok = device_state["node_ok"]
+        victim_req = device_state["victim_req"]
+        victim_prio = device_state["victim_prio"]
+        victim_valid = device_state["victim_valid"]
+    else:
+        free_i = np.floor(na.free).astype(np.int32)
+        if free_delta is not None:
+            free_i = apply_free_delta(free_i, free_delta)
+        labels = na.labels.view(np.uint32)
+        taints = na.taints_hard.view(np.uint32)
+        node_ok = na.valid & na.schedulable
+        victim_req = na.victim_req
+        victim_prio = na.victim_prio
+        victim_valid = na.victim_valid
+
+    return (
+        a_req, a_gid, a_prio, a_valid,
+        batch.g_term_req.view(np.uint32),
+        batch.g_term_forb.view(np.uint32),
+        batch.g_term_valid,
+        batch.g_anyof.view(np.uint32),
+        batch.g_anyof_valid,
+        batch.g_tol.view(np.uint32),
+        labels, taints, node_ok,
+        node_order,
+        free_i,
+        victim_req, victim_prio, victim_valid,
+    )
+
+
+def preempt_jit_cache_entries() -> int:
+    """Compiled-variant count of the preemption kernel (compile-vs-hit
+    accounting, same contract as ops.assign.jit_cache_entries)."""
+    try:
+        return preempt_solve._cache_size()
+    except Exception:
+        return -1

@@ -170,3 +170,37 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
             *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after,
                                   rounds=rounds, accept_round=around)
+
+
+def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int):
+    """Node-dimension sharded dispatch of ops.preempt_solve.preempt_solve.
+
+    Same layout contract as solve_sharded: ask/group args replicate (tiny —
+    at most 32 ask rows), node-side tensors — including the [M, V, R] victim
+    tables — shard along M; the per-ask lexicographic argmin over nodes
+    becomes a sharded reduce over ICI. np_args is
+    ops.preempt_solve.prepare_preempt_args' tuple; victim tables already
+    committed with this mesh's shardings (SnapshotEncoder.victim_arrays)
+    are recognized by device_put and skip the transfer.
+    """
+    from yunikorn_tpu.ops import preempt_solve as ps_mod
+
+    node_s, node_s2, repl = _shardings(mesh)
+    node_s3 = NamedSharding(mesh, P(NODE_AXIS, None, None))
+    (a_req, a_gid, a_prio, a_valid, g_term_req, g_term_forb, g_term_valid,
+     g_anyof, g_anyof_valid, g_tol, labels, taints, node_ok, node_order,
+     free_i, victim_req, victim_prio, victim_valid) = np_args
+    put = jax.device_put
+    args = (
+        put(a_req, repl), put(a_gid, repl), put(a_prio, repl),
+        put(a_valid, repl),
+        put(g_term_req, repl), put(g_term_forb, repl), put(g_term_valid, repl),
+        put(g_anyof, repl), put(g_anyof_valid, repl), put(g_tol, repl),
+        put(labels, node_s2), put(taints, node_s2), put(node_ok, node_s),
+        put(node_order, node_s),
+        put(free_i, node_s2),
+        put(victim_req, node_s3), put(victim_prio, node_s2),
+        put(victim_valid, node_s2),
+    )
+    with mesh:
+        return ps_mod.preempt_solve(*args, max_candidates=max_candidates)

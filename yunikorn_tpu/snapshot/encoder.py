@@ -52,6 +52,10 @@ MAX_TERMS = 8        # OR-terms per group (nodeSelector + affinity terms)
 MAX_ANYOF = 8        # multi-value In expressions per term
 MAX_PREF_TERMS = 4   # preferredDuringScheduling terms per group (scoring)
 
+# victim-table padding priority: bigger than any real pod priority (K8s
+# priorities are int32), so padded slots never look preemptable on device
+VICTIM_PRIO_PAD = 2**30
+
 
 from yunikorn_tpu.snapshot.vocab import _next_pow2 as _bucket
 
@@ -60,6 +64,15 @@ from yunikorn_tpu.snapshot.vocab import _next_pow2 as _bucket
 # DeviceNodeState uploads must agree, or a stale array is served as "clean")
 DEVICE_FIELDS = ("free_i", "cap_i", "labels", "taints_hard", "taints_soft",
                  "ports", "node_ok")
+
+# victim-table mirror (the batched preemption planner's node-side state).
+# Maintained lazily — sync_victims runs only on preemption-pressure cycles —
+# and uploaded as its own field group so allocation-path refreshes never pay
+# for it. One encode writes a node's whole table, so the group is
+# dirty-tracked as a unit rather than per field. victim_app (the interned
+# app/gang id column) stays HOST-side only: no kernel consumes it yet, so
+# uploading it would be dead bytes on the pressure path.
+VICTIM_FIELDS = ("victim_req", "victim_prio", "victim_valid")
 
 
 def _set_bit(arr: np.ndarray, bit: int) -> None:
@@ -208,6 +221,11 @@ class PodBatch:
     # StorageClass/DRA object stores don't bump cache.generation): such a
     # batch must never be served from build_batch_cached's memo
     cacheable: bool = True
+    # [G] bool: the group's constraints exceed what the device preemption
+    # planner models (host-evaluated expressions, OR-affinity fallback, host
+    # ports, DRA claims, volume restrictions) — asks in such groups take the
+    # exact host planner instead
+    g_preempt_host: Optional[np.ndarray] = None
 
     @property
     def placement_dependent(self) -> bool:
@@ -222,6 +240,8 @@ class NodeArrays:
     """Incrementally maintained dense node-side state."""
 
     def __init__(self, vocabs: Vocabs, min_capacity: int = 128):
+        from yunikorn_tpu.ops.preempt import MAX_VICTIMS_PER_NODE
+
         self.vocabs = vocabs
         self.capacity = min_capacity
         self._name_to_idx: Dict[str, int] = {}
@@ -231,6 +251,7 @@ class NodeArrays:
         self._W = vocabs.labels.num_words
         self._Wt = vocabs.taints.num_words
         self._Wp = vocabs.ports.num_words
+        self.victim_slots = MAX_VICTIMS_PER_NODE
         self._alloc_arrays()
         self.version = 0
 
@@ -244,6 +265,21 @@ class NodeArrays:
         self.ports = np.zeros((m, self._Wp), np.uint32)
         self.schedulable = np.zeros((m,), bool)
         self.valid = np.zeros((m,), bool)
+        # per-node victim tables for the batched preemption planner:
+        # MAX_VICTIMS_PER_NODE rows per node in eviction order (priority asc,
+        # newest first — ops.preempt.victim_table is the single source of the
+        # ordering). victim_prio pads with VICTIM_PRIO_PAD so empty slots
+        # never pass the `< ask priority` eligibility test on device.
+        V = self.victim_slots
+        self.victim_req = np.zeros((m, V, self._R), np.int32)
+        self.victim_prio = np.full((m, V), VICTIM_PRIO_PAD, np.int32)
+        self.victim_valid = np.zeros((m, V), bool)
+        self.victim_app = np.full((m, V), -1, np.int32)
+        # row -> tuple of victim uids in table order (host-side identity for
+        # turning a device-chosen (node, slot-prefix) back into releases)
+        self.victim_uids: Dict[int, tuple] = getattr(self, "victim_uids", {})
+        self.victim_version = getattr(self, "victim_version", 0)
+        self._victim_dirty: bool = True
         # live nodes carrying PreferNoSchedule taints (gates the fused Pallas
         # kernel without scanning the padded arrays per solve)
         self._soft_taint_rows: set = getattr(self, "_soft_taint_rows", set())
@@ -264,9 +300,16 @@ class NodeArrays:
         if not self._free_rows:
             old = self.capacity
             self.capacity *= 2
-            for arr_name in ("free", "capacity_arr", "labels", "taints_hard", "taints_soft", "ports"):
+            for arr_name in ("free", "capacity_arr", "labels", "taints_hard",
+                             "taints_soft", "ports", "victim_req"):
                 arr = getattr(self, arr_name)
                 new = np.zeros((self.capacity,) + arr.shape[1:], arr.dtype)
+                new[:old] = arr
+                setattr(self, arr_name, new)
+            for arr_name, fill in (("victim_prio", VICTIM_PRIO_PAD),
+                                   ("victim_app", -1)):
+                arr = getattr(self, arr_name)
+                new = np.full((self.capacity,) + arr.shape[1:], fill, arr.dtype)
                 new[:old] = arr
                 setattr(self, arr_name, new)
             for arr_name in ("schedulable", "valid"):
@@ -274,6 +317,9 @@ class NodeArrays:
                 new = np.zeros((self.capacity,), arr.dtype)
                 new[:old] = arr
                 setattr(self, arr_name, new)
+            vv = np.zeros((self.capacity,) + self.victim_valid.shape[1:], bool)
+            vv[:old] = self.victim_valid
+            self.victim_valid = vv
             self._free_rows = list(range(old, self.capacity))
             grew = True
         # vocab growth: re-pad the bitset/resource dims
@@ -293,11 +339,17 @@ class NodeArrays:
             self.taints_hard = repad(self.taints_hard, Wt)
             self.taints_soft = repad(self.taints_soft, Wt)
             self.ports = repad(self.ports, Wp)
+            if self.victim_req.shape[2] != R:
+                new = np.zeros((self.victim_req.shape[0],
+                                self.victim_req.shape[1], R), np.int32)
+                new[:, :, : self.victim_req.shape[2]] = self.victim_req
+                self.victim_req = new
             self._R, self._W, self._Wt, self._Wp = R, W, Wt, Wp
             grew = True
         if grew:
             self.version += 1
             self._full_dirty = True
+            self._victim_dirty = True
 
     def index_of(self, name: str) -> Optional[int]:
         return self._name_to_idx.get(name)
@@ -413,6 +465,7 @@ class NodeArrays:
         self.taints_soft[idx] = 0
         self.ports[idx] = 0
         self._soft_taint_rows.discard(idx)
+        self._clear_victim_row(idx)
         self._free_rows.append(idx)
         self.version += 1
         self._dirty_fields |= set(DEVICE_FIELDS)
@@ -423,6 +476,45 @@ class NodeArrays:
             self.schedulable[idx] = schedulable
             self.version += 1
             self._dirty_fields.add("node_ok")
+
+    def _clear_victim_row(self, idx: int) -> None:
+        if self.victim_valid[idx].any() or idx in self.victim_uids:
+            self.victim_req[idx] = 0
+            self.victim_prio[idx] = VICTIM_PRIO_PAD
+            self.victim_valid[idx] = False
+            self.victim_app[idx] = -1
+            self.victim_uids.pop(idx, None)
+            self.victim_version += 1
+            self._victim_dirty = True
+
+    def encode_victims(self, idx: int, rows, prios, apps, uids) -> None:
+        """Write one node's victim table (rows already in eviction order and
+        truncated to the slot budget — ops.preempt.victim_table's contract).
+        rows: [n, <=R] int32 quantized freed-resource rows."""
+        V = self.victim_slots
+        n = min(len(uids), V)
+        self.victim_req[idx] = 0
+        self.victim_prio[idx] = VICTIM_PRIO_PAD
+        self.victim_valid[idx] = False
+        self.victim_app[idx] = -1
+        for j in range(n):
+            row = rows[j]
+            self.victim_req[idx, j, : row.shape[0]] = row
+            self.victim_prio[idx, j] = prios[j]
+            self.victim_valid[idx, j] = True
+            self.victim_app[idx, j] = apps[j]
+        if n:
+            self.victim_uids[idx] = tuple(uids[:n])
+        else:
+            self.victim_uids.pop(idx, None)
+        self.victim_version += 1
+        self._victim_dirty = True
+
+    def take_victim_dirty(self) -> bool:
+        """True when the victim tables changed since the last take (single
+        consumer: DeviceNodeState's victim-group refresh)."""
+        dirty, self._victim_dirty = self._victim_dirty, False
+        return dirty
 
     def take_device_dirty(self) -> Tuple[bool, set]:
         """(full, fields) delta since the last take, for the device mirror.
@@ -473,6 +565,12 @@ class DeviceNodeState:
         self._arrays: Optional[dict] = None
         self._dims: Optional[tuple] = None
         self._mesh = None
+        # victim-table mirror (refresh_victims): its own buffers + dirty
+        # cycle so the allocation path never uploads it
+        self._victim_arrays: Optional[dict] = None
+        self._victim_dims: Optional[tuple] = None
+        self._victim_mesh = None
+        self.last_victim_refresh = "none"   # none | clean | full
         # statistics for tests / the bench smoke: how the last refresh ran
         self.last_refresh = "none"   # none | clean | fields | full
         self.last_fields: tuple = ()
@@ -509,7 +607,9 @@ class DeviceNodeState:
             return jax.device_put(arr)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        spec = P("nodes") if arr.ndim == 1 else P("nodes", None)
+        # every mirror array is node-major; trailing dims (victim slot,
+        # resource) stay replicated within the shard
+        spec = P("nodes", *([None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(mesh, spec))
 
     def refresh(self, mesh=None) -> dict:
@@ -551,6 +651,37 @@ class DeviceNodeState:
         self.upload_bytes += uploaded
         return self._arrays
 
+    def refresh_victims(self, mesh=None) -> dict:
+        """Bring the victim-table mirror up to date and return the base
+        arrays merged with the victim group. Separate from refresh(): the
+        allocation hot path never touches (or uploads) victim state; the
+        preemption path pays for it only when the tables actually changed
+        (same O(what changed) contract, group-granular)."""
+        base = self.refresh(mesh=mesh)
+        na = self.nodes
+        vdims = (na.capacity, na.victim_slots, na._R)
+        stale = na.take_victim_dirty()
+        if (self._victim_arrays is None or stale or vdims != self._victim_dims
+                or mesh is not self._victim_mesh):
+            views = {f: getattr(na, f) for f in VICTIM_FIELDS}
+            try:
+                self._victim_arrays = {k: self._put(v, mesh)
+                                       for k, v in views.items()}
+            except Exception:
+                # the dirty flag was consumed; a failed upload must not leave
+                # later planners reading a stale mirror as "clean"
+                na._victim_dirty = True
+                raise
+            self._victim_dims = vdims
+            self._victim_mesh = mesh
+            self.upload_bytes += sum(v.nbytes for v in views.values())
+            self.last_victim_refresh = "full"
+        else:
+            self.last_victim_refresh = "clean"
+        out = dict(base)
+        out.update(self._victim_arrays)
+        return out
+
 
 class SnapshotEncoder:
     """Maintains NodeArrays against a SchedulerCache + encodes pod batches."""
@@ -567,6 +698,15 @@ class SnapshotEncoder:
         self._group_cache_max = 8192
         self._unschedulable_overrides: Dict[str, bool] = {}
         self._taint_version = 0
+        # victim-table staleness: node names whose tables need re-encode at
+        # the next sync_victims. Fed by sync_nodes (pod churn marks the node
+        # dirty) and by the core's allocation bookkeeping hooks
+        # (mark_victims_stale); consumed lazily so allocation-only cycles
+        # never pay for victim encoding.
+        self._victim_stale: set = set()
+        self._victims_synced = False
+        # app-id interning for the victim tables' app/gang column
+        self._app_ids: Dict[str, int] = {}
         # device-resident node mirror, built lazily at the first solve (its
         # construction initializes the JAX backend)
         self.device: Optional[DeviceNodeState] = None
@@ -579,6 +719,90 @@ class SnapshotEncoder:
         if self.device is None:
             self.device = DeviceNodeState(self.nodes)
         return self.device.refresh(mesh=mesh)
+
+    def victim_arrays(self, mesh=None) -> dict:
+        """Refresh and return the device node tensors INCLUDING the victim
+        tables (the batched preemption planner's inputs). Call sync_victims
+        first so the tables reflect the current cache."""
+        if self.device is None:
+            self.device = DeviceNodeState(self.nodes)
+        return self.device.refresh_victims(mesh=mesh)
+
+    def mark_victims_stale(self, node_name: str) -> None:
+        """Core hook: allocation bookkeeping changed for this node (an
+        allocation was committed, released or restored), so its pods'
+        managed-ness — and therefore its victim table — may have changed
+        without any cache-side pod event."""
+        self._victim_stale.add(node_name)
+
+    def sync_victims(self, app_of_pod: Dict[str, str], pc_lookup) -> int:
+        """Re-encode victim tables for stale nodes (lazy incremental path).
+
+        app_of_pod: victim pod uid -> application id — membership defines
+        "yunikorn-managed" exactly like the host planner's filter; the app id
+        is interned into the table's app/gang column. Returns the number of
+        nodes re-encoded (0 on a clean sync: nothing uploads downstream).
+        """
+        import math
+
+        from yunikorn_tpu.common.resource import get_pod_resource
+        from yunikorn_tpu.ops.preempt import pod_priority, victim_table
+
+        if not self._victims_synced:
+            # first sync: every known node (cache and already-encoded rows)
+            self._victim_stale |= set(self.cache.node_names())
+            self._victim_stale |= set(self.nodes._name_to_idx)
+            self._victims_synced = True
+        if not self._victim_stale:
+            return 0
+        stale, self._victim_stale = self._victim_stale, set()
+        rv = self.vocabs.resources
+        managed = app_of_pod.__contains__
+        count = 0
+        # sorted: deterministic encode order (same discipline as sync_nodes)
+        for name in sorted(stale):
+            idx = self.nodes.index_of(name)
+            if idx is None:
+                continue
+            # snapshot, not get_node: informer threads mutate the live
+            # NodeInfo.pods dict under the cache lock, and victim_table
+            # iterates it — the host planner's _NodeTables snapshots for
+            # the same reason
+            info = self.cache.snapshot_node(name)
+            if info is None:
+                self.nodes._clear_victim_row(idx)
+                count += 1
+                continue
+            victims = victim_table(info, pc_lookup, managed)
+            # intern all resource names BEFORE sizing rows (vocab growth
+            # repads the arrays first — encode_node's discipline)
+            slot_rows = [[(rv.slot(n), rv.quantize(n, val))
+                          for n, val in get_pod_resource(v).resources.items()]
+                         for v in victims]
+            self.nodes.ensure_padding()
+            rows = []
+            for slots in slot_rows:
+                row = np.zeros((rv.num_slots,), np.int32)
+                for slot, val in slots:
+                    # floor: freed capacity is UNDER-estimated so a device
+                    # plan never promises an eviction the exact host search
+                    # would refuse (integral device units are exact)
+                    row[slot] = math.floor(val)
+                rows.append(row)
+            prios = []
+            apps = []
+            uids = []
+            for v in victims:
+                prios.append(pod_priority(v))
+                app = app_of_pod.get(v.uid, "")
+                aid = self._app_ids.get(app)
+                if aid is None:
+                    aid = self._app_ids[app] = len(self._app_ids)
+                apps.append(aid)
+                uids.append(v.uid)
+            self.nodes.encode_victims(idx, rows, prios, apps, uids)
+            count += 1
+        return count
 
     @staticmethod
     def placed_fingerprint(extra_placed) -> tuple:
@@ -649,6 +873,10 @@ class SnapshotEncoder:
             dirty, objects = names, names
         else:
             dirty, objects = self.cache.take_dirty_nodes()
+        # pod churn invalidates the node's victim table too; the tables are
+        # re-encoded lazily at the next sync_victims, not here — allocation
+        # cycles must not pay for preemption state they never read
+        self._victim_stale |= set(dirty)
         # sorted: dirty/objects are SETS — hash-order iteration would make
         # node row assignment (and every downstream tensor: label bitsets,
         # locality domain ids, solve inputs) vary with PYTHONHASHSEED across
@@ -1242,6 +1470,12 @@ class SnapshotEncoder:
         # stale invisibly, so these batches are excluded from the memo
         cacheable = all(spec.volumes is None and spec.claims is None
                         for spec in group_specs)
+        g_preempt_host = np.zeros((G,), bool)
+        for gi, spec in enumerate(group_specs):
+            g_preempt_host[gi] = bool(
+                spec.needs_host_eval or spec.host_affinity_terms is not None
+                or spec.ports.any() or spec.claims is not None
+                or spec.volumes is not None)
 
         locality, host_mask, host_soft, valid, deferred = self._fold_locality(
             asks, group_ids, len(group_specs), g_claims, N, G,
@@ -1274,6 +1508,7 @@ class SnapshotEncoder:
             base_host_soft=base_host_soft,
             g_claims=g_claims,
             cacheable=cacheable,
+            g_preempt_host=g_preempt_host,
         )
 
     def _fold_locality(self, asks, group_ids, num_groups, g_claims, N, G,

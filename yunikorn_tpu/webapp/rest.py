@@ -107,6 +107,13 @@ class RestServer:
                 if path == "/ws/v1/metrics":
                     # same registry snapshot that backs /metrics, as JSON
                     return self._reply(200, core.metrics_snapshot())
+                if path == "/ws/v1/preemptions":
+                    # recent preemption plans (ring-buffered): which ask
+                    # evicted which victims on which node, by which planner
+                    # (device = batched victim-selection solve, host =
+                    # fallback loop)
+                    return self._reply(200,
+                                       {"Preemptions": core.recent_preemptions()})
                 if path == "/ws/v1/events":
                     # filtered event tail (failure triage without a
                     # debugger): ?objectKey=ns/name&reason=R&count=N
