@@ -1,8 +1,8 @@
 # Developer entry points (reference Makefile analog).
 
-.PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke smoke \
-	lint run-scheduler run-admission dryrun clean image sched_image \
-	adm_image webtest_image
+.PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
+	chaos-smoke smoke lint run-scheduler run-admission dryrun clean image \
+	sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -52,7 +52,13 @@ preempt-smoke:  ## batched preemption planner: differential suite (device plan =
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		python scripts/preempt_bench.py --sizes 512,4096 --assert-speedup 4096
 
-smoke: bench-smoke obs-smoke preempt-smoke  ## all tier-1 smoke targets
+chaos-smoke:  ## fault-injection suite: every supervised device path (assign/preempt/mesh/upload) faulted — degradation-tier result-equivalence vs fault-free schedule_once, circuit re-close after recovery, /ws/v1/health transitions, pipeline no-wedge
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_solver_chaos.py \
+		tests/test_pipeline.py::test_pipeline_solve_failure_does_not_wedge \
+		-q -p no:cacheprovider
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -233,6 +233,7 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                 "vs_baseline": 0.0,
                 "error": f"{type(e2).__name__}: {e2}"[:400],
                 "init_secs": round(clock() - t0, 1),
+                "degradations": {"transitions": [], "final": {}},
             }))
             sys.exit(1)
     platform = devs[0].platform
@@ -245,6 +246,20 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
         # the 10k×50k TPU bucket cannot finish on CPU inside the budget
         _downshift_for_cpu_fallback()
     return platform
+
+
+def _degradations(core) -> dict:
+    """Per-path solver degradation record for the bench JSON: tier changes
+    that happened during the run plus the final tier of any path not on its
+    primary. A clean device run emits {"transitions": [], "final": {}} —
+    BENCH_* trajectories can tell a genuine device number from one that
+    silently fell back mid-run."""
+    try:
+        sup = core.supervisor
+        return {"transitions": sup.degradations(),
+                "final": sup.degraded_paths()}
+    except Exception:
+        return {"transitions": [], "final": {}}
 
 
 def _preempt_stat(core) -> float:
@@ -386,7 +401,7 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
         # commit/publish + sampled bind spans) is the one that lands on disk
         _dump_trace(ms.core, "shim e2e")
         return (stats.throughput(), wall, stats.success_count, len(pods),
-                _preempt_stat(ms.core))
+                _preempt_stat(ms.core), _degradations(ms.core))
     finally:
         ms.stop()
 
@@ -529,6 +544,7 @@ def main() -> int:
         "unit": "pods/s",
         "vs_baseline": round(pods_per_s / TARGET_PODS_PER_S, 3),
         "preempt_plan_ms": preempt_ms,
+        "degradations": _degradations(core),
     }
 
     if MODE == "both":
@@ -549,8 +565,8 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
     """Run the BindStats shim mode and build the bench JSON for it. With a
     core-cycle number, that stays the headline (north-star metric) and the
     shim e2e rides along; standalone shim mode publishes the shim number."""
-    shim_tp, shim_wall, bound, total, shim_preempt_ms = run_shim_mode(
-        N_PODS, N_NODES)
+    shim_tp, shim_wall, bound, total, shim_preempt_ms, shim_degr = \
+        run_shim_mode(N_PODS, N_NODES)
     print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
           f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
     if core_pods_per_s is None:
@@ -562,6 +578,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             "vs_baseline": round(shim_tp / TARGET_PODS_PER_S, 3),
             "shim_e2e_bound": bound,
             "preempt_plan_ms": shim_preempt_ms,
+            "degradations": shim_degr,
         }
     return {
         "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
@@ -576,6 +593,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         "core_cycle_warm_s": round(core_warm_s, 3),
         "preempt_plan_ms": (preempt_ms if preempt_ms is not None
                             else shim_preempt_ms),
+        "degradations": shim_degr,
     }
 
 

@@ -138,6 +138,106 @@ def test_informer_error_backoff_is_exponential():
     assert gaps[3] > gaps[0] * 1.9, gaps
 
 
+def test_informer_backoff_caps_and_restarts_are_exported():
+    """Under a PERMANENTLY failing server the reflector's exponential
+    backoff must cap at _BACKOFF_MAX (recovery latency after a long outage
+    stays bounded) and every restart must be counted in
+    informer_restarts_total — not only warned into the log."""
+    from yunikorn_tpu.client.kube import _Informer
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+    class FailingClient:
+        def __init__(self):
+            self.attempts = []
+
+        def request_json(self, *a, **k):
+            self.attempts.append(time.monotonic())
+            raise ConnectionError("boom")
+
+        def _request(self, *a, **k):  # pragma: no cover - relist fails first
+            raise ConnectionError("boom")
+
+    client = FailingClient()
+    inf = _Informer(client, InformerType.NODE)
+    inf._BACKOFF_BASE = 0.02
+    inf._BACKOFF_MAX = 0.15
+    reg = MetricsRegistry()
+    inf.attach_metrics(reg)
+    inf.run()
+    deadline = time.time() + 8
+    # enough attempts that the doubling (0.02 -> 0.15 cap) has saturated
+    while len(client.attempts) < 10 and time.time() < deadline:
+        time.sleep(0.02)
+    inf.stop()
+    attempts = list(client.attempts)
+    assert len(attempts) >= 10, "informer stopped retrying"
+    gaps = [b - a for a, b in zip(attempts, attempts[1:])]
+    # capped: every gap stays under _BACKOFF_MAX * 1.5 (the jitter ceiling)
+    # plus scheduling slack — unbounded doubling fails this
+    assert max(gaps) < 0.15 * 1.5 + 0.2, gaps
+    # ...but it really did back off from the base before capping
+    assert max(gaps[3:]) > 0.02, gaps
+    # every restart counted, with the informer label
+    restarts = reg.get("informer_restarts_total")
+    assert restarts is not None
+    assert restarts.value(informer=InformerType.NODE.value) >= len(attempts) - 1
+    assert inf.restarts >= len(attempts) - 1
+    # never synced: the staleness probe reports None, not a bogus age
+    assert inf.sync_age() is None
+
+
+def test_informer_sync_age_tracks_progress(api):
+    """A healthy informer's sync age resets on list/watch progress and is
+    exported through the provider's sync_ages (the health monitor input)."""
+    server, cfg = api
+    server.add_node_doc("sa-n0")
+    provider = RealAPIProvider(cfg)
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    provider.attach_metrics(reg)
+    provider.start()
+    provider.wait_for_sync(timeout=10)
+    try:
+        ages = provider.sync_ages()
+        assert ages[InformerType.NODE.value] is not None
+        assert ages[InformerType.NODE.value] < 30
+        assert provider.restart_count() == 0
+        # the gauge landed in the registry with the informer label
+        g = reg.get("informer_last_sync_age_seconds")
+        assert g is not None
+        assert g.value(informer=InformerType.NODE.value) < 30
+    finally:
+        provider.stop()
+
+
+def test_informer_sync_age_refreshes_at_scrape():
+    """A wedged informer (synced once, then nothing) must show a GROWING
+    last-sync age to a scrape-only deployment: the gauge refreshes at
+    exposition time, not only when a health probe happens to call
+    sync_age() — otherwise it reads a flat 0 during exactly the staleness
+    incident it exists to surface."""
+    from yunikorn_tpu.client.kube import _Informer
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+    inf = _Informer(object(), InformerType.NODE)
+    reg = MetricsRegistry()
+    inf.attach_metrics(reg)
+    inf._note_sync()                  # synced once (timestamp only: the
+    g = reg.get("informer_last_sync_age_seconds")  # gauge is scrape-derived)
+    reg.expose()
+    assert g.value(informer=InformerType.NODE.value) < 0.2
+    time.sleep(0.25)                  # ...then the reflector wedges
+    text = reg.expose()               # a Prometheus scrape, nothing else
+    assert g.value(informer=InformerType.NODE.value) >= 0.2
+    assert "informer_last_sync_age_seconds" in text
+    # the JSON surface (/ws/v1/metrics renders the same registry) too
+    time.sleep(0.1)
+    snap = reg.snapshot()
+    assert snap["informer_last_sync_age_seconds"][
+        f"informer={InformerType.NODE.value}"] >= 0.3
+
+
 def test_partial_sync_timeout_names_the_laggard(api):
     """wait_for_sync failing must say WHICH informer didn't sync."""
     server, cfg = api

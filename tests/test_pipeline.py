@@ -188,6 +188,46 @@ def test_release_mid_flight_never_commits():
     assert len(app.allocations) == 7
 
 
+def test_pipeline_solve_failure_does_not_wedge():
+    """An in-flight pipelined cycle whose solve raises on EVERY degradation
+    tier must be abandoned cleanly: `_pipeline_inflight` unwedged, the
+    in-flight gate exclusions cleared, the failure counted — and the next
+    cycle re-admits the same asks and places them."""
+    import dataclasses
+
+    cache, core, _ = make_core()
+    core.supervisor.options = dataclasses.replace(
+        core.supervisor.options, max_retries=0, breaker_threshold=100,
+        backoff_base_s=0.001)
+    pods = make_sleep_pods(8, "app", queue="root.q", name_prefix="wz")
+    core.update_allocation(AllocationRequest(asks=asks_of(pods)))
+    core._pipeline_tick()                     # dispatches wave 1
+    assert core._pipeline_inflight is not None
+    assert core._inflight_ask_keys
+    # poison the MATERIALIZE of the in-flight cycle on every tier (dispatch
+    # already happened; the 3 rules cover device retry + cpu + host)
+    core.supervisor.faults.fail("assign", times=3)
+    core._pipeline_tick()                     # finish fails -> abandon
+    assert core._pipeline_inflight is None
+    assert core._inflight_ask_keys == set()
+    assert core._inflight_gate_seed == []
+    c = core.obs.get("scheduling_cycle_failures_total")
+    assert c.value(stage="solve") == 1
+    # the abandon is a FAILURE to the health subsystem: the run loop reads
+    # this flag and skips _note_cycle_success, so the failure streak keeps
+    # counting (readiness can actually trip on repeated abandons)
+    assert core._cycle_abandoned is True
+    assert core._failure_streak >= 1
+    app = core.partition.applications["app"]
+    assert len(app.allocations) == 0
+    assert len(app.pending_asks) == 8         # asks survived the abandon
+    # faults exhausted: the next cycles re-admit and place everything
+    core._pipeline_tick()
+    core._pipeline_tick()
+    assert len(app.allocations) == 8
+    assert core._pipeline_inflight is None
+
+
 def test_pipeline_overlap_smoke():
     """The bench-smoke contract (make bench-smoke): a small-bucket pipelined
     run must (a) engage the overlap — encode of cycle N+1 starts before the

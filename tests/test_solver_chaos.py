@@ -1,0 +1,672 @@
+"""Solver chaos suite: faults injected into every supervised device path.
+
+The differential guarantee (ISSUE 4): with faults injected — fail-fast,
+hang-past-deadline, fail-N-then-recover, permanent failure — placements are
+identical to a fault-free `schedule_once` run on the same event trace, the
+circuit re-closes after the fault clears (a recovered TPU is reclaimed
+without restart), `/ws/v1/health` reflects each transition, and a permanent
+device failure leaves the scheduler live and placing pods via the host
+tier, never stalled.
+
+Driven through the injectable fault plane (robustness/faults.py): rules are
+consumed inside the supervised attempt on the watchdog worker, so a
+scripted `slow` really trips the dispatch deadline the way a wedged XLA
+dispatch would.
+"""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import (
+    AddApplicationRequest,
+    AllocationAsk,
+    AllocationRequest,
+    ApplicationRequest,
+    NodeAction,
+    NodeInfo,
+    NodeRequest,
+    RegisterResourceManagerRequest,
+    UserGroupInfo,
+)
+from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+from yunikorn_tpu.robustness.supervisor import (
+    AllTiersFailed,
+    SupervisorOptions,
+)
+
+
+class NullCallback:
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+FAST = SupervisorOptions(deadline_s=30.0, max_retries=2, backoff_base_s=0.005,
+                         breaker_threshold=2, probe_interval_s=0.2)
+
+
+def make_core(n_nodes=32, options=None, pipeline=False, shard=None):
+    cache = SchedulerCache()
+    core = CoreScheduler(
+        cache,
+        solver_options=SolverOptions(pipeline=pipeline, shard=shard),
+        supervisor_options=options or dataclasses_replace(FAST))
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="chaos", policy_group="queues"),
+        NullCallback())
+    nodes = make_kwok_nodes(n_nodes)
+    for n in nodes:
+        cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[
+        NodeInfo(node_id=n.name, action=NodeAction.CREATE) for n in nodes]))
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="app", queue_name="root.q",
+        user=UserGroupInfo(user="u"))]))
+    return cache, core
+
+
+def dataclasses_replace(opts):
+    import dataclasses
+
+    return dataclasses.replace(opts)
+
+
+def asks_of(pods):
+    return [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p)
+            for p in pods]
+
+
+def placements_by_name(core, uid_to_name):
+    out = {}
+    for app in core.partition.applications.values():
+        for key, alloc in app.allocations.items():
+            out[uid_to_name[key]] = alloc.node_id
+    return out
+
+
+def run_trace(core, waves, names):
+    for pods in waves:
+        names.update({p.uid: p.name for p in pods})
+        core.update_allocation(AllocationRequest(asks=asks_of(pods)))
+        core.schedule_once()
+    return placements_by_name(core, names)
+
+
+def two_waves(cpu_milli=100):
+    return [make_sleep_pods(60, "app", queue="root.q", name_prefix="c1",
+                            cpu_milli=cpu_milli),
+            make_sleep_pods(60, "app", queue="root.q", name_prefix="c2",
+                            cpu_milli=cpu_milli)]
+
+
+def clean_placements(cpu_milli=100):
+    cache, core = make_core()
+    names = {}
+    return run_trace(core, two_waves(cpu_milli), names)
+
+
+def outcome(core, path, kind):
+    c = core.obs.get("supervised_dispatch_total")
+    return c.value(path=path, outcome=kind) if c is not None else 0.0
+
+
+# ---------------------------------------------------------------- fail fast
+def test_transient_fault_retries_and_matches_fault_free():
+    """A transient dispatch failure retries the same tier: placements stay
+    identical to the fault-free run and the circuit never opens."""
+    cache, core = make_core()
+    core.supervisor.faults.fail("assign", times=1, tier="device")
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == clean_placements()
+    assert len(got) == 120
+    assert outcome(core, "assign", "transient") >= 1
+    snap = core.supervisor.snapshot()
+    assert snap["assign"]["tier"] == "device"
+    assert snap["assign"]["circuits"]["device"]["state"] == "closed"
+    assert core.supervisor.degradations() == []
+
+
+def test_persistent_fault_degrades_immediately_and_matches():
+    """A persistent (compile/shape-class) failure skips the same-tier retry,
+    opens the circuit, and the CPU re-jit tier answers with identical
+    placements."""
+    cache, core = make_core()
+    core.supervisor.faults.fail("assign", times=10, tier="device",
+                                persistent=True)
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == clean_placements()
+    snap = core.supervisor.snapshot()
+    assert snap["assign"]["circuits"]["device"]["state"] == "open"
+    assert snap["assign"]["tier"] == "cpu"
+    g = core.obs.get("solver_degradation_state")
+    assert g.value(path="assign") == 1.0
+    events = [d["event"] for d in core.supervisor.degradations()]
+    assert "degrade" in events
+
+
+# ---------------------------------------------------- hang past the deadline
+def test_hang_past_deadline_degrades_and_matches():
+    """A dispatch that sleeps past the deadline is abandoned by the watchdog
+    (the wedged-relay failure mode) and the cycle completes on the next tier
+    with identical placements — the scheduler never stalls."""
+    opts = dataclasses_replace(FAST)
+    opts.deadline_s = 0.25
+    cache, core = make_core(options=opts)
+    core.supervisor.faults.slow("assign", seconds=2.0, times=100,
+                                tier="device")
+    names = {}
+    t0 = time.time()
+    got = run_trace(core, two_waves(), names)
+    wall = time.time() - t0
+    assert got == clean_placements()
+    assert outcome(core, "assign", "deadline") >= 1
+    # two cycles x (one deadline each + fallback solve): a wedged dispatch
+    # costs its deadline, never the whole budget
+    assert wall < 20, wall
+    # consecutive deadline blows opened the device circuit
+    assert core.supervisor.snapshot()["assign"]["circuits"]["device"]["state"] == "open"
+
+
+# ------------------------------------------------- fail N then recover/probe
+def test_fail_n_then_recover_circuit_recloses():
+    """Failures open the device circuit (degrade to cpu); once the fault
+    clears, the half-open probe re-closes it — the recovered backend is
+    reclaimed without restart."""
+    opts = dataclasses_replace(FAST)
+    opts.max_retries = 0
+    cache, core = make_core(options=opts)
+    core.supervisor.faults.fail("assign", times=4, tier="device")
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == clean_placements()
+    assert core.supervisor.snapshot()["assign"]["circuits"]["device"]["state"] == "open"
+    core.supervisor.faults.clear()
+
+    # past the probe interval the next dispatch probes the device tier and
+    # its materialized success re-closes the circuit
+    time.sleep(opts.probe_interval_s + 0.05)
+    extra = make_sleep_pods(10, "app", queue="root.q", name_prefix="rec")
+    names.update({p.uid: p.name for p in extra})
+    core.update_allocation(AllocationRequest(asks=asks_of(extra)))
+    core.schedule_once()
+    snap = core.supervisor.snapshot()
+    assert snap["assign"]["circuits"]["device"]["state"] == "closed"
+    assert snap["assign"]["tier"] == "device"
+    events = [d["event"] for d in core.supervisor.degradations()]
+    assert events.count("degrade") >= 1 and events.count("recover") >= 1
+    g = core.obs.get("solver_degradation_state")
+    assert g.value(path="assign") == 0.0
+    assert len(placements_by_name(core, names)) == 130
+
+
+# --------------------------------------------- permanent failure → host tier
+def test_permanent_device_failure_places_via_host_tier():
+    """Device AND cpu tiers permanently down: the scheduler keeps placing
+    pods through the exact host path, with placements identical to the
+    fault-free device run (homogeneous batch: the host greedy reproduces
+    the device water-fill exactly)."""
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 60.0  # keep the circuits open for the test
+    cache, core = make_core(options=opts)
+    core.supervisor.faults.fail_forever("assign", tier="device")
+    core.supervisor.faults.fail_forever("assign", tier="cpu")
+    names = {}
+    # 4-core pods over 32-core nodes: the batch spans many nodes, so the
+    # equivalence check exercises the host water-fill across node boundaries
+    got = run_trace(core, two_waves(cpu_milli=4000), names)
+    assert got == clean_placements(cpu_milli=4000)
+    assert len(got) == 120
+    snap = core.supervisor.snapshot()
+    assert snap["assign"]["tier"] == "host"
+    g = core.obs.get("solver_degradation_state")
+    assert g.value(path="assign") == 2.0
+    # still live and still placing: a third wave lands through the host tier
+    extra = make_sleep_pods(20, "app", queue="root.q", name_prefix="c3",
+                            cpu_milli=4000)
+    names.update({p.uid: p.name for p in extra})
+    core.update_allocation(AllocationRequest(asks=asks_of(extra)))
+    core.schedule_once()
+    placed = placements_by_name(core, names)
+    assert len(placed) == 140
+    report = core.health_report()
+    assert report["Healthy"] is True  # degraded != dead
+    assert report["components"]["solver"]["state"] == "degraded"
+    assert report["components"]["solver"]["degraded"] == {"assign": "host"}
+
+
+# ------------------------------------------------------------- upload faults
+def test_host_tier_honors_anti_affinity():
+    """Device AND cpu tiers down: the host tier must enforce the
+    placement-dependent predicates the device solve encodes — required pod
+    anti-affinity pods land on distinct nodes, never stacked on the
+    binpacking winner."""
+    from yunikorn_tpu.common.objects import Affinity, PodAffinityTerm
+
+    def anti_wave():
+        pods = make_sleep_pods(4, "app", queue="root.q", name_prefix="anti",
+                               extra_labels={"app": "singleton"})
+        for p in pods:
+            p.spec.affinity = Affinity(pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    label_selector={"matchLabels": {"app": "singleton"}},
+                    topology_key="kubernetes.io/hostname")])
+        return pods
+
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 60.0
+    cache, core = make_core(options=opts)
+    core.supervisor.faults.fail_forever("assign", tier="device")
+    core.supervisor.faults.fail_forever("assign", tier="cpu")
+    names = {}
+    got = run_trace(core, [anti_wave()], names)
+    assert core.supervisor.snapshot()["assign"]["tier"] == "host"
+    assert len(got) == 4
+    assert len(set(got.values())) == 4  # one per node
+
+    clean_cache, clean_core = make_core()
+    clean = run_trace(clean_core, [anti_wave()], {})
+    assert got == clean
+
+
+def test_host_tier_honors_inflight_ports():
+    """The host tier must see the same committed-but-not-assumed hostPort
+    overlay the device tiers receive as ports_delta — without it, two
+    consecutive degraded cycles could each place a pod wanting the same
+    hostPort on the binpacking-winner node."""
+    import numpy as np
+
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.robustness.host_solve import host_assign
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+    from yunikorn_tpu.snapshot.vocab import port_bit
+
+    cache = SchedulerCache()
+    for i in range(2):
+        cache.update_node(make_node(f"pn{i}", cpu_milli=8000))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pod = make_pod("port-pod", cpu_milli=100, memory=2**20)
+    pod.spec.containers[0].ports = [{"hostPort": 8080, "protocol": "TCP"}]
+    ask = AllocationAsk(pod.uid, "app", get_pod_resource(pod), pod=pod)
+    batch = enc.build_batch([ask])
+
+    # no overlay: binpacking picks the lowest-index node
+    free_row = int(host_assign([ask], batch, enc, cache)[0])
+    assert free_row >= 0
+    # overlay says that node already holds 8080 from an in-flight commit
+    b = enc.vocabs.ports.lookup(port_bit("TCP", 8080))
+    assert b >= 0
+    ports_delta = np.zeros((enc.nodes.capacity, enc.vocabs.ports.num_words),
+                           np.uint32)
+    ports_delta[free_row, b // 32] |= np.uint32(1 << (b % 32))
+    got = int(host_assign([ask], batch, enc, cache,
+                          ports_delta=ports_delta)[0])
+    assert got >= 0 and got != free_row
+
+
+def test_single_tier_fallback_gauge_value():
+    """A single-tier path degraded to its external fallback reports the
+    dedicated gauge value (3=external fallback), not the assign ladder's
+    cpu slot (1) — an operator must not read 'cpu re-jit' on a path that
+    has no such tier."""
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+    from yunikorn_tpu.robustness.supervisor import (
+        FALLBACK_TIER,
+        SupervisedExecutor,
+    )
+
+    reg = MetricsRegistry()
+    ex = SupervisedExecutor(SupervisorOptions(
+        deadline_s=5.0, max_retries=0, breaker_threshold=1,
+        probe_interval_s=60.0), registry=reg)
+
+    def boom():
+        raise ValueError("shape mismatch")  # persistent class: opens now
+
+    with pytest.raises(ValueError):
+        ex.run("upload", boom)
+    assert ex.current_tier("upload") == FALLBACK_TIER
+    g = reg.get("solver_degradation_state")
+    assert g.value(path="upload") == 3.0
+
+
+def test_failed_upload_falls_back_to_per_cycle_transfer():
+    """A failing device-mirror upload opens the upload circuit; the solve
+    proceeds with per-cycle uploads, and the probe re-closes the circuit
+    after the fault clears."""
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    cache, core = make_core(options=opts)
+    core.supervisor.faults.fail("upload", times=1)
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == clean_placements()
+    assert outcome(core, "upload", "transient") >= 1
+    assert outcome(core, "assign", "ok") >= 1
+    # fault cleared after one firing; past the probe interval the upload
+    # path recovers on the next cycle
+    time.sleep(opts.probe_interval_s + 0.05)
+    extra = make_sleep_pods(5, "app", queue="root.q", name_prefix="up")
+    names.update({p.uid: p.name for p in extra})
+    core.update_allocation(AllocationRequest(asks=asks_of(extra)))
+    core.schedule_once()
+    assert core.supervisor.snapshot()["upload"]["circuits"]["device"]["state"] == "closed"
+
+
+def test_deadline_abandoned_upload_orphans_device_mirror():
+    """A deadline-abandoned dispatch leaves its watchdog thread RUNNING; the
+    device mirror it may still be mutating must be orphaned — replaced
+    object, epoch bump — so the zombie's late writes land on an
+    unreferenced object instead of tearing the next cycle's refresh."""
+    import dataclasses
+
+    cache, core = make_core()
+    names = {}
+    w1 = make_sleep_pods(30, "app", queue="root.q", name_prefix="ob1")
+    names.update({p.uid: p.name for p in w1})
+    core.update_allocation(AllocationRequest(asks=asks_of(w1)))
+    core.schedule_once()                       # warm: compiles, builds mirror
+    dev0 = core.encoder.device
+    assert dev0 is not None and not dev0.dead
+    epoch0 = core.encoder.mirror_epoch
+    # tighten the deadline only now (the warm-up compile stays unaffected),
+    # then wedge the next mirror refresh past it
+    core.supervisor.options = dataclasses.replace(
+        core.supervisor.options, deadline_s=0.3, max_retries=0,
+        breaker_threshold=100)
+    core.supervisor.faults.slow("upload", seconds=1.2, times=1)
+    w2 = make_sleep_pods(30, "app", queue="root.q", name_prefix="ob2")
+    names.update({p.uid: p.name for p in w2})
+    core.update_allocation(AllocationRequest(asks=asks_of(w2)))
+    core.schedule_once()
+    # the upload nests inside the assign dispatch and both share the
+    # deadline, so the abandonment lands on one or both paths
+    assert (outcome(core, "upload", "deadline") >= 1
+            or outcome(core, "assign", "deadline") >= 1)
+    assert dev0.dead is True                   # orphaned...
+    assert core.encoder.device is not dev0     # ...and replaced
+    assert core.encoder.mirror_epoch > epoch0
+    # the cycle itself still placed everything (per-cycle transfer fallback)
+    assert len(placements_by_name(core, names)) == 60
+    # let the zombie unwedge: it must bail on the stale epoch, and a later
+    # cycle rebuilds a LIVE mirror from scratch
+    time.sleep(1.3)
+    w3 = make_sleep_pods(5, "app", queue="root.q", name_prefix="ob3")
+    names.update({p.uid: p.name for p in w3})
+    core.update_allocation(AllocationRequest(asks=asks_of(w3)))
+    core.schedule_once()
+    assert core.encoder.device is not None
+    assert core.encoder.device is not dev0
+    assert not core.encoder.device.dead
+    assert len(placements_by_name(core, names)) == 65
+
+
+def test_abandoned_thread_nested_dispatch_bails():
+    """A watchdog thread abandoned by its waiter is a zombie: its NESTED
+    supervised calls must raise instead of running, and none of its
+    outcomes may move live circuits or metrics."""
+    from yunikorn_tpu.robustness.supervisor import (
+        AbandonedDispatch,
+        DeadlineExceeded,
+        SupervisedExecutor,
+    )
+
+    ex = SupervisedExecutor(SupervisorOptions(
+        deadline_s=0.1, max_retries=0, breaker_threshold=1,
+        probe_interval_s=60.0))
+    seen = {}
+
+    def outer():
+        time.sleep(0.4)                        # outlives the deadline
+        seen["allow"] = ex.allow("inner")      # zombie gate: must refuse
+        try:
+            ex.run("inner", lambda: "never")
+        except AbandonedDispatch:
+            seen["bailed"] = True
+        return "late"
+
+    with pytest.raises(DeadlineExceeded):
+        ex.run("outer", outer)
+    deadline = time.time() + 5
+    while "bailed" not in seen and time.time() < deadline:
+        time.sleep(0.02)
+    assert seen.get("bailed") is True
+    assert seen.get("allow") is False          # allow() refuses zombies too
+    assert "inner" not in ex.snapshot()        # never registered, never moved
+
+
+def test_open_mesh_circuit_drops_to_unsharded_mirror():
+    """With the mesh circuit open the cycle must take the single-device
+    shape up front: the mirror refreshes UNSHARDED and the fallback solve
+    reuses it, instead of paying a sharded upload the skipped mesh dispatch
+    would discard plus a full per-cycle transfer."""
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 3600.0             # keep the circuit open
+    cache, core = make_core(options=opts, shard=True)
+    core.supervisor.faults.fail("mesh", times=1)
+    names = {}
+    got = run_trace(core, two_waves(), names)  # wave 1 opens the circuit
+    assert got == clean_placements()
+    assert len(got) == 120
+    assert core.supervisor.snapshot()["mesh"]["circuits"]["device"]["state"] == "open"
+    dev = core.encoder.device
+    assert dev is not None
+    # wave 2 ran degraded: the live mirror must be committed unsharded so
+    # the single-device solve could reuse it (no double transfer)
+    assert dev._mesh is None
+
+
+# -------------------------------------------------------- preemption faults
+def preemption_core(options):
+    """Full node + one evictable low-priority victim per node, then a
+    high-priority ask that can only place by preempting."""
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.si import Allocation
+
+    cache = SchedulerCache()
+    victims = []
+    for i in range(2):
+        cache.update_node(make_node(f"pn{i}", cpu_milli=2000,
+                                    memory=8 * 2**30))
+        v = make_pod(f"pv-{i}", cpu_milli=2000, memory=2**28,
+                     node_name=f"pn{i}", phase="Running", priority=0)
+        cache.update_pod(v)
+        victims.append(v)
+    core = CoreScheduler(cache, solver_options=SolverOptions(pipeline=False),
+                         supervisor_options=options)
+    released = []
+
+    class Callback(NullCallback):
+        def update_allocation(self, response):
+            for rel in getattr(response, "released", []):
+                released.append(rel.allocation_key)
+
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="p", policy_group="queues"),
+        Callback())
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id="victim-app",
+                              queue_name="root.qv",
+                              user=UserGroupInfo(user="v")),
+        AddApplicationRequest(application_id="hi-app", queue_name="root.qhi",
+                              user=UserGroupInfo(user="h"))]))
+    infos = [NodeInfo(node_id=f"pn{i}", action=NodeAction.CREATE,
+                      existing_allocations=[Allocation(
+                          allocation_key=v.uid, application_id="victim-app",
+                          node_id=f"pn{i}",
+                          resource=get_pod_resource(v))])
+             for i, v in enumerate(victims)]
+    core.update_node(NodeRequest(nodes=infos))
+    return cache, core, released
+
+
+def test_preempt_device_fault_host_planner_covers():
+    """A failing device preemption solve opens the preempt circuit and the
+    host planner covers the cycle: the victim is still evicted."""
+    from yunikorn_tpu.common.objects import make_pod
+
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 60.0
+    cache, core, released = preemption_core(opts)
+    core.supervisor.faults.fail_forever("preempt")
+    hp = make_pod("hi-pod", cpu_milli=2000, memory=2**28, priority=100)
+    cache.update_pod(hp)
+    core.update_allocation(AllocationRequest(asks=[AllocationAsk(
+        hp.uid, "hi-app", get_pod_resource(hp), priority=100, pod=hp)]))
+    core.schedule_once()
+    assert released, "host planner did not evict under a preempt-path fault"
+    plans = core.obs.get("preemption_plans_total")
+    assert plans.value(planner="host") >= 1
+    assert core.supervisor.snapshot()["preempt"]["circuits"]["device"]["state"] == "open"
+
+
+# --------------------------------------------------------- health endpoint
+def test_health_endpoint_reflects_transitions():
+    """/ws/v1/health: 200 + per-component detail when healthy; solver
+    degradation visible while circuits are open; 503 when every tier of a
+    path is unserviceable; recovery restores 200 and the device tier."""
+    from yunikorn_tpu.webapp.rest import RestServer
+
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 0.3
+    cache, core = make_core(options=opts)
+    rest = RestServer(core, None, port=0)
+    port = rest.start()
+
+    def health():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ws/v1/health", timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        names = {}
+        pods = make_sleep_pods(10, "app", queue="root.q", name_prefix="h1")
+        names.update({p.uid: p.name for p in pods})
+        core.update_allocation(AllocationRequest(asks=asks_of(pods)))
+        core.schedule_once()
+        code, rep = health()
+        assert code == 200 and rep["Healthy"] is True and rep["ready"] is True
+        assert rep["components"]["solver"]["state"] == "ok"
+        assert "scheduling" in rep["components"]
+
+        # every tier down → the next cycle fails entirely → unserviceable
+        core.supervisor.faults.fail_forever("assign")
+        pods = make_sleep_pods(5, "app", queue="root.q", name_prefix="h2")
+        names.update({p.uid: p.name for p in pods})
+        core.update_allocation(AllocationRequest(asks=asks_of(pods)))
+        with pytest.raises(AllTiersFailed):
+            core.schedule_once()
+        code, rep = health()
+        assert code == 503 and rep["Healthy"] is False
+        assert rep["components"]["solver"]["state"] == "unserviceable"
+        assert "assign" in rep["components"]["solver"]["unserviceable"]
+        assert rep["components"]["scheduling"]["last_failure"]["stage"]
+
+        # fault clears; past the probe interval the probe dispatch re-closes
+        # the device circuit and health returns to 200/ok
+        core.supervisor.faults.clear()
+        time.sleep(opts.probe_interval_s + 0.05)
+        core.schedule_once()
+        code, rep = health()
+        assert code == 200 and rep["Healthy"] is True
+        assert rep["components"]["solver"]["state"] == "ok"
+        assert core.supervisor.snapshot()["assign"]["tier"] == "device"
+        assert len(placements_by_name(core, names)) == 15
+    finally:
+        rest.stop()
+
+
+def test_cycle_failures_counted_by_stage():
+    """Satellite: core/scheduler cycle failures are counted (stage label)
+    and surfaced in the health report instead of only swallowed into the
+    log — driven through the run loop so the except path itself is tested."""
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 60.0
+    cache, core = make_core(options=opts)
+    core.supervisor.faults.fail_forever("assign")
+    pods = make_sleep_pods(5, "app", queue="root.q", name_prefix="cf")
+    core.update_allocation(AllocationRequest(asks=asks_of(pods)))
+    core.start()
+    try:
+        deadline = time.time() + 10
+        c = core.obs.get("scheduling_cycle_failures_total")
+        while time.time() < deadline:
+            if sum(v for _, _, v in c.collect()) >= 1:
+                break
+            time.sleep(0.05)
+        total = {labels: v for _, labels, v in c.collect()}
+        assert sum(total.values()) >= 1, total
+    finally:
+        core.stop()
+    assert core._last_cycle_failure is not None
+    rep = core.health_report()
+    assert "last_failure" in rep["components"]["scheduling"]
+
+
+# ------------------------------------------------------- dispatcher drops
+def test_dispatcher_deadline_drop_is_counted(monkeypatch):
+    """Satellite: an overflow event whose dispatch timeout expires before
+    buffer space frees is DROPPED — the drop must be counted
+    (dispatch_dropped_total), not only logged."""
+    import threading
+
+    from yunikorn_tpu.common.events import SchedulingEvent
+    from yunikorn_tpu.dispatcher import dispatcher as dmod
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+    monkeypatch.setattr(dmod, "ASYNC_RETRY_INTERVAL", 0.05)
+    d = dmod.Dispatcher(capacity=4, dispatch_timeout=0.15)
+    reg = MetricsRegistry()
+    d.attach_metrics(reg)
+    gate = threading.Event()
+    first = threading.Event()
+
+    def handler(event):
+        first.set()
+        gate.wait(timeout=30)
+
+    d.register_event_handler("blocker", dmod.EventType.SCHEDULER, handler)
+    d.start()
+    try:
+        d.dispatch(SchedulingEvent())          # consumer grabs it and blocks
+        assert first.wait(timeout=5)
+        for _ in range(4):                     # fill the buffer to capacity
+            d.dispatch(SchedulingEvent())
+        overflowed = [SchedulingEvent() for _ in range(3)]
+        for e in overflowed:                   # past capacity → retry worker
+            d.dispatch(SchedulingEvent())
+        assert reg.get("dispatcher_overflow_total").value() >= 3
+        # the consumer stays blocked, so buffer space never frees and the
+        # overflow events' deadlines (0.15s) expire → counted drops
+        deadline = time.time() + 10
+        dropped = reg.get("dispatch_dropped_total")
+        while time.time() < deadline and dropped.value() < 3:
+            time.sleep(0.05)
+        assert dropped.value() >= 3, dropped.value()
+        assert d.dropped_count >= 3
+    finally:
+        gate.set()
+        d.stop()

@@ -301,6 +301,49 @@ class _Informer:
         self.synced = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # reflector health: restart count + last successful sync activity
+        # (relist done or watch event applied). Exported through
+        # attach_metrics / RealAPIProvider.sync_ages so restarts and
+        # staleness are visible instead of only warned into the log.
+        self.restarts = 0
+        self.last_sync: Optional[float] = None
+        self._m_restarts = None
+        self._g_sync_age = None
+
+    def attach_metrics(self, registry) -> None:
+        first = self._g_sync_age is None
+        self._m_restarts = registry.counter(
+            "informer_restarts_total",
+            "reflector loop restarts after an error, by informer",
+            labelnames=("informer",))
+        self._g_sync_age = registry.gauge(
+            "informer_last_sync_age_seconds",
+            "seconds since the informer last made sync progress "
+            "(refreshed at each scrape, each sync and each health probe)",
+            labelnames=("informer",))
+        if self.restarts:
+            self._m_restarts.inc(self.restarts, informer=self.informer.value)
+        if first:
+            # gauges are push-model: without a per-scrape refresh, a wedged
+            # informer's age would stay frozen at its last pushed value for
+            # deployments that only scrape /metrics and never hit the
+            # health endpoint — flat 0 during exactly the staleness
+            # incident the gauge exists to surface
+            registry.on_collect(self.sync_age)
+
+    def _note_sync(self) -> None:
+        # timestamp only: the on_collect hook re-derives the gauge at each
+        # scrape, so the per-event push would just be metric-lock traffic
+        # on the reflector hot path
+        self.last_sync = time.time()
+
+    def sync_age(self) -> Optional[float]:
+        """Seconds since last sync progress; None = never synced. Refreshes
+        the exported gauge as a side effect (gauges are push-model)."""
+        age = None if self.last_sync is None else time.time() - self.last_sync
+        if age is not None and self._g_sync_age is not None:
+            self._g_sync_age.set(round(age, 3), informer=self.informer.value)
+        return age
 
     def _key(self, obj) -> str:
         uid = getattr(getattr(obj, "metadata", None), "uid", "")
@@ -367,9 +410,14 @@ class _Informer:
             except Exception as e:
                 # exponential backoff with full jitter (client-go reflector
                 # backs off the same way); a flapping API server must not be
-                # hammered at a fixed 1 Hz by every informer at once
+                # hammered at a fixed 1 Hz by every informer at once. The
+                # backoff CAPS at _BACKOFF_MAX: recovery latency after a
+                # long outage stays bounded (pinned by test_kube_chaos).
                 delay = backoff * (0.5 + random.random())
                 backoff = min(backoff * 2.0, self._BACKOFF_MAX)
+                self.restarts += 1
+                if self._m_restarts is not None:
+                    self._m_restarts.inc(informer=self.informer.value)
                 logger.warning("informer %s restarting after error (backoff %.1fs): %s",
                                self.informer.value, delay, e)
                 rv = ""
@@ -395,6 +443,7 @@ class _Informer:
             if key not in fresh:
                 self._deliver("delete", obj)
         self.synced.set()
+        self._note_sync()
         return rv
 
     def _watch(self, rv: str) -> str:
@@ -419,6 +468,7 @@ class _Informer:
                     raise RuntimeError(f"watch error: {obj_doc}")
                 last_rv = ((obj_doc.get("metadata") or {})
                            .get("resourceVersion") or last_rv)
+                self._note_sync()
                 if etype == "BOOKMARK":
                     continue
                 obj = self.decoder(obj_doc)
@@ -458,6 +508,21 @@ class RealAPIProvider(APIProvider):
             for t in types
         }
         self._started = False
+
+    # -- observability / health --------------------------------------------
+    def attach_metrics(self, registry) -> None:
+        """Wire reflector restart counters + last-sync-age gauges into the
+        core's registry (the shim attaches this next to the dispatcher's)."""
+        for inf in self._informers.values():
+            inf.attach_metrics(registry)
+
+    def sync_ages(self) -> Dict[str, Optional[float]]:
+        """{informer: seconds since last sync progress} (None = never) —
+        the staleness input of robustness/health.informers_source."""
+        return {t.value: inf.sync_age() for t, inf in self._informers.items()}
+
+    def restart_count(self) -> int:
+        return sum(inf.restarts for inf in self._informers.values())
 
     # -- APIProvider --------------------------------------------------------
     def add_event_handler(self, informer: InformerType,

@@ -93,11 +93,14 @@ def main(argv=None) -> int:
                 cluster.add_node(node)
 
     from yunikorn_tpu.core.scheduler import SolverOptions
+    from yunikorn_tpu.robustness.supervisor import SupervisorOptions
 
     cache = SchedulerCache()
     core = CoreScheduler(cache,
                          solver_options=SolverOptions.from_conf(holder.get()),
-                         trace_spans=holder.get().obs_trace_spans)
+                         trace_spans=holder.get().obs_trace_spans,
+                         supervisor_options=SupervisorOptions.from_conf(
+                             holder.get()))
     context = Context(cluster, core, cache=cache)
     shim = KubernetesShim(cluster, core, context=context)
     rest = RestServer(core, context, port=args.rest_port)

@@ -63,6 +63,14 @@ CM_SOLVER_PREEMPT_DEVICE = PREFIX_SOLVER + "preemptDevice"  # auto | true | fals
 # observability.* keys (the obs/ registry + tracer)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
 
+# robustness.* keys (supervised device dispatches, robustness/supervisor.py)
+PREFIX_ROBUSTNESS = "robustness."
+CM_ROBUST_DEADLINE = PREFIX_ROBUSTNESS + "dispatchDeadlineSeconds"
+CM_ROBUST_MAX_RETRIES = PREFIX_ROBUSTNESS + "maxRetries"
+CM_ROBUST_BREAKER_THRESHOLD = PREFIX_ROBUSTNESS + "breakerThreshold"
+CM_ROBUST_PROBE_INTERVAL = PREFIX_ROBUSTNESS + "probeIntervalSeconds"
+CM_ROBUST_PROBE_DEADLINE = PREFIX_ROBUSTNESS + "probeDeadlineSeconds"
+
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
 
@@ -126,6 +134,16 @@ class SchedulerConf:
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
+    # --- robustness knobs --- (SupervisedExecutor: every device dispatch
+    # gets a deadline, classified bounded retry, and a per-path circuit
+    # breaker degrading device → cpu → host; see robustness/supervisor.py)
+    # deadline is generous: a first-touch compile at a big bucket can
+    # legitimately take minutes — the deadline catches WEDGED dispatches
+    robustness_dispatch_deadline_s: float = 300.0
+    robustness_max_retries: int = 2
+    robustness_breaker_threshold: int = 3
+    robustness_probe_interval_s: float = 30.0
+    robustness_probe_deadline_s: float = 20.0
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -241,6 +259,21 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     if CM_OBS_TRACE_SPANS in data:
         conf.obs_trace_spans = _parse_int(
             data[CM_OBS_TRACE_SPANS], conf.obs_trace_spans)
+    if CM_ROBUST_DEADLINE in data:
+        conf.robustness_dispatch_deadline_s = _parse_duration(
+            data[CM_ROBUST_DEADLINE], conf.robustness_dispatch_deadline_s)
+    if CM_ROBUST_MAX_RETRIES in data:
+        conf.robustness_max_retries = _parse_int(
+            data[CM_ROBUST_MAX_RETRIES], conf.robustness_max_retries)
+    if CM_ROBUST_BREAKER_THRESHOLD in data:
+        conf.robustness_breaker_threshold = _parse_int(
+            data[CM_ROBUST_BREAKER_THRESHOLD], conf.robustness_breaker_threshold)
+    if CM_ROBUST_PROBE_INTERVAL in data:
+        conf.robustness_probe_interval_s = _parse_duration(
+            data[CM_ROBUST_PROBE_INTERVAL], conf.robustness_probe_interval_s)
+    if CM_ROBUST_PROBE_DEADLINE in data:
+        conf.robustness_probe_deadline_s = _parse_duration(
+            data[CM_ROBUST_PROBE_DEADLINE], conf.robustness_probe_deadline_s)
     for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
                       (CM_SOLVER_SHARD, "solver_shard"),
                       (CM_SOLVER_PIPELINE, "solver_pipeline"),

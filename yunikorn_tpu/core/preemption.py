@@ -252,6 +252,7 @@ def dispatch_preemption_solve(
     inflight_by_node: Optional[Dict[str, object]] = None,
     candidate_nodes: Optional[List[str]] = None,
     mesh=None,
+    mirror_epoch: Optional[int] = None,
 ) -> Optional[PreemptSolveHandle]:
     """Encode + async-dispatch the batched victim-selection solve.
 
@@ -283,6 +284,9 @@ def dispatch_preemption_solve(
         # covers them all — skip the victim sync/upload and the dispatch
         return None
 
+    # zombie checkpoint: a dispatch abandoned while wedged above must not
+    # reach the victim tables after a replacement mirror went live
+    encoder.ensure_mirror_epoch(mirror_epoch)
     synced = encoder.sync_victims(app_of_pod, cache.get_priority_class)
     na = encoder.nodes
     node_order = np.full((na.capacity,), ps_mod.NODE_ORDER_EXCLUDED, np.int32)
@@ -301,9 +305,13 @@ def dispatch_preemption_solve(
                 row = encoder.quantize_request(res)
                 free_delta[idx, : row.shape[0]] += row
 
+    from yunikorn_tpu.snapshot.encoder import MirrorDiscarded
+
     device_state = None
     try:
-        device_state = encoder.victim_arrays(mesh=mesh)
+        device_state = encoder.victim_arrays(mesh=mesh, epoch=mirror_epoch)
+    except MirrorDiscarded:
+        raise  # abandoned-dispatch zombie: stop, don't fall back
     except Exception:
         logger.exception("victim-table device refresh failed; "
                          "falling back to per-call upload")

@@ -79,7 +79,14 @@ from yunikorn_tpu.obs.metrics import (
 from yunikorn_tpu.obs.trace import CycleTracer
 from yunikorn_tpu.ops import assign as assign_mod
 from yunikorn_tpu.ops.assign import solve_batch
-from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+from yunikorn_tpu.robustness.health import HealthMonitor, solver_source
+from yunikorn_tpu.robustness.supervisor import (
+    ASSIGN_LADDER,
+    AbandonedDispatch,
+    SupervisedExecutor,
+    SupervisorOptions,
+)
+from yunikorn_tpu.snapshot.encoder import MirrorDiscarded, SnapshotEncoder
 
 logger = log("core.scheduler")
 
@@ -169,9 +176,29 @@ class _PipelineCycle:
     t_encode_end: float = 0.0
     t_dispatched: float = 0.0
     policy: str = "binpacking"
-    result: Optional[object] = None
+    result: Optional["_SolveHandle"] = None
     # row→name mapping snapshotted at dispatch (commit-time remap guard)
     node_names: Optional[Dict[int, str]] = None
+
+
+@dataclasses.dataclass
+class _SolveHandle:
+    """One supervised assignment solve: the dispatch inputs (kept so a
+    degraded tier can re-solve against the exact same state), the tier the
+    dispatch used, and the async result awaiting materialization."""
+    admitted: List
+    batch: object
+    policy: str
+    overlay: object
+    node_mask: object
+    inflight_ports: object
+    tier: str = "device"
+    result: Optional[object] = None   # async SolveResult (device/cpu tiers)
+    allow_mesh: bool = True           # False: locality-fallback drain solves
+    # encoder mirror epoch captured on the scheduler thread right before
+    # each supervised execute: an abandoned dispatch that unwedges after a
+    # discard finds it stale and bails instead of racing the live mirror
+    mirror_epoch: Optional[int] = None
 
 
 class CoreScheduler(SchedulerAPI):
@@ -180,7 +207,8 @@ class CoreScheduler(SchedulerAPI):
     def __init__(self, cache: SchedulerCache, interval: float = 0.1,
                  solver_policy: Optional[str] = None,
                  solver_options: Optional[SolverOptions] = None,
-                 trace_spans: int = 4096):
+                 trace_spans: int = 4096,
+                 supervisor_options: Optional[SupervisorOptions] = None):
         self._lock = locking.RMutex()
         self.cache = cache
         self.encoder = SnapshotEncoder(cache)
@@ -249,6 +277,37 @@ class CoreScheduler(SchedulerAPI):
         self.obs = MetricsRegistry()
         self.tracer = CycleTracer(capacity=max(int(trace_spans), 64))
         m = self.obs
+        # ---- robustness (robustness/): supervised device dispatches ----
+        # Every device path (assign solve, preempt solve, mesh dispatch,
+        # device-mirror upload) runs through the supervisor: deadlines,
+        # classified bounded retry, per-path circuit breakers degrading
+        # device → cpu → host, half-open probes reclaiming a recovered
+        # backend. The health monitor aggregates circuit state, cycle
+        # failures, informer staleness (wired by the shim) and dispatcher
+        # backlog into /ws/v1/health.
+        self.supervisor = SupervisedExecutor(
+            supervisor_options, registry=m, tracer=self.tracer)
+        # a deadline-abandoned dispatch leaves a daemon thread that may still
+        # mutate the device mirror whenever it unwedges — orphan the mirror
+        # so those late writes can't tear the next cycle's refresh
+        self.supervisor.on_abandon = self._on_dispatch_abandoned
+        self.health = HealthMonitor()
+        self.health.register("scheduling", self._scheduling_health)
+        self.health.register("solver", solver_source(self.supervisor))
+        self._m_cycle_failures = m.counter(
+            "scheduling_cycle_failures_total",
+            "scheduling cycles that raised, by pipeline stage "
+            "(pre-round-9 these were swallowed into the log)",
+            labelnames=("stage",))
+        self._last_cycle_failure: Optional[dict] = None
+        self._failure_streak = 0
+        self._last_cycle_success_at = time.time()
+        # stage marker the run loop reads when a tick raises (single
+        # scheduler thread writes it at each stage boundary)
+        self._cycle_stage: Optional[str] = None
+        # set by _pipeline_finish when it abandons an in-flight cycle: the
+        # run loop must not record that tick as a cycle success
+        self._cycle_abandoned = False
         # reference perf test samples
         # yunikorn_scheduler_container_allocation_attempt_total; these keep
         # the established names so dashboards/tests carry over
@@ -758,6 +817,7 @@ class CoreScheduler(SchedulerAPI):
         # publish before the dispatcher/shim shut down behind us
         with self._pipeline_mu:
             self._drain_pipeline()
+        self.supervisor.close()
 
     def trigger(self) -> None:
         with self._wake:
@@ -796,12 +856,25 @@ class CoreScheduler(SchedulerAPI):
                         prev = cur
                         time.sleep(min(self._interval / 2, 0.02))
                 self._seq_at_cycle = self._ask_seq
+                self._cycle_abandoned = False
                 if self._pipeline_enabled():
                     self._pipeline_tick()
                 else:
                     self.schedule_once()
-            except Exception:
-                logger.exception("scheduling cycle failed")
+                # a tick whose in-flight cycle was ABANDONED (solve failed on
+                # every tier; _pipeline_finish swallowed it to keep the
+                # pipeline moving) is a failure, not a success: skipping the
+                # success note keeps the failure streak counting so the
+                # health report's readiness rule can actually trip
+                if not self._cycle_abandoned:
+                    self._note_cycle_success()
+            except Exception as e:
+                # never silent (the pre-round-9 bare log line): counted by
+                # stage, stamped into the health report, still logged
+                if not getattr(e, "_yk_cycle_noted", False):
+                    self._note_cycle_failure(self._cycle_stage or "cycle", e)
+                logger.exception("scheduling cycle failed (stage=%s)",
+                                 self._cycle_stage or "cycle")
 
     def _pipeline_enabled(self) -> bool:
         """The two-stage pipeline engages for the single-partition case (the
@@ -818,17 +891,27 @@ class CoreScheduler(SchedulerAPI):
         flight is finished first so direct callers observe its results)."""
         total = 0
         payloads = []
-        with self._pipeline_mu:
-            self._drain_pipeline()
-            with self._lock:
-                multi = len(self.partitions) > 1
-                for pname in list(self.partitions):
-                    if getattr(self.partitions[pname], "draining", False):
-                        continue  # removed from config; no new scheduling
-                    self._use_partition(pname)
-                    n, payload = self._schedule_partition(restrict_nodes=multi)
-                    total += n
-                    payloads.append(payload)
+        try:
+            with self._pipeline_mu:
+                self._cycle_stage = "sequential"
+                self._drain_pipeline()
+                with self._lock:
+                    multi = len(self.partitions) > 1
+                    for pname in list(self.partitions):
+                        if getattr(self.partitions[pname], "draining", False):
+                            continue  # removed from config; no new scheduling
+                        self._use_partition(pname)
+                        n, payload = self._schedule_partition(restrict_nodes=multi)
+                        total += n
+                        payloads.append(payload)
+        except Exception as e:
+            # count + stamp the failure here so DIRECT callers (tests, REST
+            # triggers) surface in the health report too; the run loop skips
+            # re-noting an already-noted exception
+            if not getattr(e, "_yk_cycle_noted", False):
+                self._note_cycle_failure("sequential", e)
+                e._yk_cycle_noted = True
+            raise
         for payload in payloads:
             self._publish_cycle(payload)
         return total
@@ -911,8 +994,23 @@ class CoreScheduler(SchedulerAPI):
                 self.partition.name == "default"
                 else self._partition_policy.get(self.partition.name, self._policy))
 
+    def _on_dispatch_abandoned(self, path: str, tier: str) -> None:
+        """Supervisor hook: a dispatch blew its deadline and was abandoned.
+
+        The watchdog thread is still running the wedged call and will mutate
+        whatever it was touching if it ever unwedges — for device-tier paths
+        that includes the persistent device mirror's buffers and dirty-field
+        bookkeeping, which the next cycle's refresh would race (a torn sync
+        means wrong free-capacity tensors, i.e. wrong placements). Orphan
+        the mirror so the late writes land on an unreferenced object; the
+        replacement starts with one full upload."""
+        if tier in ("cpu", "host"):
+            return  # host-side tiers never touch the device mirror
+        with self._lock:
+            self.encoder.discard_device_mirror()
+
     def _dispatch_solve(self, batch, policy, overlay, node_mask,
-                        inflight_ports):
+                        inflight_ports, allow_mesh=True, mirror_epoch=None):
         """Route one batch to the resolved solve path (sharded or single),
         threading the persistent device-resident node tensors through so the
         chunk-invariant node state transfers O(changes), not O(M), per cycle.
@@ -925,34 +1023,66 @@ class CoreScheduler(SchedulerAPI):
         device mirror's upload tally costs microseconds, so the clean hot
         path stays clean."""
         so = self.solver
-        use_mesh = (self._mesh is not None
-                    and self.encoder.nodes.capacity % self._mesh.devices.size == 0)
+        # an open mesh circuit drops the whole cycle to the single-device
+        # shape up front: the mirror then refreshes unsharded (mesh=None) and
+        # the fallback solve reuses it, instead of paying a sharded upload
+        # the skipped mesh dispatch would discard plus a full per-cycle
+        # transfer in the fallback. allow() half-opens a cooled-off circuit,
+        # so the probe dispatch still happens here.
+        use_mesh = (allow_mesh and self._mesh is not None
+                    and self.encoder.nodes.capacity % self._mesh.devices.size == 0
+                    and self.supervisor.allow("mesh"))
+        # device-mirror upload: its own supervised path — a failing/wedged
+        # upload opens the "upload" circuit and the solve falls back to the
+        # per-cycle full transfer until a half-open probe re-closes it.
+        # A mesh-disallowed solve (the locality-fallback drain) skips the
+        # mirror whenever a mesh exists: refreshing the shared mirror with a
+        # different sharding would thrash the main cycle's buffers.
         device_state = None
-        try:
-            device_state = self.encoder.device_arrays(
-                mesh=self._mesh if use_mesh else None)
-        except Exception:
-            logger.exception("device node-state refresh failed; "
-                             "falling back to per-cycle upload")
+        if ((allow_mesh or self._mesh is None)
+                and self.supervisor.allow("upload")):
+            # the epoch travels from the handle (captured on the scheduler
+            # thread pre-dispatch); a direct caller captures fresh here
+            epoch = (mirror_epoch if mirror_epoch is not None
+                     else self.encoder.mirror_epoch)
+            try:
+                device_state = self.supervisor.run(
+                    "upload",
+                    lambda: self.encoder.device_arrays(
+                        mesh=self._mesh if use_mesh else None, epoch=epoch))
+            except (AbandonedDispatch, MirrorDiscarded):
+                raise  # zombie thread: stop, don't run a pointless solve
+            except Exception:
+                logger.exception("device node-state refresh failed; "
+                                 "falling back to per-cycle upload")
         jc0 = assign_mod.jit_cache_entries()
+        result = None
         if use_mesh:
             from yunikorn_tpu.parallel.mesh import solve_sharded
 
-            result = solve_sharded(batch, self.encoder.nodes, self._mesh,
-                                   max_rounds=so.max_rounds, chunk=so.chunk,
-                                   policy=policy, free_delta=overlay,
-                                   node_mask=node_mask,
-                                   ports_delta=inflight_ports,
-                                   max_batch=so.max_batch,
-                                   device_state=device_state)
-        else:
+            try:
+                result = self.supervisor.run(
+                    "mesh",
+                    lambda: solve_sharded(
+                        batch, self.encoder.nodes, self._mesh,
+                        max_rounds=so.max_rounds, chunk=so.chunk,
+                        policy=policy, free_delta=overlay,
+                        node_mask=node_mask, ports_delta=inflight_ports,
+                        max_batch=so.max_batch, device_state=device_state))
+            except AbandonedDispatch:
+                raise  # zombie thread: stop, don't run a pointless solve
+            except Exception:
+                logger.exception("sharded-mesh dispatch failed; this cycle "
+                                 "solves single-device")
+        if result is None:
             result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                  max_rounds=so.max_rounds, chunk=so.chunk,
                                  use_pallas=self._use_pallas,
                                  free_delta=overlay, node_mask=node_mask,
                                  ports_delta=inflight_ports,
                                  max_batch=so.max_batch,
-                                 device_state=device_state)
+                                 device_state=(None if use_mesh
+                                               else device_state))
         jc1 = assign_mod.jit_cache_entries()
         stats = {"pods": int(batch.num_pods)}
         if jc0 >= 0 and jc1 >= 0:
@@ -979,6 +1109,108 @@ class CoreScheduler(SchedulerAPI):
         self._m_batch_pods.observe(batch.num_pods)
         self._last_solve_stats = stats
         return result
+
+    # ------------------------------------------- supervised solve (tiers)
+    # The assignment solve runs through the supervisor's degradation ladder:
+    #   device — the resolved backend (mesh-sharded or single), async
+    #   cpu    — the same program re-jitted on the host CPU backend (same
+    #            arithmetic → identical placements), async
+    #   host   — the exact host path (robustness/host_solve.py), pure
+    #            Python/numpy, computed at materialize time
+    # Dispatch and materialization are supervised separately so the
+    # pipelined cycle keeps its overlap: a dispatch-time failure degrades
+    # immediately; a materialize-time failure (including a blown deadline)
+    # re-solves the SAME captured inputs on the next tier, so a degraded
+    # cycle commits exactly what the healthy cycle would have.
+
+    def _solve_tier_dispatch(self, h: "_SolveHandle", tier: str):
+        if tier == "device":
+            return self._dispatch_solve(h.batch, h.policy, h.overlay,
+                                        h.node_mask, h.inflight_ports,
+                                        allow_mesh=h.allow_mesh,
+                                        mirror_epoch=h.mirror_epoch)
+        if tier == "cpu":
+            return self._dispatch_solve_cpu(h)
+        return None  # host tier solves at materialize time
+
+    def _dispatch_solve_cpu(self, h: "_SolveHandle"):
+        """CPU-backend re-jitted solve: same program, same arithmetic, host
+        platform — the first fallback when the device runtime is failing."""
+        import jax
+
+        so = self.solver
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            result = solve_batch(h.batch, self.encoder.nodes, policy=h.policy,
+                                 max_rounds=so.max_rounds, chunk=so.chunk,
+                                 use_pallas=False, free_delta=h.overlay,
+                                 node_mask=h.node_mask,
+                                 ports_delta=h.inflight_ports,
+                                 max_batch=so.max_batch, device_state=None)
+        self._m_batch_pods.observe(h.batch.num_pods)
+        self._last_solve_stats = {"pods": int(h.batch.num_pods),
+                                  "tier": "cpu"}
+        return result
+
+    def _host_assign(self, h: "_SolveHandle"):
+        from yunikorn_tpu.robustness.host_solve import host_assign
+
+        assigned = host_assign(h.admitted, h.batch, self.encoder, self.cache,
+                               policy=h.policy, free_delta=h.overlay,
+                               node_mask=h.node_mask,
+                               ports_delta=h.inflight_ports)
+        self._last_solve_stats = {"pods": int(h.batch.num_pods),
+                                  "tier": "host"}
+        return assigned
+
+    def _solve_dispatch(self, admitted, batch, policy, overlay, node_mask,
+                        inflight_ports, allow_mesh=True) -> "_SolveHandle":
+        """Supervised dispatch on the path's current tier. Dispatch success
+        alone never re-closes a half-open circuit (commit_success=False) —
+        only a materialized result proves the tier healthy."""
+        h = _SolveHandle(admitted=admitted, batch=batch, policy=policy,
+                         overlay=overlay, node_mask=node_mask,
+                         inflight_ports=inflight_ports,
+                         allow_mesh=allow_mesh,
+                         mirror_epoch=self.encoder.mirror_epoch)
+
+        def mk(tier):
+            return lambda: self._solve_tier_dispatch(h, tier)
+
+        result, tier = self.supervisor.execute(
+            "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
+            commit_success=False)
+        h.result, h.tier = result, tier
+        return h
+
+    def _solve_materialize(self, h: "_SolveHandle"):
+        """Finish one supervised solve: materialize the async result under
+        the dispatch deadline; a failure degrades and RE-SOLVES the handle's
+        captured inputs on the next tier. Raises AllTiersFailed when even
+        the host tier cannot answer."""
+        import numpy as np
+
+        n = h.batch.num_pods
+        # a RE-solve at materialize time is a new dispatch: it must carry
+        # the current epoch, not the (possibly superseded) dispatch-time one
+        h.mirror_epoch = self.encoder.mirror_epoch
+
+        def mk(tier):
+            def fn():
+                if tier == h.tier and h.result is not None:
+                    result, h.result = h.result, None  # retry re-dispatches
+                    return np.asarray(result.assigned)[:n]
+                if tier == "host":
+                    return self._host_assign(h)
+                result = self._solve_tier_dispatch(h, tier)
+                return np.asarray(result.assigned)[:n]
+            return fn
+
+        assigned, tier = self.supervisor.execute(
+            "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
+            start_tier=h.tier)
+        h.tier = tier
+        return assigned
 
     def _ask_pending(self, ask) -> bool:
         app = self.partition.applications.get(ask.application_id)
@@ -1132,6 +1364,11 @@ class CoreScheduler(SchedulerAPI):
         planner is off, or nothing is eligible."""
         if not (self._preemption_enabled and self._preempt_device_enabled()):
             return None
+        if not self.supervisor.allow("preempt"):
+            # circuit open: the host planner covers this cycle outright
+            # (_plan_preemption's no-handle branch); an expired cooldown
+            # turned this call into the half-open probe admission
+            return None
         import numpy as np
 
         # fast path: nothing unplaced (the overwhelmingly common cycle)
@@ -1166,12 +1403,19 @@ class CoreScheduler(SchedulerAPI):
         use_mesh = (self._mesh is not None
                     and self.encoder.nodes.capacity % self._mesh.devices.size == 0)
         t0 = time.time()
+        epoch = self.encoder.mirror_epoch
         try:
-            handle = dispatch_preemption_solve(
-                self.cache, self.encoder, prospective, self._app_of_pod(),
-                inflight_by_node=self._inflight_by_node(),
-                candidate_nodes=self._preempt_candidate_nodes(),
-                mesh=self._mesh if use_mesh else None)
+            # dispatch success alone must not re-close a half-open circuit:
+            # the materialized finish is what proves the path healthy
+            handle = self.supervisor.run(
+                "preempt",
+                lambda: dispatch_preemption_solve(
+                    self.cache, self.encoder, prospective, self._app_of_pod(),
+                    inflight_by_node=self._inflight_by_node(),
+                    candidate_nodes=self._preempt_candidate_nodes(),
+                    mesh=self._mesh if use_mesh else None,
+                    mirror_epoch=epoch),
+                commit_success=False)
         except Exception:
             logger.exception("batched preemption dispatch failed; "
                              "host planner will cover this cycle")
@@ -1213,8 +1457,19 @@ class CoreScheduler(SchedulerAPI):
             handle.inflight_by_node = inflight_by_node
             handle.app_of_pod = app_of_pod
             unplaced_keys = {a.allocation_key for a in unplaced_asks}
-            plans, attempted, stats = finish_preemption_solve(
-                handle, only_keys=unplaced_keys)
+            try:
+                # supervised finish: a wedged/failing materialization opens
+                # the preempt circuit and this cycle re-plans on the host
+                plans, attempted, stats = self.supervisor.run(
+                    "preempt",
+                    lambda: finish_preemption_solve(
+                        handle, only_keys=unplaced_keys))
+            except Exception:
+                logger.exception("device preemption finish failed; "
+                                 "re-planning this cycle on the host")
+                handle = None
+                stats = {}
+        if handle is not None:
             if stats.get("fallbacks"):
                 self._m_preempt_fallback.inc(stats["fallbacks"])
             # residue: unplaced asks the dispatch never saw — locality-
@@ -1306,6 +1561,7 @@ class CoreScheduler(SchedulerAPI):
         t0 = time.time()
         self._cycle_seq += 1
         cid = self._cycle_seq
+        self.supervisor.cycle_id = cid
         self._check_app_completion()
         self._check_placeholder_timeouts()
         replaced = self._replace_placeholders()
@@ -1344,13 +1600,12 @@ class CoreScheduler(SchedulerAPI):
             t_encode = time.time()
             policy = self._policy_for_partition()
             self._resolve_solver_runtime()
-            result = self._dispatch_solve(batch, policy, overlay, node_mask,
-                                          inflight_ports)
-            import numpy as np
-
+            handle = self._solve_dispatch(admitted, batch, policy, overlay,
+                                          node_mask, inflight_ports)
             # materializing the result is the device sync point: everything
-            # up to here was async dispatch
-            assigned = np.asarray(result.assigned)[: batch.num_pods]
+            # up to here was async dispatch; a failing/wedged tier degrades
+            # and re-solves the same inputs (supervised)
+            assigned = self._solve_materialize(handle)
             t_solve = time.time()
             # second-stage dispatch: the batched victim-selection solve for
             # the rows the assignment left unplaced runs on device while the
@@ -1439,17 +1694,22 @@ class CoreScheduler(SchedulerAPI):
 
     def _pipeline_tick(self) -> int:
         with self._pipeline_mu:
+            self._cycle_stage = "prepare"
             prep = self._pipeline_prepare()
             prev, self._pipeline_inflight = self._pipeline_inflight, None
             finished, n_prev = None, 0
             if prev is not None:
+                self._cycle_stage = "finish"
                 finished, n_prev = self._pipeline_finish(prev)
             extra = None
             try:
+                self._cycle_stage = "housekeeping"
                 extra = self._pipeline_housekeeping()
                 if prep is not None:
+                    self._cycle_stage = "dispatch"
                     self._pipeline_dispatch(prep)
                     self._pipeline_inflight = prep
+                self._cycle_stage = "publish"
             finally:
                 # publish AFTER the next solve is dispatched: the assume/
                 # bind drain then runs while the device (or XLA's native
@@ -1569,8 +1829,10 @@ class CoreScheduler(SchedulerAPI):
             self.encoder.sync_nodes()
             cyc.policy = self._policy_for_partition()
             self._resolve_solver_runtime_locked()
-            cyc.result = self._dispatch_solve(batch, cyc.policy, overlay,
-                                              None, inflight_ports)
+            self.supervisor.cycle_id = cyc.cycle_id
+            cyc.result = self._solve_dispatch(cyc.admitted, batch,
+                                              cyc.policy, overlay, None,
+                                              inflight_ports)
             # row→name snapshot for the commit: a row remapped while the
             # solve is in flight must not receive its placement
             cyc.node_names = dict(self.encoder.nodes._idx_to_name)
@@ -1589,14 +1851,30 @@ class CoreScheduler(SchedulerAPI):
             self._inflight_gate_seed = seed
 
     def _pipeline_finish(self, cyc: "_PipelineCycle") -> Tuple[Optional[tuple], int]:
-        """Materialize + commit one in-flight cycle; returns (payload, n)."""
-        import numpy as np
+        """Materialize + commit one in-flight cycle; returns (payload, n).
 
+        A solve whose every tier failed (or whose deadline blew past even
+        the host tier) ABANDONS the cycle instead of wedging the pipeline:
+        the in-flight gate state is cleared, the asks stay pending (commit
+        never ran), and the next cycle re-admits them — the failure is
+        counted and lands in the health report."""
         batch = cyc.batch
         t_mat0 = time.time()
+        self.supervisor.cycle_id = cyc.cycle_id
         # the device sync point — deliberately OUTSIDE the core lock so
         # informer/API threads are never stalled on device latency
-        assigned = np.asarray(cyc.result.assigned)[: batch.num_pods]
+        try:
+            assigned = self._solve_materialize(cyc.result)
+        except Exception as e:
+            self._note_cycle_failure("solve", e)
+            self._cycle_abandoned = True
+            logger.exception("pipelined cycle %d abandoned: solve failed on "
+                             "every tier", cyc.cycle_id)
+            with self._lock:
+                self._use_partition("default")
+                self._inflight_ask_keys = set()
+                self._inflight_gate_seed = []
+            return None, 0
         t_mat1 = time.time()
         self.tracer.add("solve", cyc.cycle_id, cyc.t_dispatched, t_mat0)
         self.tracer.add("materialize", cyc.cycle_id, t_mat0, t_mat1)
@@ -1719,8 +1997,6 @@ class CoreScheduler(SchedulerAPI):
 
         Returns (committed allocations, still-unplaced asks, rounds used).
         """
-        import numpy as np
-
         so = self.solver
         committed: List[Allocation] = []
         rounds = 0
@@ -1736,18 +2012,15 @@ class CoreScheduler(SchedulerAPI):
             inflight_ports = self._inflight_ports()
             self.encoder.sync_nodes()
             batch = self.encoder.build_batch(remaining, extra_placed=placements)
-            # device-resident node tensors only off the mesh path: the drain
-            # always solves single-device, and refreshing the shared mirror
-            # with a different sharding would thrash the main cycle's buffers
-            ds = (self.encoder.device_arrays(mesh=None)
-                  if self._mesh is None else None)
-            result = solve_batch(batch, self.encoder.nodes, policy=policy,
-                                 max_rounds=so.max_rounds, chunk=so.chunk,
-                                 use_pallas=self._use_pallas,
-                                 free_delta=overlay, node_mask=node_mask,
-                                 ports_delta=inflight_ports,
-                                 max_batch=so.max_batch, device_state=ds)
-            assigned = np.asarray(result.assigned)[: batch.num_pods]
+            # drain rounds ride the same supervised ladder as the main solve
+            # (allow_mesh=False: the drain always solves single-device, and
+            # refreshing the shared mirror with a different sharding would
+            # thrash the main cycle's buffers) — a failing device runtime
+            # degrades the round instead of aborting a half-committed cycle
+            h = self._solve_dispatch(remaining, batch, policy, overlay,
+                                     node_mask, inflight_ports,
+                                     allow_mesh=False)
+            assigned = self._solve_materialize(h)
             progress = False
             next_remaining: List = []
             for i, ask in enumerate(remaining):
@@ -2224,6 +2497,52 @@ class CoreScheduler(SchedulerAPI):
         if last:
             snap["last_cycle"] = last
         return snap
+
+    def _note_cycle_success(self) -> None:
+        self._last_cycle_success_at = time.time()
+        self._failure_streak = 0
+        self._cycle_stage = None
+
+    def _note_cycle_failure(self, stage: str, exc: BaseException) -> None:
+        """One scheduling-cycle failure: counted by stage and kept as the
+        health report's last-failure record (time + reason) instead of only
+        swallowed into the log."""
+        self._m_cycle_failures.inc(stage=stage)
+        self._failure_streak += 1
+        self._last_cycle_failure = {
+            "at": round(time.time(), 3),
+            "stage": stage,
+            "reason": f"{type(exc).__name__}: {exc}"[:300],
+        }
+        self._cycle_stage = None
+
+    def _scheduling_health(self) -> dict:
+        """Health source: the scheduling loop itself. Liveness fails only
+        when the run-loop thread died while supposed to be running; a
+        failure streak (no successful cycle since) fails readiness."""
+        now = time.time()
+        out: dict = {
+            "healthy": True,
+            "last_success_age_s": round(now - self._last_cycle_success_at, 1),
+            "cycles": int(self._m_solve_cycles.value()),
+        }
+        if self._last_cycle_failure is not None:
+            out["last_failure"] = dict(self._last_cycle_failure)
+        if self._failure_streak:
+            out["failure_streak"] = self._failure_streak
+            if self._failure_streak >= 3:
+                out["healthy"] = False
+        thread = self._thread
+        if (self._running.is_set() and thread is not None
+                and not thread.is_alive()):
+            out["healthy"] = False
+            out["live"] = False
+            out["state"] = "loop-dead"
+        return out
+
+    def health_report(self) -> dict:
+        """The /ws/v1/health payload (robustness/health.py aggregation)."""
+        return self.health.report()
 
     def _record_cycle_entry(self, pname: str, entry: dict) -> None:
         """Publish one cycle's stage breakdown (core lock held): the

@@ -84,6 +84,9 @@ class Dispatcher:
         self._m_overflow = None
         self._m_batch = None
         self._m_depth = None
+        self._m_dropped = None
+        # drops counted even before a registry attaches (health/tests)
+        self.dropped_count = 0
 
     # -- observability ------------------------------------------------------
     def attach_metrics(self, registry) -> None:
@@ -107,6 +110,13 @@ class Dispatcher:
         self._m_depth = registry.gauge(
             "dispatcher_queue_depth",
             "events still queued (buffer + overflow) after the last drain")
+        self._m_dropped = registry.counter(
+            "dispatch_dropped_total",
+            "overflow events dropped because their dispatch timeout expired "
+            "before buffer space freed (reference: DispatchTimeout)")
+        if self.dropped_count:
+            # drops that happened before the registry attached still count
+            self._m_dropped.inc(self.dropped_count)
 
     # -- registration -------------------------------------------------------
     def register_event_handler(self, name: str, event_type: EventType,
@@ -168,7 +178,13 @@ class Dispatcher:
                     # single popper: only this worker ever removes entries
                     self._overflow.popleft()
             elif time.time() > deadline:
+                # the drop is COUNTED, not only logged: a deadline-expired
+                # event is lost work (an FSM transition that never fires)
+                # and must be visible on a dashboard, not only in the log
                 logger.error("dispatch timeout for event %s", event)
+                self.dropped_count += 1
+                if self._m_dropped is not None:
+                    self._m_dropped.inc()
                 with self._overflow_cond:
                     self._overflow.popleft()
 
@@ -198,6 +214,15 @@ class Dispatcher:
         if self._retry_thread is not None:
             self._retry_thread.join(timeout=10)
             self._retry_thread = None
+
+    def backlog(self) -> Tuple[int, int]:
+        """(buffered, overflow) depths — the health monitor's event-plane
+        probe (robustness/health.dispatcher_source)."""
+        with self._cond:
+            buffered = len(self._buf)
+        with self._overflow_cond:
+            overflow = len(self._overflow)
+        return buffered, overflow
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Block until the overflow deque and buffer are empty and the

@@ -218,6 +218,27 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
+        # refreshers run at the top of every exposition (expose/snapshot):
+        # age- and depth-style gauges are push-model, so without this a
+        # scrape-only deployment (no health-probe traffic) would read the
+        # value from whenever the owner last happened to push — e.g. a
+        # wedged informer's last-sync age frozen at 0 during the exact
+        # staleness incident the gauge exists to catch
+        self._collect_hooks: List = []
+
+    def on_collect(self, fn) -> None:
+        """Register a zero-arg callback run before each exposition."""
+        with self._lock:
+            self._collect_hooks.append(fn)
+
+    def _run_collect_hooks(self) -> None:
+        with self._lock:
+            hooks = list(self._collect_hooks)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                pass  # a scrape must never fail on a refresher
 
     def _get_or_create(self, cls, name, help, labelnames, **kwargs):
         with self._lock:
@@ -259,6 +280,7 @@ class MetricsRegistry:
     # ------------------------------------------------------------- renderers
     def expose(self, prefix: str = "yunikorn_") -> str:
         """Prometheus text exposition (format 0.0.4)."""
+        self._run_collect_hooks()
         lines: List[str] = []
         for m in self.families():
             full = prefix + m.name
@@ -280,6 +302,7 @@ class MetricsRegistry:
         numbers (the legacy `/ws/v1/metrics` keys, e.g.
         `allocation_attempt_allocated`); labeled families nest by label
         values; histograms report count/sum/per-bucket cumulative counts."""
+        self._run_collect_hooks()
         out: dict = {}
         for m in self.families():
             if isinstance(m, Histogram):

@@ -1,0 +1,37 @@
+"""Fault containment around device dispatches + the health subsystem.
+
+The JAX port added a fault domain the reference shim never had: the device
+runtime. A wedged or persistently failing XLA dispatch must degrade the
+solver — device backend → CPU-backend re-jitted solve → exact host path —
+never stop placement (POP, arXiv:2110.11927: granular allocation solvers
+stay serviceable under degradation; Priority-Matters, arXiv:2511.08373:
+the production packing solver may get slower or coarser, never stop
+answering).
+
+    supervisor  — SupervisedExecutor: per-dispatch deadlines (watchdog
+                  worker), error classification, bounded jittered retry,
+                  per-path circuit breakers with half-open probe recovery
+    faults      — injectable fault plane the chaos suite drives
+    health      — component health state machine behind /ws/v1/health
+    host_solve  — the exact host-path assignment tier (last resort)
+"""
+from yunikorn_tpu.robustness.faults import FaultPlane, InjectedFault
+from yunikorn_tpu.robustness.health import HealthMonitor
+from yunikorn_tpu.robustness.supervisor import (
+    AllTiersFailed,
+    DeadlineExceeded,
+    SupervisedExecutor,
+    SupervisorOptions,
+    classify_error,
+)
+
+__all__ = [
+    "AllTiersFailed",
+    "DeadlineExceeded",
+    "FaultPlane",
+    "HealthMonitor",
+    "InjectedFault",
+    "SupervisedExecutor",
+    "SupervisorOptions",
+    "classify_error",
+]

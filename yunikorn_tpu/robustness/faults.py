@@ -1,0 +1,119 @@
+"""Injectable fault plane: the seams the chaos suite drives.
+
+Reference pattern: NewMockedAPIProvider(showError) + the mockable
+Bind/Create/Delete seams (apifactory_mock.go:137-165) let the reference
+inject client-plane faults; the JAX port's new fault domain is the device
+runtime, so the injection point sits inside every SUPERVISED dispatch
+attempt (SupervisedExecutor runs `on_attempt` on the watchdog worker right
+before the wrapped call — a scripted `slow` therefore really trips the
+dispatch deadline, exactly like a wedged XLA dispatch would).
+
+Rules match (path, tier): `fail("assign", tier="device")` poisons only the
+device tier, so the chaos suite can prove the CPU/host tiers keep answering
+while the primary is down.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a scripted fail rule (classified transient by default)."""
+
+
+class InjectedPersistentFault(InjectedFault):
+    """Scripted fault classified persistent (compile/shape-error analog)."""
+
+
+class _Rule:
+    __slots__ = ("kind", "tier", "times", "after", "delay_s", "exc")
+
+    def __init__(self, kind: str, tier: Optional[str], times: float,
+                 after: int, delay_s: float, exc: Optional[Exception]):
+        self.kind = kind            # "fail" | "slow"
+        self.tier = tier            # None matches every tier
+        self.times = times          # remaining firings (inf = forever)
+        self.after = after          # attempts to let through first
+        self.delay_s = delay_s
+        self.exc = exc
+
+
+class FaultPlane:
+    """Per-path scripted faults, consumed attempt by attempt."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._rules: Dict[str, List[_Rule]] = {}
+        # attempts seen per (path, tier) — lets tests assert retry counts
+        self.attempts: Dict[str, int] = {}
+
+    # -- scripting ---------------------------------------------------------
+    def fail(self, path: str, times: int = 1, tier: Optional[str] = None,
+             after: int = 0, exc: Optional[Exception] = None,
+             persistent: bool = False) -> None:
+        """Raise on the next `times` matching attempts (after `after`)."""
+        if exc is None:
+            cls = InjectedPersistentFault if persistent else InjectedFault
+            exc = cls(f"injected fault on {path}"
+                      + (f"/{tier}" if tier else ""))
+        with self._mu:
+            self._rules.setdefault(path, []).append(
+                _Rule("fail", tier, times, after, 0.0, exc))
+
+    def fail_forever(self, path: str, tier: Optional[str] = None,
+                     exc: Optional[Exception] = None) -> None:
+        self.fail(path, times=float("inf"), tier=tier, exc=exc)
+
+    def slow(self, path: str, seconds: float, times: int = 1,
+             tier: Optional[str] = None, after: int = 0) -> None:
+        """Sleep before the next `times` matching attempts (deadline test)."""
+        with self._mu:
+            self._rules.setdefault(path, []).append(
+                _Rule("slow", tier, times, after, float(seconds), None))
+
+    def clear(self, path: Optional[str] = None) -> None:
+        with self._mu:
+            if path is None:
+                self._rules.clear()
+            else:
+                self._rules.pop(path, None)
+
+    def pending(self, path: str) -> int:
+        """Matching rules still armed (diagnostics)."""
+        with self._mu:
+            return sum(1 for r in self._rules.get(path, ())
+                       if r.times > 0)
+
+    # -- the seam ----------------------------------------------------------
+    def on_attempt(self, path: str, tier: str) -> None:
+        """Called by the supervisor inside every supervised attempt.
+
+        May sleep (slow rules) and then raise (fail rules). Rules are
+        consumed in script order; a rule's `after` budget is decremented by
+        matching attempts that pass through it.
+        """
+        delay = 0.0
+        exc: Optional[Exception] = None
+        with self._mu:
+            key = f"{path}/{tier}"
+            self.attempts[key] = self.attempts.get(key, 0) + 1
+            for rule in self._rules.get(path, ()):  # script order
+                if rule.tier is not None and rule.tier != tier:
+                    continue
+                if rule.times <= 0:
+                    continue
+                if rule.after > 0:
+                    rule.after -= 1
+                    continue
+                rule.times -= 1
+                if rule.kind == "slow":
+                    delay += rule.delay_s
+                else:
+                    exc = rule.exc
+                    break
+        if delay > 0:
+            time.sleep(delay)
+        if exc is not None:
+            raise exc

@@ -1,0 +1,168 @@
+"""The host-tier assignment solve: the degradation ladder's last resort.
+
+When both device tiers (native backend, CPU-backend re-jit) are circuit-open
+the scheduler must still place pods — the reference scheduler IS a host
+loop, so the exact host path exists by construction: the same predicate
+helpers the required-node path and the differential oracle
+(tests/test_solver_differential.py) already use, driven in the solve's rank
+order over the encoder's quantized tensors.
+
+Arithmetic matches the device solve deliberately: quantized int fit against
+floor(free) - ceil(overlay) (the shared ops.assign.apply_free_delta), node
+scores from the same normalized-free formula (models/policies.py), ties
+broken by lowest row index (the device's stable argsort does the same).
+Feasibility matches too: the per-group host mask the device solve ANDs in
+(volume/PV node affinity, DRA, overflowed locality groups) plus the exact
+per-pod locality evaluation (snapshot.locality.host_locality_mask) with an
+intra-solve placement overlay. For homogeneous batches this reproduces the
+device water-fill placement exactly; for constraint-heavy batches it stays
+feasible-correct (every placement passes the host predicates) — slower and
+possibly coarser, never silent.
+
+Cost: O(pods × nodes) Python/numpy — acceptable for an emergency tier whose
+job is liveness, not throughput.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from yunikorn_tpu.ops.assign import apply_free_delta
+from yunikorn_tpu.ops.host_predicates import (
+    host_ports_of,
+    node_selector_matches,
+    tolerates_node_taints,
+)
+from yunikorn_tpu.snapshot.locality import (
+    _pod_constraints,
+    all_anti_terms,
+    host_locality_mask,
+)
+
+
+def host_assign(admitted: List, batch, encoder, cache,
+                policy: str = "binpacking",
+                free_delta: Optional[np.ndarray] = None,
+                node_mask: Optional[np.ndarray] = None,
+                ports_delta: Optional[np.ndarray] = None) -> np.ndarray:
+    """Place one batch entirely on the host. Returns [num_pods] int32 of
+    node rows (-1 = unplaced), aligned with `admitted` like the device
+    solve's `assigned`."""
+    na = encoder.nodes
+    M = na.capacity
+    n = batch.num_pods
+    assigned = np.full((n,), -1, np.int32)
+    if n == 0:
+        return assigned
+
+    ok = na.valid & na.schedulable
+    if node_mask is not None:
+        ok = ok & node_mask[:M]
+    free = np.floor(na.free).astype(np.int64)
+    if free_delta is not None:
+        free = apply_free_delta(free, free_delta)
+    cap = np.maximum(na.capacity_arr.astype(np.float64), 1.0)
+    req = np.ceil(batch.req[:n]).astype(np.int64)
+    R = min(req.shape[1], free.shape[1])
+
+    # per-group host feasibility the device solve also ANDs in
+    # (ops.assign._finish_solve_args): volume/PV node affinity, DRA,
+    # host-evaluated affinity operators, overflowed locality groups
+    hm = batch.g_host_mask
+    hm_cols = 0 if hm is None else min(M, hm.shape[1])
+
+    # exact per-pod locality (spread / affinity / anti-affinity + symmetry),
+    # the host twin of the in-solve _loc_rules_mask; placements made by THIS
+    # solve feed back through the extra_placed overlay
+    sym_terms = all_anti_terms(cache)
+    loc_overlay: List = []  # [(Pod, node_name)] placed by this solve
+
+    # host-port occupancy: cache-visible pods + pods this solve places
+    ports_used = {}  # row -> set[(proto, port)]
+
+    def node_ports(row: int, name: str) -> set:
+        cached = ports_used.get(row)
+        if cached is not None:
+            return cached
+        used: set = set()
+        info = cache.snapshot_node(name)
+        if info is not None:
+            for p in info.pods.values():
+                used |= host_ports_of(p)
+        ports_used[row] = used
+        return used
+
+    order = np.argsort(batch.rank[:n], kind="stable")
+    for i in order.tolist():
+        if not batch.valid[i]:
+            continue
+        ask = admitted[i] if i < len(admitted) else None
+        pod = getattr(ask, "pod", None)
+        row = req[i, :R]
+        feasible = ok & (free[:, :R] >= row).all(axis=1)
+        if hm is not None:
+            gmask = np.zeros(M, bool)
+            gmask[:hm_cols] = hm[int(batch.group_id[i]), :hm_cols]
+            feasible &= gmask
+        if pod is not None and (_pod_constraints(pod)
+                                or any(t.counts_pod(pod)
+                                       for t in sym_terms)):
+            feasible &= host_locality_mask(
+                pod, cache, na, extra_placed=loc_overlay)[:M]
+        if not feasible.any():
+            continue
+        # same score the device computes per round (models/policies.py):
+        # mean normalized free, packed for binpacking/align, spread inverted
+        norm_free = (free.astype(np.float64) / cap).mean(axis=1)
+        scores = norm_free if policy == "spread" else 1.0 - norm_free
+        scores = np.where(feasible, scores, -np.inf)
+        wanted_ports = host_ports_of(pod) if pod is not None else set()
+        # committed-but-not-assumed allocations hold ports the cache can't
+        # see yet — the same [capacity, Wp] u32 overlay the device tiers
+        # receive as ports_delta (core._inflight_ports)
+        inflight_mask = None
+        if ports_delta is not None and wanted_ports:
+            from yunikorn_tpu.snapshot.vocab import port_bit
+
+            pv = encoder.vocabs.ports
+            inflight_mask = np.zeros(ports_delta.shape[1], np.uint32)
+            for proto, port in wanted_ports:
+                b = pv.lookup(port_bit(proto, port))
+                if b >= 0:
+                    inflight_mask[b // 32] |= np.uint32(1 << (b % 32))
+        placed = False
+        for _ in range(int(feasible.sum())):
+            best = int(np.argmax(scores))  # ties -> lowest row index
+            if not np.isfinite(scores[best]):
+                break
+            name = na.name_of(best)
+            if name is None:
+                scores[best] = -np.inf
+                continue
+            if (inflight_mask is not None and best < ports_delta.shape[0]
+                    and (ports_delta[best] & inflight_mask).any()):
+                scores[best] = -np.inf
+                continue
+            if pod is not None:
+                info = cache.snapshot_node(name)
+                node = info.node if info is not None else None
+                if node is not None and (
+                        not node_selector_matches(pod, node)
+                        or not tolerates_node_taints(pod, node)
+                        or (wanted_ports
+                            and wanted_ports & node_ports(best, name))):
+                    scores[best] = -np.inf
+                    continue
+            assigned[i] = best
+            free[best, :R] -= row
+            if wanted_ports:
+                node_ports(best, name)
+                ports_used[best] |= wanted_ports
+            if pod is not None:
+                loc_overlay.append((pod, name))
+            placed = True
+            break
+        if not placed:
+            continue
+    return assigned

@@ -54,6 +54,22 @@ class KubernetesShim:
         obs = getattr(scheduler_api, "obs", None)
         if obs is not None:
             dispatcher.attach_metrics(obs)
+            if hasattr(api_provider, "attach_metrics"):
+                # reflector restarts + last-sync-age gauges (real provider)
+                api_provider.attach_metrics(obs)
+        # health sources beyond the core's own (scheduling loop + solver
+        # circuits): informer staleness and dispatcher backlog join the
+        # /ws/v1/health report when the core carries a monitor
+        health = getattr(scheduler_api, "health", None)
+        if health is not None:
+            from yunikorn_tpu.robustness.health import (
+                dispatcher_source,
+                informers_source,
+            )
+
+            health.register("dispatcher", dispatcher_source(dispatcher))
+            if hasattr(api_provider, "sync_ages"):
+                health.register("informers", informers_source(api_provider))
         dispatcher.register_event_handler(
             "AppHandler", EventType.APPLICATION, self.context.application_event_handler())
         dispatcher.register_event_handler(

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -579,6 +580,12 @@ class DeviceNodeState:
         # per dispatch — a clean cycle reads 0, the observability contract
         # "near-zero transfer when nothing changed" becomes measurable)
         self.upload_bytes = 0
+        # set by SnapshotEncoder.discard_device_mirror when a deadline-blown
+        # dispatch was abandoned while (possibly) still inside this object on
+        # its watchdog thread: the orphan's late buffer swaps land here,
+        # unreferenced, and any dirty delta it consumed is restored on exit
+        # so the replacement mirror never serves stale buffers as "clean"
+        self.dead = False
 
     def take_upload_bytes(self) -> int:
         b, self.upload_bytes = self.upload_bytes, 0
@@ -615,6 +622,8 @@ class DeviceNodeState:
     def refresh(self, mesh=None) -> dict:
         """Bring the device mirror up to date; returns the array dict."""
         na = self.nodes
+        if self.dead:
+            raise MirrorDiscarded("device mirror was discarded")
         full, fields = na.take_device_dirty()
         try:
             return self._refresh_taken(na, full, fields, mesh)
@@ -624,6 +633,12 @@ class DeviceNodeState:
             # buffers as "clean" — force a full re-upload on the next try
             na._full_dirty = True
             raise
+        finally:
+            # an orphaned mirror (discard_device_mirror ran while this call
+            # was wedged on its watchdog thread): give back the delta it
+            # consumed — the live replacement must see everything as dirty
+            if self.dead:
+                na._full_dirty = True
 
     def _refresh_taken(self, na, full, fields, mesh) -> dict:
         dims = (na.capacity, na._R, na._W, na._Wt, na._Wp)
@@ -678,9 +693,17 @@ class DeviceNodeState:
             self.last_victim_refresh = "full"
         else:
             self.last_victim_refresh = "clean"
+        if self.dead:  # orphaned mid-call: see refresh()
+            na._victim_dirty = True
         out = dict(base)
         out.update(self._victim_arrays)
         return out
+
+
+class MirrorDiscarded(RuntimeError):
+    """A device-mirror call outlived a discard_device_mirror (its dispatch
+    was deadline-abandoned and a replacement mirror is live): it must bail
+    without touching shared state, or it would race the scheduler thread."""
 
 
 class SnapshotEncoder:
@@ -708,25 +731,85 @@ class SnapshotEncoder:
         # app-id interning for the victim tables' app/gang column
         self._app_ids: Dict[str, int] = {}
         # device-resident node mirror, built lazily at the first solve (its
-        # construction initializes the JAX backend)
+        # construction initializes the JAX backend). _mirror_mu + the epoch
+        # make mirror entry atomic against discard_device_mirror: a
+        # deadline-abandoned dispatch that finally unwedges finds its
+        # captured epoch stale and bails (MirrorDiscarded) instead of
+        # racing the live thread on the replacement mirror.
         self.device: Optional[DeviceNodeState] = None
+        self._mirror_mu = threading.Lock()
+        self._mirror_epoch = 0
         # one-deep built-batch memo: (key, extra fingerprint, batch)
         self._batch_cache: Optional[tuple] = None
         self.last_encode_cached = False
 
-    def device_arrays(self, mesh=None) -> dict:
-        """Refresh and return the persistent device-resident node tensors."""
-        if self.device is None:
-            self.device = DeviceNodeState(self.nodes)
-        return self.device.refresh(mesh=mesh)
+    @property
+    def mirror_epoch(self) -> int:
+        """Capture BEFORE a supervised dispatch (on the scheduler thread)
+        and pass to device_arrays/victim_arrays: a call whose dispatch was
+        abandoned mid-wedge then finds the epoch advanced and bails."""
+        with self._mirror_mu:
+            return self._mirror_epoch
 
-    def victim_arrays(self, mesh=None) -> dict:
+    def _check_epoch_locked(self, epoch: Optional[int]) -> None:
+        if epoch is not None and epoch != self._mirror_epoch:
+            raise MirrorDiscarded(
+                f"mirror epoch {epoch} superseded by "
+                f"{self._mirror_epoch} (dispatch was abandoned)")
+
+    def ensure_mirror_epoch(self, epoch: Optional[int]) -> None:
+        """Raise MirrorDiscarded when the captured epoch is stale (a
+        discard happened since): checkpoints in longer dispatch code paths
+        stop an unwedged zombie thread before it touches shared state."""
+        with self._mirror_mu:
+            self._check_epoch_locked(epoch)
+
+    def _mirror_enter(self, epoch: Optional[int]) -> DeviceNodeState:
+        """Epoch check + get-or-create, atomic against discard: a stale
+        call can never install or grab the LIVE replacement mirror."""
+        with self._mirror_mu:
+            self._check_epoch_locked(epoch)
+            if self.device is None:
+                self.device = DeviceNodeState(self.nodes)
+            return self.device
+
+    def device_arrays(self, mesh=None, epoch: Optional[int] = None) -> dict:
+        """Refresh and return the persistent device-resident node tensors."""
+        return self._mirror_enter(epoch).refresh(mesh=mesh)
+
+    def victim_arrays(self, mesh=None, epoch: Optional[int] = None) -> dict:
         """Refresh and return the device node tensors INCLUDING the victim
         tables (the batched preemption planner's inputs). Call sync_victims
         first so the tables reflect the current cache."""
-        if self.device is None:
-            self.device = DeviceNodeState(self.nodes)
-        return self.device.refresh_victims(mesh=mesh)
+        return self._mirror_enter(epoch).refresh_victims(mesh=mesh)
+
+    def discard_device_mirror(self) -> None:
+        """Orphan the device mirror after a deadline-abandoned dispatch.
+
+        The supervisor's watchdog abandons (never kills) a wedged dispatch:
+        the daemon thread may STILL be inside DeviceNodeState.refresh(),
+        mutating buffers and dirty-field bookkeeping whenever it finally
+        unwedges. Reusing that object from the next cycle would race those
+        late writes (a torn dirty-field sync corrupts the mirror's capacity
+        tensors — wrong placements, silently). Instead the mirror is
+        replaced: the orphan is flagged dead so its exit path restores any
+        dirty delta it consumed, its late buffer swaps land on an
+        unreferenced object, and the successor starts cold (one full
+        upload — the price of a blown deadline, not of every cycle). The
+        epoch bump makes a zombie that never reached the mirror bail at
+        entry instead of touching the replacement.
+
+        Guarded seams only: the deadline protects DEVICE dispatches (the
+        wedge-prone boundary — transfers, collectives, remote compile);
+        host-side numpy sections have no real wedge mode and stay
+        unguarded."""
+        with self._mirror_mu:
+            dev, self.device = self.device, None
+            if dev is not None:
+                dev.dead = True
+            self._mirror_epoch += 1
+        self.nodes._full_dirty = True
+        self.nodes._victim_dirty = True
 
     def mark_victims_stale(self, node_name: str) -> None:
         """Core hook: allocation bookkeeping changed for this node (an

@@ -89,7 +89,18 @@ class RestServer:
                 # the full partition DAO — serializing 10k nodes under the
                 # core lock per scrape would stall scheduling cycles
                 if path in ("/ws/v1/health", "/health"):
-                    return self._reply(200, {"Healthy": True})
+                    # real liveness/readiness with per-component detail
+                    # (robustness/health.py): circuit/degradation state,
+                    # last-cycle failures, informer staleness, dispatcher
+                    # backlog. 503 on liveness failure so a plain HTTP
+                    # probe restarts a dead loop; a DEGRADED scheduler is
+                    # serving and stays 200 (detail says how).
+                    if hasattr(core, "health_report"):
+                        report = core.health_report()
+                    else:
+                        report = {"Healthy": True}
+                    return self._reply(
+                        200 if report.get("Healthy", True) else 503, report)
                 if path == "/metrics":
                     body = core.obs.expose().encode()
                     self.send_response(200)
