@@ -1,8 +1,8 @@
 # Developer entry points (reference Makefile analog).
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
-	chaos-smoke smoke lint run-scheduler run-admission dryrun clean image \
-	sched_image adm_image webtest_image
+	chaos-smoke gate-smoke smoke lint run-scheduler run-admission dryrun \
+	clean image sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -58,7 +58,16 @@ chaos-smoke:  ## fault-injection suite: every supervised device path (assign/pre
 		tests/test_pipeline.py::test_pipeline_solve_failure_does_not_wedge \
 		-q -p no:cacheprovider
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke  ## all tier-1 smoke targets
+gate-smoke:  ## array-form admission gate: differential suite (vector == legacy on randomized quota/limit/gang/pipelined traces) + microbench asserting the vectorized gate beats the legacy loop at >=20k asks on CPU + the churn-encode O(changed) contract
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_gate_vectorized.py \
+		"tests/test_incremental_encoder.py::test_pod_batch_partial_reencode_is_o_changed" \
+		-q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/gate_bench.py --sizes 2000,20000 \
+		--assert-speedup 20000 --churn-check
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -1,0 +1,499 @@
+"""Differential suite for the array-form admission gate (core/gate.py).
+
+vector_admit must be indistinguishable from legacy_admit — identical admitted
+set, identical global order, identical held count — across randomized queue
+trees with nested quotas, user/group limits, priority offsets/fences,
+pre-loaded accounting, gang asks, and the pipelined gate's seed_admissions /
+exclude-keys traces. The randomized cases are seeded (deterministic); the
+end-to-end cases run the full CoreScheduler in verify mode (the vectorized
+gate runs, the legacy loop re-runs as the oracle, gate_mismatch_total pins
+zero) over sequential AND pipelined cycles.
+"""
+import random
+
+import pytest
+
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.common.si import AllocationAsk, UserGroupInfo
+from yunikorn_tpu.core import gate as gate_mod
+from yunikorn_tpu.core.gate import GateFallback, legacy_admit, vector_admit
+from yunikorn_tpu.core.queues import LimitConfig, QueueConfig, QueueTree
+
+USERS = [
+    ("alice", ["dev"]),
+    ("bob", ["dev", "ops"]),
+    ("carol", []),
+    # duplicated group: the legacy loop double-charges the shared group
+    # accumulator for this user's admissions — the vector gate's weighted
+    # membership must reproduce that exactly
+    ("dave", ["ops", "ops"]),
+]
+
+CAP = Resource({"cpu": 1000, "memory": 1000, "gpu": 64})
+
+
+class FakeApp:
+    """The three attributes the gate reads off an application."""
+
+    def __init__(self, user, groups, submit_time, queue_name):
+        self.user = UserGroupInfo(user=user, groups=list(groups))
+        self.submit_time = submit_time
+        self.queue_name = queue_name
+
+
+def _rand_res(rng, lo, hi, gpu_p=0.3):
+    out = {}
+    for name, p in (("cpu", 0.9), ("memory", 0.8), ("gpu", gpu_p)):
+        if rng.random() < p:
+            out[name] = rng.randint(lo, hi)
+    return Resource(out)
+
+
+def random_tree(rng) -> QueueTree:
+    """Random 1-3 level hierarchy: quotas on ~half the nodes (parents too,
+    so sibling leaves share a constrained ancestor), limits on ~a third,
+    priority offsets and fences sprinkled in."""
+
+    def mk(name, depth):
+        cfg = QueueConfig(name=name)
+        if rng.random() < 0.55:
+            cfg.max_resource = _rand_res(rng, 8, 60)
+        if rng.random() < 0.35:
+            cfg.limits = [
+                LimitConfig(
+                    users=rng.choice([["*"], ["alice"], ["alice", "bob"],
+                                      ["dave"], []]),
+                    groups=rng.choice([[], ["dev"], ["*"], ["dev", "ops"],
+                                       ["ops"]]),
+                    max_resources=_rand_res(rng, 4, 40),
+                )
+                for _ in range(rng.randint(1, 2))
+            ]
+        if rng.random() < 0.4:
+            cfg.properties["priority.offset"] = str(rng.randint(-3, 3))
+            if rng.random() < 0.3:
+                cfg.properties["priority.policy"] = "fence"
+        if depth < 2 and rng.random() < 0.5:
+            cfg.parent = True
+            for i in range(rng.randint(1, 3)):
+                cfg.children.append(mk(f"{name}c{i}", depth + 1))
+        return cfg
+
+    root = QueueConfig(name="root", parent=True)
+    for i in range(rng.randint(1, 4)):
+        root.children.append(mk(f"q{i}", 1))
+    return QueueTree(root)
+
+
+def preload_accounting(rng, tree):
+    """Pre-existing allocations: committed usage the budgets subtract."""
+    for leaf in tree.leaves():
+        if rng.random() < 0.6:
+            r = _rand_res(rng, 0, 20)
+            leaf.add_allocated(r)
+            user, groups = rng.choice(USERS)
+            leaf.add_user_allocated(user, r, groups)
+
+
+def random_trace(rng, tree, n_asks=None):
+    leaves = [q.full_name for q in tree.leaves()]
+    by_queue = {}
+    apps = {}
+    for i in range(n_asks if n_asks is not None else rng.randint(1, 120)):
+        qname = rng.choice(leaves)
+        user, groups = rng.choice(USERS)
+        app = apps.get((qname, user))
+        if app is None:
+            app = apps[(qname, user)] = FakeApp(
+                user, groups, round(rng.random() * 100, 3), qname)
+        gang = rng.random() < 0.15
+        ask = AllocationAsk(
+            f"ask-{i}", f"app-{qname}-{user}",
+            _rand_res(rng, 0, 12),
+            priority=rng.choice([0, 0, 0, 1, 5, -2]),
+            placeholder=gang and rng.random() < 0.5,
+            task_group_name="tg" if gang else "",
+            seq=i)
+        by_queue.setdefault(qname, []).append((app, ask))
+    return by_queue
+
+
+def meta_for(tree, by_queue, cap=CAP):
+    meta = {}
+    for qname in by_queue:
+        leaf = tree.resolve(qname, create=False)
+        meta[qname] = (leaf,
+                       leaf.dominant_share(cap) if leaf else 0.0,
+                       leaf.priority_adjustment() if leaf else 0)
+    return meta
+
+
+def random_seeds(rng, tree):
+    leaves = [q.full_name for q in tree.leaves()]
+    seeds = []
+    for _ in range(rng.randint(0, 8)):
+        user, groups = rng.choice(USERS)
+        seeds.append((rng.choice(leaves), _rand_res(rng, 0, 10),
+                      user, tuple(groups)))
+    return seeds
+
+
+def both_paths(tree, by_queue, seeds=None):
+    """Run vector then legacy on copies of the same trace; neither path may
+    mutate tree state (asserted implicitly by running them back to back)."""
+    v_adm, v_held, stats = vector_admit(
+        {q: list(v) for q, v in by_queue.items()},
+        meta_for(tree, by_queue), tree, seeds)
+    l_adm, l_held = legacy_admit(
+        {q: list(v) for q, v in by_queue.items()},
+        meta_for(tree, by_queue), tree, seeds)
+    return (v_adm, v_held, stats), (l_adm, l_held)
+
+
+def assert_equivalent(tree, by_queue, seeds=None):
+    (v_adm, v_held, _), (l_adm, l_held) = both_paths(tree, by_queue, seeds)
+    assert [a.allocation_key for a in v_adm] == \
+        [a.allocation_key for a in l_adm]
+    assert v_held == l_held
+
+
+# --------------------------------------------------------------- randomized
+def test_randomized_trees_differential():
+    """60 seeded random (tree, accounting, trace) scenarios — quota chains,
+    nested limits, fences, gang asks — vector == legacy exactly."""
+    for seed in range(60):
+        rng = random.Random(seed)
+        tree = random_tree(rng)
+        preload_accounting(rng, tree)
+        by_queue = random_trace(rng, tree)
+        assert_equivalent(tree, by_queue)
+
+
+def test_randomized_with_seed_admissions():
+    """The pipelined gate's in-flight charge (seed_admissions) reproduced:
+    vector budget charging == legacy cycle_extra pre-population."""
+    for seed in range(40):
+        rng = random.Random(1000 + seed)
+        tree = random_tree(rng)
+        preload_accounting(rng, tree)
+        by_queue = random_trace(rng, tree)
+        assert_equivalent(tree, by_queue, seeds=random_seeds(rng, tree))
+
+
+def test_randomized_order_is_total():
+    """The admitted order must be the legacy order even with heavy priority
+    ties (many asks per queue, few distinct priorities/submit times)."""
+    for seed in range(20):
+        rng = random.Random(2000 + seed)
+        tree = random_tree(rng)
+        leaves = [q.full_name for q in tree.leaves()]
+        apps = {q: FakeApp("alice", ["dev"], 1.0, q) for q in leaves}
+        by_queue = {}
+        for i in range(150):
+            q = rng.choice(leaves)
+            ask = AllocationAsk(f"t-{i}", "app", Resource({"cpu": 1}),
+                                priority=rng.choice([0, 1]), seq=i)
+            by_queue.setdefault(q, []).append((apps[q], ask))
+        assert_equivalent(tree, by_queue)
+
+
+# ------------------------------------------------------------- edge shapes
+def _flat_tree(max_resource=None, limits=(), props=None):
+    leaf = QueueConfig(name="q", max_resource=max_resource,
+                       limits=list(limits), properties=dict(props or {}))
+    root = QueueConfig(name="root", parent=True, children=[leaf])
+    return QueueTree(root)
+
+
+def test_no_constraints_pure_ranking():
+    tree = _flat_tree()
+    app = FakeApp("alice", ["dev"], 5.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk(f"a{i}", "app", Resource({"cpu": 1}),
+                            priority=i % 3, seq=i))
+        for i in range(10)]}
+    (v_adm, v_held, stats), (l_adm, l_held) = both_paths(tree, by_queue)
+    assert [a.allocation_key for a in v_adm] == \
+        [a.allocation_key for a in l_adm]
+    assert (v_held, l_held) == (0, 0)
+    assert stats["trackers"] == 0          # never built a budget matrix
+
+
+def test_queue_already_over_quota_holds_everything():
+    """allocated > max before the cycle: every ask held, even asks that do
+    not request the violating resource (within_limit checks the TOTAL)."""
+    tree = _flat_tree(max_resource=Resource({"cpu": 10}))
+    leaf = tree.resolve("root.q", create=False)
+    leaf.add_allocated(Resource({"cpu": 12}))
+    app = FakeApp("alice", [], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk("a0", "app", Resource({"memory": 5}), seq=0)),
+        (app, AllocationAsk("a1", "app", Resource({"cpu": 1}), seq=1)),
+    ]}
+    (v_adm, v_held, _), (l_adm, l_held) = both_paths(tree, by_queue)
+    assert v_adm == [] and l_adm == []
+    assert v_held == l_held == 2
+
+
+def test_partial_fit_boundary():
+    """Exactly-at-quota admissions: the boundary ask admits, the next holds,
+    and a smaller later ask can still slot in (the legacy loop's behavior)."""
+    tree = _flat_tree(max_resource=Resource({"cpu": 10}))
+    app = FakeApp("alice", [], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk("a0", "app", Resource({"cpu": 6}), seq=0)),
+        (app, AllocationAsk("a1", "app", Resource({"cpu": 5}), seq=1)),  # held
+        (app, AllocationAsk("a2", "app", Resource({"cpu": 4}), seq=2)),
+        (app, AllocationAsk("a3", "app", Resource({"cpu": 1}), seq=3)),  # held
+    ]}
+    (v_adm, v_held, _), (l_adm, l_held) = both_paths(tree, by_queue)
+    assert [a.allocation_key for a in v_adm] == ["a0", "a2"] == \
+        [a.allocation_key for a in l_adm]
+    assert v_held == l_held == 2
+
+
+def test_group_limit_shared_across_users():
+    """A group limit caps the group's AGGREGATE in-cycle usage across
+    different users (and sibling leaves under a limited parent)."""
+    lim = LimitConfig(groups=["dev"], max_resources=Resource({"cpu": 8}))
+    child_a = QueueConfig(name="a")
+    child_b = QueueConfig(name="b")
+    parent = QueueConfig(name="p", parent=True, limits=[lim],
+                         children=[child_a, child_b])
+    tree = QueueTree(QueueConfig(name="root", parent=True, children=[parent]))
+    alice = FakeApp("alice", ["dev"], 1.0, "root.p.a")
+    bob = FakeApp("bob", ["dev"], 2.0, "root.p.b")
+    by_queue = {
+        "root.p.a": [(alice, AllocationAsk("a0", "app",
+                                           Resource({"cpu": 5}), seq=0))],
+        "root.p.b": [(bob, AllocationAsk("b0", "app",
+                                         Resource({"cpu": 5}), seq=1))],
+    }
+    (v_adm, v_held, _), (l_adm, l_held) = both_paths(tree, by_queue)
+    assert [a.allocation_key for a in v_adm] == \
+        [a.allocation_key for a in l_adm]
+    assert v_held == l_held == 1           # second leaf blows the shared cap
+
+
+def test_duplicate_group_double_charges():
+    """dave's ["ops", "ops"] double-charges the ops aggregate per admission
+    (legacy record_cycle_admission folds once per list entry); the check
+    itself uses the request once. The weighted vector scan must agree."""
+    lim = LimitConfig(groups=["ops"], max_resources=Resource({"cpu": 10}))
+    tree = _flat_tree(limits=[lim])
+    dave = FakeApp("dave", ["ops", "ops"], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (dave, AllocationAsk(f"d{i}", "app", Resource({"cpu": 3}), seq=i))
+        for i in range(4)]}
+    (v_adm, v_held, _), (l_adm, l_held) = both_paths(tree, by_queue)
+    assert [a.allocation_key for a in v_adm] == \
+        [a.allocation_key for a in l_adm]
+    # 3 charged as 6: d0 passes (check 0+3<=10), d1 passes (6+3<=10),
+    # d2 holds (12+3>10), d3 holds
+    assert v_held == l_held == 2
+
+
+def test_priority_fence_ordering():
+    props = {"priority.offset": "5", "priority.policy": "fence"}
+    tree = _flat_tree(props=props)
+    app = FakeApp("alice", [], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk("lo", "app", Resource({"cpu": 1}),
+                            priority=0, seq=0)),
+        (app, AllocationAsk("hi", "app", Resource({"cpu": 1}),
+                            priority=3, seq=1)),
+    ]}
+    (v_adm, _, _), (l_adm, _) = both_paths(tree, by_queue)
+    assert [a.allocation_key for a in v_adm] == ["hi", "lo"] == \
+        [a.allocation_key for a in l_adm]
+
+
+def test_oversized_quantity_raises_gatefallback():
+    tree = _flat_tree(max_resource=Resource({"cpu": 10}))
+    app = FakeApp("alice", [], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk("big", "app",
+                            Resource({"cpu": 1 << 50}), seq=0))]}
+    with pytest.raises(GateFallback):
+        vector_admit(by_queue, meta_for(tree, by_queue), tree)
+    # the legacy loop (the production fallback) still decides it
+    l_adm, l_held = legacy_admit(by_queue, meta_for(tree, by_queue), tree)
+    assert l_adm == [] and l_held == 1
+
+
+def test_batch_ceiling_raises_gatefallback(monkeypatch):
+    monkeypatch.setattr(gate_mod, "_MAX_ASKS", 4)
+    tree = _flat_tree(max_resource=Resource({"cpu": 100}))
+    app = FakeApp("alice", [], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk(f"a{i}", "app", Resource({"cpu": 1}), seq=i))
+        for i in range(5)]}
+    with pytest.raises(GateFallback):
+        vector_admit(by_queue, meta_for(tree, by_queue), tree)
+
+
+def test_weighted_charge_ceiling_raises_gatefallback(monkeypatch):
+    """Duplicated-group charge weights multiply the cumulative-sum bound:
+    w_max * n must fit the same ceiling as n, else the exact int64 scan
+    could trip an unconstrained column or wrap — fall back, never wrap."""
+    monkeypatch.setattr(gate_mod, "_MAX_ASKS", 4)
+    lim = LimitConfig(groups=["ops"], max_resources=Resource({"cpu": 100}))
+    tree = _flat_tree(limits=[lim])
+    dave = FakeApp("dave", ["ops", "ops"], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (dave, AllocationAsk(f"d{i}", "app", Resource({"cpu": 1}), seq=i))
+        for i in range(3)]}              # n=3 fits the batch cap; 2x3 doesn't
+    with pytest.raises(GateFallback):
+        vector_admit(by_queue, meta_for(tree, by_queue), tree)
+    # under the real ceiling the weighted trace still matches legacy
+    monkeypatch.setattr(gate_mod, "_MAX_ASKS", 1 << 18)
+    assert_equivalent(tree, by_queue)
+
+
+def test_pass_cap_falls_through_to_exact_finish(monkeypatch):
+    """With the vectorized pass budget forced to 1, the per-ask exact finish
+    must complete the cycle with the identical result."""
+    monkeypatch.setattr(gate_mod, "_MAX_PASSES", 1)
+    for seed in range(10):
+        rng = random.Random(3000 + seed)
+        tree = random_tree(rng)
+        preload_accounting(rng, tree)
+        by_queue = random_trace(rng, tree)
+        assert_equivalent(tree, by_queue)
+
+
+# ------------------------------------------------------------- end to end
+def _e2e_core(queues_yaml, gate_verify=True, **core_kwargs):
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes
+    from yunikorn_tpu.common.si import (
+        NodeAction, NodeInfo, NodeRequest, RegisterResourceManagerRequest)
+    from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+
+    class NullCallback:
+        def __getattr__(self, name):
+            return lambda *a, **k: None
+
+    cache = SchedulerCache()
+    core = CoreScheduler(
+        cache,
+        solver_options=SolverOptions(gate_verify=gate_verify, **core_kwargs))
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="gate-e2e", policy_group="queues",
+                                       config=queues_yaml),
+        NullCallback())
+    nodes = make_kwok_nodes(16)
+    for n in nodes:
+        cache.update_node(n)
+    core.update_node(NodeRequest(nodes=[
+        NodeInfo(node_id=n.name, action=NodeAction.CREATE) for n in nodes]))
+    return cache, core
+
+
+E2E_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: qa
+            resources:
+              max: {vcore: 8, memory: 16Gi}
+            limits:
+              - users: ["ua"]
+                maxresources: {vcore: 4}
+          - name: qb
+            properties:
+              priority.offset: "2"
+"""
+
+
+def _submit(core, app_id, queue, user, pods):
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest, AllocationRequest, ApplicationRequest,
+        UserGroupInfo)
+
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id=app_id, queue_name=queue,
+        user=UserGroupInfo(user=user, groups=["g"]))]))
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, app_id, get_pod_resource(p), pod=p)
+        for p in pods]))
+
+
+def test_e2e_verify_mode_sequential():
+    """Full scheduler, verify mode on: the vectorized gate runs every cycle,
+    the legacy oracle re-runs after it, and the mismatch counter pins 0
+    across quota-held, limit-held and plain cycles."""
+    from yunikorn_tpu.common.objects import make_pod
+
+    cache, core = _e2e_core(E2E_YAML)
+    _submit(core, "appa", "root.qa", "ua",
+            [make_pod(f"pa-{i}", cpu_milli=1000, memory="512Mi")
+             for i in range(12)])
+    _submit(core, "appb", "root.qb", "ub",
+            [make_pod(f"pb-{i}", cpu_milli=500, memory="256Mi")
+             for i in range(8)])
+    for _ in range(3):
+        core.schedule_once()
+    assert core.obs.get("gate_mismatch_total").value() == 0
+    assert core.obs.get("gate_path_total").value(path="vector") >= 3
+    # the qa quota (4 vcore user limit under an 8 vcore max) held some asks
+    assert core.obs.get("unschedulable_total").value(reason="quota_held") > 0
+
+
+def test_e2e_verify_mode_pipelined():
+    """Pipelined ticks: the overlap gate runs with exclude_keys +
+    seed_admissions; the oracle re-runs with the same overlays — no drift."""
+    from yunikorn_tpu.common.objects import make_pod
+
+    cache, core = _e2e_core(E2E_YAML)
+    for w in range(3):
+        _submit(core, f"appw{w}", "root.qa", "ua",
+                [make_pod(f"pw{w}-{i}", cpu_milli=700, memory="128Mi")
+                 for i in range(5)])
+        core._pipeline_tick()
+    for _ in range(4):
+        core._pipeline_tick()
+    assert core._pipeline_inflight is None
+    assert core.obs.get("gate_mismatch_total").value() == 0
+    assert core.obs.get("gate_path_total").value(path="vector") >= 3
+
+
+def test_e2e_gate_disabled_runs_legacy():
+    from yunikorn_tpu.common.objects import make_pod
+
+    cache, core = _e2e_core(E2E_YAML, gate_verify=False, gate_vector=False)
+    _submit(core, "appa", "root.qa", "ua",
+            [make_pod("pl-0", cpu_milli=500, memory="128Mi")])
+    core.schedule_once()
+    assert core.obs.get("gate_path_total").value(path="legacy") >= 1
+    assert core.obs.get("gate_path_total").value(path="vector") == 0
+
+
+def test_e2e_gang_trace_verify():
+    """Gang apps (placeholders + real asks) through verify-mode cycles."""
+    from yunikorn_tpu.common.objects import make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest, AllocationRequest, ApplicationRequest,
+        TaskGroup, UserGroupInfo)
+
+    cache, core = _e2e_core(E2E_YAML)
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="gang", queue_name="root.qa",
+        user=UserGroupInfo(user="ua"),
+        task_groups=[TaskGroup(name="tg", min_member=3,
+                               min_resource={"cpu": "500m"})])]))
+    phs = [make_pod(f"ph-{i}", cpu_milli=500) for i in range(3)]
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, "gang", get_pod_resource(p), placeholder=True,
+                      task_group_name="tg", pod=p) for p in phs]))
+    core.schedule_once()
+    real = [make_pod(f"rm-{i}", cpu_milli=500) for i in range(3)]
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, "gang", get_pod_resource(p),
+                      task_group_name="tg", pod=p) for p in real]))
+    core.schedule_once()
+    assert core.obs.get("gate_mismatch_total").value() == 0

@@ -167,3 +167,103 @@ def test_device_mirror_refresh_modes():
     enc.device_arrays()
     assert enc.device.last_refresh == "full"
     _assert_device_mirror(enc, 0, 1)
+
+
+def test_pod_batch_partial_reencode_is_o_changed():
+    """Round-10 contract: a churn cycle re-derives signatures/quantization
+    only for new or changed asks (the per-ask encoded-row cache serves the
+    rest), and the partially-cached batch is bit-identical to a cold encode
+    of the same ask list."""
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.update_node(make_node(f"pb-n{i}", cpu_milli=64000,
+                                    memory=128 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [make_pod(f"pb-p{i}", cpu_milli=100 + (i % 3) * 50)
+            for i in range(1000)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p, seq=i)
+            for i, p in enumerate(pods)]
+
+    enc.build_batch(asks)
+    assert enc.last_encode_rows == 1000
+    assert enc.last_encode_rows_reencoded == 1000    # cold: everything fresh
+
+    enc.build_batch(asks)
+    assert enc.last_encode_rows_reencoded == 0       # unchanged: all cached
+
+    # 1% churn: 10 re-submitted asks (same key, fresh seq + new resource —
+    # the core's resubmission identity) plus 5 brand-new asks
+    churned = list(asks)
+    for i in range(10):
+        p = make_pod(f"pb-p{i}", cpu_milli=900)
+        churned[i] = AllocationAsk(asks[i].allocation_key, "app",
+                                   get_pod_resource(p), pod=p, seq=2000 + i)
+    new_pods = [make_pod(f"pb-new{i}", cpu_milli=250) for i in range(5)]
+    churned.extend(AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p,
+                                 seq=3000 + i)
+                   for i, p in enumerate(new_pods))
+
+    live = enc.build_batch(churned)
+    assert enc.last_encode_rows == 1005
+    assert enc.last_encode_rows_reencoded == 15      # O(changed), not O(pods)
+
+    cold_enc = SnapshotEncoder(cache, vocabs=enc.vocabs)
+    cold_enc.sync_nodes(full=True)
+    cold = cold_enc.build_batch(churned)
+    assert (live.req == cold.req).all()
+    assert (live.group_id == cold.group_id).all()
+    assert (live.valid == cold.valid).all()
+    assert live.ask_keys == cold.ask_keys
+    assert (live.g_tol == cold.g_tol).all()
+    assert (live.g_term_req == cold.g_term_req).all()
+
+
+def test_pod_batch_cache_floors_eviction_at_batch_size():
+    """A batch larger than the LRU cap (possible on the legacy gate path,
+    which has no batch ceiling) must not thrash: eviction is floored at the
+    live batch size, so an unchanged repeat cycle still re-derives zero."""
+    cache = SchedulerCache()
+    cache.update_node(make_node("fl-n0", cpu_milli=64000, memory=128 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    enc._ask_row_cache_max = 8                       # force an over-cap batch
+    pods = [make_pod(f"fl-p{i}", cpu_milli=100) for i in range(30)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p, seq=i)
+            for i, p in enumerate(pods)]
+    enc.build_batch(asks)
+    assert enc.last_encode_rows_reencoded == 30
+    enc.build_batch(asks)
+    assert enc.last_encode_rows_reencoded == 0       # no steady-state thrash
+    # stale entries (departed asks) still evict back down to the live set
+    enc.build_batch(asks[:8])
+    assert len(enc._ask_row_cache) == 8
+
+
+def test_pod_batch_cache_invalidates_on_anti_term_churn():
+    """Anti-affinity term-set churn regenerates the memoized term list; the
+    per-ask cache must miss (identity key) and re-derive signatures, keeping
+    locality-dependent groups exact."""
+    from yunikorn_tpu.common.objects import PodAffinityTerm
+
+    cache = SchedulerCache()
+    cache.update_node(make_node("at-n0", cpu_milli=8000, memory=16 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [make_pod(f"at-p{i}", cpu_milli=100, labels={"app": "web"})
+            for i in range(20)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p, seq=i)
+            for i, p in enumerate(pods)]
+    enc.build_batch(asks)
+    enc.build_batch(asks)
+    assert enc.last_encode_rows_reencoded == 0
+    # a cached pod carrying a new anti-affinity term bumps anti_version
+    from yunikorn_tpu.common.objects import Affinity
+
+    anti = make_pod("at-anti", cpu_milli=100)
+    anti.spec.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(topology_key="kubernetes.io/hostname",
+                        label_selector={"matchLabels": {"app": "web"}})])
+    cache.update_pod(anti)
+    enc.build_batch(asks)
+    assert enc.last_encode_rows_reencoded == len(asks)   # full re-derive

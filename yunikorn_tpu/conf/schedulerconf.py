@@ -59,6 +59,8 @@ CM_SOLVER_SHARD = PREFIX_SOLVER + "shardSolve"         # auto | true | false
 CM_SOLVER_FALLBACK_ROUNDS = PREFIX_SOLVER + "localityFallbackRounds"
 CM_SOLVER_PIPELINE = PREFIX_SOLVER + "pipeline"         # auto | true | false
 CM_SOLVER_PREEMPT_DEVICE = PREFIX_SOLVER + "preemptDevice"  # auto | true | false
+CM_SOLVER_GATE = PREFIX_SOLVER + "gateVectorized"       # auto | true | false
+CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
 
 # observability.* keys (the obs/ registry + tracer)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
@@ -131,6 +133,14 @@ class SchedulerConf:
     # victim-selection solve per pressure cycle, host planner as oracle/
     # fallback
     solver_preempt_device: str = "auto"
+    # array-form admission gate ("auto" = on): quota + user/group-limit
+    # admission as grouped prefix-scan arithmetic (core/gate.py), legacy
+    # per-ask loop as fallback
+    solver_gate: str = "auto"
+    # differential gate oracle: run the legacy loop after every vectorized
+    # gate and pin the results identical (doubles gate host cost; the
+    # gate-equivalence test tier runs with this on)
+    solver_gate_verify: str = "false"
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -277,7 +287,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
                       (CM_SOLVER_SHARD, "solver_shard"),
                       (CM_SOLVER_PIPELINE, "solver_pipeline"),
-                      (CM_SOLVER_PREEMPT_DEVICE, "solver_preempt_device")):
+                      (CM_SOLVER_PREEMPT_DEVICE, "solver_preempt_device"),
+                      (CM_SOLVER_GATE, "solver_gate")):
         if key in data:
             v = data[key].strip().lower()
             if v in ("auto", "true", "false"):
@@ -285,6 +296,14 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             else:
                 logger.warning("invalid tri-state value %r for %s, keeping %s",
                                data[key], key, getattr(conf, attr))
+    if CM_SOLVER_GATE_VERIFY in data:
+        v = data[CM_SOLVER_GATE_VERIFY].strip().lower()
+        if v in ("true", "false"):
+            conf.solver_gate_verify = v
+        else:
+            logger.warning("invalid boolean value %r for %s, keeping %s",
+                           data[CM_SOLVER_GATE_VERIFY], CM_SOLVER_GATE_VERIFY,
+                           conf.solver_gate_verify)
     return conf
 
 

@@ -149,6 +149,12 @@ class Queue:
         self.group_allocated: Dict[str, Resource] = {}
         self.group_app_counts: Dict[str, int] = {}
         self.config = config or QueueConfig(name=name)
+        # accounting/shape epoch; only the ROOT's counter is authoritative
+        # (QueueTree.version) — bumped by allocation accounting, config
+        # reload and dynamic queue creation so per-cycle caches of derived
+        # queue state (dominant share, priority adjustment, leaf resolution)
+        # can invalidate without re-walking the tree
+        self.version = 0
 
     # ------------------------------------------------------------------ shape
     @property
@@ -170,12 +176,16 @@ class Queue:
 
     # ------------------------------------------------------------- accounting
     def add_allocated(self, r: Resource) -> None:
+        q = self
         for q in self.ancestors_and_self():
             q.allocated = q.allocated.add(r)
+        q.version += 1  # q is the root after the walk
 
     def remove_allocated(self, r: Resource) -> None:
+        q = self
         for q in self.ancestors_and_self():
             q.allocated = q.allocated.sub(r)
+        q.version += 1
 
     def headroom(self, total_cluster: Optional[Resource] = None) -> Optional[Resource]:
         """Tightest remaining quota across self and ancestors (None = unlimited)."""
@@ -379,6 +389,7 @@ class QueueTree:
             if config is None:
                 return
             self._reload_into(self.root, config)
+            self.root.version += 1
 
     def _reload_into(self, q: Queue, cfg: QueueConfig) -> None:
         q.config = cfg
@@ -425,11 +436,19 @@ class QueueTree:
                     if i < len(parts) - 1:
                         child.config.parent = True  # dynamic intermediate
                     q.children[part] = child
+                    self.root.version += 1
                 q = child
             if not q.is_leaf:
                 # app submitted to a parent queue: reject (reference behavior)
                 return None
             return q
+
+    @property
+    def version(self) -> int:
+        """Accounting/shape epoch of the whole tree (the root's counter):
+        bumped by allocation accounting, config reload and dynamic queue
+        creation. Per-cycle caches of derived queue state key on it."""
+        return self.root.version
 
     def any_limits(self) -> bool:
         """Does ANY queue in the tree configure limits (incl. parents)?"""

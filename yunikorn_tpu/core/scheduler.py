@@ -68,6 +68,7 @@ from yunikorn_tpu.core.partition import (
     CoreNode,
     Partition,
 )
+from yunikorn_tpu.core.gate import GateFallback, legacy_admit, vector_admit
 from yunikorn_tpu.core.queues import QueueTree, parse_queues_yaml
 from yunikorn_tpu.log.logger import log
 from yunikorn_tpu.obs.metrics import (
@@ -136,6 +137,17 @@ class SolverOptions:
     # and the fallback for constraints the device can't model. Tri-state:
     # None = "auto" = on.
     preempt_device: Optional[bool] = None
+    # array-form admission gate (solver.gateVectorized): quota + user/group
+    # -limit admission as grouped prefix-scan arithmetic over one lexsorted
+    # rank (core/gate.py), with the legacy per-ask loop as the fallback for
+    # cycles the exact int64 arithmetic cannot represent. Tri-state: None =
+    # "auto" = on.
+    gate_vector: Optional[bool] = None
+    # differential oracle (solver.gateVerify): run the legacy loop after
+    # every vectorized gate and pin the results identical — a mismatch
+    # counts gate_mismatch_total and the legacy result wins. Doubles the
+    # gate's host cost; test/debug knob.
+    gate_verify: bool = False
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -157,6 +169,9 @@ class SolverOptions:
             pipeline=tri.get(getattr(conf, "solver_pipeline", "auto"), None),
             preempt_device=tri.get(
                 getattr(conf, "solver_preempt_device", "auto"), None),
+            gate_vector=tri.get(getattr(conf, "solver_gate", "auto"), None),
+            gate_verify=str(getattr(conf, "solver_gate_verify",
+                                    "false")).lower() == "true",
         )
 
 
@@ -171,6 +186,12 @@ class _PipelineCycle:
     extra_fp: tuple            # in-flight placements baked into the encode
     encode_cached: bool
     overlapped: bool           # encode ran while a solve was in flight
+    # gate/encode stats captured at prepare time (the finish stage that
+    # publishes the cycle entry runs AFTER the next cycle's prepare, whose
+    # gate/encode would otherwise have overwritten the live counters)
+    gate_stats: dict = dataclasses.field(default_factory=dict)
+    encode_rows: int = 0
+    encode_reencoded: int = 0
     t_prepare_start: float = 0.0
     t_gate: float = 0.0
     t_encode_end: float = 0.0
@@ -352,6 +373,33 @@ class CoreScheduler(SchedulerAPI):
             "unschedulable_total",
             "unplaced-ask attempts by reason (one count per cycle the ask "
             "stays unplaced)", labelnames=("reason",))
+        # ---- array-form admission gate (round 10) ----
+        self._m_gate_path = m.counter(
+            "gate_path_total",
+            "admission-gate executions by path (vector = array-form "
+            "prefix-scan admission, legacy = per-ask loop, fallback = "
+            "vector raised GateFallback and the legacy loop ran)",
+            labelnames=("path",))
+        self._m_gate_mismatch = m.counter(
+            "gate_mismatch_total",
+            "verify-mode cycles where the vectorized gate diverged from the "
+            "legacy loop (the legacy result wins; any nonzero count is a bug)")
+        self._m_gate_stage = m.histogram(
+            "gate_stage_ms",
+            "admission-gate sub-stage latency (rank = lexsort ranking, "
+            "admit = prefix-scan / per-ask-loop admission)",
+            labelnames=("stage",), buckets=MS_BUCKETS)
+        # stats of the most recent gate pass (path, passes, sub-stage ms);
+        # ride the cycle entry and the gate tracer span
+        self._last_gate_stats: dict = {}
+        # per-cycle queue-meta cache: (key, {qname: (leaf, share, adj)}) —
+        # leaf resolution, DRF dominant share and priority adjustment are
+        # pure functions of the tree's accounting epoch + cluster capacity
+        self._gate_meta_cache: Optional[tuple] = None
+        # in-flight quantized-row cache for _inflight_overlay: allocation
+        # key -> quantized request row (quantize once per allocation, not
+        # once per allocation per cycle)
+        self._inflight_row_cache: Dict[str, object] = {}
         self._m_transfer_bytes = m.counter(
             "device_transfer_bytes_total",
             "host->device bytes: persistent node-mirror uploads + sharded "
@@ -1649,7 +1697,10 @@ class CoreScheduler(SchedulerAPI):
                 "total_ms": round((end - t0) * 1000, 2),
                 "pipelined": 0,
                 "encode_cached": int(self.encoder.last_encode_cached),
+                "encode_rows": self.encoder.last_encode_rows,
+                "encode_reencoded": self.encoder.last_encode_rows_reencoded,
             }
+            entry.update(_gate_extras(self._last_gate_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
@@ -1657,9 +1708,10 @@ class CoreScheduler(SchedulerAPI):
             tr = self.tracer
             pname = self.partition.name
             tr.add("gate", cid, t0, t_gate, pods=len(admitted),
-                   partition=pname)
+                   partition=pname, **_gate_extras(self._last_gate_stats))
             tr.add("encode", cid, t_gate, t_encode,
-                   cached=int(self.encoder.last_encode_cached))
+                   cached=int(self.encoder.last_encode_cached),
+                   reencoded=self.encoder.last_encode_rows_reencoded)
             tr.add("solve", cid, t_encode, t_solve, **self._last_solve_stats)
             tr.add("commit", cid, t_solve, t_commit, allocs=len(new_allocs))
         return len(new_allocs), (pinned, replaced, new_allocs,
@@ -1767,12 +1819,16 @@ class CoreScheduler(SchedulerAPI):
                 extra_fp=self.encoder.placed_fingerprint(inflight_placed),
                 encode_cached=self.encoder.last_encode_cached,
                 overlapped=self._pipeline_inflight is not None,
+                gate_stats=dict(self._last_gate_stats),
+                encode_rows=self.encoder.last_encode_rows,
+                encode_reencoded=self.encoder.last_encode_rows_reencoded,
                 t_prepare_start=t0, t_gate=t_gate, t_encode_end=time.time())
             self.tracer.add("gate", cyc.cycle_id, t0, t_gate,
-                            pods=len(admitted))
+                            pods=len(admitted), **_gate_extras(cyc.gate_stats))
             self.tracer.add("encode", cyc.cycle_id, t_gate, cyc.t_encode_end,
                             cached=int(cyc.encode_cached),
-                            overlapped=int(cyc.overlapped))
+                            overlapped=int(cyc.overlapped),
+                            reencoded=cyc.encode_reencoded)
             return cyc
 
     def _pipeline_housekeeping(self) -> Optional[tuple]:
@@ -1920,9 +1976,12 @@ class CoreScheduler(SchedulerAPI):
                 "total_ms": round((end - cyc.t_prepare_start) * 1000, 2),
                 "pipelined": 1,
                 "encode_cached": int(cyc.encode_cached),
+                "encode_rows": cyc.encode_rows,
+                "encode_reencoded": cyc.encode_reencoded,
                 "overlap_ms": round(overlap_ms, 2),
                 "overlap_ratio": round(overlap_ms / max(solve_ms, 1e-6), 3),
             }
+            entry.update(_gate_extras(cyc.gate_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
@@ -2182,22 +2241,51 @@ class CoreScheduler(SchedulerAPI):
         return out
 
     def _inflight_overlay(self):
-        """[capacity, R] overlay of committed-but-not-yet-assumed allocations."""
+        """[capacity, R] overlay of committed-but-not-yet-assumed allocations.
+
+        Quantized rows are cached per allocation key (keyed to the exact
+        Resource object, so a re-committed key with a new resource
+        re-quantizes) and accumulated with one np.add.at gather instead of a
+        per-alloc quantize_request + row add every cycle — the in-flight set
+        is O(last cycle's commits), and the old loop re-quantized all of it
+        every cycle."""
         import numpy as np
 
         drop = [k for k in self._inflight
                 if self.cache.get_pod_node_name(k) is not None]
+        cache_rows = self._inflight_row_cache
         for k in drop:
             self._inflight.pop(k, None)
+            cache_rows.pop(k, None)
         if not self._inflight:
+            if cache_rows:
+                cache_rows.clear()
             return None
-        overlay = np.zeros((self.encoder.nodes.capacity, self.encoder.vocabs.resources.num_slots),
-                           np.float32)
-        for alloc in self._inflight.values():
+        if len(cache_rows) > 2 * len(self._inflight) + 64:
+            # keys released through other paths leave orphans; sweep rarely
+            for k in [k for k in cache_rows if k not in self._inflight]:
+                cache_rows.pop(k, None)
+        R = self.encoder.vocabs.resources.num_slots
+        n = len(self._inflight)
+        rows = np.zeros((n, R), np.float32)
+        idxs = np.empty((n,), np.int64)
+        count = 0
+        for key, alloc in self._inflight.items():
             idx = self.encoder.nodes.index_of(alloc.node_id)
-            if idx is not None:
-                row = self.encoder.quantize_request(alloc.resource)
-                overlay[idx, : row.shape[0]] += row
+            if idx is None:
+                continue
+            cached = cache_rows.get(key)
+            if cached is None or cached[0] is not alloc.resource:
+                cached = cache_rows[key] = (
+                    alloc.resource, self.encoder.quantize_request(alloc.resource))
+            row = cached[1]
+            # cached rows may predate vocab growth: shorter than R, never longer
+            rows[count, : row.shape[0]] = row
+            idxs[count] = idx
+            count += 1
+        overlay = np.zeros((self.encoder.nodes.capacity, R), np.float32)
+        if count:
+            np.add.at(overlay, idxs[:count], rows[:count])
         return overlay
 
     def _collect_and_gate(self, exclude_keys=None, seed_admissions=None):
@@ -2213,7 +2301,15 @@ class CoreScheduler(SchedulerAPI):
         groups)] of the in-flight batch, charged against quota/user limits as
         in-cycle admissions — conservatively reproducing the queue usage the
         sequential order would have committed before this gate.
+
+        Two interchangeable admission paths (core/gate.py): the array-form
+        vectorized pass (default — one lexsort + grouped prefix-scan
+        admission) and the legacy per-ask loop (fallback for GateFallback
+        cycles, forced by solver.gateVectorized=false, and the verify mode's
+        differential oracle). Both are pure w.r.t. queue-tree state, so the
+        verify mode can run them back to back on the same cycle.
         """
+        t0 = time.perf_counter()
         cluster_cap = self._cluster_capacity()
 
         by_queue: Dict[str, List[Tuple[CoreApplication, object]]] = {}
@@ -2224,73 +2320,80 @@ class CoreScheduler(SchedulerAPI):
                 if exclude_keys is not None and ask.allocation_key in exclude_keys:
                     continue
                 by_queue.setdefault(app.queue_name, []).append((app, ask))
+        if not by_queue:
+            self._last_gate_stats = {}
+            return [], [], 0
 
-        queue_shares = []
-        adj_of: Dict[str, int] = {}
-        for qname in by_queue:
-            leaf = self.queues.resolve(qname, create=False)
-            share = leaf.dominant_share(cluster_cap) if leaf else 0.0
-            adj = leaf.priority_adjustment() if leaf else 0
-            adj_of[qname] = adj
-            best_prio = max(((e[1].priority or 0) + adj) for e in by_queue[qname])
-            # cross-queue: highest adjusted priority first, then fair share
-            queue_shares.append((-best_prio, share, qname))
-        queue_shares.sort()
-
-        admitted: List[object] = []
+        meta = self._gate_queue_meta(by_queue, cluster_cap)
+        admitted: Optional[List[object]] = None
         held = 0
-        # in-cycle admissions accumulate per queue NODE (keyed by full name) so
-        # sibling leaves cannot jointly blow through a shared parent's max
-        cycle_extra: Dict[str, Resource] = {}
-        # user/group-limit overlay shared across ALL leaves this cycle (keys
-        # "<queue>|u|<user>" / "<queue>|g|<group>"), so sibling leaves under a
-        # limited parent are jointly capped
-        limit_cycle_extra: Dict[str, Resource] = {}
-        if seed_admissions:
-            any_limits = self.queues.any_limits()
-            for qname, res, user, groups in seed_admissions:
-                leaf = self.queues.resolve(qname, create=False)
-                if leaf is None:
-                    continue
-                for q in leaf.ancestors_and_self():
-                    if q.config.max_resource is not None:
-                        cycle_extra[q.full_name] = cycle_extra.get(
-                            q.full_name, Resource()).add(res)
-                if any_limits and leaf.has_limits_in_chain():
-                    leaf.record_cycle_admission(user, list(groups), res,
-                                                limit_cycle_extra)
-        for _neg_prio, share, qname in queue_shares:
-            leaf = self.queues.resolve(qname, create=False)
-            entries = by_queue[qname]
-            prio_adj = adj_of.get(qname, 0)
-            entries.sort(key=lambda e: (
-                -((e[1].priority or 0) + prio_adj),
-                e[0].submit_time,
-                e[1].seq,
-            ))
-            # queues with no max anywhere in their chain skip the walk entirely
-            quota_chain = (
-                [q for q in leaf.ancestors_and_self() if q.config.max_resource is not None]
-                if leaf is not None else []
-            )
-            has_limits = leaf is not None and leaf.has_limits_in_chain()
-            for app, ask in entries:
-                if quota_chain and not _fits_quota_with(quota_chain, cycle_extra, ask.resource):
-                    held += 1
-                    continue
-                if has_limits:
-                    groups = list(app.user.groups)
-                    if not leaf.fits_user_limit(app.user.user, groups, ask.resource,
-                                                cycle_extra=limit_cycle_extra):
-                        held += 1
-                        continue
-                    leaf.record_cycle_admission(app.user.user, groups, ask.resource,
-                                                limit_cycle_extra)
-                for q in quota_chain:
-                    cycle_extra[q.full_name] = cycle_extra.get(q.full_name, Resource()).add(ask.resource)
-                admitted.append(ask)
+        stats: dict = {}
+        if self.solver.gate_vector is not False:
+            try:
+                admitted, held, stats = vector_admit(by_queue, meta,
+                                                     self.queues,
+                                                     seed_admissions)
+                self._m_gate_path.inc(path="vector")
+            except GateFallback as e:
+                # the cycle's quantities exceed the gate's exact int64 range
+                # (or the batch its size ceiling): the loop is the authority
+                logger.warning("vectorized gate fell back to the legacy "
+                               "loop: %s", e)
+                self._m_gate_path.inc(path="fallback")
+                stats = {"path": "legacy", "fallback": str(e)}
+        if admitted is None:
+            if not stats:
+                self._m_gate_path.inc(path="legacy")
+                stats = {"path": "legacy"}
+            admitted, held = legacy_admit(by_queue, meta, self.queues,
+                                          seed_admissions)
+        elif self.solver.gate_verify:
+            ref_admitted, ref_held = legacy_admit(by_queue, meta, self.queues,
+                                                  seed_admissions)
+            if (ref_held != held
+                    or [a.allocation_key for a in ref_admitted]
+                    != [a.allocation_key for a in admitted]):
+                self._m_gate_mismatch.inc()
+                logger.error(
+                    "vectorized gate diverged from the legacy loop "
+                    "(vector %d admitted/%d held, legacy %d/%d); "
+                    "using the legacy result",
+                    len(admitted), held, len(ref_admitted), ref_held)
+                admitted, held = ref_admitted, ref_held
+                stats = dict(stats, path="legacy", mismatch=1)
+        for k in ("rank_ms", "admit_ms"):
+            if k in stats:
+                self._m_gate_stage.observe(stats[k], stage=k[:-3])
+        stats["gate_total_ms"] = round((time.perf_counter() - t0) * 1000, 3)
+        self._last_gate_stats = stats
         ranks = list(range(len(admitted)))
         return admitted, ranks, held
+
+    def _gate_queue_meta(self, by_queue, cluster_cap: Resource) -> Dict[str, tuple]:
+        """qname -> (leaf, dominant_share, priority_adjustment), cached.
+
+        Leaf resolution, the DRF dominant-share walk and the priority-offset
+        chain walk are pure functions of the tree's accounting epoch
+        (QueueTree.version — bumped by allocation accounting, config reload
+        and dynamic queue creation) and the cluster capacity; re-resolving
+        every queue each gate pass was O(queues x depth) of repeated walks.
+        The cache maps are extended in place on partial hits (a new queue
+        name joining an unchanged tree resolves only itself)."""
+        key = (id(self.queues), self.queues.version,
+               tuple(sorted(cluster_cap.resources.items())))
+        cached = self._gate_meta_cache
+        if cached is None or cached[0] != key:
+            cached = self._gate_meta_cache = (key, {})
+        meta = cached[1]
+        for qname in by_queue:
+            if qname not in meta:
+                leaf = self.queues.resolve(qname, create=False)
+                meta[qname] = (
+                    leaf,
+                    leaf.dominant_share(cluster_cap) if leaf else 0.0,
+                    leaf.priority_adjustment() if leaf else 0,
+                )
+        return meta
 
     # ------------------------------------------------------------------- gang
     def _replace_placeholders(self) -> AllocationResponse:
@@ -2684,6 +2787,20 @@ class CoreScheduler(SchedulerAPI):
         return json.dumps(self.get_partition_dao(), default=str)
 
 
+def _gate_extras(stats: dict) -> dict:
+    """Gate-pass stats (core/gate.py) renamed for the cycle entry and the
+    gate tracer span: path + sub-stage ms + scan-pass/tracker counts."""
+    out = {}
+    for src, dst in (("path", "gate_path"), ("rank_ms", "gate_rank_ms"),
+                     ("admit_ms", "gate_admit_ms"), ("passes", "gate_passes"),
+                     ("trackers", "gate_trackers"),
+                     ("finish_loop", "gate_finish_loop")):
+        if src in stats:
+            v = stats[src]
+            out[dst] = round(v, 3) if isinstance(v, float) else v
+    return out
+
+
 def _acc_resource(acc: Dict[str, int], resource: Resource) -> None:
     """Fold a resource into a plain int accumulator (Resource.add would copy
     the dict per call — measurable at 50k allocations/releases)."""
@@ -2691,13 +2808,3 @@ def _acc_resource(acc: Dict[str, int], resource: Resource) -> None:
         acc[rk] = acc.get(rk, 0) + rv
 
 
-def _fits_quota_with(quota_chain, cycle_extra: Dict[str, Resource], req: Resource) -> bool:
-    """fits_quota overlaying the in-cycle per-queue-node admissions.
-
-    quota_chain holds only the ancestors that actually configure a max.
-    """
-    for q in quota_chain:
-        extra = cycle_extra.get(q.full_name, Resource())
-        if not q.allocated.add(extra).add(req).within_limit(q.config.max_resource):
-            return False
-    return True

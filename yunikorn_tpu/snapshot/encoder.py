@@ -742,6 +742,30 @@ class SnapshotEncoder:
         # one-deep built-batch memo: (key, extra fingerprint, batch)
         self._batch_cache: Optional[tuple] = None
         self.last_encode_cached = False
+        # per-ask encoded-row cache (round 10): allocation_key -> (ask seq,
+        # anti-term set identity, group signature, request signature,
+        # quantized request row). Group/request signatures and the quantized
+        # row are pure functions of (ask.pod, ask.resource, the anti-term
+        # set): a re-submitted ask gets a fresh core seq (the same identity
+        # rule build_batch_cached's memo key uses), and anti-term set churn
+        # regenerates the memoized list object (locality.all_anti_terms,
+        # keyed by cache.anti_version — the same invalidation feed that
+        # marks nodes dirty for sync_nodes). A churn cycle therefore
+        # re-derives signatures only for new/changed asks; unchanged rows
+        # assemble straight from the cache. LRU-bounded like _group_cache.
+        self._ask_row_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        # capacity >= the vector gate's 2^18-ask batch ceiling (gate._MAX_ASKS)
+        # so even a maximal batch fits whole: a cap below the batch size would
+        # evict this cycle's earliest-iterated entries every cycle — a steady-
+        # state LRU thrash that silently re-derives O(batch - cap) rows.
+        # build_batch additionally floors eviction at the live batch size so
+        # legacy-gate batches beyond this ceiling cannot thrash either.
+        self._ask_row_cache_max = 1 << 18
+        # encode-cost accounting for the most recent build_batch: total rows
+        # vs rows that actually re-derived signatures/quantization (the
+        # O(changed) contract gate-smoke and the bench assert on)
+        self.last_encode_rows = 0
+        self.last_encode_rows_reencoded = 0
 
     @property
     def mirror_epoch(self) -> int:
@@ -925,6 +949,8 @@ class SnapshotEncoder:
         if cached is not None and cached[0] == key and (
                 cached[1] == fp or not cached[2].placement_dependent):
             self.last_encode_cached = True
+            self.last_encode_rows = cached[2].num_pods
+            self.last_encode_rows_reencoded = 0
             batch = cached[2]
             if cached[1] != fp:
                 # placement-independent: the overlay only matters to solve
@@ -1407,15 +1433,54 @@ class SnapshotEncoder:
         # batch — one cycle of snapshot staleness, same class of tradeoff as
         # the node-array sync point.
         taint_bits = self.vocabs.taints.used_bits()
+
+        # ---- per-ask encoded-row cache resolution ----
+        # One pass resolving every ask's (group signature, request signature,
+        # quantized row): unchanged asks (same allocation key + seq, same
+        # anti-term set object) come straight out of the cache; only new or
+        # changed asks pay the signature walks and quantization. Distinct
+        # fresh request shapes still quantize once (a deployment's pods all
+        # ask the same).
+        ask_cache = self._ask_row_cache
+        resolved: List[tuple] = []
+        fresh_rows: Dict[tuple, np.ndarray] = {}
+        n_reencoded = 0
+        for ask in asks:
+            pod = ask.pod
+            key = ask.allocation_key
+            rec = ask_cache.get(key) if pod is not None else None
+            if rec is not None and rec[0] == ask.seq and rec[1] is anti_terms:
+                ask_cache.move_to_end(key)
+                resolved.append((rec[2], rec[3], rec[4]))
+                continue
+            n_reencoded += 1
+            gsig: tuple = ("<none>",) if pod is None \
+                else self._group_signature(pod, anti_terms)
+            rsig = tuple(sorted(ask.resource.resources.items()))
+            row = fresh_rows.get(rsig)
+            if row is None:
+                row = fresh_rows[rsig] = self.quantize_request(ask.resource)
+                if row.shape[0] > R:
+                    # vocab grew past the padded width: restart wider (the
+                    # records already cached make the retry near-free)
+                    return self.build_batch(asks, ranks, queue_ids, min_batch,
+                                            extra_placed=extra_placed)
+            resolved.append((gsig, rsig, row))
+            if pod is not None:
+                ask_cache[key] = (ask.seq, anti_terms, gsig, rsig, row)
+        # floor the cap at the batch just encoded (the legacy gate path has
+        # no batch ceiling): every live row was touched above, so eviction
+        # only ever drops stale entries, never this cycle's rows
+        while len(ask_cache) > max(self._ask_row_cache_max, n):
+            ask_cache.popitem(last=False)
+        self.last_encode_rows = n
+        self.last_encode_rows_reencoded = n_reencoded
+
         group_specs: List[GroupSpec] = []
         group_ids: List[int] = []
         sig_to_gid: Dict[tuple, int] = {}
-        for ask in asks:
+        for ask, (sig, _rsig, _row) in zip(asks, resolved):
             pod = ask.pod
-            if pod is None:
-                sig: tuple = ("<none>",)
-            else:
-                sig = self._group_signature(pod, anti_terms)
             gid = sig_to_gid.get(sig)
             if gid is not None:
                 # re-encode if the taint vocab grew since this group was cached
@@ -1451,22 +1516,17 @@ class SnapshotEncoder:
         Wt = self.vocabs.taints.num_words
         Wp = self.vocabs.ports.num_words
 
-        # requests dedup: large batches are dominated by identical shapes (a
-        # deployment's pods all ask the same), so quantize each distinct
-        # resource once and scatter all its rows in one vectorized assignment
+        # requests: scatter the resolved quantized rows grouped by shape
+        # signature — one vectorized assignment per distinct shape (large
+        # batches are dominated by identical shapes). Cached rows may predate
+        # vocab growth (shorter than R, never longer): the slice pads.
         req = np.zeros((N, R), np.float32)
         # sig -> (quantized row, row indices asking for it)
         sig_rows: Dict[tuple, Tuple[np.ndarray, list]] = {}
-        for i, ask in enumerate(asks):
-            sig = tuple(sorted(ask.resource.resources.items()))
-            entry = sig_rows.get(sig)
+        for i, (_gsig, rsig, row) in enumerate(resolved):
+            entry = sig_rows.get(rsig)
             if entry is None:
-                row = self.quantize_request(ask.resource)
-                if row.shape[0] > R:
-                    # vocab grew past the padded width: restart wider
-                    return self.build_batch(asks, ranks, queue_ids, min_batch,
-                                            extra_placed=extra_placed)
-                sig_rows[sig] = (row, [i])
+                sig_rows[rsig] = (row, [i])
             else:
                 entry[1].append(i)
         for row, idxs in sig_rows.values():
