@@ -1,8 +1,8 @@
 # Developer entry points (reference Makefile analog).
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
-	chaos-smoke gate-smoke smoke lint run-scheduler run-admission dryrun \
-	clean image sched_image adm_image webtest_image
+	chaos-smoke gate-smoke gate-device-smoke smoke lint run-scheduler \
+	run-admission dryrun clean image sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -67,7 +67,16 @@ gate-smoke:  ## array-form admission gate: differential suite (vector == legacy 
 		python scripts/gate_bench.py --sizes 2000,20000 \
 		--assert-speedup 20000 --churn-check
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke  ## all tier-1 smoke targets
+gate-device-smoke:  ## device-resident gate+encode: differential suite (device scan == host vector == legacy, incl. pipelined/gang e2e + degradation-ladder chaos) + pass-bound regression (saturated shape <= ceil(log2 n)+C passes, never data-dependent blowup) + the O(changed) row-store upload contract
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_gate_device.py \
+		tests/test_solver_chaos.py -k "gate or encode_row" \
+		-q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/gate_bench.py --sizes 2000,20000 --saturated \
+		--passes --device-churn-check
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

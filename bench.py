@@ -276,10 +276,18 @@ def _cycle_stats(core) -> dict:
             "pod_encode_ms": float(timing.get("encode_ms", 0.0)),
             "gate_path": timing.get("gate_path", ""),
             "encode_reencoded": int(timing.get("encode_reencoded", 0)),
+            # device gate+encode pipeline (round 11): scan wall, bounded
+            # pass count, and the row-store's O(changed) upload evidence
+            "gate_device_ms": float(timing.get("gate_device_ms", 0.0)),
+            "gate_passes": int(timing.get("gate_passes", 0)),
+            "encode_device_rows": int(timing.get("encode_device_rows", 0)),
+            "encode_device_bytes": int(timing.get("encode_device_bytes", 0)),
         }
     except Exception:
         return {"gate_ms": 0.0, "pod_encode_ms": 0.0, "gate_path": "",
-                "encode_reencoded": 0}
+                "encode_reencoded": 0, "gate_device_ms": 0.0,
+                "gate_passes": 0, "encode_device_rows": 0,
+                "encode_device_bytes": 0}
 
 
 def _preempt_stat(core) -> float:

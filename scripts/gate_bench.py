@@ -1,25 +1,35 @@
 #!/usr/bin/env python
-"""Admission-gate microbench: array-form vector_admit vs the legacy per-ask
-loop, plus the churn-encode O(changed) check.
+"""Admission-gate microbench: the jitted device scan vs array-form
+vector_admit vs the legacy per-ask loop, plus the churn-encode O(changed)
+check.
 
 The trace models a real pending backlog: a three-level queue tree (quotas on
 leaves AND a shared parent, user/group limits on a slice of it), asks spread
 over the leaves from a handful of users. Three contention shapes (see
 build_tree): default ~6% held (the north-star backlog that mostly fits),
---contended ~26% held, --saturated ~85% held. This is the shape where the
-per-ask host loop collapses: every ask pays a quota-chain walk + limit scan
-+ accumulator folds in pure Python, while the vector gate pays one lexsort
-+ a few prefix-scan passes.
+--contended ~26% held, --saturated ~85% held. The saturated shape is the
+device scan's reason to exist: the host scan's pass count is data-dependent
+(~13 there), the device scan's is bounded ceil(log2(n))+C by construction.
 
 Per size prints one JSON line:
-  {"asks": N, "legacy_ms": ..., "vector_ms": ..., "speedup": ...,
-   "held": ..., "passes": ...}
+  {"asks": N, "legacy_ms": ..., "vector_ms": ..., "device_ms": ...,
+   "speedup": ..., "device_speedup": ..., "held": ..., "passes": ...,
+   "device_passes": ..., "max_passes": ...}
 
 --sizes 2000,20000,50000   ask counts (default "2000,20000")
 --assert-speedup N         exit 1 unless vector beats legacy at every
                            size >= N (the gate-smoke CI gate)
+--device                   also run (and report) the jitted device scan
+--passes                   print a pass report per size and assert the
+                           device pass count stays within its log-depth
+                           bound (implies --device; the gate-device-smoke
+                           CI gate — the saturated shape must complete in
+                           <= ceil(log2(n))+C passes, never a
+                           data-dependent blowup)
 --churn-check              also run the encoder churn check: a 1%-churn
                            cycle must re-encode only the changed rows
+--device-churn-check       the device row store analog: a 1%-churn cycle
+                           must UPLOAD only the changed rows
 """
 import argparse
 import json
@@ -112,21 +122,23 @@ def meta_for(tree, by_queue):
     return meta
 
 
-def bench_size(n_asks, repeats=3, scale=1.3):
-    from yunikorn_tpu.core.gate import legacy_admit, vector_admit
+def bench_size(n_asks, repeats=3, scale=1.3, device=False):
+    from yunikorn_tpu.core.gate import (
+        extract_problem, legacy_admit, vector_admit)
 
     tree = build_tree(n_asks, scale=scale)
     by_queue = build_trace(tree, n_asks)
     meta = meta_for(tree, by_queue)
 
-    def run(fn):
+    def run(fn, warm=0):
         best = float("inf")
         out = None
-        for _ in range(repeats):
+        for rep in range(repeats + warm):
             trace = {q: list(v) for q, v in by_queue.items()}
             t0 = time.perf_counter()
             out = fn(trace)
-            best = min(best, (time.perf_counter() - t0) * 1000)
+            if rep >= warm:
+                best = min(best, (time.perf_counter() - t0) * 1000)
         return best, out
 
     legacy_ms, (l_adm, l_held) = run(
@@ -136,7 +148,7 @@ def bench_size(n_asks, repeats=3, scale=1.3):
     assert [a.allocation_key for a in v_adm] == \
         [a.allocation_key for a in l_adm], "vector gate diverged from legacy"
     assert v_held == l_held, (v_held, l_held)
-    return {
+    out = {
         "asks": n_asks,
         "legacy_ms": round(legacy_ms, 2),
         "vector_ms": round(vector_ms, 2),
@@ -146,10 +158,34 @@ def bench_size(n_asks, repeats=3, scale=1.3):
         "rank_ms": round(stats.get("rank_ms", 0.0), 2),
         "admit_ms": round(stats.get("admit_ms", 0.0), 2),
     }
+    if device:
+        from yunikorn_tpu.ops import gate_solve
+
+        # warm=1: the first call at a bucket pays the XLA compile; the
+        # steady-state number is what a production cycle pays
+        device_ms, (d_adm, d_held, d_stats) = run(
+            lambda tr: gate_solve.device_admit(
+                extract_problem(tr, meta, tree)), warm=1)
+        assert [a.allocation_key for a in d_adm] == \
+            [a.allocation_key for a in l_adm], "device gate diverged"
+        assert d_held == l_held, (d_held, l_held)
+        out.update({
+            "device_ms": round(device_ms, 2),
+            "device_speedup": round(legacy_ms / max(device_ms, 1e-9), 2),
+            "device_vs_vector": round(vector_ms / max(device_ms, 1e-9), 2),
+            "device_passes": d_stats.get("passes"),
+            "max_passes": d_stats.get("max_passes",
+                                      gate_solve.max_passes_for(n_asks)),
+            "device_finish_loop": d_stats.get("finish_loop", 0),
+        })
+    return out
 
 
-def churn_check(n_pods=2000, churn=0.01):
-    """1%-churn contract: the second encode re-derives only changed rows."""
+def _churn_harness(n_pods, churn, n_nodes=64):
+    """Shared churn-trace scaffolding for the two O(changed) contracts: an
+    encoder over a node cache, a pod/ask batch builder, and the 1%-churn
+    mutation (fresh seq + changed request — both contracts must see the
+    SAME workload). Returns (enc, asks, mutate, n_changed)."""
     from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
     from yunikorn_tpu.common.objects import make_node, make_pod
     from yunikorn_tpu.common.resource import get_pod_resource
@@ -157,7 +193,7 @@ def churn_check(n_pods=2000, churn=0.01):
     from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
 
     cache = SchedulerCache()
-    for i in range(64):
+    for i in range(n_nodes):
         cache.update_node(make_node(f"n{i}", cpu_milli=64000,
                                     memory=128 * 2**30))
     enc = SnapshotEncoder(cache)
@@ -165,15 +201,25 @@ def churn_check(n_pods=2000, churn=0.01):
     pods = [make_pod(f"p{i}", cpu_milli=100) for i in range(n_pods)]
     asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p, seq=i)
             for i, p in enumerate(pods)]
+    n_changed = max(int(n_pods * churn), 1)
+
+    def mutate():
+        for i in range(n_changed):
+            p = make_pod(f"p{i}", cpu_milli=700)
+            asks[i] = AllocationAsk(asks[i].allocation_key, "app",
+                                    get_pod_resource(p), pod=p,
+                                    seq=n_pods + i)
+
+    return enc, asks, mutate, n_changed
+
+
+def churn_check(n_pods=2000, churn=0.01):
+    """1%-churn contract: the second encode re-derives only changed rows."""
+    enc, asks, mutate, n_changed = _churn_harness(n_pods, churn)
     t0 = time.perf_counter()
     enc.build_batch(asks)
     cold_ms = (time.perf_counter() - t0) * 1000
-    n_changed = max(int(n_pods * churn), 1)
-    for i in range(n_changed):
-        p = make_pod(f"p{i}", cpu_milli=700)
-        asks[i] = AllocationAsk(asks[i].allocation_key, "app",
-                                get_pod_resource(p), pod=p,
-                                seq=n_pods + i)
+    mutate()
     t0 = time.perf_counter()
     enc.build_batch(asks)
     churn_ms = (time.perf_counter() - t0) * 1000
@@ -190,12 +236,46 @@ def churn_check(n_pods=2000, churn=0.01):
     return out
 
 
+def device_churn_check(n_pods=2000, churn=0.01):
+    """O(changed) TRANSFER contract: the second sync uploads only the
+    changed rows' data into the device row pool."""
+    enc, asks, mutate, n_changed = _churn_harness(n_pods, churn)
+    store = enc.device_row_store()
+    t0 = time.perf_counter()
+    store.sync_and_gather(asks, n_pods)
+    cold_ms = (time.perf_counter() - t0) * 1000
+    mutate()
+    t0 = time.perf_counter()
+    store.sync_and_gather(asks, n_pods)
+    churn_ms = (time.perf_counter() - t0) * 1000
+    out = {
+        "pods": n_pods,
+        "changed": n_changed,
+        "rows_uploaded": store.last_upload_rows,
+        "bytes_uploaded": store.last_upload_bytes,
+        "cold_sync_ms": round(cold_ms, 2),
+        "churn_sync_ms": round(churn_ms, 2),
+    }
+    print(json.dumps(out), flush=True)
+    assert store.last_upload_rows == n_changed, \
+        (store.last_upload_rows, n_changed)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", default="2000,20000")
     ap.add_argument("--assert-speedup", type=int, default=0, metavar="N",
                     help="exit 1 unless vector beats legacy at sizes >= N")
+    ap.add_argument("--device", action="store_true",
+                    help="also run the jitted device scan")
+    ap.add_argument("--passes", action="store_true",
+                    help="pass report + regression assertion: the device "
+                         "scan must finish within its log-depth bound "
+                         "(ceil(log2(n))+C) at every size (implies "
+                         "--device)")
     ap.add_argument("--churn-check", action="store_true")
+    ap.add_argument("--device-churn-check", action="store_true")
     ap.add_argument("--contended", action="store_true",
                     help="quotas at ~80%% of demand (~26%% held): every "
                          "(leaf, user) limit saturated")
@@ -205,17 +285,30 @@ def main():
     args = ap.parse_args()
 
     scale = 0.2 if args.saturated else (1.0 if args.contended else 1.3)
+    device = args.device or args.passes
     failed = False
     for size in (int(s) for s in args.sizes.split(",") if s):
-        r = bench_size(size, scale=scale)
+        r = bench_size(size, scale=scale, device=device)
         print(json.dumps(r), flush=True)
         if args.assert_speedup and size >= args.assert_speedup \
                 and r["speedup"] <= 1.0:
             print(f"# FAIL: vector gate did not beat the legacy loop at "
                   f"{size} asks ({r['speedup']}x)", file=sys.stderr)
             failed = True
+        if args.passes:
+            print(f"# passes @ {size}: host-vector={r['passes']} "
+                  f"device={r['device_passes']} "
+                  f"bound={r['max_passes']} "
+                  f"(leftovers={r['device_finish_loop']})", flush=True)
+            if r["device_passes"] > r["max_passes"]:
+                print(f"# FAIL: device pass count {r['device_passes']} "
+                      f"exceeds the log-depth bound {r['max_passes']} at "
+                      f"{size} asks", file=sys.stderr)
+                failed = True
     if args.churn_check:
         churn_check()
+    if args.device_churn_check:
+        device_churn_check()
     return 1 if failed else 0
 
 

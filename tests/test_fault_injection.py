@@ -57,6 +57,32 @@ def test_bind_failure_fails_task_and_releases(sched):
     sched.wait_for_task_state("app-1", p2.uid, task_mod.BOUND)
 
 
+def test_bind_failure_transient_retries_then_binds(sched):
+    """A bind that races cluster state (node gone mid-bind) is NOT terminal:
+    the allocation is released and the task re-queues (Allocated → Pending →
+    fresh ask), binding on a later cycle once the failure clears — the
+    node-remove-with-pods-in-flight scenario's recovery contract."""
+    sched.add_node(make_node("node-1", cpu_milli=2000))
+    client = sched.cluster.get_client()
+    calls = {"n": 0}
+
+    def flaky_bind(pod, node):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise KeyError(f"bind: node {node} not found")
+        client._cluster.bind_pod(pod.uid, node)
+
+    client.bind_fn = flaky_bind
+    p = sched.add_pod(yk_pod("survivor"))
+    sched.wait_for_task_state("app-1", p.uid, task_mod.BOUND, timeout=20)
+    assert calls["n"] >= 3
+    task = sched.context.get_application("app-1").get_task(p.uid)
+    assert task.bind_retries == 2
+    # accounting is clean after the release/re-admit round trips
+    leaf = sched.core.queues.resolve("root.default", create=False)
+    assert leaf.allocated.get("cpu") == 500
+
+
 def test_placeholder_create_failure_soft_fallback(sched):
     sched.add_node(make_node("node-1", cpu_milli=8000))
     client = sched.cluster.get_client()

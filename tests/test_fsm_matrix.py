@@ -87,7 +87,8 @@ def test_application_matrix_is_exhaustive():
 TASK_STATES = list(task_mod.ANY)
 TASK_EVENTS = [task_mod.INIT_TASK, task_mod.SUBMIT_TASK, task_mod.TASK_ALLOCATED,
                task_mod.TASK_BOUND, task_mod.COMPLETE_TASK, task_mod.KILL_TASK,
-               task_mod.TASK_KILLED, task_mod.TASK_REJECTED, task_mod.TASK_FAIL]
+               task_mod.TASK_KILLED, task_mod.TASK_REJECTED, task_mod.TASK_FAIL,
+               task_mod.TASK_RETRY]
 
 TASK_EXPECTED = {}
 for s in task_mod.ANY:
@@ -107,6 +108,9 @@ TASK_EXPECTED.update({
     (task_mod.ALLOCATED, task_mod.TASK_BOUND): task_mod.BOUND,
     (task_mod.ALLOCATED, task_mod.KILL_TASK): task_mod.KILLING,
     (task_mod.ALLOCATED, task_mod.TASK_FAIL): task_mod.FAILED,
+    # bind raced cluster state (node deleted mid-bind): allocation released,
+    # task re-queues and re-submits a fresh ask (bounded by BIND_RETRY_MAX)
+    (task_mod.ALLOCATED, task_mod.TASK_RETRY): task_mod.PENDING,
     (task_mod.BOUND, task_mod.KILL_TASK): task_mod.KILLING,
     (task_mod.KILLING, task_mod.TASK_KILLED): task_mod.KILLED,
     (task_mod.REJECTED, task_mod.TASK_FAIL): task_mod.FAILED,

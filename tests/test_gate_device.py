@@ -1,0 +1,321 @@
+"""Differential + transfer-contract suite for the device-resident gate and
+the device row store (ops/gate_solve.py, snapshot/encoder.DeviceRowStore).
+
+The device scan must be indistinguishable from the host vectorized scan —
+identical admitted set, identical global order, identical held count — and
+transitively from the legacy loop, across the same randomized scenario
+space that pinned the host scan (tests/test_gate_vectorized.py): random
+trees with nested quotas, user/group limits, fences, gang asks, pipelined
+seed/exclude traces. Additionally pinned here:
+
+- the pass bound: the jitted scan can never run more than
+  ceil(log2(n_pad)) + GATE_PASS_SLACK passes, and a scan that hits the cap
+  still returns the exact result via the host finish of the leftovers;
+- the exact-int32 fast path and the int64 path decide identically;
+- encode_rows quantization is bit-identical to the host quantize chain;
+- a churn cycle uploads only changed rows (the O(changed-asks) transfer
+  contract), and the gathered req tensor equals batch.req.astype(int32).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.core import gate as gate_mod
+from yunikorn_tpu.core.gate import extract_problem, host_scan, legacy_admit
+from yunikorn_tpu.ops import gate_solve
+
+from tests.test_gate_vectorized import (
+    CAP,
+    E2E_YAML,
+    FakeApp,
+    _e2e_core,
+    _flat_tree,
+    _submit,
+    meta_for,
+    preload_accounting,
+    random_seeds,
+    random_trace,
+    random_tree,
+)
+
+
+def run_three(tree, by_queue, seeds=None):
+    """device, host-vectorized and legacy on copies of the same trace."""
+    meta = meta_for(tree, by_queue)
+    problem = extract_problem({q: list(v) for q, v in by_queue.items()},
+                              meta, tree, seeds)
+    d_adm, d_held, d_stats = gate_solve.device_admit(problem)
+    v_adm, v_held, _ = host_scan(problem)
+    l_adm, l_held = legacy_admit({q: list(v) for q, v in by_queue.items()},
+                                 meta, tree, seeds)
+    return (d_adm, d_held, d_stats), (v_adm, v_held), (l_adm, l_held)
+
+
+def assert_three_way(tree, by_queue, seeds=None):
+    (d_adm, d_held, d_stats), (v_adm, v_held), (l_adm, l_held) = run_three(
+        tree, by_queue, seeds)
+    keys = [a.allocation_key for a in d_adm]
+    assert keys == [a.allocation_key for a in v_adm]
+    assert keys == [a.allocation_key for a in l_adm]
+    assert d_held == v_held == l_held
+    if "max_passes" in d_stats:
+        assert d_stats["passes"] <= d_stats["max_passes"]
+    return d_stats
+
+
+# --------------------------------------------------------------- randomized
+def test_randomized_trees_differential():
+    """60 seeded random (tree, accounting, trace) scenarios — device ==
+    host vectorized == legacy exactly, pass bound respected."""
+    for seed in range(60):
+        rng = random.Random(seed)
+        tree = random_tree(rng)
+        preload_accounting(rng, tree)
+        by_queue = random_trace(rng, tree)
+        assert_three_way(tree, by_queue)
+
+
+def test_randomized_with_seed_admissions():
+    """The pipelined gate's in-flight charge (seed_admissions) through the
+    device scan: identical to both host paths."""
+    for seed in range(40):
+        rng = random.Random(1000 + seed)
+        tree = random_tree(rng)
+        preload_accounting(rng, tree)
+        by_queue = random_trace(rng, tree)
+        assert_three_way(tree, by_queue, seeds=random_seeds(rng, tree))
+
+
+def test_pass_cap_leftovers_finish_exact(monkeypatch):
+    """With the pass budget strangled to 1, the device scan leaves
+    undecided asks; finish_leftovers must complete them to the identical
+    result — the no-data-dependent-blowup guarantee's other half."""
+    monkeypatch.setattr(gate_solve, "GATE_PASS_SLACK", -7)  # max_passes ~ 1
+    saw_leftovers = False
+    for seed in range(20):
+        rng = random.Random(3000 + seed)
+        tree = random_tree(rng)
+        preload_accounting(rng, tree)
+        by_queue = random_trace(rng, tree)
+        stats = assert_three_way(tree, by_queue)
+        if stats.get("finish_loop"):
+            saw_leftovers = True
+    assert saw_leftovers, "pass cap of ~1 never left leftovers — test inert"
+
+
+def test_int64_wide_values_path():
+    """Quantities past the int32 bound (memory in bytes at cluster scale)
+    take the int64 kernel; decisions stay pinned."""
+    tree = _flat_tree(max_resource=Resource({"memory": 40 * 2**30}))
+    app = FakeApp("alice", [], 1.0, "root.q")
+    by_queue = {"root.q": [
+        (app, AllocationAsk(f"m{i}", "app",
+                            Resource({"memory": 8 * 2**30}), seq=i))
+        for i in range(8)]}
+    stats = assert_three_way(tree, by_queue)
+    assert stats["passes"] >= 1
+
+
+def test_device_matches_on_bench_shapes():
+    """The gate_bench trace generator's three contention shapes at a small
+    size: the shapes the perf acceptance is judged on stay pinned."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "gate_bench", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "gate_bench.py"))
+    gb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gb)
+    for scale in (1.3, 1.0, 0.2):
+        tree = gb.build_tree(2000, scale=scale)
+        by_queue = gb.build_trace(tree, 2000)
+        stats = assert_three_way(tree, by_queue)
+        assert stats["passes"] <= gate_solve.max_passes_for(2000)
+
+
+# ----------------------------------------------------------- encode / rows
+def _mk_ask(i, res, seq=None):
+    return AllocationAsk(f"ask-{i}", "app", res, seq=seq if seq is not None
+                         else i)
+
+
+def test_encode_rows_matches_host_quantization():
+    """Device quantization (encode_rows) is bit-identical to the host
+    SnapshotEncoder.quantize_request chain, including the f32 rounding and
+    non-integral values, across random resource shapes."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    enc = SnapshotEncoder(SchedulerCache())
+    rng = random.Random(7)
+    asks = []
+    for i in range(64):
+        res = {"cpu": rng.randint(1, 10**6),
+               "memory": rng.randint(1, 2**40)}
+        if rng.random() < 0.3:
+            res["nvidia.com/gpu"] = rng.randint(1, 16)
+        if rng.random() < 0.2:
+            res["weird"] = rng.random() * 100  # non-integral host fallback
+        asks.append(_mk_ask(i, Resource(res)))
+    store = enc.device_row_store()
+    req = store.sync_and_gather(asks, len(asks))
+    got = np.asarray(req)
+    for i, ask in enumerate(asks):
+        want = np.zeros((store._R,), np.float32)
+        row = enc.quantize_request(ask.resource)
+        want[: row.shape[0]] = row
+        assert np.array_equal(got[i], want.astype(np.int32)), (
+            i, ask.resource.resources, got[i], want)
+
+
+def test_row_store_churn_uploads_only_changed():
+    """The O(changed-asks) transfer contract: a 1%-churn second cycle
+    uploads exactly the changed rows; an unchanged cycle uploads none."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    enc = SnapshotEncoder(SchedulerCache())
+    store = enc.device_row_store()
+    asks = [_mk_ask(i, Resource({"cpu": 100 + i % 7})) for i in range(500)]
+    req1 = store.sync_and_gather(asks, 512)
+    assert store.last_upload_rows == 500
+    # identical cycle: zero rows shipped, gather still serves the batch
+    req2 = store.sync_and_gather(asks, 512)
+    assert store.last_upload_rows == 0
+    assert store.last_upload_bytes == 0
+    assert np.array_equal(np.asarray(req1), np.asarray(req2))
+    # 1% churn: fresh seq + new resource on 5 asks → exactly 5 rows ship
+    for i in range(5):
+        asks[i] = _mk_ask(i, Resource({"cpu": 9000}), seq=1000 + i)
+    req3 = store.sync_and_gather(asks, 512)
+    assert store.last_upload_rows == 5
+    got = np.asarray(req3)
+    assert (got[:5, 0] == 9000).all()
+    assert np.array_equal(got[5:500], np.asarray(req1)[5:500])
+    # padding rows are the reserved zero slot
+    assert (got[500:] == 0).all()
+
+
+def test_row_store_vocab_growth_resets():
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    enc = SnapshotEncoder(SchedulerCache())
+    store = enc.device_row_store()
+    store.sync_and_gather([_mk_ask(0, Resource({"cpu": 1}))], 64)
+    # intern enough fresh resource names to cross the padded-slot boundary
+    for j in range(store._R + 1):
+        enc.vocabs.resources.slot(f"vendor.io/dev{j}")
+    store.sync_and_gather([_mk_ask(0, Resource({"cpu": 1}))], 64)
+    assert store.resets == 1
+    assert store.last_upload_rows == 1  # full re-upload of the live batch
+
+
+def test_device_req_matches_batch_req():
+    """The solve-facing contract: the device req gather equals
+    batch.req.astype(int32) row for row, padding included."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for i in range(8):
+        cache.update_node(make_node(f"n{i}", cpu_milli=64000,
+                                    memory=128 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [make_pod(f"p{i}", cpu_milli=100 + i, memory=(i + 1) * 2**20)
+            for i in range(100)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p, seq=i)
+            for i, p in enumerate(pods)]
+    batch = enc.build_batch(asks)
+    req_dev = enc.device_req(asks, batch)
+    assert req_dev is not None
+    assert np.array_equal(np.asarray(req_dev), batch.req.astype(np.int32))
+
+
+# ------------------------------------------------------------- end to end
+def test_e2e_device_verify_sequential():
+    """Full scheduler with the device gate as primary tier, verify mode on:
+    the legacy oracle re-runs after every device gate; mismatch pins 0."""
+    from yunikorn_tpu.common.objects import make_pod
+
+    cache, core = _e2e_core(E2E_YAML, gate_device=True)
+    _submit(core, "appa", "root.qa", "ua",
+            [make_pod(f"da-{i}", cpu_milli=1000, memory="512Mi")
+             for i in range(12)])
+    _submit(core, "appb", "root.qb", "ub",
+            [make_pod(f"db-{i}", cpu_milli=500, memory="256Mi")
+             for i in range(8)])
+    for _ in range(3):
+        core.schedule_once()
+    assert core.obs.get("gate_mismatch_total").value() == 0
+    assert core.obs.get("gate_path_total").value(path="device") >= 3
+    assert core.obs.get("gate_passes_total").value() >= 1
+    assert core.obs.get("unschedulable_total").value(reason="quota_held") > 0
+
+
+def test_e2e_device_verify_pipelined():
+    """Pipelined ticks through the device gate: exclude_keys +
+    seed_admissions overlays decided on device, oracle-pinned."""
+    from yunikorn_tpu.common.objects import make_pod
+
+    cache, core = _e2e_core(E2E_YAML, gate_device=True)
+    for w in range(3):
+        _submit(core, f"appw{w}", "root.qa", "ua",
+                [make_pod(f"dw{w}-{i}", cpu_milli=700, memory="128Mi")
+                 for i in range(5)])
+        core._pipeline_tick()
+    for _ in range(4):
+        core._pipeline_tick()
+    assert core._pipeline_inflight is None
+    assert core.obs.get("gate_mismatch_total").value() == 0
+    assert core.obs.get("gate_path_total").value(path="device") >= 3
+
+
+def test_e2e_gang_trace_device_verify():
+    """Gang apps (placeholders + real asks) through device verify cycles."""
+    from yunikorn_tpu.common.objects import make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest, AllocationRequest, ApplicationRequest,
+        TaskGroup, UserGroupInfo)
+
+    cache, core = _e2e_core(E2E_YAML, gate_device=True)
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="gang", queue_name="root.qa",
+        user=UserGroupInfo(user="ua"),
+        task_groups=[TaskGroup(name="tg", min_member=3,
+                               min_resource={"cpu": "500m"})])]))
+    phs = [make_pod(f"dph-{i}", cpu_milli=500) for i in range(3)]
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, "gang", get_pod_resource(p), placeholder=True,
+                      task_group_name="tg", pod=p) for p in phs]))
+    core.schedule_once()
+    real = [make_pod(f"drm-{i}", cpu_milli=500) for i in range(3)]
+    core.update_allocation(AllocationRequest(asks=[
+        AllocationAsk(p.uid, "gang", get_pod_resource(p),
+                      task_group_name="tg", pod=p) for p in real]))
+    core.schedule_once()
+    assert core.obs.get("gate_mismatch_total").value() == 0
+
+
+def test_e2e_gate_fallback_still_legacy():
+    """Oversized quantities raise GateFallback at extraction: no tier runs,
+    the legacy loop decides, and the fallback path is counted — with the
+    device pipeline on."""
+    from yunikorn_tpu.common.objects import make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationRequest
+
+    cache, core = _e2e_core(E2E_YAML, gate_verify=False, gate_device=True)
+    p = make_pod("huge", cpu_milli=1 << 50)
+    _submit(core, "appa", "root.qa", "ua", [p])
+    core.schedule_once()
+    assert core.obs.get("gate_path_total").value(path="fallback") >= 1
+    assert core.obs.get("gate_path_total").value(path="device") == 0

@@ -364,6 +364,9 @@ def test_pass_cap_falls_through_to_exact_finish(monkeypatch):
 
 # ------------------------------------------------------------- end to end
 def _e2e_core(queues_yaml, gate_verify=True, **core_kwargs):
+    # this file pins the HOST vectorized gate; the device tier has its own
+    # e2e verify suite (tests/test_gate_device.py)
+    core_kwargs.setdefault("gate_device", False)
     from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
     from yunikorn_tpu.client.synthetic import make_kwok_nodes
     from yunikorn_tpu.common.si import (

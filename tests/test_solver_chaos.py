@@ -50,14 +50,17 @@ FAST = SupervisorOptions(deadline_s=30.0, max_retries=2, backoff_base_s=0.005,
                          breaker_threshold=2, probe_interval_s=0.2)
 
 
-def make_core(n_nodes=32, options=None, pipeline=False, shard=None):
+def make_core(n_nodes=32, options=None, pipeline=False, shard=None,
+              config="", **solver_kwargs):
     cache = SchedulerCache()
     core = CoreScheduler(
         cache,
-        solver_options=SolverOptions(pipeline=pipeline, shard=shard),
+        solver_options=SolverOptions(pipeline=pipeline, shard=shard,
+                                     **solver_kwargs),
         supervisor_options=options or dataclasses_replace(FAST))
     core.register_resource_manager(
-        RegisterResourceManagerRequest(rm_id="chaos", policy_group="queues"),
+        RegisterResourceManagerRequest(rm_id="chaos", policy_group="queues",
+                                       config=config),
         NullCallback())
     nodes = make_kwok_nodes(n_nodes)
     for n in nodes:
@@ -670,3 +673,123 @@ def test_dispatcher_deadline_drop_is_counted(monkeypatch):
     finally:
         gate.set()
         d.stop()
+
+
+# ------------------------------------------------ gate degradation ladder
+# The device-resident admission gate (ops/gate_solve.py) runs through the
+# same supervisor as the solve, on its own "gate" path with the ladder
+# device → cpu (host vectorized scan) → host (legacy per-ask loop). The
+# differential guarantee mirrors the assign-path suite above: any faulted
+# tier degrades with PLACEMENT-identical results (all three gate backends
+# are pinned bit-identical), the circuit re-closes once the fault clears,
+# and a wedged device gate can never stall the loop.
+
+GATE_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: q
+            resources:
+              max: {vcore: 10, memory: 100Gi}
+"""
+
+
+def gate_clean_placements():
+    """Fault-free reference run on the quota-constrained trace: the gate
+    actively holds asks (demand 12 vcore > 10 vcore max), so gate-path
+    equivalence is visible in WHICH pods place, not just how many."""
+    cache, core = make_core(config=GATE_YAML)
+    names = {}
+    return run_trace(core, two_waves(), names)
+
+
+def test_gate_device_fault_degrades_to_vector_and_matches():
+    """A persistently failing device gate degrades to the host vectorized
+    tier with identical admissions/placements; the gate circuit opens."""
+    cache, core = make_core(config=GATE_YAML)
+    core.supervisor.faults.fail("gate", times=10, tier="device",
+                                persistent=True)
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == gate_clean_placements()
+    snap = core.supervisor.snapshot()
+    assert snap["gate"]["circuits"]["device"]["state"] == "open"
+    assert snap["gate"]["tier"] == "cpu"
+    assert core.obs.get("gate_path_total").value(path="vector") >= 1
+    assert core.obs.get("gate_path_total").value(path="device") == 0
+    g = core.obs.get("solver_degradation_state")
+    assert g.value(path="gate") == 1.0
+
+
+def test_gate_all_array_tiers_down_legacy_answers():
+    """Device AND host-vectorized tiers down: the legacy per-ask loop still
+    decides every cycle, placements unchanged — the gate ladder's bottom
+    tier is the exact reference semantics."""
+    opts = dataclasses_replace(FAST)
+    opts.breaker_threshold = 1
+    opts.max_retries = 0
+    opts.probe_interval_s = 60.0
+    cache, core = make_core(options=opts, config=GATE_YAML)
+    core.supervisor.faults.fail_forever("gate", tier="device")
+    core.supervisor.faults.fail_forever("gate", tier="cpu")
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == gate_clean_placements()
+    snap = core.supervisor.snapshot()
+    assert snap["gate"]["tier"] == "host"
+    assert core.obs.get("gate_path_total").value(path="legacy") >= 1
+
+
+def test_gate_hang_past_deadline_degrades_and_matches():
+    """A device gate that wedges past the dispatch deadline is abandoned by
+    the watchdog and the cycle completes on the host scan — the admission
+    path can no longer stall the loop either."""
+    opts = dataclasses_replace(FAST)
+    opts.deadline_s = 0.25
+    cache, core = make_core(options=opts, config=GATE_YAML)
+    core.supervisor.faults.slow("gate", seconds=2.0, times=100,
+                                tier="device")
+    names = {}
+    t0 = time.time()
+    got = run_trace(core, two_waves(), names)
+    wall = time.time() - t0
+    assert got == gate_clean_placements()
+    assert outcome(core, "gate", "deadline") >= 1
+    assert wall < 20, wall
+    assert core.supervisor.snapshot()["gate"]["circuits"]["device"]["state"] == "open"
+
+
+def test_gate_fault_clears_device_tier_recovers():
+    """Once the injected gate fault clears, the half-open probe re-closes
+    the device circuit and the device scan is reclaimed without restart."""
+    opts = dataclasses_replace(FAST)
+    opts.max_retries = 0
+    cache, core = make_core(options=opts, config=GATE_YAML)
+    core.supervisor.faults.fail("gate", times=4, tier="device")
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == gate_clean_placements()
+    assert core.supervisor.snapshot()["gate"]["circuits"]["device"]["state"] == "open"
+    core.supervisor.faults.clear()
+    time.sleep(opts.probe_interval_s + 0.05)
+    extra = make_sleep_pods(5, "app", queue="root.q", name_prefix="grec",
+                            cpu_milli=100)
+    names.update({p.uid: p.name for p in extra})
+    core.update_allocation(AllocationRequest(asks=asks_of(extra)))
+    core.schedule_once()
+    snap = core.supervisor.snapshot()
+    assert snap["gate"]["circuits"]["device"]["state"] == "closed"
+    assert snap["gate"]["tier"] == "device"
+    assert core.obs.get("gate_path_total").value(path="device") >= 1
+
+
+def test_encode_row_store_fault_falls_back_to_host_req():
+    """A failing device row-store sync (the supervised "encode" path) falls
+    back to the host req tensor for the cycle; placements unchanged."""
+    cache, core = make_core(config=GATE_YAML)
+    core.supervisor.faults.fail("encode", times=20)
+    names = {}
+    got = run_trace(core, two_waves(), names)
+    assert got == gate_clean_placements()

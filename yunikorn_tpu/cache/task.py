@@ -55,6 +55,15 @@ KILL_TASK = "KillTask"
 TASK_KILLED = "TaskKilled"
 TASK_REJECTED = "TaskRejected"
 TASK_FAIL = "TaskFail"
+TASK_RETRY = "TaskRetry"
+
+# bind attempts per task before the failure is treated as terminal: a bind
+# can race cluster state (the target node deleted between the core's commit
+# and the API bind — the node-remove-with-pods-in-flight scenario), and the
+# pod is still Pending and unassigned, so terminal-failing it strands a
+# schedulable pod forever. The cap keeps a persistently failing bind (API
+# rejecting the pod itself) from looping.
+BIND_RETRY_MAX = 5
 
 _TRANSITIONS = [
     Transition(INIT_TASK, [NEW], PENDING),
@@ -67,6 +76,9 @@ _TRANSITIONS = [
     Transition(TASK_KILLED, [KILLING], KILLED),
     Transition(TASK_REJECTED, [NEW, PENDING, SCHEDULING], REJECTED),
     Transition(TASK_FAIL, [NEW, PENDING, SCHEDULING, REJECTED, ALLOCATED], FAILED),
+    # bind failed against live cluster state (allocation already released):
+    # back to Pending, which re-submits a fresh ask on the next dispatch
+    Transition(TASK_RETRY, [ALLOCATED], PENDING),
 ]
 
 
@@ -96,6 +108,7 @@ class Task:
         self.created_time = pod.metadata.creation_timestamp
         self.scheduling_state = TaskSchedulingState.PENDING
         self.terminated_reason = ""
+        self.bind_retries = 0
         self._lock = locking.RMutex()
         self.fsm = FSM(NEW, _TRANSITIONS, {
             "enter_state": self._log_transition,
@@ -108,6 +121,7 @@ class Task:
             "before_" + COMPLETE_TASK: lambda e: self._before_completed(),
             "after_" + COMPLETE_TASK: lambda e: self._after_completed(),
             "before_" + TASK_FAIL: lambda e: self._before_fail(*e.args),
+            "before_" + TASK_RETRY: lambda e: self._before_retry(*e.args),
         })
 
     # ------------------------------------------------------------------ state
@@ -189,14 +203,15 @@ class Task:
                                       self.alias, self.node_name)
                 dispatch_mod.dispatch(TaskEventRecord(
                     self.application.application_id, self.task_id, TASK_BOUND))
-            except Exception as e:  # bind failure → release + fail
+            except Exception as e:  # bind failure → release + retry or fail
                 logger.exception("bind failed for %s", self.alias)
                 get_recorder().eventf("Pod", self.alias, "Warning", "PodBindFailure",
                                       "binding pod %s failed: %s", self.alias, e)
                 self.release_allocation(TerminationType.STOPPED_BY_RM, f"bind failure: {e}")
                 try:
                     dispatch_mod.dispatch(TaskEventRecord(
-                        self.application.application_id, self.task_id, TASK_FAIL, (str(e),)))
+                        self.application.application_id, self.task_id,
+                        self._bind_failure_event(), (str(e),)))
                 except Exception:
                     pass
 
@@ -247,6 +262,33 @@ class Task:
         if self.application.state == app_mod.RESUMING:
             dispatch_mod.dispatch(AppEventRecord(
                 self.application.application_id, app_mod.APP_TASK_COMPLETED))
+
+    def _bind_failure_event(self) -> str:
+        """Outcome of a failed bind: retry while the pod is still a live,
+        unassigned API object and the retry budget holds — the failure then
+        raced cluster state (node deleted mid-flight) rather than being
+        inherent to the pod — else terminal TASK_FAIL (the reference
+        behavior). The allocation was already released either way; a retry
+        walks Allocated → Pending, and Pending's entry hook re-submits a
+        fresh ask, so the next cycle re-places the pod on surviving nodes."""
+        self.bind_retries += 1
+        if self.bind_retries > BIND_RETRY_MAX:
+            return TASK_FAIL
+        # NOT guarded on is_assigned: the shim cache assumes the pod onto
+        # the target node before the bind (update_pod stamps node_name on
+        # the cached object), so the pod we just failed to bind always
+        # looks assigned here; the release above un-assumes it
+        pod = self.context.schedulers_cache.get_pod(self.task_id)
+        if pod is None or pod.is_terminated():
+            return TASK_FAIL
+        return TASK_RETRY
+
+    def _before_retry(self, reason: str = "") -> None:
+        logger.info("task %s: bind attempt %d failed (%s); re-queueing",
+                    self.alias, self.bind_retries, reason)
+        self.allocation_key = ""
+        self.node_name = ""
+        self.scheduling_state = TaskSchedulingState.PENDING
 
     def _before_fail(self, reason: str = "") -> None:
         self.terminated_reason = reason

@@ -60,6 +60,7 @@ CM_SOLVER_FALLBACK_ROUNDS = PREFIX_SOLVER + "localityFallbackRounds"
 CM_SOLVER_PIPELINE = PREFIX_SOLVER + "pipeline"         # auto | true | false
 CM_SOLVER_PREEMPT_DEVICE = PREFIX_SOLVER + "preemptDevice"  # auto | true | false
 CM_SOLVER_GATE = PREFIX_SOLVER + "gateVectorized"       # auto | true | false
+CM_SOLVER_GATE_DEVICE = PREFIX_SOLVER + "gateDevice"    # auto | true | false
 CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
 
 # observability.* keys (the obs/ registry + tracer)
@@ -137,6 +138,11 @@ class SchedulerConf:
     # admission as grouped prefix-scan arithmetic (core/gate.py), legacy
     # per-ask loop as fallback
     solver_gate: str = "auto"
+    # device-resident gate+encode ("auto" = on): the bounded-pass jitted
+    # admission scan (ops/gate_solve.py) as the gate's primary tier, with
+    # the host-vectorized scan and the legacy loop as the supervised
+    # degradation ladder, plus the DeviceRowStore req tensor for the solve
+    solver_gate_device: str = "auto"
     # differential gate oracle: run the legacy loop after every vectorized
     # gate and pin the results identical (doubles gate host cost; the
     # gate-equivalence test tier runs with this on)
@@ -288,7 +294,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
                       (CM_SOLVER_SHARD, "solver_shard"),
                       (CM_SOLVER_PIPELINE, "solver_pipeline"),
                       (CM_SOLVER_PREEMPT_DEVICE, "solver_preempt_device"),
-                      (CM_SOLVER_GATE, "solver_gate")):
+                      (CM_SOLVER_GATE, "solver_gate"),
+                      (CM_SOLVER_GATE_DEVICE, "solver_gate_device")):
         if key in data:
             v = data[key].strip().lower()
             if v in ("auto", "true", "false"):

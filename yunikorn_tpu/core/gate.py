@@ -51,6 +51,8 @@ arithmetic cannot represent (GateFallback).
 """
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -281,42 +283,81 @@ def _min_budget(cur: Optional[Dict[str, int]], mx: Dict[str, int],
     return out
 
 
-def vector_admit(by_queue: Dict[str, list], meta: Dict[str, tuple],
-                 queue_tree, seed_admissions=None) -> Tuple[list, int, dict]:
-    """Array-form replacement for the legacy gate's rank + admit phases.
+@dataclasses.dataclass
+class GateProblem:
+    """One cycle's admission decision, extracted into arrays.
 
-    Runs with the cyclic GC paused (restored on exit): the flatten/extract
+    The shared front end of the two scan back ends (the host numpy scan and
+    ops/gate_solve.py's jitted device scan): rank order, exact int64 budget
+    matrix, per-ask request rows over the tracked resource columns, and the
+    (tracker, ask, weight) membership rows sorted by (tracker, position).
+    Extraction is the only phase that touches Python scheduler objects; a
+    scan back end consumes arrays only, so the two can be tier-laddered by
+    the supervisor without re-walking the queue tree.
+    """
+    asks_ord: List                 # asks in the legacy global rank order
+    n: int                         # len(asks_ord)
+    status0: "np.ndarray"          # [n] int8 seed: 1 = tracker-less pre-admit
+    Rm: "np.ndarray"               # [n, K] int64 request rows (tracked cols)
+    B: "np.ndarray"                # [T, K] int64 budgets (_INF unconstrained)
+    mem_tr: "np.ndarray"           # [M] membership tracker id (sorted major)
+    mem_pos: "np.ndarray"          # [M] membership ask position (sorted minor)
+    mem_w: "np.ndarray"            # [M] legacy charge multiplicity
+    T: int                         # tracker count (0 = pure ranking)
+    K: int                         # tracked resource column count
+    t0: float = 0.0                # extraction start (perf_counter)
+    t_rank: float = 0.0            # rank phase end
+    t_extract: float = 0.0         # extraction end
+
+
+@contextlib.contextmanager
+def paused_gc():
+    """Cyclic GC paused (restored on exit): the gate's flatten/extract
     phase allocates ~10 tuples+lists per ask, and the collections those
     trigger traverse the scheduler's whole object graph — measured at up to
-    a third of the gate's wall time at 50k asks, all jitter.
-    """
+    a third of the gate's wall time at 50k asks, all jitter."""
     import gc
 
     was_enabled = gc.isenabled()
     if was_enabled:
         gc.disable()
     try:
-        return _vector_admit(by_queue, meta, queue_tree, seed_admissions)
+        yield
     finally:
         if was_enabled:
             gc.enable()
 
 
-def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
-    """vector_admit's body — see its docstring.
+def vector_admit(by_queue: Dict[str, list], meta: Dict[str, tuple],
+                 queue_tree, seed_admissions=None) -> Tuple[list, int, dict]:
+    """Array-form replacement for the legacy gate's rank + admit phases:
+    extract_problem + the host numpy scan (host_scan), GC paused."""
+    with paused_gc():
+        return host_scan(
+            extract_problem(by_queue, meta, queue_tree, seed_admissions))
+
+
+def extract_problem(by_queue, meta, queue_tree,
+                    seed_admissions=None) -> GateProblem:
+    """Flatten pending asks into a GateProblem — see GateProblem.
 
     by_queue: qname -> [(app, ask)] pending entries (exclude_keys already
     applied by the collector). meta: qname -> (leaf, fair_share, prio_adj)
     resolved by the caller (per-cycle cached). queue_tree: the live
     QueueTree (seed charging resolves queues the pending set may not name).
 
-    Returns (admitted asks in the legacy global order, held count, stats).
     Raises GateFallback when the cycle cannot be represented exactly.
     """
     t0 = time.perf_counter()
     qnames = list(by_queue)
     if not qnames:
-        return [], 0, {"path": "vector", "passes": 0, "trackers": 0}
+        return GateProblem(asks_ord=[], n=0, status0=np.empty(0, np.int8),
+                           Rm=np.empty((0, 1), np.int64),
+                           B=np.empty((0, 1), np.int64),
+                           mem_tr=np.empty(0, np.int64),
+                           mem_pos=np.empty(0, np.int64),
+                           mem_w=np.empty(0, np.int64),
+                           T=0, K=1, t0=t0, t_rank=t0, t_extract=t0)
     if sum(len(v) for v in by_queue.values()) > _MAX_ASKS:
         raise GateFallback(
             f"batch exceeds the exact-arithmetic ceiling of {_MAX_ASKS} asks")
@@ -430,10 +471,15 @@ def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
     T = len(trackers.budgets)
     if T == 0:
         # no quota, no limits anywhere near the pending set: pure ranking
-        return (asks_ord, 0,
-                {"path": "vector", "passes": 0, "trackers": 0,
-                 "rank_ms": (t_rank - t0) * 1000,
-                 "admit_ms": (time.perf_counter() - t_rank) * 1000})
+        return GateProblem(asks_ord=asks_ord, n=n,
+                           status0=np.ones((n,), np.int8),
+                           Rm=np.empty((0, 1), np.int64),
+                           B=np.empty((0, 1), np.int64),
+                           mem_tr=np.empty(0, np.int64),
+                           mem_pos=np.empty(0, np.int64),
+                           mem_w=np.empty(0, np.int64),
+                           T=0, K=1, t0=t0, t_rank=t_rank,
+                           t_extract=time.perf_counter())
 
     B = trackers.matrix()
     K = B.shape[1]
@@ -515,9 +561,54 @@ def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
     else:
         mem_tr = mem_pos = mem_w = np.empty(0, np.int64)
 
+    status0 = np.zeros((n,), np.int8)   # 0 undecided, 1 admitted, -1 held
+    status0[combo_arr < 0] = 1          # tracker-less asks always admit
+    return GateProblem(asks_ord=asks_ord, n=n, status0=status0, Rm=Rm, B=B,
+                       mem_tr=mem_tr, mem_pos=mem_pos, mem_w=mem_w,
+                       T=T, K=K, t0=t0, t_rank=t_rank,
+                       t_extract=time.perf_counter())
+
+
+def _segments(mt: "np.ndarray") -> Tuple["np.ndarray", "np.ndarray"]:
+    """(seg_start, seg_of) for tracker-major membership rows: the first row
+    index of each tracker segment, and each row's segment ordinal."""
+    seg_start = np.flatnonzero(np.r_[True, mt[1:] != mt[:-1]])
+    seg_len = np.diff(np.r_[seg_start, mt.size])
+    return seg_start, np.repeat(np.arange(seg_start.size), seg_len)
+
+
+def _seg_excl_cumsum(X: "np.ndarray", seg_start, seg_of) -> "np.ndarray":
+    """Segmented EXCLUSIVE cumsum of [M, K] rows, in place on a fresh
+    array: cs becomes sum of the rows strictly before each row within its
+    segment (segment 0 always starts at row 0, so only its offset needs
+    zeroing). Callers may keep mutating the returned array."""
+    cs = np.cumsum(X, axis=0)
+    offset = cs[np.maximum(seg_start - 1, 0)]
+    offset[0] = 0
+    cs -= offset[seg_of]
+    cs -= X
+    return cs
+
+
+def host_scan(problem: GateProblem) -> Tuple[list, int, dict]:
+    """The host numpy scan back end: iterative one-sided-overestimate passes
+    over a GateProblem, compacting the membership arrays between passes.
+    Returns (admitted asks in global order, held count, stats)."""
+    n, T = problem.n, problem.T
+    if n == 0:
+        return [], 0, {"path": "vector", "passes": 0, "trackers": 0}
+    asks_ord = problem.asks_ord
+    t0, t_rank = problem.t0, problem.t_rank
+    if T == 0:
+        return (asks_ord, 0,
+                {"path": "vector", "passes": 0, "trackers": 0,
+                 "rank_ms": (t_rank - t0) * 1000,
+                 "admit_ms": (time.perf_counter() - t_rank) * 1000})
+    Rm, B, K = problem.Rm, problem.B, problem.K
+    mem_tr, mem_pos, mem_w = problem.mem_tr, problem.mem_pos, problem.mem_w
+
     # ---- iterative vectorized admission
-    status = np.zeros((n,), np.int8)    # 0 undecided, 1 admitted, -1 held
-    status[combo_arr < 0] = 1           # tracker-less asks always admit
+    status = problem.status0.copy()
     # live membership view, compacted to undecided rows between passes: pass
     # 1 touches everything, later passes only the deferred remainder. `pre`
     # carries, per surviving row, the EXACT weighted usage of the already-
@@ -540,16 +631,9 @@ def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
         # within limit" test (every undecided predecessor counted, a
         # superset of the truly-admitted ones), and one-sided: passing it
         # proves the exact check passes
-        cs = np.cumsum(req, axis=0)
-        seg_start = np.flatnonzero(np.r_[True, mt[1:] != mt[:-1]])
-        seg_len = np.diff(np.r_[seg_start, mt.size])
-        seg_of = np.repeat(np.arange(seg_start.size), seg_len)
-        # segment 0 always starts at row 0, so only its offset needs zeroing
-        offset = cs[np.maximum(seg_start - 1, 0)]
-        offset[0] = 0
-        # in-place: cs becomes the exclusive prefix, then the full check sum
-        cs -= offset[seg_of]
-        cs -= req                       # undecided usage BEFORE this row
+        seg_start, seg_of = _segments(mt)
+        # in-place: cs is the exclusive prefix, then the full check sum
+        cs = _seg_excl_cumsum(req, seg_start, seg_of)
         cs += pre
         cs += rrow
         row_viol = (cs > bm).any(axis=1)
@@ -573,13 +657,8 @@ def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
         # bake this pass's admissions into the surviving rows' prefixes:
         # segmented exclusive cumsum over admitted rows only (a deferred
         # row's own contribution is zero, so inclusive == exclusive there)
-        req_adm = req * adm_rows[:, None]
-        cs2 = np.cumsum(req_adm, axis=0)
-        off2 = cs2[np.maximum(seg_start - 1, 0)]
-        off2[0] = 0
-        cs2 -= off2[seg_of]
-        cs2 -= req_adm
-        pre = pre + cs2
+        pre = pre + _seg_excl_cumsum(req * adm_rows[:, None],
+                                     seg_start, seg_of)
         # definite-hold sweep over the deferred remainder: admitted usage
         # before a row only grows across passes, so an ask whose own
         # request no longer fits on some tracker can never admit
@@ -593,12 +672,33 @@ def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
         pre, rrow, req, bm = pre[und], rrow[und], req[und], bm[und]
 
     # pathological non-convergence: exact per-ask finish over the leftovers
-    # (pre holds each surviving row's admitted-predecessor usage; `extra`
-    # accumulates usage admitted DURING this finish per tracker — together
-    # they ARE the legacy accumulators)
+    finish = exact_finish(problem, status, mt, mp, mw, pre)
+
+    admitted = [asks_ord[pos] for pos in np.flatnonzero(status == 1).tolist()]
+    held = int((status == -1).sum())
+    t_end = time.perf_counter()
+    return admitted, held, {
+        "path": "vector", "passes": passes, "trackers": T,
+        "finish_loop": finish,
+        "rank_ms": (t_rank - t0) * 1000,
+        "admit_ms": (t_end - t_rank) * 1000,
+    }
+
+
+def exact_finish(problem: GateProblem, status, mt, mp, mw, pre) -> int:
+    """Exact per-ask finish over the undecided leftovers, in ask order.
+
+    mt/mp/mw are the COMPACTED membership rows still live (undecided asks
+    only, tracker-major), `pre` their admitted-predecessor usage — the
+    sequential loop's accumulator state baked per row. `extra` accumulates
+    usage admitted DURING this finish per tracker — together they ARE the
+    legacy accumulators. Mutates `status` in place; returns the number of
+    asks finished this way (0 on the common converged case).
+    """
     finish = np.flatnonzero(status == 0)
     if finish.size:
-        extra = np.zeros((T, K), np.int64)
+        Rm, B = problem.Rm, problem.B
+        extra = np.zeros((problem.T, problem.K), np.int64)
         for pos in finish.tolist():
             rows_i = np.flatnonzero(mp == pos)
             tl = mt[rows_i]
@@ -608,13 +708,22 @@ def _vector_admit(by_queue, meta, queue_tree, seed_admissions=None):
             else:
                 np.add.at(extra, tl, row[None, :] * mw[rows_i][:, None])
                 status[pos] = 1
+    return int(finish.size)
 
-    admitted = [asks_ord[pos] for pos in np.flatnonzero(status == 1).tolist()]
-    held = int((status == -1).sum())
-    t_end = time.perf_counter()
-    return admitted, held, {
-        "path": "vector", "passes": passes, "trackers": T,
-        "finish_loop": int(finish.size),
-        "rank_ms": (t_rank - t0) * 1000,
-        "admit_ms": (t_end - t_rank) * 1000,
-    }
+
+def finish_leftovers(problem: GateProblem, status) -> int:
+    """Exact finish for a scan that returned undecided leftovers WITHOUT the
+    compacted prefix state (the device scan's bounded-pass cap overflow):
+    rebuild each undecided row's admitted-predecessor usage with one
+    segmented pass over the full membership arrays, then run exact_finish.
+    O(M·K) once plus O(leftovers) — leftovers are rare by construction.
+    Mutates `status` in place; returns the finished-ask count."""
+    if not (status == 0).any():
+        return 0
+    mt, mp, mw = problem.mem_tr, problem.mem_pos, problem.mem_w
+    reqw = problem.Rm[mp] * mw[:, None]
+    # admitted usage strictly BEFORE each row, within its tracker segment
+    pre = _seg_excl_cumsum(reqw * (status[mp] == 1)[:, None],
+                           *_segments(mt))
+    und = status[mp] == 0
+    return exact_finish(problem, status, mt[und], mp[und], mw[und], pre[und])

@@ -68,6 +68,7 @@ from yunikorn_tpu.core.partition import (
     CoreNode,
     Partition,
 )
+from yunikorn_tpu.core import gate as gate_mod
 from yunikorn_tpu.core.gate import GateFallback, legacy_admit, vector_admit
 from yunikorn_tpu.core.queues import QueueTree, parse_queues_yaml
 from yunikorn_tpu.log.logger import log
@@ -84,6 +85,7 @@ from yunikorn_tpu.robustness.health import HealthMonitor, solver_source
 from yunikorn_tpu.robustness.supervisor import (
     ASSIGN_LADDER,
     AbandonedDispatch,
+    DeadlineExceeded,
     SupervisedExecutor,
     SupervisorOptions,
 )
@@ -143,6 +145,12 @@ class SolverOptions:
     # cycles the exact int64 arithmetic cannot represent. Tri-state: None =
     # "auto" = on.
     gate_vector: Optional[bool] = None
+    # device-resident gate+encode pipeline (solver.gateDevice): the
+    # bounded-pass jitted admission scan (ops/gate_solve.py) as the gate's
+    # primary tier — supervised ladder device → host-vectorized → legacy —
+    # plus the DeviceRowStore req tensor (O(changed asks) upload + device
+    # gather) feeding the solve. Tri-state: None = "auto" = on.
+    gate_device: Optional[bool] = None
     # differential oracle (solver.gateVerify): run the legacy loop after
     # every vectorized gate and pin the results identical — a mismatch
     # counts gate_mismatch_total and the legacy result wins. Doubles the
@@ -170,6 +178,8 @@ class SolverOptions:
             preempt_device=tri.get(
                 getattr(conf, "solver_preempt_device", "auto"), None),
             gate_vector=tri.get(getattr(conf, "solver_gate", "auto"), None),
+            gate_device=tri.get(
+                getattr(conf, "solver_gate_device", "auto"), None),
             gate_verify=str(getattr(conf, "solver_gate_verify",
                                     "false")).lower() == "true",
         )
@@ -192,6 +202,8 @@ class _PipelineCycle:
     gate_stats: dict = dataclasses.field(default_factory=dict)
     encode_rows: int = 0
     encode_reencoded: int = 0
+    # device row-store upload accounting captured at prepare (rows/bytes)
+    encode_device: dict = dataclasses.field(default_factory=dict)
     t_prepare_start: float = 0.0
     t_gate: float = 0.0
     t_encode_end: float = 0.0
@@ -373,12 +385,13 @@ class CoreScheduler(SchedulerAPI):
             "unschedulable_total",
             "unplaced-ask attempts by reason (one count per cycle the ask "
             "stays unplaced)", labelnames=("reason",))
-        # ---- array-form admission gate (round 10) ----
+        # ---- array-form admission gate (rounds 10/11) ----
         self._m_gate_path = m.counter(
             "gate_path_total",
-            "admission-gate executions by path (vector = array-form "
-            "prefix-scan admission, legacy = per-ask loop, fallback = "
-            "vector raised GateFallback and the legacy loop ran)",
+            "admission-gate executions by path (device = bounded-pass "
+            "jitted scan, vector = host array-form prefix-scan admission, "
+            "legacy = per-ask loop, fallback = extraction raised "
+            "GateFallback and the legacy loop ran)",
             labelnames=("path",))
         self._m_gate_mismatch = m.counter(
             "gate_mismatch_total",
@@ -387,11 +400,20 @@ class CoreScheduler(SchedulerAPI):
         self._m_gate_stage = m.histogram(
             "gate_stage_ms",
             "admission-gate sub-stage latency (rank = lexsort ranking, "
-            "admit = prefix-scan / per-ask-loop admission)",
+            "admit = prefix-scan / per-ask-loop admission, encode = "
+            "device row-store sync + req gather)",
             labelnames=("stage",), buckets=MS_BUCKETS)
+        self._m_gate_passes = m.counter(
+            "gate_passes_total",
+            "admission-scan passes executed across cycles (device scan or "
+            "host vectorized; the device pass count is bounded by "
+            "ceil(log2(n))+C by construction)")
         # stats of the most recent gate pass (path, passes, sub-stage ms);
         # ride the cycle entry and the gate tracer span
         self._last_gate_stats: dict = {}
+        # device row-store upload accounting of the most recent encode
+        # (rows/bytes actually shipped — the O(changed) transfer contract)
+        self._last_encode_device: dict = {}
         # per-cycle queue-meta cache: (key, {qname: (leaf, share, adj)}) —
         # leaf resolution, DRF dominant share and priority adjustment are
         # pure functions of the tree's accounting epoch + cluster capacity
@@ -1645,9 +1667,10 @@ class CoreScheduler(SchedulerAPI):
             inflight_placed = self._inflight_placements()
             batch = self.encoder.build_batch_cached(admitted, ranks=ranks,
                                                     extra_placed=inflight_placed)
+            self._resolve_solver_runtime()
+            self._attach_device_req(admitted, batch)
             t_encode = time.time()
             policy = self._policy_for_partition()
-            self._resolve_solver_runtime()
             handle = self._solve_dispatch(admitted, batch, policy, overlay,
                                           node_mask, inflight_ports)
             # materializing the result is the device sync point: everything
@@ -1700,6 +1723,9 @@ class CoreScheduler(SchedulerAPI):
                 "encode_rows": self.encoder.last_encode_rows,
                 "encode_reencoded": self.encoder.last_encode_rows_reencoded,
             }
+            if self._last_encode_device:
+                entry["encode_device_rows"] = self._last_encode_device["rows"]
+                entry["encode_device_bytes"] = self._last_encode_device["bytes"]
             entry.update(_gate_extras(self._last_gate_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -1812,6 +1838,8 @@ class CoreScheduler(SchedulerAPI):
             self.encoder.sync_nodes()
             batch = self.encoder.build_batch_cached(
                 admitted, ranks=ranks, extra_placed=inflight_placed)
+            self._resolve_solver_runtime_locked()
+            self._attach_device_req(admitted, batch)
             self._cycle_seq += 1
             cyc = _PipelineCycle(
                 cycle_id=self._cycle_seq, admitted=admitted, ranks=ranks,
@@ -1822,6 +1850,7 @@ class CoreScheduler(SchedulerAPI):
                 gate_stats=dict(self._last_gate_stats),
                 encode_rows=self.encoder.last_encode_rows,
                 encode_reencoded=self.encoder.last_encode_rows_reencoded,
+                encode_device=dict(self._last_encode_device),
                 t_prepare_start=t0, t_gate=t_gate, t_encode_end=time.time())
             self.tracer.add("gate", cyc.cycle_id, t0, t_gate,
                             pods=len(admitted), **_gate_extras(cyc.gate_stats))
@@ -1981,6 +2010,9 @@ class CoreScheduler(SchedulerAPI):
                 "overlap_ms": round(overlap_ms, 2),
                 "overlap_ratio": round(overlap_ms / max(solve_ms, 1e-6), 3),
             }
+            if cyc.encode_device:
+                entry["encode_device_rows"] = cyc.encode_device["rows"]
+                entry["encode_device_bytes"] = cyc.encode_device["bytes"]
             entry.update(_gate_extras(cyc.gate_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -2302,12 +2334,16 @@ class CoreScheduler(SchedulerAPI):
         in-cycle admissions — conservatively reproducing the queue usage the
         sequential order would have committed before this gate.
 
-        Two interchangeable admission paths (core/gate.py): the array-form
-        vectorized pass (default — one lexsort + grouped prefix-scan
-        admission) and the legacy per-ask loop (fallback for GateFallback
-        cycles, forced by solver.gateVectorized=false, and the verify mode's
-        differential oracle). Both are pure w.r.t. queue-tree state, so the
-        verify mode can run them back to back on the same cycle.
+        Three admission paths, tier-laddered when the device pipeline is on
+        (supervised path "gate": device → cpu → host, i.e. the bounded-pass
+        jitted scan (ops/gate_solve.py), the host array-form scan, the
+        legacy per-ask loop): all three consume the same extracted
+        GateProblem, so a degraded tier re-decides the exact same cycle.
+        GateFallback (quantities the exact int64 arithmetic cannot
+        represent) is raised at extraction, before any tier runs — the
+        legacy loop is the authority for those cycles. All paths are pure
+        w.r.t. queue-tree state, so the verify mode can run the legacy
+        oracle after any of them on the same cycle.
         """
         t0 = time.perf_counter()
         cluster_cap = self._cluster_capacity()
@@ -2328,26 +2364,52 @@ class CoreScheduler(SchedulerAPI):
         admitted: Optional[List[object]] = None
         held = 0
         stats: dict = {}
-        if self.solver.gate_vector is not False:
+        use_device = self._gate_device_on()
+        use_vector = self.solver.gate_vector is not False
+        problem = None
+        if use_device or use_vector:
             try:
-                admitted, held, stats = vector_admit(by_queue, meta,
-                                                     self.queues,
-                                                     seed_admissions)
-                self._m_gate_path.inc(path="vector")
+                with gate_mod.paused_gc():
+                    problem = gate_mod.extract_problem(by_queue, meta,
+                                                       self.queues,
+                                                       seed_admissions)
             except GateFallback as e:
                 # the cycle's quantities exceed the gate's exact int64 range
                 # (or the batch its size ceiling): the loop is the authority
-                logger.warning("vectorized gate fell back to the legacy "
+                logger.warning("array gate fell back to the legacy "
                                "loop: %s", e)
                 self._m_gate_path.inc(path="fallback")
                 stats = {"path": "legacy", "fallback": str(e)}
+        if problem is not None and use_device:
+            from yunikorn_tpu.ops import gate_solve
+
+            def legacy_tier():
+                adm, h = legacy_admit(by_queue, meta, self.queues,
+                                      seed_admissions)
+                return adm, h, {"path": "legacy"}
+
+            tiers = [("device", lambda: gate_solve.device_admit(problem))]
+            if use_vector:
+                tiers.append(("cpu", lambda: gate_mod.host_scan(problem)))
+            tiers.append(("host", legacy_tier))
+            jc0 = gate_solve.jit_cache_entries()
+            (admitted, held, stats), tier = self.supervisor.execute(
+                "gate", tiers)
+            jc1 = gate_solve.jit_cache_entries()
+            if tier == "device" and jc0 >= 0 and jc1 > jc0:
+                stats = dict(stats, compiled=True)
+            self._m_gate_path.inc(path={"device": "device", "cpu": "vector",
+                                        "host": "legacy"}[tier])
+        elif problem is not None and use_vector:
+            admitted, held, stats = gate_mod.host_scan(problem)
+            self._m_gate_path.inc(path="vector")
         if admitted is None:
             if not stats:
                 self._m_gate_path.inc(path="legacy")
                 stats = {"path": "legacy"}
             admitted, held = legacy_admit(by_queue, meta, self.queues,
                                           seed_admissions)
-        elif self.solver.gate_verify:
+        elif self.solver.gate_verify and stats.get("path") != "legacy":
             ref_admitted, ref_held = legacy_admit(by_queue, meta, self.queues,
                                                   seed_admissions)
             if (ref_held != held
@@ -2364,10 +2426,54 @@ class CoreScheduler(SchedulerAPI):
         for k in ("rank_ms", "admit_ms"):
             if k in stats:
                 self._m_gate_stage.observe(stats[k], stage=k[:-3])
+        if stats.get("passes"):
+            self._m_gate_passes.inc(int(stats["passes"]))
         stats["gate_total_ms"] = round((time.perf_counter() - t0) * 1000, 3)
         self._last_gate_stats = stats
         ranks = list(range(len(admitted)))
         return admitted, ranks, held
+
+    def _gate_device_on(self) -> bool:
+        """Tri-state solver.gateDevice resolved: auto = on (the supervisor
+        ladder degrades to the host scans whenever the backend misbehaves,
+        so auto does not need to probe the platform up front)."""
+        return self.solver.gate_device is not False
+
+    def _attach_device_req(self, admitted, batch) -> None:
+        """Attach the device-resident req tensor (DeviceRowStore gather) to
+        a built batch: a churn cycle then uploads only changed rows + an
+        int32 slot index instead of the whole [N, R] req tensor, and the
+        solve's pod requests never leave the device. Single-device path
+        only (the mesh path replicates host arrays); supervised under the
+        "encode" path so a wedged device op degrades to the host req
+        instead of hanging the cycle."""
+        batch.req_device = None
+        self._last_encode_device = {}
+        if not self._gate_device_on() or self._mesh is not None:
+            return
+        if not self.supervisor.allow("encode"):
+            return  # circuit open: host req until a probe re-closes it
+        t0 = time.perf_counter()
+        try:
+            batch.req_device = self.supervisor.run(
+                "encode", lambda: self.encoder.device_req(admitted, batch))
+        except DeadlineExceeded:
+            # the zombie may still assign into the store when it unwedges:
+            # orphan it (the successor starts cold, one full re-upload)
+            self.encoder.row_store = None
+            return
+        except Exception:
+            logger.exception("device req-row sync failed; host req this "
+                             "cycle")
+            return
+        self._m_gate_stage.observe((time.perf_counter() - t0) * 1000,
+                                   stage="encode")
+        store = self.encoder.row_store
+        if store is not None:
+            self._last_encode_device = {
+                "rows": store.last_upload_rows,
+                "bytes": store.last_upload_bytes,
+            }
 
     def _gate_queue_meta(self, by_queue, cluster_cap: Resource) -> Dict[str, tuple]:
         """qname -> (leaf, dominant_share, priority_adjustment), cached.
@@ -2794,7 +2900,11 @@ def _gate_extras(stats: dict) -> dict:
     for src, dst in (("path", "gate_path"), ("rank_ms", "gate_rank_ms"),
                      ("admit_ms", "gate_admit_ms"), ("passes", "gate_passes"),
                      ("trackers", "gate_trackers"),
-                     ("finish_loop", "gate_finish_loop")):
+                     ("finish_loop", "gate_finish_loop"),
+                     ("device_ms", "gate_device_ms"),
+                     ("max_passes", "gate_max_passes"),
+                     ("transfer_bytes", "gate_transfer_bytes"),
+                     ("compiled", "gate_compiled")):
         if src in stats:
             v = stats[src]
             out[dst] = round(v, 3) if isinstance(v, float) else v

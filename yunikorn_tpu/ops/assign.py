@@ -1057,7 +1057,8 @@ def pad2d(arr, width, fill):
 
 
 def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
-                       ports_delta=None, device_state=None):
+                       ports_delta=None, device_state=None,
+                       allow_req_device=True):
     """Assemble the positional numpy args + static kwargs for `solve`.
 
     Shared by solve_batch (single device) and parallel.mesh.solve_sharded
@@ -1083,7 +1084,18 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
     na = node_arrays
     g_ports_u32 = batch.g_ports.view(np.uint32)
     use_device = device_state is not None and not g_ports_u32.any()
-    req_i = batch.req.astype(np.int32)
+    # device-resident req (DeviceRowStore gather, values pinned identical
+    # to req.astype(int32)): skips the per-cycle [N, R] host upload — with
+    # the O(changed) row-store uploads, a churn cycle's pod-request
+    # transfer is changed rows + an int32 index, not the whole tensor.
+    # Only on the persistent-device-state path: the host/port paths below
+    # concatenate and fancy-index req on the host.
+    req_dev = getattr(batch, "req_device", None) if allow_req_device else None
+    if use_device and req_dev is not None \
+            and tuple(req_dev.shape) == batch.req.shape:
+        req_i = req_dev
+    else:
+        req_i = batch.req.astype(np.int32)
     score_cols = req_i.shape[1]
     if use_device:
         import jax.numpy as jnp
@@ -1256,9 +1268,13 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     (solve_chunked: lax.scan over rank-ordered [max_batch]-pod slices with
     capacity + locality-count carry) — see MAX_SOLVE_PODS.
     """
+    mb = 1 << (max(int(max_batch), 64).bit_length() - 1)
     np_args, static_kwargs = prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
-        ports_delta=ports_delta, device_state=device_state)
+        ports_delta=ports_delta, device_state=device_state,
+        # the chunked path rank-sorts pod args on the host — a device req
+        # there would bounce device→host→device; use the host rows
+        allow_req_device=batch.req.shape[0] <= mb)
     solve_kwargs = dict(
         max_rounds=max_rounds,
         chunk=chunk,
@@ -1272,7 +1288,6 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         **static_kwargs,
     )
     N = np_args[0].shape[0]
-    mb = 1 << (max(int(max_batch), 64).bit_length() - 1)
     if N > mb:
         # N and mb are both powers of two (encoder bucket / rounding above):
         # one compiled lax.scan program over [mb]-pod rank-ordered slices

@@ -1,0 +1,333 @@
+"""Device-resident admission gate: the bounded-pass scan on XLA.
+
+The host vectorized gate (core/gate.py host_scan) already reformulated the
+legacy per-ask admission walk into segmented prefix-scan passes, but two
+costs remained on the host thread: the passes themselves (numpy, GIL-bound,
+serial with everything else the scheduler does) and their DATA-DEPENDENT
+count — the adversarial 85%-held saturated trace degrades to ~13 passes
+(docs/PERF.md round-10), because each pass finalizes only the violators
+whose prefixes are provably exact and defers the chain behind them.
+
+This module moves the scan into ONE jitted XLA program with a pass budget
+that is bounded BY CONSTRUCTION:
+
+  max_passes = ceil(log2(n_pad)) + GATE_PASS_SLACK
+
+a `lax.while_loop` whose trip count can never exceed that bound, whatever
+the trace looks like (the CvxCluster/POP playbook: replace data-dependent
+sequential control flow with a fixed-shape parallel program). Real traces
+converge well inside the bound (the saturated 50k trace needs ~13 < 16+4);
+an adversarial trace that does not leaves a (tiny) undecided remainder that
+`core/gate.finish_leftovers` decides exactly on the host — O(leftovers)
+work, and the differential oracle pins the result identical to the host
+scan and transitively to the legacy loop either way.
+
+Formulation notes (why this is not a transliteration of host_scan):
+
+- *No scatters.* XLA:CPU lowers `.at[].max/min` scatters an order of
+  magnitude slower than gathers; every ask-level aggregation ("does any of
+  this ask's rows violate?") instead runs as a segmented 1-D cumsum over a
+  PRECOMPUTED ask-sorted permutation of the membership rows, broadcast back
+  with pure gathers. Status lives at ROW granularity inside the kernel (all
+  rows of an ask always agree); the [n] ask vector is reassembled on the
+  host from one numpy scatter after materialization.
+- *No compaction.* The host scan shrinks its arrays between passes; the
+  device program keeps fixed shapes and masks decided rows — that is what
+  makes it one compile per bucket.
+- *Host-exact pass ordering.* Two segmented [M, K] cumsums per pass — the
+  admitted-only prefix right after this pass's admissions (feeding the
+  definite-hold sweep, host_scan's exact rule order: a stale sweep was
+  measured to nearly double the pass count on the saturated shape) and the
+  not-held prefix after the sweep (feeding the next pass's violator test,
+  which the first-violator hold rule's exactness proof requires to exclude
+  every held row).
+- *Narrowest exact dtype per cycle.* The scan runs in int32 whenever the
+  cycle's worst-case prefix sum and budget magnitudes provably fit (checked
+  against the exact per-column sums before upload — the same discipline as
+  core/gate's _check_magnitude ceilings), int64 otherwise; most traces
+  except raw memory-bytes columns fit int32, which halves the kernel's
+  memory traffic.
+
+Exactness: the gate's arithmetic is EXACT integer (budgets up to 2^61, see
+core/gate.py's caps) — the int64 variant runs under
+jax.experimental.enable_x64 (thread-local; the f32 assignment solve in the
+same process is untouched).
+
+Semantics pinned bit-identical to host_scan (same admitted set, order, held
+count) by tests/test_gate_device.py across randomized trees/limits/gang and
+pipelined seed/exclude traces — the same differential-oracle pattern
+(gateVerify) that pinned the host scan to the legacy loop.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+
+import jax
+import numpy as np
+
+from yunikorn_tpu.core import gate as gate_mod
+from yunikorn_tpu.snapshot.vocab import _next_pow2
+
+# extra passes past ceil(log2(n_pad)): covers the small non-logarithmic tail
+# real traces show (the saturated 50k shape converges in ~13-14 where
+# ceil(log2(65536)) alone allows 16)
+GATE_PASS_SLACK = 4
+
+_INF = gate_mod._INF
+
+
+def max_passes_for(n: int) -> int:
+    """The pass budget for a batch of n asks (after bucketing): the bound
+    the acceptance criterion and gate_bench assert against."""
+    n_pad = _next_pow2(max(n, 1), 256)
+    return max(int(math.ceil(math.log2(n_pad))), 1) + GATE_PASS_SLACK
+
+
+@functools.partial(jax.jit, static_argnames=("max_passes",))
+def _gate_scan(reqw, bm2, rstatus0, seg_first_t, perm_a, inv_perm_a,
+               seg_first_a, seg_last_a, *, max_passes: int):
+    """Masked bounded-pass admission over padded row-level shapes.
+
+    reqw      [M, K] int64  weighted charge per membership row (0 on pads)
+    bm2       [M, K] int64  budget-minus-own-request per row (_INF-ish pads)
+    rstatus0  [M] int8      0 undecided / 1 decided (pads)
+    seg_first_t [M] int32   first row index of this row's TRACKER segment
+    perm_a / inv_perm_a / seg_first_a / seg_last_a [M] int32
+                            ask-sorted view: permutation, its inverse, and
+                            per-row first/last index of the row's ASK
+                            segment within the sorted view
+
+    Returns (rstatus [M] int8 with -1 = held, passes int32).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def seg_excl(mask):
+        """Segmented EXCLUSIVE cumsum of the rows' weighted charges where
+        `mask` holds ([M] bool → [M, K])."""
+        X = jnp.where(mask[:, None], reqw, 0)
+        base = jnp.cumsum(X, axis=0) - X
+        return base - base[seg_first_t]
+
+    def ask_any(flag):
+        """Broadcast per-ask OR of a row-level flag back to rows: segmented
+        count over the ask-sorted view, pure gathers + one 1-D cumsum."""
+        s = flag.astype(jnp.int32)[perm_a]
+        cs = jnp.cumsum(s)
+        tot = cs[seg_last_a] - cs[seg_first_a] + s[seg_first_a]
+        return tot[inv_perm_a] > 0
+
+    def body(carry):
+        rstatus, ov, passes = carry
+        undec = rstatus == 0
+        # violator: the one-sided over-estimate check (charges of every
+        # not-yet-held predecessor + own row) fails in any tracker
+        row_viol = undec & jnp.any(ov > bm2, axis=1)
+        va = ask_any(row_viol)
+        # every undecided non-violator admits
+        rstatus = jnp.where(undec & ~va, jnp.int8(1), rstatus)
+        # a violator holds iff NO earlier (ask-level) violator shares any
+        # tracker — its prefix is then exact; otherwise defer: the earlier
+        # violator's removal could free budget
+        vr = (va & undec).astype(jnp.int32)
+        cs = jnp.cumsum(vr) - vr
+        blocked_row = undec & ((cs - cs[seg_first_t]) > 0)
+        ba = ask_any(blocked_row)
+        rstatus = jnp.where(undec & va & ~ba, jnp.int8(-1), rstatus)
+        # definite-hold sweep against the admitted prefix INCLUDING this
+        # pass's admissions (host_scan's exact rule order): admitted usage
+        # only grows, so an ask whose own request no longer fits can never
+        # admit
+        ad = seg_excl(rstatus == jnp.int8(1))
+        live = rstatus == 0
+        sa = ask_any(live & jnp.any(ad > bm2, axis=1))
+        rstatus = jnp.where(live & sa, jnp.int8(-1), rstatus)
+        # next pass's over-estimate excludes every hold this pass took —
+        # the first-violator rule's exactness proof needs that
+        return rstatus, seg_excl(rstatus != jnp.int8(-1)), passes + 1
+
+    def cond(carry):
+        rstatus, _ov, passes = carry
+        return (passes < max_passes) & jnp.any(rstatus == 0)
+
+    rstatus, _ov, passes = lax.while_loop(
+        cond, body,
+        (rstatus0, seg_excl(rstatus0 != jnp.int8(-1)), jnp.int32(0)))
+    return rstatus, passes
+
+
+def _ask_view(mem_pos: np.ndarray, M_pad: int):
+    """Static index arrays for the kernel's ask-sorted aggregation view.
+
+    Rows arrive (tracker, pos)-sorted; the stable argsort by ask position
+    makes each ask's rows contiguous. Padded rows keep their identity slots
+    (each its own segment)."""
+    M = mem_pos.shape[0]
+    perm = np.arange(M_pad, dtype=np.int64)
+    perm[:M] = np.argsort(mem_pos, kind="stable")
+    sorted_pos = np.full((M_pad,), -1, np.int64)
+    sorted_pos[:M] = mem_pos[perm[:M]]
+    # mark pads as distinct pseudo-asks so segments never span the boundary
+    if M_pad > M:
+        sorted_pos[M:] = -np.arange(2, M_pad - M + 2)
+    is_start = np.r_[True, sorted_pos[1:] != sorted_pos[:-1]]
+    idx = np.arange(M_pad)
+    seg_first = np.maximum.accumulate(np.where(is_start, idx, 0))
+    seg_last = np.full((M_pad,), M_pad - 1, np.int64)
+    seg_last[:-1] = np.where(is_start[1:], idx[:-1], M_pad - 1)
+    np.minimum.accumulate(seg_last[::-1], out=seg_last[::-1])
+    inv = np.empty((M_pad,), np.int64)
+    inv[perm] = idx
+    return (perm.astype(np.int32), inv.astype(np.int32),
+            seg_first.astype(np.int32), seg_last.astype(np.int32))
+
+
+def device_admit(problem: "gate_mod.GateProblem", *, backend=None):
+    """Run a GateProblem through the jitted bounded-pass scan.
+
+    Pads every dimension to power-of-two buckets (one compile per bucket
+    combination), executes under enable_x64 (exact int64 arithmetic), pulls
+    back the [M] int8 row-status vector, and finishes any undecided
+    leftovers exactly on the host. Returns (admitted, held, stats) with the
+    same contract as core/gate.host_scan; stats["path"] == "device".
+    """
+    import jax.numpy as jnp
+    from jax.experimental import enable_x64
+
+    n, T = problem.n, problem.T
+    t_start = time.perf_counter()
+    if n == 0:
+        return [], 0, {"path": "device", "passes": 0, "trackers": 0}
+    base_stats = {
+        "path": "device", "trackers": T,
+        "rank_ms": (problem.t_rank - problem.t0) * 1000,
+    }
+    M = problem.mem_tr.size
+    if T == 0 or M == 0:
+        # pure ranking (no quota/limits near the pending set, or every ask
+        # tracker-less): nothing to scan on any backend
+        admitted = [problem.asks_ord[pos]
+                    for pos in np.flatnonzero(problem.status0 == 1).tolist()]
+        return admitted, 0, dict(
+            base_stats, passes=0,
+            admit_ms=(time.perf_counter() - problem.t_rank) * 1000)
+
+    M_pad = _next_pow2(M, 256)
+    K = problem.K
+    K_pad = _next_pow2(K, 2)
+    max_passes = max_passes_for(n)
+
+    # loop-invariant row tensors, gathered on the host once per cycle
+    # (numpy fancy-indexing; the kernel then never touches Rm/B directly)
+    rrow = problem.Rm[problem.mem_pos]                    # [M, K]
+    wcharge = rrow * problem.mem_w[:, None]
+    braw = problem.B[problem.mem_tr] - rrow               # budget minus own
+
+    # narrowest exact dtype: int32 whenever the worst-case prefix sum (the
+    # exact per-column charge totals) and every finite budget magnitude
+    # provably fit — halves the scan's memory traffic. _INF-backed entries
+    # clamp to a sentinel strictly above any reachable prefix.
+    _I32CAP = np.int64(1) << 30
+    col_sums = wcharge.sum(axis=0, dtype=np.int64)
+    finite = np.abs(braw) < (_INF >> 1)
+    fits32 = (int(col_sums.max(initial=0)) < _I32CAP
+              and (np.abs(braw[finite]).max(initial=0) if finite.any()
+                   else 0) < _I32CAP)
+    dtype = np.int32 if fits32 else np.int64
+    inf_sentinel = _I32CAP if fits32 else _INF
+
+    reqw = np.zeros((M_pad, K_pad), dtype)
+    reqw[:M, :K] = wcharge
+    bm2 = np.full((M_pad, K_pad), inf_sentinel, dtype)
+    bm2[:M, :K] = np.where(finite, braw, inf_sentinel)
+    rstatus0 = np.ones((M_pad,), np.int8)                 # pads decided
+    rstatus0[:M] = problem.status0[problem.mem_pos]
+
+    # tracker-segment starts (rows arrive tracker-major); pads are solo
+    is_start = np.empty((M_pad,), bool)
+    is_start[0] = True
+    is_start[1:M] = problem.mem_tr[1:] != problem.mem_tr[:-1]
+    is_start[M:] = True
+    idx = np.arange(M_pad)
+    seg_first_t = np.maximum.accumulate(
+        np.where(is_start, idx, 0)).astype(np.int32)
+    perm_a, inv_perm_a, seg_first_a, seg_last_a = _ask_view(
+        problem.mem_pos, M_pad)
+
+    host_arrays = (reqw, bm2, rstatus0, seg_first_t, perm_a, inv_perm_a,
+                   seg_first_a, seg_last_a)
+    with enable_x64():
+        args = [jnp.asarray(a) for a in host_arrays]
+        if backend is not None:
+            dev = jax.local_devices(backend=backend)[0]
+            args = [jax.device_put(a, dev) for a in args]
+        jrstatus, jpasses = _gate_scan(*args, max_passes=max_passes)
+        rstatus = np.asarray(jrstatus)[:M]
+        passes = int(jpasses)
+
+    # reassemble the per-ask status: all rows of an ask agree by
+    # construction, one numpy scatter instead of any device-side one
+    status = problem.status0.copy()
+    status[problem.mem_pos] = rstatus
+    finish = gate_mod.finish_leftovers(problem, status)
+    admitted = [problem.asks_ord[pos]
+                for pos in np.flatnonzero(status == 1).tolist()]
+    held = int((status == -1).sum())
+    return admitted, held, dict(
+        base_stats,
+        passes=passes,
+        max_passes=max_passes,
+        finish_loop=finish,
+        admit_ms=(time.perf_counter() - problem.t_rank) * 1000,
+        device_ms=(time.perf_counter() - t_start) * 1000,
+        transfer_bytes=int(sum(a.nbytes for a in host_arrays)),
+    )
+
+
+# --------------------------------------------------------------- encode_rows
+# The encoder's request-row quantization as a device program: changed rows
+# arrive as RAW resource values (exact int64) plus the per-slot scales, are
+# quantized on device with arithmetic bit-identical to the host's
+# SnapshotEncoder.quantize_request chain (float64 divide → ceil → float32 →
+# int32; the host path stores f32 rows and the solve casts them int32, so
+# the device store must reproduce that exact rounding), and scatter into the
+# persistent row pool. The batch's req tensor is then a pure device gather —
+# a churn cycle's host→device traffic for pod requests is O(changed rows)
+# of row data plus an O(n) int32 slot index.
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def encode_rows(pool, raw, scales, slots):
+    """Quantize raw rows and scatter them into the row pool.
+
+    pool   [cap, R] int32   persistent quantized rows (donated: updated
+                            in place, the old buffer is consumed)
+    raw    [C, R]  int64    raw resource values of changed rows (0 pads —
+                            their quantized row is 0, and pads point at the
+                            reserved all-zero slot 0)
+    scales [R]     float64  per-slot device-unit scales
+    slots  [C]     int32    target pool slot per row (0 for pads)
+    """
+    import jax.numpy as jnp
+
+    rows = (jnp.ceil(raw / scales[None, :])
+            .astype(jnp.float32).astype(jnp.int32))
+    return pool.at[slots].set(rows)
+
+
+@jax.jit
+def gather_rows(pool, idx):
+    """[N, R] int32 request tensor for one batch: a pure device gather of
+    each ask's pool slot (0 = the reserved zero row for padding)."""
+    return pool[idx]
+
+
+def jit_cache_entries() -> int:
+    """Compiled-variant count of the gate scan (CoreScheduler reads this to
+    tell a first-bucket compile from a cache hit). -1 when unavailable."""
+    try:
+        return _gate_scan._cache_size()
+    except Exception:
+        return -1

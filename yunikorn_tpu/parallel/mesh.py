@@ -87,7 +87,10 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     # once per cycle.
     np_args, static_kwargs = assign_mod.prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
-        ports_delta=ports_delta, device_state=device_state)
+        ports_delta=ports_delta, device_state=device_state,
+        # replicated device_put of pod args expects host arrays; the
+        # row-store req is a single-device gather the mesh path skips
+        allow_req_device=False)
 
     if not compile_only:
         global last_replicated_bytes

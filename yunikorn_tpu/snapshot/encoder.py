@@ -227,6 +227,12 @@ class PodBatch:
     # ports, DRA claims, volume restrictions) — asks in such groups take the
     # exact host planner instead
     g_preempt_host: Optional[np.ndarray] = None
+    # [N, R] int32 DEVICE-resident req tensor (DeviceRowStore gather) —
+    # attached by the core when the device gate+encode pipeline is on;
+    # prepare_solve_args prefers it over re-uploading req when the solve
+    # takes the persistent-device-state path. Values are pinned identical
+    # to req.astype(int32). None = host req only.
+    req_device: Optional[object] = None
 
     @property
     def placement_dependent(self) -> bool:
@@ -706,6 +712,174 @@ class MirrorDiscarded(RuntimeError):
     without touching shared state, or it would race the scheduler thread."""
 
 
+class DeviceRowStore:
+    """Persistent device-resident quantized request rows ([cap, R] int32).
+
+    The device half of the per-ask encoded-row cache (round 10 made
+    re-DERIVING rows O(changed); this makes re-TRANSFERRING them O(changed)
+    too): each allocation key owns a pool slot keyed by its core seq, a
+    churn cycle uploads only the changed rows' RAW values — quantized on
+    device by the jitted ops.gate_solve.encode_rows, bit-identical to the
+    host quantize_request chain — and the batch's req tensor for the solve
+    is a pure device gather over an O(n) int32 slot index. Slot 0 is the
+    reserved all-zero row (batch padding). LRU-evicted past the same 2^18
+    ceiling as the host row cache; vocab growth past the padded row width
+    resets the pool (one full re-upload, counted in `resets`).
+
+    Single-writer: the scheduler thread under the core lock (same
+    discipline as NodeArrays). Batches hold materialized gather RESULTS,
+    so eviction/reset can never corrupt an in-flight batch.
+    """
+
+    def __init__(self, vocabs: Vocabs, min_capacity: int = 1024,
+                 max_rows: int = 1 << 18):
+        from collections import OrderedDict
+
+        self.vocabs = vocabs
+        self._slot_of: "OrderedDict[str, list]" = OrderedDict()  # key -> [seq, slot]
+        self._free: List[int] = []
+        self._capacity = max(int(min_capacity), 2)
+        self._max_rows = max_rows
+        self._R: Optional[int] = None
+        self.pool = None
+        # transfer accounting (the O(changed) contract tests assert on)
+        self.last_upload_rows = 0
+        self.last_upload_bytes = 0
+        self.upload_rows_total = 0
+        self.resets = 0
+        self._upload_bytes_acc = 0
+        # one-deep gather memo: a no-change cycle (same slot index, no
+        # uploads) reuses the previous device req outright — the batch-memo
+        # discipline of round 6 applied to the gather dispatch (~1-2 ms of
+        # jit dispatch otherwise paid by every clean cycle)
+        self._gather_memo: Optional[tuple] = None  # (idx bytes, pool, req)
+
+    def take_upload_bytes(self) -> int:
+        """Row-data bytes uploaded since the last take (mirrors
+        DeviceNodeState.take_upload_bytes for the cycle trace)."""
+        b, self._upload_bytes_acc = self._upload_bytes_acc, 0
+        return b
+
+    def _reset(self, R: int) -> None:
+        import jax.numpy as jnp
+
+        if self.pool is not None:
+            self.resets += 1
+        self._slot_of.clear()
+        self._free = []
+        self._R = R
+        self.pool = jnp.zeros((self._capacity, R), jnp.int32)
+
+    def _grow(self, need: int) -> None:
+        import jax.numpy as jnp
+
+        new_cap = self._capacity
+        while new_cap < need:
+            new_cap *= 2
+        if new_cap == self._capacity:
+            return
+        pad = jnp.zeros((new_cap - self._capacity, self._R), jnp.int32)
+        self.pool = jnp.concatenate([self.pool, pad], axis=0)
+        self._capacity = new_cap
+
+    def _raw_row(self, resource) -> "np.ndarray":
+        """Exact raw-value row over the padded slot space. Non-integral
+        values pre-quantize on the host and ship q*scale, which the device
+        ceil-div maps back to exactly q (integer values — the normal case —
+        quantize fully on device)."""
+        rv = self.vocabs.resources
+        slots = [(rv.slot(name), name, value)
+                 for name, value in resource.resources.items()]
+        row = np.zeros((self._R,), np.int64)
+        for slot, name, value in slots:
+            if slot >= self._R:
+                return None  # vocab grew mid-batch: caller resets
+            if isinstance(value, int) or (isinstance(value, float)
+                                          and value.is_integer()):
+                row[slot] = int(value)
+            else:
+                q = math.ceil(rv.quantize(name, value))
+                row[slot] = int(q) * rv.scale(name)
+        return row
+
+    def sync_and_gather(self, asks: Sequence[AllocationAsk], n_pad: int):
+        """Ensure every ask's quantized row is pool-resident (uploading
+        only new/changed rows through the jitted quantization) and return
+        the [n_pad, R] int32 device req tensor in ask order (padding rows
+        all-zero via slot 0). Returns None when the vocab width changed
+        mid-call (the caller falls back to the host req for this cycle)."""
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        from yunikorn_tpu.ops import gate_solve
+
+        rv = self.vocabs.resources
+        R = rv.num_slots
+        if self.pool is None or self._R != R:
+            self._reset(R)
+        slot_of = self._slot_of
+        changed: List[tuple] = []      # (slot, raw row)
+        idx = np.zeros((n_pad,), np.int32)
+        for i, ask in enumerate(asks):
+            key = ask.allocation_key
+            rec = slot_of.get(key)
+            if rec is not None and rec[0] == ask.seq:
+                slot_of.move_to_end(key)
+                idx[i] = rec[1]
+                continue
+            raw = self._raw_row(ask.resource)
+            if raw is None:
+                return None
+            if rec is None:
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    # evict LRU once past the ceiling (floored at the live
+                    # batch, same discipline as the host row cache)
+                    while (len(slot_of) >= max(self._max_rows, len(asks))
+                           and slot_of):
+                        _, (_seq, s) = slot_of.popitem(last=False)
+                        self._free.append(s)
+                    if self._free:
+                        slot = self._free.pop()
+                    else:
+                        slot = len(slot_of) + 1        # slot 0 reserved
+                        self._grow(slot + 1)
+                slot_of[key] = rec = [ask.seq, slot]
+            else:
+                rec[0] = ask.seq
+                slot_of.move_to_end(key)
+            changed.append((rec[1], raw))
+            idx[i] = rec[1]
+        self.last_upload_rows = len(changed)
+        self.last_upload_bytes = 0
+        with enable_x64():
+            if changed:
+                C_pad = _bucket(len(changed), 64)
+                raw_m = np.zeros((C_pad, R), np.int64)
+                slots_m = np.zeros((C_pad,), np.int32)
+                for j, (slot, raw) in enumerate(changed):
+                    raw_m[j] = raw
+                    slots_m[j] = slot
+                scales = np.ones((R,), np.float64)
+                for name, slot, scale in rv.items():
+                    scales[slot] = float(scale)
+                self.pool = gate_solve.encode_rows(
+                    self.pool, jnp.asarray(raw_m), jnp.asarray(scales),
+                    jnp.asarray(slots_m))
+                self.last_upload_bytes = int(raw_m.nbytes + slots_m.nbytes
+                                             + scales.nbytes)
+                self.upload_rows_total += len(changed)
+                self._upload_bytes_acc += self.last_upload_bytes
+            key = idx.tobytes()
+            memo = self._gather_memo
+            if memo is not None and memo[1] is self.pool and memo[0] == key:
+                return memo[2]
+            req = gate_solve.gather_rows(self.pool, jnp.asarray(idx))
+            self._gather_memo = (key, self.pool, req)
+            return req
+
+
 class SnapshotEncoder:
     """Maintains NodeArrays against a SchedulerCache + encodes pod batches."""
 
@@ -766,6 +940,25 @@ class SnapshotEncoder:
         # O(changed) contract gate-smoke and the bench assert on)
         self.last_encode_rows = 0
         self.last_encode_rows_reencoded = 0
+        # device-resident request-row pool (the device gate+encode pipeline;
+        # lazy: constructing it initializes the JAX backend)
+        self.row_store: Optional[DeviceRowStore] = None
+
+    def device_row_store(self) -> DeviceRowStore:
+        if self.row_store is None:
+            self.row_store = DeviceRowStore(self.vocabs)
+        return self.row_store
+
+    def device_req(self, asks: Sequence[AllocationAsk], batch) -> object:
+        """[N, R] int32 device req tensor for a built batch — the row
+        store's O(changed)-upload gather. None when the store cannot serve
+        this batch (vocab width raced the encode); the caller then uses the
+        host batch.req for the cycle."""
+        store = self.device_row_store()
+        req = store.sync_and_gather(asks, batch.req.shape[0])
+        if req is not None and req.shape[1] != batch.req.shape[1]:
+            return None  # width drifted from the encoded batch: host path
+        return req
 
     @property
     def mirror_epoch(self) -> int:
