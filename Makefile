@@ -1,8 +1,9 @@
 # Developer entry points (reference Makefile analog).
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
-	chaos-smoke gate-smoke gate-device-smoke smoke lint run-scheduler \
-	run-admission dryrun clean image sched_image adm_image webtest_image
+	chaos-smoke gate-smoke gate-device-smoke pack-smoke smoke lint \
+	run-scheduler run-admission dryrun clean image sched_image adm_image \
+	webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -76,7 +77,14 @@ gate-device-smoke:  ## device-resident gate+encode: differential suite (device s
 		python scripts/gate_bench.py --sizes 2000,20000 --saturated \
 		--passes --device-churn-check
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke  ## all tier-1 smoke targets
+pack-smoke:  ## optimal packing (solver.policy=optimal): feasibility-parity property suite (pack placements pass greedy-side feasibility on randomized fragmented/priority-skew/gang/quota traces, seeded determinism, fallback on loss) + microbench asserting the pack plan beats greedy packed units on the fragmented shape with warm plan latency within 2x greedy
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_pack_solve.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/pack_bench.py --shapes 1024x128,2048x256 \
+		--assert-quality
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -236,6 +236,9 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                 "degradations": {"transitions": [], "final": {}},
                 "gate_ms": 0.0,
                 "pod_encode_ms": 0.0,
+                "solver_policy": "greedy",
+                "pack_util": 0.0,
+                "pack_plan_ms": 0.0,
             }))
             sys.exit(1)
     platform = devs[0].platform
@@ -282,12 +285,18 @@ def _cycle_stats(core) -> dict:
             "gate_passes": int(timing.get("gate_passes", 0)),
             "encode_device_rows": int(timing.get("encode_device_rows", 0)),
             "encode_device_bytes": int(timing.get("encode_device_bytes", 0)),
+            # optimal packing A/B (round 12): which policy committed, the
+            # pack/greedy packed-units ratio, and the pack plan latency
+            "solver_policy": timing.get("solver_policy", "greedy"),
+            "pack_util": float(timing.get("pack_util", 0.0)),
+            "pack_plan_ms": float(timing.get("pack_plan_ms", 0.0)),
         }
     except Exception:
         return {"gate_ms": 0.0, "pod_encode_ms": 0.0, "gate_path": "",
                 "encode_reencoded": 0, "gate_device_ms": 0.0,
                 "gate_passes": 0, "encode_device_rows": 0,
-                "encode_device_bytes": 0}
+                "encode_device_bytes": 0, "solver_policy": "greedy",
+                "pack_util": 0.0, "pack_plan_ms": 0.0}
 
 
 def _preempt_stat(core) -> float:

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Pack-solver microbench: LP/ADMM global packing vs the greedy argmin.
+
+Builds the fragmentation shapes where a global view pays — heterogeneous
+node flavors (cpu-rich/mem-poor vs cpu-poor/mem-rich) under a mixed
+cpu-heavy/mem-heavy ask wave with priority skew, the multi-dimensional
+contention the greedy scalar score cannot see (PAPERS.md: CvxCluster's
+granular-allocation LP, POP's partitioned subproblems) — and A/Bs packed
+utilization and warm plan latency.
+
+Per shape prints one JSON line:
+  {"pods": N, "nodes": M, "parts": K, "greedy_placed": ..., "pack_placed":
+   ..., "util_ratio": ..., "greedy_warm_ms": ..., "pack_warm_ms": ...,
+   "latency_ratio": ...}
+
+--shapes 1024x128,4096x512     podsxnodes shapes (default three shapes)
+--assert-quality               exit 1 unless on the LAST (largest) shape the
+                               pack plan beats greedy packed units AND warm
+                               plan latency stays within --max-latency-ratio
+                               (the pack-smoke CI gate)
+--max-latency-ratio 2.0        acceptance bound for pack_warm/greedy_warm
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(n_pods: int, n_nodes: int, seed: int = 0):
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        # fragmented fleet: two node flavors with opposite headroom shapes
+        if i % 2 == 0:
+            cache.update_node(make_node(f"n{i:05d}", cpu_milli=8000,
+                                        memory=4 * 2**30))
+        else:
+            cache.update_node(make_node(f"n{i:05d}", cpu_milli=2000,
+                                        memory=16 * 2**30))
+    pods = []
+    for k in range(n_pods):
+        if rng.random() < 0.5:
+            pods.append(make_pod(f"p{k}", cpu_milli=1900, memory=2**28,
+                                 priority=rng.choice([0, 5])))
+        else:
+            pods.append(make_pod(f"p{k}", cpu_milli=300, memory=3 * 2**30,
+                                 priority=rng.choice([0, 5])))
+    import numpy as np
+
+    # priorities reach BOTH solvers: the asks carry them, and the ranks
+    # replicate the gate's priority-desc-then-FIFO order, so the bench A/B
+    # (and choose_plan's priority guard) exercises the skew production sees
+    asks = [AllocationAsk(p.uid, "pack-app", get_pod_resource(p),
+                          priority=p.spec.priority or 0, pod=p)
+            for p in pods]
+    priorities = np.asarray([p.spec.priority or 0 for p in pods])
+    order = np.lexsort((np.arange(len(pods)), -priorities))
+    ranks = np.empty(len(pods), np.int64)
+    ranks[order] = np.arange(len(pods))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return enc, enc.build_batch(asks, ranks=ranks.tolist()), priorities
+
+
+def run_shape(n_pods: int, n_nodes: int) -> dict:
+    import numpy as np
+
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    enc, batch, priorities = build(n_pods, n_nodes)
+
+    def greedy():
+        r = solve_batch(batch, enc.nodes)
+        return np.asarray(r.assigned)[: batch.num_pods]
+
+    def pack():
+        r = pack_mod.pack_solve_batch(batch, enc.nodes, seed=7)
+        return np.asarray(r.assigned)[: batch.num_pods], r.n_parts
+
+    ga = greedy()                        # cold (trace+compile)
+    t0 = time.time()
+    ga = greedy()
+    greedy_ms = (time.time() - t0) * 1000
+    pa, parts = pack()                   # cold
+    t0 = time.time()
+    pa, parts = pack()
+    pack_ms = (time.time() - t0) * 1000
+
+    # the production decision rule: priority-guarded, capacity-normalized
+    use_pack, st = pack_mod.choose_plan(
+        ga, pa, batch.req.astype(np.int32), batch.valid,
+        cap_i=np.floor(enc.nodes.capacity_arr).astype(np.int64),
+        priorities=np.asarray(priorities))
+    return {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "parts": parts,
+        "greedy_placed": st["greedy"]["placed"],
+        "pack_placed": st["pack"]["placed"],
+        "greedy_units": st["greedy"]["units"],
+        "pack_units": st["pack"]["units"],
+        "pack_wins": bool(use_pack),
+        # the SAME quantity the core's pack_util/pack_last_util reports:
+        # capacity-normalized packed units, pack/greedy — the bench gate
+        # must agree with the decision rule it exercises
+        "util_ratio": round(st["pack"]["units_norm"]
+                            / max(st["greedy"]["units_norm"], 1e-9), 4),
+        "greedy_warm_ms": round(greedy_ms, 1),
+        "pack_warm_ms": round(pack_ms, 1),
+        "latency_ratio": round(pack_ms / max(greedy_ms, 1e-6), 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="1024x128,2048x256,4096x512")
+    ap.add_argument("--assert-quality", action="store_true",
+                    help="exit 1 unless the last shape's pack plan beats "
+                         "greedy packed units within the latency bound")
+    ap.add_argument("--max-latency-ratio", type=float, default=2.0)
+    args = ap.parse_args()
+
+    last = None
+    for shape in args.shapes.split(","):
+        n_pods, n_nodes = (int(x) for x in shape.strip().split("x"))
+        last = run_shape(n_pods, n_nodes)
+        print(json.dumps(last), flush=True)
+
+    if args.assert_quality and last is not None:
+        if not last["pack_wins"] or last["util_ratio"] <= 1.0:
+            print(f"FAIL: pack plan did not beat greedy on the "
+                  f"{last['pods']}x{last['nodes']} shape "
+                  f"(util_ratio {last['util_ratio']})", file=sys.stderr)
+            return 1
+        if last["latency_ratio"] > args.max_latency_ratio:
+            print(f"FAIL: warm pack plan latency {last['pack_warm_ms']}ms is "
+                  f"{last['latency_ratio']}x greedy "
+                  f"(bound {args.max_latency_ratio}x)", file=sys.stderr)
+            return 1
+        print(f"OK: pack beats greedy (util_ratio {last['util_ratio']}, "
+              f"latency {last['latency_ratio']}x <= "
+              f"{args.max_latency_ratio}x)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

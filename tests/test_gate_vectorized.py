@@ -500,3 +500,95 @@ def test_e2e_gang_trace_verify():
                       task_group_name="tg", pod=p) for p in real]))
     core.schedule_once()
     assert core.obs.get("gate_mismatch_total").value() == 0
+
+
+# ------------------------------------------------- ask-level extraction cache
+def test_extract_cache_rederives_only_changed():
+    """Churn contract (the round-11 ROADMAP follow-up): with an
+    AskExtractCache threaded through, a second extraction over a mostly
+    unchanged pending set re-derives ONLY the new asks — and produces a
+    GateProblem bit-identical to the cache-less extraction."""
+    import numpy as np
+
+    rng = random.Random(42)
+    tree = random_tree(rng)
+    by_queue = random_trace(rng, tree, n_asks=100)
+    cache = gate_mod.AskExtractCache()
+
+    p_cold = gate_mod.extract_problem(by_queue, meta_for(tree, by_queue),
+                                      tree, cache=cache)
+    assert cache.derived == p_cold.n and cache.hits == 0
+
+    # churn: 10 new asks join, everything else unchanged
+    leaves = [q.full_name for q in tree.leaves()]
+    app = FakeApp("alice", ["dev"], 55.0, leaves[0])
+    for i in range(10):
+        by_queue.setdefault(leaves[0], []).append((app, AllocationAsk(
+            f"churn-{i}", "app-churn", _rand_res(rng, 0, 12), seq=1000 + i)))
+    p_warm = gate_mod.extract_problem(by_queue, meta_for(tree, by_queue),
+                                      tree, cache=cache)
+    assert cache.derived == 10, (cache.derived, cache.hits)
+    assert cache.hits == p_warm.n - 10
+
+    # equivalence: cached extraction == cache-less extraction, bit for bit
+    p_ref = gate_mod.extract_problem(by_queue, meta_for(tree, by_queue), tree)
+    assert [a.allocation_key for a in p_warm.asks_ord] == \
+        [a.allocation_key for a in p_ref.asks_ord]
+    for field in ("status0", "Rm", "B", "mem_tr", "mem_pos", "mem_w"):
+        assert np.array_equal(getattr(p_warm, field), getattr(p_ref, field)), \
+            field
+
+    # a REPLACED ask object (same key, new ask) must re-derive
+    qn, entries = next((q, v) for q, v in by_queue.items() if v)
+    old_app, old_ask = entries[0]
+    entries[0] = (old_app, AllocationAsk(
+        old_ask.allocation_key, old_ask.application_id,
+        Resource({"cpu": 1}), seq=old_ask.seq))
+    gate_mod.extract_problem(by_queue, meta_for(tree, by_queue), tree,
+                             cache=cache)
+    assert cache.derived == 1
+
+    # IN-PLACE mutations on the SAME ask object (update_allocation restamps
+    # seq; priority/resource could be swapped) must also re-derive
+    churn_app, churn_ask = by_queue[leaves[0]][-1]
+    churn_ask.seq += 5000
+    gate_mod.extract_problem(by_queue, meta_for(tree, by_queue), tree,
+                             cache=cache)
+    assert cache.derived == 1
+    churn_ask.resource = Resource({"memory": 2})
+    p_mut = gate_mod.extract_problem(by_queue, meta_for(tree, by_queue),
+                                     tree, cache=cache)
+    assert cache.derived == 1
+    p_mut_ref = gate_mod.extract_problem(by_queue, meta_for(tree, by_queue),
+                                         tree)
+    assert np.array_equal(p_mut.Rm, p_mut_ref.Rm)
+
+
+def test_extract_cache_admission_parity():
+    """Randomized parity: cached extraction feeds host_scan the same
+    decisions the cache-less path makes, across churn waves."""
+    for seed in range(6):
+        rng = random.Random(seed)
+        tree = random_tree(rng)
+        by_queue = random_trace(rng, tree)
+        cache = gate_mod.AskExtractCache()
+        for wave in range(3):
+            p_c = gate_mod.extract_problem(
+                by_queue, meta_for(tree, by_queue), tree, cache=cache)
+            adm_c, held_c, _ = gate_mod.host_scan(p_c)
+            p_r = gate_mod.extract_problem(
+                by_queue, meta_for(tree, by_queue), tree)
+            adm_r, held_r, _ = gate_mod.host_scan(p_r)
+            assert held_c == held_r
+            assert [a.allocation_key for a in adm_c] == \
+                [a.allocation_key for a in adm_r]
+            # next wave: drop some, add some
+            for q in list(by_queue):
+                by_queue[q] = [e for e in by_queue[q] if rng.random() < 0.7]
+                if not by_queue[q]:
+                    del by_queue[q]
+            extra = random_trace(rng, tree, n_asks=rng.randint(1, 30))
+            for q, v in extra.items():
+                by_queue.setdefault(q, []).extend(v)
+            if not by_queue:
+                break

@@ -1,0 +1,441 @@
+"""Feasibility-parity property suite for the LP/ADMM pack solver
+(ops/pack_solve.py, solver.policy=optimal).
+
+The pack plan's contract: it may place a DIFFERENT set of pods than the
+greedy solve — that is the point — but every placement it emits must pass
+the exact greedy-side feasibility (host predicates, group screens, capacity
+prefix-fit), the same seed must reproduce the same plan, a plan that does
+not beat greedy must fall back, and a faulted pack path must leave the
+cycle's placements exactly what the greedy policy would have committed.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import (Affinity, NodeSelectorRequirement,
+                                         NodeSelectorTerm, Taint, Toleration,
+                                         make_node, make_pod)
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import (
+    AddApplicationRequest,
+    AllocationAsk,
+    AllocationRequest,
+    ApplicationRequest,
+    NodeAction,
+    NodeInfo,
+    NodeRequest,
+    RegisterResourceManagerRequest,
+    UserGroupInfo,
+)
+from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+from yunikorn_tpu.ops import pack_solve as pack_mod
+from yunikorn_tpu.ops.assign import solve_batch
+from yunikorn_tpu.ops.host_predicates import pod_fits_node
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+ZONES = ["z0", "z1", "z2"]
+DISKS = ["ssd", "hdd"]
+
+
+def random_node(rng, i):
+    """Fragmented fleet: mixed capacities/flavors, some tainted/unschedulable."""
+    flavor = rng.random()
+    if flavor < 0.4:
+        node = make_node(f"n{i:04d}", cpu_milli=8000, memory=4 * 2**30,
+                         labels={"zone": rng.choice(ZONES),
+                                 "disk": rng.choice(DISKS)})
+    else:
+        node = make_node(f"n{i:04d}", cpu_milli=rng.choice([2000, 4000]),
+                         memory=rng.choice([8, 16]) * 2**30,
+                         labels={"zone": rng.choice(ZONES),
+                                 "disk": rng.choice(DISKS)})
+    if rng.random() < 0.2:
+        node.spec.taints = [Taint(key="dedicated", value="batch",
+                                  effect="NoSchedule")]
+    if rng.random() < 0.08:
+        node.spec.unschedulable = True
+    return node
+
+
+def random_pod(rng, i):
+    """Priority-skewed mixed sizes with a sprinkling of constraints."""
+    if rng.random() < 0.5:
+        pod = make_pod(f"p{i}", cpu_milli=rng.choice([1500, 1900]),
+                       memory=2**28, priority=rng.choice([0, 1, 5]))
+    else:
+        pod = make_pod(f"p{i}", cpu_milli=rng.choice([200, 400]),
+                       memory=rng.choice([1, 3]) * 2**30,
+                       priority=rng.choice([0, 1, 5]))
+    r = rng.random()
+    if r < 0.2:
+        pod.spec.node_selector = {"zone": rng.choice(ZONES)}
+    elif r < 0.3:
+        pod.spec.affinity = Affinity(node_required_terms=[NodeSelectorTerm(
+            match_expressions=[NodeSelectorRequirement(
+                "disk", rng.choice(["In", "NotIn"]), [rng.choice(DISKS)])])])
+    if rng.random() < 0.15:
+        pod.spec.tolerations = [Toleration(key="dedicated", operator="Equal",
+                                           value="batch",
+                                           effect="NoSchedule")]
+    return pod
+
+
+def build_trace(seed, n_nodes=48, n_pods=160):
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    nodes = [random_node(rng, i) for i in range(n_nodes)]
+    for n in nodes:
+        cache.update_node(n)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [random_pod(rng, i) for i in range(n_pods)]
+    asks = [AllocationAsk(p.uid, "pack-app", get_pod_resource(p), pod=p)
+            for p in pods]
+    return cache, enc, nodes, pods, asks, enc.build_batch(asks)
+
+
+# ---------------------------------------------------------- feasibility parity
+@pytest.mark.parametrize("seed", range(8))
+def test_pack_placements_pass_greedy_side_feasibility(seed):
+    """Every placement the pack plan emits must satisfy the exact host
+    predicates and per-node capacity — i.e. nothing greedy-side feasibility
+    would reject, on randomized fragmented/priority-skew traces."""
+    cache, enc, nodes, pods, asks, batch = build_trace(seed)
+    result = pack_mod.pack_solve_batch(batch, enc.nodes, seed=seed)
+    assigned = np.asarray(result.assigned)[: batch.num_pods]
+    assert int(np.asarray(result.free_after).min()) >= 0
+
+    by_name = {n.name: n for n in nodes}
+    placed_on = {}
+    for i, pod in enumerate(pods):
+        idx = int(assigned[i])
+        if idx >= 0:
+            placed_on.setdefault(enc.nodes.name_of(idx), []).append(pod)
+    for name, placed in placed_on.items():
+        node = by_name[name]
+        free = cache.get_node(name).available()
+        for k, pod in enumerate(placed):
+            others = placed[:k] + placed[k + 1:]
+            err = pod_fits_node(pod, node, free, others)
+            assert err in (None, "insufficient resources"), (
+                seed, name, pod.name, err)
+        for res in ("cpu", "memory"):
+            total = sum(get_pod_resource(p).get(res) for p in placed)
+            assert total <= free.get(res), (seed, name, res, total)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_pack_seeded_determinism(seed):
+    """Same seed -> bit-identical plan; a different seed may repartition."""
+    _, enc, _, _, _, batch = build_trace(seed)
+    a = np.asarray(pack_mod.pack_solve_batch(batch, enc.nodes,
+                                             seed=123).assigned)
+    b = np.asarray(pack_mod.pack_solve_batch(batch, enc.nodes,
+                                             seed=123).assigned)
+    assert np.array_equal(a, b)
+
+
+def test_pack_repair_places_strandable_pods():
+    """Per-subproblem fallback: with abundant homogeneous capacity every
+    valid pod must place — a random partition that strands pods in an
+    exhausted part is repaired by the greedy pass over the full node set."""
+    cache = SchedulerCache()
+    for i in range(32):
+        cache.update_node(make_node(f"n{i:03d}", cpu_milli=16000,
+                                    memory=64 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [make_pod(f"p{i}", cpu_milli=500, memory=2**28) for i in range(256)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p)
+            for p in pods]
+    batch = enc.build_batch(asks)
+    result = pack_mod.pack_solve_batch(batch, enc.nodes, seed=1)
+    assigned = np.asarray(result.assigned)[: batch.num_pods]
+    assert int((assigned >= 0).sum()) == len(pods)
+
+
+def test_choose_plan_falls_back_when_pack_not_better():
+    """The differential decision rule: pack commits only on a strictly
+    better (priority classes, placed, normalized units, -nodes) key; ties
+    keep greedy."""
+    req = np.full((4, 2), 10, np.int32)
+    valid = np.ones(4, bool)
+    g = np.array([0, 0, 1, -1], np.int32)
+    same = np.array([1, 1, 0, -1], np.int32)
+    fewer = np.array([0, 0, -1, -1], np.int32)
+    more = np.array([0, 0, 1, 1], np.int32)
+    denser = np.array([0, 0, 0, -1], np.int32)
+    assert not pack_mod.choose_plan(g, same, req, valid)[0]     # tie → greedy
+    assert not pack_mod.choose_plan(g, fewer, req, valid)[0]
+    assert pack_mod.choose_plan(g, more, req, valid)[0]
+    assert pack_mod.choose_plan(g, denser, req, valid)[0]       # fewer nodes
+
+
+def test_choose_plan_priority_guard_blocks_starvation():
+    """Priority Matters: a pack plan that packs MORE units by displacing a
+    high-priority ask for bulkier low-priority ones must LOSE, class by
+    class from the top; within a class, packing quality still decides."""
+    # ask 0: priority 100, small; asks 1-3: priority 0, large
+    req = np.array([[1, 1], [50, 50], [50, 50], [50, 50]], np.int32)
+    valid = np.ones(4, bool)
+    prio = np.array([100, 0, 0, 0], np.int64)
+    greedy = np.array([0, 0, -1, -1], np.int32)   # places the prio-100 ask
+    pack = np.array([-1, 0, 1, 2], np.int32)      # more units, starves it
+    use, _ = pack_mod.choose_plan(greedy, pack, req, valid, priorities=prio)
+    assert not use
+    # without the guard the units win: the priorities arg IS the guard
+    assert pack_mod.choose_plan(greedy, pack, req, valid)[0]
+    # same top-class coverage + more low-priority placed → pack wins
+    pack_ok = np.array([0, 0, 1, 2], np.int32)
+    assert pack_mod.choose_plan(greedy, pack_ok, req, valid,
+                                priorities=prio)[0]
+
+
+def test_choose_plan_capacity_normalized_units():
+    """The commit objective matches the solver's: per-column normalization
+    by mean node capacity, so a bulky raw-integer column (bytes) cannot
+    outvote the contended scored column (milliCPU)."""
+    # col 0: capacity 10/node (scarce); col 1: capacity 1e6/node (bulky)
+    cap = np.array([[10, 10**6]] * 4, np.int64)
+    valid = np.ones(2, bool)
+    # plan A places the scarce-column ask, plan B the bulky-column ask
+    req = np.array([[10, 0], [0, 10**5]], np.int32)
+    a = np.array([0, -1], np.int32)
+    b = np.array([-1, 1], np.int32)
+    # raw units would prefer B (1e5 > 10); normalized prefers A (1.0 > 0.1)
+    use_b, st = pack_mod.choose_plan(a, b, req, valid, cap_i=cap)
+    assert not use_b, st
+    assert pack_mod.choose_plan(a, b, req, valid)[0]  # raw units: B wins
+
+
+def test_pack_unsupported_batches_raise():
+    """Locality and host-port batches are outside the model: explicit
+    PackUnsupported, never a silently wrong plan."""
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.update_node(make_node(f"n{i}", cpu_milli=4000, memory=8 * 2**30,
+                                    labels={"zone": "z0"}))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    port_pod = make_pod("pp", cpu_milli=100, memory=2**20)
+    port_pod.spec.containers[0].ports = [{"hostPort": 9000, "protocol": "TCP"}]
+    batch = enc.build_batch([AllocationAsk(
+        port_pod.uid, "app", get_pod_resource(port_pod), pod=port_pod)])
+    with pytest.raises(pack_mod.PackUnsupported):
+        pack_mod.pack_solve_batch(batch, enc.nodes)
+
+    from yunikorn_tpu.common.objects import TopologySpreadConstraint
+
+    spread = make_pod("sp", cpu_milli=100, memory=2**20,
+                      labels={"grp": "a"})
+    spread.spec.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1, topology_key="zone",
+        when_unsatisfiable="DoNotSchedule", label_selector={"grp": "a"})]
+    batch2 = enc.build_batch([AllocationAsk(
+        spread.uid, "app", get_pod_resource(spread), pod=spread)])
+    if batch2.locality is not None:
+        with pytest.raises(pack_mod.PackUnsupported):
+            pack_mod.pack_solve_batch(batch2, enc.nodes)
+
+
+def test_pack_beats_greedy_on_contended_shape():
+    """The A/B the feature exists for: heterogeneous node flavors under a
+    mixed cpu-heavy/mem-heavy wave — the pack plan must win the comparison."""
+    cache = SchedulerCache()
+    rng = random.Random(3)
+    for i in range(128):
+        if i % 2 == 0:
+            cache.update_node(make_node(f"n{i:03d}", cpu_milli=8000,
+                                        memory=4 * 2**30))
+        else:
+            cache.update_node(make_node(f"n{i:03d}", cpu_milli=2000,
+                                        memory=16 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = []
+    for i in range(1024):
+        if rng.random() < 0.5:
+            pods.append(make_pod(f"p{i}", cpu_milli=1900, memory=2**28,
+                                 priority=rng.choice([0, 5])))
+        else:
+            pods.append(make_pod(f"p{i}", cpu_milli=300, memory=3 * 2**30,
+                                 priority=rng.choice([0, 5])))
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p)
+            for p in pods]
+    batch = enc.build_batch(asks)
+    ga = np.asarray(solve_batch(batch, enc.nodes).assigned)[: batch.num_pods]
+    pa = np.asarray(pack_mod.pack_solve_batch(
+        batch, enc.nodes, seed=7).assigned)[: batch.num_pods]
+    use_pack, stats = pack_mod.choose_plan(ga, pa, batch.req.astype(np.int32),
+                                           batch.valid)
+    assert use_pack, stats
+    assert stats["pack"]["units"] > stats["greedy"]["units"]
+
+
+# ------------------------------------------------------------------ core e2e
+class _CB:
+    def update_allocation(self, r): pass
+    def update_application(self, r): pass
+    def update_node(self, r): pass
+    def predicates(self, a): return None
+    def preemption_predicates(self, a): return None
+    def send_event(self, e): pass
+    def update_container_scheduling_state(self, r): pass
+    def get_state_dump(self): return "{}"
+
+
+def make_core(policy="optimal", queues_yaml=None):
+    cache = SchedulerCache()
+    core = CoreScheduler(cache, solver_options=SolverOptions(policy=policy))
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues",
+                                       config=queues_yaml or ""),
+        _CB())
+    return cache, core
+
+
+def run_core_trace(core, cache, n_nodes=32, waves=2, per_wave=60,
+                   gang=False, cpu=400):
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+
+    nodes = make_kwok_nodes(n_nodes)
+    infos = []
+    for n in nodes:
+        cache.update_node(n)
+        infos.append(NodeInfo(node_id=n.name, action=NodeAction.CREATE))
+    core.update_node(NodeRequest(nodes=infos))
+    core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id="app", queue_name="root.q",
+        user=UserGroupInfo(user="u"))]))
+    placements = {}
+    names = {}
+    for w in range(waves):
+        pods = make_sleep_pods(per_wave, "app", queue="root.q",
+                               name_prefix=f"w{w}", cpu_milli=cpu)
+        asks = []
+        for p in pods:
+            names[p.uid] = p.metadata.name
+            ask = AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p)
+            if gang:
+                ask.task_group_name = f"tg{w}"
+            asks.append(ask)
+        core.update_allocation(AllocationRequest(asks=asks))
+        core.schedule_once()
+        app = core.partition.applications.get("app")
+        for key, alloc in app.allocations.items():
+            placements[names.get(key, key)] = alloc.node_id
+    return placements
+
+
+@pytest.mark.parametrize("gang", [False, True])
+def test_core_optimal_policy_commits_valid_plan(gang):
+    """solver.policy=optimal through the full core cycle (incl. gang-tagged
+    asks): every committed allocation lands on a real node within capacity,
+    and the cycle entry carries the policy A/B keys."""
+    cache, core = make_core("optimal")
+    placements = run_core_trace(core, cache, gang=gang)
+    assert len(placements) == 120
+    per_node = {}
+    for key, node in placements.items():
+        per_node[node] = per_node.get(node, 0) + 400
+    for node, used in per_node.items():
+        info = cache.get_node(node)
+        assert info is not None
+        assert used <= info.allocatable.get("cpu")
+    entry = (core.metrics.get("last_cycle") or {}).get("default") or {}
+    assert entry.get("solver_policy") in ("greedy", "optimal")
+    assert "pack_plan_ms" in entry or "pack_skip" in entry
+
+
+def test_core_quota_held_trace_matches_greedy_admission():
+    """Quota-held traces: the optimal policy must never place more than the
+    quota admits — the gate runs before either solver and is policy-blind."""
+    queues_yaml = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: q
+            resources:
+              max: {vcore: 10}
+"""
+    cache_g, core_g = make_core("greedy", queues_yaml)
+    got_g = run_core_trace(core_g, cache_g, waves=1, per_wave=60)
+    cache_o, core_o = make_core("optimal", queues_yaml)
+    got_o = run_core_trace(core_o, cache_o, waves=1, per_wave=60)
+    # quota admits 25 pods of 400m; both policies must commit exactly those
+    assert len(got_g) == len(got_o) == 25
+
+
+def test_core_pack_fault_falls_back_to_greedy_placements():
+    """A faulted pack path must leave the cycle exactly greedy: placements
+    identical to a policy=greedy run, outcome counted, loop never wedged."""
+    cache_g, core_g = make_core("greedy")
+    want = run_core_trace(core_g, cache_g)
+
+    cache_o, core_o = make_core("optimal")
+    core_o.supervisor.faults.fail("pack", times=8, tier="device")
+    got = run_core_trace(core_o, cache_o)
+    assert got == want
+    c = core_o.obs.get("pack_plans_total")
+    assert c.value(outcome="failed") + c.value(outcome="skipped") >= 1
+
+
+def test_conf_policy_parsing_and_rejection():
+    """solver.policy parses through the validated choice helper; unknown
+    values for any enumerated option reject the update loudly."""
+    from yunikorn_tpu.conf import schedulerconf as sc
+
+    conf = sc.parse_config_map({"solver.policy": "optimal"})
+    assert conf.solver_policy == "optimal"
+    assert SolverOptions.from_conf(conf).policy == "optimal"
+    conf = sc.parse_config_map({"solver.policy": "auto"})
+    assert SolverOptions.from_conf(conf).policy == "greedy"
+    for key, bad in (("solver.policy", "fastest"),
+                     ("solver.gateVectorized", "maybe"),
+                     ("solver.gateDevice", "1"),
+                     ("solver.preemptDevice", "yes"),
+                     ("solver.gateVerify", "auto")):
+        with pytest.raises(ValueError):
+            sc.parse_config_map({key: bad})
+    # the holder rejects a hot-reload update and keeps serving the old
+    # config; an invalid INITIAL configmap fails the boot loudly (there is
+    # no previous config — swallowing it would run everything on defaults)
+    holder = sc.ConfHolder()
+    holder.update_config_maps([{"solver.policy": "optimal"}], initial=True)
+    kept = holder.update_config_maps([{"solver.policy": "bogus"}])
+    assert kept.solver_policy == "optimal"
+    with pytest.raises(ValueError):
+        sc.ConfHolder().update_config_maps([{"solver.policy": "bogus"}],
+                                           initial=True)
+
+
+def test_pack_with_device_mirror_and_node_mask():
+    """The pack dispatch reuses the greedy dispatch's persistent device
+    mirror; with a partition node mask the masked nodes must stay excluded
+    (regression: the device-state + node_mask path had an undefined-name
+    bug that silently disabled the mirror for every masked solve)."""
+    cache = SchedulerCache()
+    for i in range(16):
+        cache.update_node(make_node(f"n{i:02d}", cpu_milli=4000,
+                                    memory=8 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = [make_pod(f"p{i}", cpu_milli=500, memory=2**20)
+            for i in range(64)]
+    asks = [AllocationAsk(p.uid, "app", get_pod_resource(p), pod=p)
+            for p in pods]
+    batch = enc.build_batch(asks)
+    mask = np.zeros(enc.nodes.capacity, bool)
+    allowed = {enc.nodes.index_of(f"n{i:02d}") for i in range(8)}
+    for idx in allowed:
+        mask[idx] = True
+    dev = enc.device_arrays()
+    result = pack_mod.pack_solve_batch(batch, enc.nodes, node_mask=mask,
+                                       device_state=dev, seed=1)
+    assigned = np.asarray(result.assigned)[: batch.num_pods]
+    assert (assigned >= 0).all()
+    assert set(assigned.tolist()) <= allowed

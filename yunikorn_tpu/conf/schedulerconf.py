@@ -62,6 +62,14 @@ CM_SOLVER_PREEMPT_DEVICE = PREFIX_SOLVER + "preemptDevice"  # auto | true | fals
 CM_SOLVER_GATE = PREFIX_SOLVER + "gateVectorized"       # auto | true | false
 CM_SOLVER_GATE_DEVICE = PREFIX_SOLVER + "gateDevice"    # auto | true | false
 CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
+CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optimal
+
+# the tri-state device-path gates share one value domain; solver.policy and
+# solver.gateVerify have their own. All parse through _parse_choice: an
+# unknown value REJECTS the configmap update (ValueError) instead of
+# silently keeping a default the operator did not ask for.
+TRI_STATE = ("auto", "true", "false")
+SOLVER_POLICIES = ("auto", "greedy", "optimal")
 
 # observability.* keys (the obs/ registry + tracer)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
@@ -147,6 +155,12 @@ class SchedulerConf:
     # gate and pin the results identical (doubles gate host cost; the
     # gate-equivalence test tier runs with this on)
     solver_gate_verify: str = "false"
+    # assignment policy: "optimal" runs the jitted LP/ADMM pack solver
+    # (ops/pack_solve.py) next to the greedy solve and commits whichever
+    # plan packs better (greedy is the floor — the cycle falls back when the
+    # pack plan does not beat it); "auto" = greedy for now (flips when the
+    # hardware A/B lands, like PALLAS_TPU_DEFAULT)
+    solver_policy: str = "auto"
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -225,6 +239,19 @@ def _parse_int(v: str, default: int) -> int:
         return default
 
 
+def _parse_choice(key: str, v: str, allowed: Tuple[str, ...]) -> str:
+    """Validated enumerated option (the tri-state device-path gates,
+    solver.gateVerify, solver.policy). Unknown values raise — the whole
+    configmap update is rejected loudly (ConfHolder keeps the previous
+    config) instead of silently running with a default the operator did not
+    configure."""
+    s = v.strip().lower()
+    if s not in allowed:
+        raise ValueError(
+            f"invalid value {v!r} for {key}: expected one of {allowed}")
+    return s
+
+
 def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None) -> SchedulerConf:
     """Parse a flattened configmap into a SchedulerConf (reference :344-448)."""
     conf = (base or SchedulerConf()).clone()
@@ -290,27 +317,17 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     if CM_ROBUST_PROBE_DEADLINE in data:
         conf.robustness_probe_deadline_s = _parse_duration(
             data[CM_ROBUST_PROBE_DEADLINE], conf.robustness_probe_deadline_s)
-    for key, attr in ((CM_SOLVER_USE_PALLAS, "solver_use_pallas"),
-                      (CM_SOLVER_SHARD, "solver_shard"),
-                      (CM_SOLVER_PIPELINE, "solver_pipeline"),
-                      (CM_SOLVER_PREEMPT_DEVICE, "solver_preempt_device"),
-                      (CM_SOLVER_GATE, "solver_gate"),
-                      (CM_SOLVER_GATE_DEVICE, "solver_gate_device")):
+    for key, attr, allowed in (
+            (CM_SOLVER_USE_PALLAS, "solver_use_pallas", TRI_STATE),
+            (CM_SOLVER_SHARD, "solver_shard", TRI_STATE),
+            (CM_SOLVER_PIPELINE, "solver_pipeline", TRI_STATE),
+            (CM_SOLVER_PREEMPT_DEVICE, "solver_preempt_device", TRI_STATE),
+            (CM_SOLVER_GATE, "solver_gate", TRI_STATE),
+            (CM_SOLVER_GATE_DEVICE, "solver_gate_device", TRI_STATE),
+            (CM_SOLVER_GATE_VERIFY, "solver_gate_verify", ("true", "false")),
+            (CM_SOLVER_POLICY, "solver_policy", SOLVER_POLICIES)):
         if key in data:
-            v = data[key].strip().lower()
-            if v in ("auto", "true", "false"):
-                setattr(conf, attr, v)
-            else:
-                logger.warning("invalid tri-state value %r for %s, keeping %s",
-                               data[key], key, getattr(conf, attr))
-    if CM_SOLVER_GATE_VERIFY in data:
-        v = data[CM_SOLVER_GATE_VERIFY].strip().lower()
-        if v in ("true", "false"):
-            conf.solver_gate_verify = v
-        else:
-            logger.warning("invalid boolean value %r for %s, keeping %s",
-                           data[CM_SOLVER_GATE_VERIFY], CM_SOLVER_GATE_VERIFY,
-                           conf.solver_gate_verify)
+            setattr(conf, attr, _parse_choice(key, data[key], allowed))
     return conf
 
 
@@ -395,7 +412,21 @@ class ConfHolder:
                            binary_maps: Optional[List[Dict[str, bytes]]] = None) -> SchedulerConf:
         flat = flatten_config_maps(config_maps, binary_maps)
         with self._lock:
-            new_conf = parse_config_map(flat, SchedulerConf())
+            try:
+                new_conf = parse_config_map(flat, SchedulerConf())
+            except ValueError as e:
+                if initial:
+                    # at startup there is no previous config to keep —
+                    # swallowing the error would silently run the whole
+                    # deployment on defaults; fail the boot loudly instead
+                    # (deploy-time validation, the operator sees it)
+                    logger.error("invalid initial configmap: %s", e)
+                    raise
+                # hot reload with an unknown enumerated value: reject the
+                # whole update (keep serving the previous config) instead
+                # of silently running with defaults the operator didn't set
+                logger.error("rejecting configmap update: %s", e)
+                return self._conf
             if not initial:
                 check_non_reloadable(self._conf, new_conf)
                 # keep old values for non-reloadable fields

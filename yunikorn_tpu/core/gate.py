@@ -310,6 +310,33 @@ class GateProblem:
     t_extract: float = 0.0         # extraction end
 
 
+class AskExtractCache:
+    """Ask-level extraction cache: the per-ask Python derivation inside
+    extract_problem's flatten — attribute walks plus the resource-signature
+    tuple build — cached across cycles keyed by allocation key and
+    validated by ask object identity. The flatten was the last O(pending
+    asks) host pass per cycle (ROADMAP round-11 follow-up); with the cache
+    a churn cycle re-derives only the asks that actually changed, the same
+    O(changed) contract the encoder's row cache and the DeviceRowStore
+    already honor. The rank lexsort itself stays O(n log n) in C.
+
+    Validation covers the in-place mutations the scheduler actually
+    performs on a reused ask object (update_allocation restamps `seq`; a
+    resubmission may swap `resource`): an entry is fresh only when the ask
+    object, its resource object, its seq AND its priority all still match —
+    anything else re-derives, so a stale signature can never rank or
+    charge an ask differently than the legacy loop's fresh attribute reads.
+
+    hits/derived are per-call counters (reset at each extract_problem) so
+    the churn test and the cycle entry can pin the contract."""
+
+    def __init__(self):
+        # key -> (ask, resource, prio, submit, seq, sig)
+        self.d: Dict[str, tuple] = {}
+        self.hits = 0
+        self.derived = 0
+
+
 @contextlib.contextmanager
 def paused_gc():
     """Cyclic GC paused (restored on exit): the gate's flatten/extract
@@ -329,22 +356,28 @@ def paused_gc():
 
 
 def vector_admit(by_queue: Dict[str, list], meta: Dict[str, tuple],
-                 queue_tree, seed_admissions=None) -> Tuple[list, int, dict]:
+                 queue_tree, seed_admissions=None,
+                 cache=None) -> Tuple[list, int, dict]:
     """Array-form replacement for the legacy gate's rank + admit phases:
     extract_problem + the host numpy scan (host_scan), GC paused."""
     with paused_gc():
         return host_scan(
-            extract_problem(by_queue, meta, queue_tree, seed_admissions))
+            extract_problem(by_queue, meta, queue_tree, seed_admissions,
+                            cache=cache))
 
 
 def extract_problem(by_queue, meta, queue_tree,
-                    seed_admissions=None) -> GateProblem:
+                    seed_admissions=None, cache=None) -> GateProblem:
     """Flatten pending asks into a GateProblem — see GateProblem.
 
     by_queue: qname -> [(app, ask)] pending entries (exclude_keys already
     applied by the collector). meta: qname -> (leaf, fair_share, prio_adj)
     resolved by the caller (per-cycle cached). queue_tree: the live
     QueueTree (seed charging resolves queues the pending set may not name).
+    cache: optional AskExtractCache — per-ask derivation (priority/submit/
+    seq attribute walks + the resource-signature tuple) then runs only for
+    asks not seen before or replaced since (identity-validated), so a churn
+    cycle's flatten is O(changed asks) of Python plus C-level array work.
 
     Raises GateFallback when the cycle cannot be represented exactly.
     """
@@ -371,22 +404,57 @@ def extract_problem(by_queue, meta, queue_tree,
     get_prio = attrgetter("priority")
     get_submit = attrgetter("submit_time")
     get_seq = attrgetter("seq")
+    if cache is not None:
+        cache.hits = cache.derived = 0
     q_data = []
     for qname in qnames:
         entries_q = by_queue[qname]
         _leaf, share, adj = meta[qname]
         apps_q, asks_q = zip(*entries_q)
-        prio_l = list(map(get_prio, asks_q))
-        try:
+        if cache is not None:
+            # ask-level cache: derive only entries whose ask object changed
+            getd = cache.d.get
+            prio_l: List[int] = []
+            submit_l: List[float] = []
+            seq_l: List[int] = []
+            sig_q: List[tuple] = []
+            for app, ask in entries_q:
+                e = getd(ask.allocation_key)
+                if (e is None or e[0] is not ask
+                        or e[1] is not ask.resource or e[4] != ask.seq
+                        or e[2] != (ask.priority or 0)):
+                    e = (ask, ask.resource, int(ask.priority or 0),
+                         app.submit_time, ask.seq, tuple(_res_items(ask)))
+                    cache.d[ask.allocation_key] = e
+                    cache.derived += 1
+                else:
+                    cache.hits += 1
+                prio_l.append(e[2])
+                submit_l.append(e[3])
+                seq_l.append(e[4])
+                sig_q.append(e[5])
             prio = np.asarray(prio_l, np.int64) + adj
-        except (TypeError, ValueError):
-            # defensive None-priority path (ask.priority or 0)
-            prio = np.asarray([(p or 0) for p in prio_l], np.int64) + adj
-        submit = np.asarray(list(map(get_submit, apps_q)), np.float64)
-        seq = np.asarray(list(map(get_seq, asks_q)), np.int64)
+            submit = np.asarray(submit_l, np.float64)
+            seq = np.asarray(seq_l, np.int64)
+        else:
+            sig_q = None
+            prio_raw = list(map(get_prio, asks_q))
+            try:
+                prio = np.asarray(prio_raw, np.int64) + adj
+            except (TypeError, ValueError):
+                # defensive None-priority path (ask.priority or 0)
+                prio = np.asarray([(p or 0) for p in prio_raw],
+                                  np.int64) + adj
+            submit = np.asarray(list(map(get_submit, apps_q)), np.float64)
+            seq = np.asarray(list(map(get_seq, asks_q)), np.int64)
         q_data.append((-int(prio.max()), share, qname, prio, submit, seq,
-                       apps_q, asks_q))
+                       apps_q, asks_q, sig_q))
     q_data.sort(key=lambda t: t[:3])
+    if (cache is not None
+            and len(cache.d) > 2 * sum(len(t[7]) for t in q_data) + 1024):
+        # keys consumed through other paths leave orphans; sweep rarely
+        live = {a.allocation_key for t in q_data for a in t[7]}
+        cache.d = {k: v for k, v in cache.d.items() if k in live}
 
     # ---- flatten in queue order + global rank (one lexsort; stable, like
     # the legacy stable per-queue sort with its (prio, submit, seq) key)
@@ -507,7 +575,13 @@ def extract_problem(by_queue, meta, queue_tree,
     # purely a throughput optimization); rows are built once per distinct
     # shape and broadcast with one fancy-index gather. Unconstrained asks
     # get rows too — harmless, they have no membership entries.
-    sigs = list(map(tuple, map(_res_items, asks_ord)))
+    if cache is not None:
+        sig_flat: List[tuple] = []
+        for t in q_data:
+            sig_flat += t[8]
+        sigs = [sig_flat[i] for i in order.tolist()]
+    else:
+        sigs = list(map(tuple, map(_res_items, asks_ord)))
     names = trackers.res_names
     row_gid: Dict[tuple, int] = {}
     rows_l: List[np.ndarray] = []

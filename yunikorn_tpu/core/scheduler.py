@@ -156,6 +156,14 @@ class SolverOptions:
     # counts gate_mismatch_total and the legacy result wins. Doubles the
     # gate's host cost; test/debug knob.
     gate_verify: bool = False
+    # assignment policy (solver.policy): "optimal" dispatches the jitted
+    # LP/ADMM pack solver (ops/pack_solve.py) alongside the greedy solve as
+    # a supervised "pack" path and commits whichever plan packs better —
+    # the greedy plan is the floor (differential oracle in the gateVerify /
+    # preempt-parity mold: a pack plan that does not beat greedy, fails, or
+    # proves infeasible falls back for the cycle). "greedy" = the
+    # rank-ordered argmin only.
+    policy: str = "greedy"
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -182,6 +190,10 @@ class SolverOptions:
                 getattr(conf, "solver_gate_device", "auto"), None),
             gate_verify=str(getattr(conf, "solver_gate_verify",
                                     "false")).lower() == "true",
+            # auto = greedy until the hardware A/B flips the default
+            policy=("optimal"
+                    if str(getattr(conf, "solver_policy", "auto")).lower()
+                    == "optimal" else "greedy"),
         )
 
 
@@ -232,6 +244,15 @@ class _SolveHandle:
     # each supervised execute: an abandoned dispatch that unwedges after a
     # discard finds it stale and bails instead of racing the live mirror
     mirror_epoch: Optional[int] = None
+    # solver.policy=optimal: the async pack-solver plan dispatched next to
+    # the greedy solve (None = pack skipped/failed; greedy is the floor)
+    pack: Optional[object] = None
+    pack_t0: float = 0.0              # pack dispatch start (plan-latency ms)
+    # the persistent device mirror the greedy device dispatch used (single-
+    # device only): the pack dispatch reuses it read-only so an optimal
+    # cycle ships O(changed) node state + the row-store req gather, not a
+    # full re-upload (None when greedy ran on cpu/host or mesh-sharded)
+    device_state: Optional[dict] = None
 
 
 class CoreScheduler(SchedulerAPI):
@@ -408,6 +429,28 @@ class CoreScheduler(SchedulerAPI):
             "admission-scan passes executed across cycles (device scan or "
             "host vectorized; the device pass count is bounded by "
             "ceil(log2(n))+C by construction)")
+        # ---- optimal packing (round 12, solver.policy=optimal) ----
+        self._m_pack = m.counter(
+            "pack_plans_total",
+            "pack-solver (LP/ADMM, solver.policy=optimal) cycles by outcome "
+            "(won = pack plan committed, fell_back = greedy packed at least "
+            "as well, skipped = batch outside the pack model or circuit "
+            "open, failed = dispatch/materialize error, infeasible = plan "
+            "refused by the capacity re-check — any nonzero count is a bug)",
+            labelnames=("outcome",))
+        self._g_pack_util = m.gauge(
+            "pack_last_util",
+            "most recent cycle's packed-units ratio pack/greedy "
+            "(> 1 = the pack plan packed more of the cluster)")
+        self._g_pack_ms = m.gauge(
+            "pack_last_plan_ms",
+            "dispatch-to-decision latency of the most recent pack plan (ms)")
+        # stats of the most recent pack comparison (chosen policy, util
+        # ratio, plan ms); ride the cycle entry and the solve tracer span
+        self._last_pack_stats: dict = {}
+        # single-device mirror used by the most recent greedy device
+        # dispatch (stashed by _dispatch_solve for the pack dispatch)
+        self._last_solve_device_state = None
         # stats of the most recent gate pass (path, passes, sub-stage ms);
         # ride the cycle entry and the gate tracer span
         self._last_gate_stats: dict = {}
@@ -418,6 +461,10 @@ class CoreScheduler(SchedulerAPI):
         # leaf resolution, DRF dominant share and priority adjustment are
         # pure functions of the tree's accounting epoch + cluster capacity
         self._gate_meta_cache: Optional[tuple] = None
+        # ask-level extraction cache (gate.AskExtractCache): the flatten's
+        # per-ask Python derivation runs only for changed asks — the
+        # O(changed) analog of the encoder's row cache
+        self._gate_extract_cache = gate_mod.AskExtractCache()
         # in-flight quantized-row cache for _inflight_overlay: allocation
         # key -> quantized request row (quantize once per allocation, not
         # once per allocation per cycle)
@@ -1125,6 +1172,9 @@ class CoreScheduler(SchedulerAPI):
             except Exception:
                 logger.exception("device node-state refresh failed; "
                                  "falling back to per-cycle upload")
+        # single-device mirror stashed for the cycle's pack dispatch (the
+        # mesh mirror is sharded; pack is ineligible under a mesh anyway)
+        self._last_solve_device_state = device_state if not use_mesh else None
         jc0 = assign_mod.jit_cache_entries()
         result = None
         if use_mesh:
@@ -1243,15 +1293,169 @@ class CoreScheduler(SchedulerAPI):
                          inflight_ports=inflight_ports,
                          allow_mesh=allow_mesh,
                          mirror_epoch=self.encoder.mirror_epoch)
+        # solver.policy label rides every supervised dispatch + solve span
+        # this cycle, so dashboards separate the greedy and optimal paths
+        # without new series names
+        self.supervisor.policy_label = ("optimal" if self._pack_on()
+                                        else "greedy")
+        if allow_mesh:
+            # drain solves (allow_mesh=False: the locality-fallback rounds)
+            # ride the cycle's MAIN pack stats — resetting here would let a
+            # drain round clobber a pack-won comparison already recorded
+            self._last_pack_stats = {}
 
         def mk(tier):
             return lambda: self._solve_tier_dispatch(h, tier)
 
+        self._last_solve_device_state = None
         result, tier = self.supervisor.execute(
             "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
             commit_success=False)
         h.result, h.tier = result, tier
+        if tier == "device":
+            h.device_state = self._last_solve_device_state
+        if allow_mesh:
+            self._pack_dispatch(h)
         return h
+
+    # --------------------------------------------- optimal packing (pack)
+    # solver.policy=optimal: the jitted LP/ADMM pack solver (POP-partitioned
+    # global bin packing, ops/pack_solve.py) dispatches as its own
+    # single-tier supervised path NEXT TO the greedy solve — the effective
+    # ladder is device-optimal → greedy-device → cpu → host-exact: a pack
+    # dispatch that fails, blows its deadline, or trips its circuit leaves
+    # the greedy handle authoritative, and the materialized pack plan only
+    # commits when the differential comparison (choose_plan) proves it
+    # strictly better packed than greedy's. Feasibility is structural: the
+    # pack solver rounds/repairs through the same group-feasibility masks,
+    # overlays and prefix-fit arithmetic the greedy solve uses, and the
+    # free_after >= 0 re-check below refuses the plan outright otherwise.
+
+    def _pack_on(self) -> bool:
+        return getattr(self.solver, "policy", "greedy") == "optimal"
+
+    def _pack_eligible(self, batch) -> Optional[str]:
+        """None when the pack solver models this batch; else the skip
+        reason (the batch takes the greedy plan for the cycle). Drain
+        solves never reach here (_solve_dispatch gates on allow_mesh).
+        Deterministic scope gates ALL live here, before the supervised
+        dispatch: PackUnsupported raised inside supervisor.run would ride
+        the transient-retry/breaker machinery (backoff sleeps on the
+        scheduler thread, circuit flaps) for what is a benign skip."""
+        import numpy as np
+
+        from yunikorn_tpu.ops import pack_solve as pack_mod
+
+        if self._mesh is not None:
+            from yunikorn_tpu.parallel import mesh as mesh_mod
+
+            if not mesh_mod.PACK_SHARDED_SUPPORTED:
+                return "mesh"
+        if batch.locality is not None:
+            return "locality"
+        if batch.g_ports.view(np.uint32).any():
+            return "ports"
+        if not pack_mod.shape_supported(batch.req.shape[0],
+                                        self.encoder.nodes.capacity):
+            return "shape"
+        return None
+
+    def _pack_dispatch(self, h: "_SolveHandle") -> None:
+        """Async-dispatch the pack solve for an eligible optimal-policy
+        cycle; failures leave h.pack None (greedy stays authoritative)."""
+        if not self._pack_on():
+            return
+        reason = self._pack_eligible(h.batch)
+        if reason is not None:
+            self._m_pack.inc(outcome="skipped")
+            self._last_pack_stats = {"policy": "greedy", "skip": reason}
+            return
+        if not self.supervisor.allow("pack"):
+            self._m_pack.inc(outcome="skipped")
+            self._last_pack_stats = {"policy": "greedy", "skip": "circuit"}
+            return
+        from yunikorn_tpu.ops import pack_solve as pack_mod
+
+        h.pack_t0 = time.perf_counter()
+        try:
+            h.pack = self.supervisor.run(
+                "pack",
+                lambda: pack_mod.pack_solve_batch(
+                    h.batch, self.encoder.nodes, policy=h.policy,
+                    free_delta=h.overlay, node_mask=h.node_mask,
+                    ports_delta=h.inflight_ports, seed=self._cycle_seq,
+                    chunk=self.solver.chunk,
+                    device_state=h.device_state),
+                commit_success=False)
+        except AbandonedDispatch:
+            raise  # zombie thread: stop, don't continue a stale cycle
+        except pack_mod.PackUnsupported as e:
+            self._m_pack.inc(outcome="skipped")
+            self._last_pack_stats = {"policy": "greedy", "skip": str(e)}
+        except Exception:
+            self._m_pack.inc(outcome="failed")
+            self._last_pack_stats = {"policy": "greedy", "skip": "error"}
+            logger.exception("pack solve dispatch failed; greedy plan "
+                             "stands this cycle")
+
+    def _pack_choose(self, h: "_SolveHandle", greedy_assigned):
+        """Materialize the pack plan and run the differential comparison;
+        returns the committed assignment (pack only when strictly better)."""
+        import numpy as np
+
+        from yunikorn_tpu.ops import pack_solve as pack_mod
+
+        n = h.batch.num_pods
+        try:
+            pack_assigned, feasible = self.supervisor.run(
+                "pack",
+                lambda: (np.asarray(h.pack.assigned)[:n],
+                         bool(np.asarray(h.pack.feasible))))
+        except AbandonedDispatch:
+            raise  # zombie thread: stop, don't commit a stale cycle
+        except Exception:
+            self._m_pack.inc(outcome="failed")
+            self._last_pack_stats = {"policy": "greedy", "skip": "error"}
+            logger.exception("pack plan materialization failed; greedy "
+                             "plan stands this cycle")
+            return greedy_assigned
+        plan_ms = (time.perf_counter() - h.pack_t0) * 1000
+        if not feasible:
+            # structurally impossible (the rounding/repair shares greedy's
+            # fit arithmetic, and pre-existing overlay negativity is
+            # excluded from the device-side check) — belt and braces:
+            # never commit such a plan
+            self._m_pack.inc(outcome="infeasible")
+            self._last_pack_stats = {"policy": "greedy", "skip": "infeasible"}
+            logger.error("pack plan over-committed capacity; greedy plan "
+                         "stands this cycle")
+            return greedy_assigned
+        # the committed objective matches the solver's (capacity-normalized
+        # units) and is priority-guarded: the pack plan must match greedy
+        # class by class from the highest priority down before packing
+        # quality decides, so optimal can never starve a high-priority ask
+        use_pack, stats = pack_mod.choose_plan(
+            np.asarray(greedy_assigned)[:n], pack_assigned,
+            h.batch.req.astype(np.int32), h.batch.valid,
+            cap_i=np.floor(self.encoder.nodes.capacity_arr).astype(np.int64),
+            priorities=np.asarray(
+                [(a.priority or 0) for a in h.admitted], np.int64))
+        # pack_util: the A/B headline — capacity-normalized packed units of
+        # the pack plan relative to the greedy plan on the same cycle
+        # (> 1 = pack packed more of the cluster)
+        util_ratio = (stats["pack"]["units_norm"]
+                      / max(stats["greedy"]["units_norm"], 1e-9))
+        self._m_pack.inc(outcome="won" if use_pack else "fell_back")
+        self._g_pack_util.set(util_ratio)
+        self._g_pack_ms.set(plan_ms)
+        self._last_pack_stats = {
+            "policy": "optimal" if use_pack else "greedy",
+            "pack_util": round(util_ratio, 4),
+            "pack_plan_ms": round(plan_ms, 2),
+            "pack_placed": stats["pack"]["placed"],
+            "greedy_placed": stats["greedy"]["placed"],
+        }
+        return pack_assigned if use_pack else greedy_assigned
 
     def _solve_materialize(self, h: "_SolveHandle"):
         """Finish one supervised solve: materialize the async result under
@@ -1280,6 +1484,10 @@ class CoreScheduler(SchedulerAPI):
             "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
             start_tier=h.tier)
         h.tier = tier
+        if h.pack is not None:
+            # optimal policy: the differential comparison against the
+            # greedy plan decides which assignment commits
+            assigned = self._pack_choose(h, assigned)
         return assigned
 
     def _ask_pending(self, ask) -> bool:
@@ -1632,6 +1840,8 @@ class CoreScheduler(SchedulerAPI):
         self._cycle_seq += 1
         cid = self._cycle_seq
         self.supervisor.cycle_id = cid
+        self.supervisor.policy_label = ("optimal" if self._pack_on()
+                                        else "greedy")
         self._check_app_completion()
         self._check_placeholder_timeouts()
         replaced = self._replace_placeholders()
@@ -1727,6 +1937,7 @@ class CoreScheduler(SchedulerAPI):
                 entry["encode_device_rows"] = self._last_encode_device["rows"]
                 entry["encode_device_bytes"] = self._last_encode_device["bytes"]
             entry.update(_gate_extras(self._last_gate_stats))
+            entry.update(_pack_extras(self._last_pack_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
@@ -1738,7 +1949,9 @@ class CoreScheduler(SchedulerAPI):
             tr.add("encode", cid, t_gate, t_encode,
                    cached=int(self.encoder.last_encode_cached),
                    reencoded=self.encoder.last_encode_rows_reencoded)
-            tr.add("solve", cid, t_encode, t_solve, **self._last_solve_stats)
+            tr.add("solve", cid, t_encode, t_solve,
+                   policy=self._last_pack_stats.get("policy", "greedy"),
+                   **self._last_solve_stats)
             tr.add("commit", cid, t_solve, t_commit, allocs=len(new_allocs))
         return len(new_allocs), (pinned, replaced, new_allocs,
                                  preempt_releases, skipped_keys, fallback_keys)
@@ -1826,6 +2039,8 @@ class CoreScheduler(SchedulerAPI):
             self._use_partition("default")
             if getattr(self.partition, "draining", False):
                 return None
+            self.supervisor.policy_label = ("optimal" if self._pack_on()
+                                            else "greedy")
             admitted, ranks, held = self._collect_and_gate(
                 exclude_keys=self._inflight_ask_keys or None,
                 seed_admissions=self._inflight_gate_seed or None)
@@ -1961,7 +2176,8 @@ class CoreScheduler(SchedulerAPI):
                 self._inflight_gate_seed = []
             return None, 0
         t_mat1 = time.time()
-        self.tracer.add("solve", cyc.cycle_id, cyc.t_dispatched, t_mat0)
+        self.tracer.add("solve", cyc.cycle_id, cyc.t_dispatched, t_mat0,
+                        policy=self._last_pack_stats.get("policy", "greedy"))
         self.tracer.add("materialize", cyc.cycle_id, t_mat0, t_mat1)
         with self._lock:
             self._use_partition("default")
@@ -2014,6 +2230,7 @@ class CoreScheduler(SchedulerAPI):
                 entry["encode_device_rows"] = cyc.encode_device["rows"]
                 entry["encode_device_bytes"] = cyc.encode_device["bytes"]
             entry.update(_gate_extras(cyc.gate_stats))
+            entry.update(_pack_extras(self._last_pack_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
@@ -2370,9 +2587,9 @@ class CoreScheduler(SchedulerAPI):
         if use_device or use_vector:
             try:
                 with gate_mod.paused_gc():
-                    problem = gate_mod.extract_problem(by_queue, meta,
-                                                       self.queues,
-                                                       seed_admissions)
+                    problem = gate_mod.extract_problem(
+                        by_queue, meta, self.queues, seed_admissions,
+                        cache=self._gate_extract_cache)
             except GateFallback as e:
                 # the cycle's quantities exceed the gate's exact int64 range
                 # (or the batch its size ceiling): the loop is the authority
@@ -2423,6 +2640,10 @@ class CoreScheduler(SchedulerAPI):
                     len(admitted), held, len(ref_admitted), ref_held)
                 admitted, held = ref_admitted, ref_held
                 stats = dict(stats, path="legacy", mismatch=1)
+        if problem is not None:
+            # O(changed) extraction evidence for the cycle entry/bench
+            stats["extract_derived"] = self._gate_extract_cache.derived
+            stats["extract_reused"] = self._gate_extract_cache.hits
         for k in ("rank_ms", "admit_ms"):
             if k in stats:
                 self._m_gate_stage.observe(stats[k], stage=k[:-3])
@@ -2893,6 +3114,17 @@ class CoreScheduler(SchedulerAPI):
         return json.dumps(self.get_partition_dao(), default=str)
 
 
+def _pack_extras(stats: dict) -> dict:
+    """Pack-comparison stats (solver.policy=optimal) for the cycle entry:
+    the committed policy plus the A/B numbers when a comparison ran."""
+    out = {"solver_policy": stats.get("policy", "greedy")}
+    for k in ("pack_util", "pack_plan_ms", "pack_placed", "greedy_placed",
+              "skip"):
+        if k in stats:
+            out["pack_skip" if k == "skip" else k] = stats[k]
+    return out
+
+
 def _gate_extras(stats: dict) -> dict:
     """Gate-pass stats (core/gate.py) renamed for the cycle entry and the
     gate tracer span: path + sub-stage ms + scan-pass/tracker counts."""
@@ -2904,6 +3136,8 @@ def _gate_extras(stats: dict) -> dict:
                      ("device_ms", "gate_device_ms"),
                      ("max_passes", "gate_max_passes"),
                      ("transfer_bytes", "gate_transfer_bytes"),
+                     ("extract_derived", "gate_extract_derived"),
+                     ("extract_reused", "gate_extract_reused"),
                      ("compiled", "gate_compiled")):
         if src in stats:
             v = stats[src]

@@ -110,6 +110,28 @@ class _Metric:
     def _child_samples(self, labels, child):
         return [("", labels, child)]
 
+    def sum_over(self, **labels) -> float:
+        """Sum of scalar children matching a PARTIAL label set (counters/
+        gauges only) — the reading analog of a PromQL sum by(): callers that
+        don't care about one dimension (e.g. the policy label on
+        supervised_dispatch_total) aggregate over it instead of guessing
+        every value."""
+        if self.kind == "histogram":
+            raise TypeError(
+                f"{self.name}: sum_over aggregates scalar children only "
+                "(counters/gauges); histogram children are bucket records")
+        unknown = set(labels) - set(self.labelnames)
+        if unknown:
+            raise ValueError(f"{self.name}: unknown labels {sorted(unknown)}")
+        idx = [self.labelnames.index(k) for k in labels]
+        want = [str(v) for v in labels.values()]
+        total = 0
+        with self._lock:
+            for key, child in self._children.items():
+                if all(key[i] == w for i, w in zip(idx, want)):
+                    total += child
+        return total
+
 
 class Counter(_Metric):
     kind = "counter"

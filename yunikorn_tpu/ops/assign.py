@@ -1114,7 +1114,7 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
             node_ports_u32 = node_ports_u32 | jnp.asarray(pd)
         node_ok = dev["node_ok"]
         if node_mask is not None:
-            node_ok = node_ok & jnp.asarray(node_mask[:M])
+            node_ok = node_ok & jnp.asarray(node_mask[: node_ok.shape[0]])
         return _finish_solve_args(batch, req_i, score_cols, dev["labels"],
                                   dev["taints_hard"], dev["taints_soft"],
                                   node_ports_u32, node_ok, free_i, cap_i, na)

@@ -1,0 +1,495 @@
+"""Globally-optimal device packing: a jitted LP/ADMM relaxation of the
+ask×node assignment with POP-style partitioning, behind `solver.policy=optimal`.
+
+The production solve (ops/assign.py) is a rank-ordered greedy argmin: fast,
+conflict-free, but myopic — under fragmentation and priority skew it strands
+capacity a global view would pack (the first open ROADMAP item). CvxCluster
+(arXiv 2605.01614) shows granular allocation problems of exactly this shape
+solve orders of magnitude faster as relaxed convex programs; POP (arXiv
+2110.11927) shows partitioning a granular allocation problem into fixed-shape
+random subproblems keeps quality within a few percent of the full solve while
+bounding the problem size. On this codebase the bound is what matters twice
+over: it caps the dense [n, m] relaxation state a part materializes AND pins
+every compiled XLA program to a standard bucket (docs/PERF.md compile-cost
+findings — unbounded shapes mean unbounded compiles).
+
+The solve is three fixed-shape stages inside ONE jitted program:
+
+  partition   seeded `jax.random.permutation` of asks and nodes, reshaped to
+              K equal parts (POP's random partitioning). Node parts are
+              DISJOINT, so subproblems commit capacity independently — no
+              cross-part conflict resolution is ever needed.
+  relax       per part, a dual-decomposition LP relaxation (the ADMM/dual
+              ascent family): per-node-per-resource prices λ start at zero;
+              each of `lp_iters` fixed `lax.fori_loop` steps computes every
+              ask's reduced-cost utility  u = score − ⟨req, λ⟩  over the
+              part's nodes, relaxes the integral assignment to a softmax
+              x ∈ [0,1]^{n×m}, and ascends λ on the aggregate overload
+              (Σ_i x_i·req − free)⁺. Prices make contended nodes expensive,
+              steering the fractional mass toward a globally packed solution
+              instead of the greedy's per-ask argmax.
+  round+repair  seeded randomized rounding (deterministic per seed) through
+              the greedy solver's OWN accept machinery: each round samples
+              every ask a node from its relaxed assignment distribution
+              (Gumbel-max over reduced costs — proposals spread across
+              nodes in proportion to the LP's fractional mass instead of
+              herding onto one argmax node), masks to
+              `group_feasibility`-screened nodes that FIT, lexsorts by
+              (node, size desc, rank) and accepts the per-node-segment
+              prefix that fits (`ops.assign._segment_prefix_accept`),
+              best-fit-decreasing inside each segment. Asks the partition
+              strands (their part's capacity exhausted) then run through
+              the unmodified greedy round loop (`ops.assign._solve_rounds`)
+              over the FULL node set with the parts' residual capacity — so
+              a bad random cut never costs placements, and every placement
+              goes through the exact same feasibility masks and prefix-fit
+              arithmetic greedy placements do. Infeasible output is
+              impossible by construction; the core still re-checks
+              `free_after >= 0` before committing (belt and braces,
+              `pack_plans_total{outcome=infeasible}`).
+
+Scope (explicitly gated by the core, not silently mis-handled): batches with
+locality constraints, host-port requests, or a sharded mesh
+(parallel.mesh.PACK_SHARDED_SUPPORTED) fall back to greedy for the cycle —
+PackUnsupported names the reason. The differential contract with greedy is
+pinned by tests/test_pack_solve.py and enforced at runtime by the core's
+choose_plan comparison: the pack plan commits only when its packed objective
+beats the greedy plan's, otherwise the cycle falls back (the
+gateVerify/preempt-parity mold).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from yunikorn_tpu.models.policies import node_base_scores
+from yunikorn_tpu.ops.assign import (
+    NEG_INF,
+    _hoist_group_state,
+    _segment_prefix_accept,
+    _solve_rounds,
+    prepare_solve_args,
+)
+
+# fixed iteration counts: every trip count is static by construction, so the
+# compiled program's cost is bounded no matter what the trace looks like
+LP_ITERS = 24          # dual-ascent steps per part
+ROUND_ROUNDS = 4       # rounding accept rounds per part
+REPAIR_ROUNDS = 8      # greedy rounds over the full node set for leftovers
+
+# price-ascent tuning: utilities are O(1) (node_base_scores ∈ [0,1] + small
+# soft adjustments), requests/free are normalized per resource column
+_LP_ETA = 0.5          # dual step on relative overload
+_LP_INV_TAU = 8.0      # softmax sharpness of the relaxed assignment
+_LAM_MAX = 64.0        # price clip (keeps reduced costs finite/orderable)
+_MASK_FILL = -1.0e9    # finite -inf for masked softmax rows
+
+# partition sizing: smallest power-of-two K whose parts keep the dense
+# relaxation state under the cell budget, subject to floors that keep a part
+# a meaningful packing problem
+_CELL_BUDGET = 1 << 22     # max n*m f32 cells a part may materialize (16 MiB)
+_MIN_PART_PODS = 64
+_MIN_PART_NODES = 16
+MAX_PARTS = 16
+
+
+class PackUnsupported(Exception):
+    """This batch (or runtime mode) is outside the pack solver's model; the
+    caller must keep the greedy plan for the cycle."""
+
+
+def pick_parts(n_pods: int, n_nodes: int) -> int:
+    """Standard partition-count bucket for a (pods, nodes) shape.
+
+    Deterministic in the shape alone, so every compiled program variant is
+    keyed by the same standard buckets the encoder already pads to."""
+    k = 1
+    while (k < MAX_PARTS
+           and n_pods % (2 * k) == 0 and n_nodes % (2 * k) == 0
+           and n_pods // (2 * k) >= _MIN_PART_PODS
+           and n_nodes // (2 * k) >= _MIN_PART_NODES
+           and (n_pods // k) * (n_nodes // k) > _CELL_BUDGET):
+        k *= 2
+    return k
+
+
+def shape_supported(n_pods: int, n_nodes: int) -> bool:
+    """Whether a (padded pods, node capacity) shape is packable: non-empty
+    and partitionable within the cell budget. The core pre-gates on this
+    BEFORE the supervised dispatch — a deterministic scope gate must skip
+    cheaply, not ride the supervisor's transient-retry/breaker machinery."""
+    if n_pods < 1 or n_nodes < 1:
+        return False
+    k = pick_parts(n_pods, n_nodes)
+    return (n_pods // k) * (n_nodes // k) <= 4 * _CELL_BUDGET
+
+
+@dataclasses.dataclass
+class PackResult:
+    assigned: jnp.ndarray      # [N] int32 node row, -1 unassigned
+    free_after: jnp.ndarray    # [M, R] int32
+    # bool scalar: every cell of free_after >= min(initial free, 0) — the
+    # plan never over-commits beyond pre-existing overlay negativity
+    feasible: jnp.ndarray
+    n_parts: int
+    seed: int
+
+    def block_until_ready(self):
+        self.assigned.block_until_ready()
+        return self
+
+
+def _relax_part(preq_f, feas, pvalid, base, soft, free_f, lp_iters: int):
+    """Dual-decomposition LP relaxation for one part.
+
+    The relaxed program is the packing LP itself — maximize the total
+    normalized units placed, Σ x_ij·v_i with v_i = Σ_r req_f[i,r], subject
+    to per-node-per-resource capacity — solved by dual ascent: prices λ[m,R]
+    rise on overloaded (node, resource) pairs, each ask's mass moves by a
+    softmax over reduced costs  u = v − ⟨req, λ⟩ (+ a small score tiebreak)
+    across its feasible nodes AND an always-feasible null column of utility
+    0, so an ask whose value the prices no longer cover drops out instead of
+    crowding a constrained node (the knapsack-LP optimality condition).
+
+    preq_f [n, R] and free_f [m, R] are column-normalized f32; returns the
+    final reduced-cost score matrix s [n, m] (higher = prefer)."""
+    n = preq_f.shape[0]
+    m, R = free_f.shape
+    ok = feas & pvalid[:, None]
+    v = jnp.sum(preq_f, axis=1)                                # [n] value
+    tiebreak = 0.05 * (base[None, :] + soft)
+
+    def reduced(lam):
+        return v[:, None] - preq_f @ lam.T + tiebreak          # [n, m]
+
+    def body(_, lam):
+        u = jnp.where(ok, reduced(lam), _MASK_FILL)
+        u_aug = jnp.concatenate([u, jnp.zeros((n, 1), jnp.float32)], axis=1)
+        x = jax.nn.softmax(u_aug * _LP_INV_TAU, axis=1)[:, :m]
+        x = jnp.where(ok, x, 0.0)
+        load = x.T @ preq_f                                    # [m, R]
+        over = (load - free_f) / jnp.maximum(free_f, 1e-3)
+        return jnp.clip(lam + _LP_ETA * over, 0.0, _LAM_MAX)
+
+    lam = lax.fori_loop(0, lp_iters, body, jnp.zeros((m, R), jnp.float32))
+    # the base half of the tiebreak stays OUT of the returned scores: the
+    # rounding re-scores base from its CURRENT free capacity each round,
+    # and a stale dispatch-time base would keep proposals herding onto
+    # already-drained nodes; the node-static soft preferences stay in
+    return v[:, None] - preq_f @ lam.T + 0.05 * soft
+
+
+def _round_part(preq, prank, pvalid, feas, scores, nfree, ncap, size_key,
+                key, rounds: int, policy: str, sc_cols: int):
+    """Randomized rounding for one part, seeded and deterministic: each
+    round samples every ask a node from its relaxed assignment distribution
+    (Gumbel-max over the reduced costs — proposals land across nodes in
+    proportion to the LP's fractional mass instead of herding onto one
+    argmax node; see ops/assign._water_fill_proposals for the herding
+    failure), then accepts through the greedy solver's per-node-segment
+    prefix-fit — identical feasibility arithmetic. Within a node segment
+    acceptance runs LARGEST-FIRST (best-fit-decreasing, rank as the
+    tie-break): BFD's packing guarantee needs the big asks placed before
+    small ones fill the gaps. The per-round base score is refreshed from
+    the CURRENT free capacity (the LP prices are what stay fixed)."""
+    n, R = preq.shape
+    m = nfree.shape[0]
+    free_ext0 = jnp.concatenate([nfree, jnp.zeros((1, R), jnp.int32)], axis=0)
+    init = (free_ext0, ~pvalid, jnp.full((n,), -1, jnp.int32))
+
+    def body(i, state):
+        free_ext, done, assigned = state
+        cur = free_ext[:m]
+        margin = jnp.full((n, m), jnp.int32(2**30))
+        for r in range(R):                       # static unroll, like greedy
+            margin = jnp.minimum(margin,
+                                 cur[:, r][None, :] - preq[:, r][:, None])
+        ok = feas & (margin >= 0)
+        base_now = node_base_scores(cur[:, :sc_cols], ncap[:, :sc_cols],
+                                    policy)
+        u = (scores + 0.05 * base_now[None, :]) * _LP_INV_TAU
+        gumbel = jax.random.gumbel(jax.random.fold_in(key, i), (n, m))
+        sc = jnp.where(ok, u + gumbel, NEG_INF)
+        best = jnp.argmax(sc, axis=1).astype(jnp.int32)
+        cand = (~done) & jnp.any(ok, axis=1)
+        node_key = jnp.where(cand, best, m)
+        order = jnp.lexsort((prank, -size_key, node_key))
+        snode = node_key[order]
+        sreq = preq[order]
+        accept_sorted = _segment_prefix_accept(snode, sreq, free_ext, m)
+        delta = jnp.where(accept_sorted[:, None], sreq, 0)
+        free_ext = free_ext.at[snode].add(-delta)
+        free_ext = free_ext.at[m].set(0)
+        accepted = jnp.zeros((n,), bool).at[order].set(accept_sorted)
+        assigned = jnp.where(accepted, best, assigned)
+        return free_ext, done | accepted, assigned
+
+    free_ext, _, assigned = lax.fori_loop(0, rounds, body, init)
+    return assigned, free_ext[:m]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_parts", "lp_iters", "round_rounds", "repair_rounds",
+                     "chunk", "policy", "score_cols"),
+)
+def pack_solve(
+    req, group_id, rank, valid,
+    g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+    g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+    node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+    free, capacity, host_group_mask=None, host_group_soft=None, loc=None,
+    seed=0,
+    *,
+    n_parts: int,
+    lp_iters: int = LP_ITERS,
+    round_rounds: int = ROUND_ROUNDS,
+    repair_rounds: int = REPAIR_ROUNDS,
+    chunk: int = 512,
+    policy: str = "binpacking",
+    score_cols: int = 0,
+):
+    """One global pack solve. Positional args mirror `ops.assign.solve` (the
+    prepare_solve_args tuple) so the two paths cannot drift on arg prep;
+    `seed` is a traced int32 so reseeding never recompiles. Returns
+    (assigned [N] i32, free_after [M, R] i32)."""
+    if loc is not None:
+        raise PackUnsupported("locality batches take the greedy path")
+    N, R = req.shape
+    M = free.shape[0]
+    K = n_parts
+    n, m = N // K, M // K
+    sc = score_cols if score_cols > 0 else R
+
+    group_feas, group_soft = _hoist_group_state(
+        g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+        g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+        node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+        host_group_mask, host_group_soft)
+
+    # column normalization for the relaxation: prices and loads compare
+    # per-resource magnitudes, which span orders of magnitude across vocab
+    # columns (milliCPU vs bytes) — normalize by the mean node capacity
+    inv_scale = 1.0 / jnp.maximum(
+        jnp.mean(capacity.astype(jnp.float32), axis=0), 1.0)       # [R]
+
+    kp, kn, kr = jax.random.split(jax.random.PRNGKey(seed), 3)
+    pods_part = jax.random.permutation(kp, N).reshape(K, n)
+    nodes_part = jax.random.permutation(kn, M).reshape(K, m)
+    part_keys = jax.random.split(kr, K)
+
+    def solve_part(x):
+        pod_idx, node_idx, part_key = x
+        preq = req[pod_idx]                                        # [n, R]
+        pgid = group_id[pod_idx]
+        prank = rank[pod_idx]
+        pvalid = valid[pod_idx]
+        # RAW free through the fit/accept machinery: the in-flight overlay
+        # may drive a column negative, and greedy's fit refuses such nodes
+        # even for zero-request columns — clamping would let pack place
+        # where greedy-side feasibility rejects. Only the LP's price state
+        # clamps (prices need non-negative capacity).
+        nfree = free[node_idx]                                     # [m, R]
+        ncap = capacity[node_idx]
+        feas = group_feas[pgid][:, node_idx]                       # [n, m]
+        soft = group_soft[pgid][:, node_idx]
+        base = node_base_scores(nfree[:, :sc], ncap[:, :sc], policy)
+        preq_f = preq.astype(jnp.float32) * inv_scale[None, :]
+        free_f = jnp.maximum(nfree, 0).astype(jnp.float32) \
+            * inv_scale[None, :]
+        scores = _relax_part(preq_f, feas, pvalid, base, soft, free_f,
+                             lp_iters)
+        local, free_left = _round_part(preq, prank, pvalid, feas, scores,
+                                       nfree, ncap,
+                                       jnp.sum(preq_f, axis=1), part_key,
+                                       round_rounds, policy, sc)
+        node_global = jnp.where(
+            local >= 0, node_idx[jnp.clip(local, 0, m - 1)], -1)
+        return node_global.astype(jnp.int32), free_left
+
+    # lax.map = sequential over parts: peak memory is ONE part's [n, m]
+    # relaxation state, the POP bound the partition count was chosen for
+    assigned_parts, free_parts = lax.map(solve_part,
+                                         (pods_part, nodes_part, part_keys))
+
+    assigned = jnp.full((N,), -1, jnp.int32).at[pods_part.reshape(N)].set(
+        assigned_parts.reshape(N))
+    free_after = jnp.zeros((M, R), jnp.int32).at[nodes_part.reshape(M)].set(
+        free_parts.reshape(M, R))
+
+    # repair: asks the partition stranded run the unmodified greedy round
+    # loop over the FULL node set with the parts' residual capacity — the
+    # "per-subproblem fallback" that keeps a bad random cut from costing
+    # placements (and the proof-by-construction that pack feasibility is
+    # exactly greedy feasibility)
+    leftover = valid & (assigned < 0)
+    free_ext0 = jnp.concatenate(
+        [free_after, jnp.zeros((1, R), jnp.int32)], axis=0)
+    rep_assigned, _, free_ext, _, _ = _solve_rounds(
+        req, group_id, rank, leftover, group_feas, group_soft, free_ext0,
+        jnp.zeros((1, 1), jnp.int32), capacity, None, None,
+        max_rounds=repair_rounds, chunk=min(chunk, N), policy=policy,
+        use_pallas=False, pallas_interpret=False, has_loc_soft=False,
+        pallas_soft=False, score_cols=score_cols)
+    assigned = jnp.where(assigned >= 0, assigned, rep_assigned)
+    free_after = free_ext[:M]
+    # structural feasibility: placements only subtract what fits, so every
+    # cell must sit at or above min(initial free, 0) — a pre-existing
+    # negative column stays untouched, a non-negative one stays
+    # non-negative. The core refuses the plan when this is ever False.
+    feasible = jnp.all(free_after >= jnp.minimum(free, 0))
+    return assigned, free_after, feasible
+
+
+def pack_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
+                     free_delta=None, node_mask=None, ports_delta=None,
+                     seed: int = 0, lp_iters: int = LP_ITERS,
+                     round_rounds: int = ROUND_ROUNDS,
+                     repair_rounds: int = REPAIR_ROUNDS,
+                     chunk: int = 512, device_state=None) -> PackResult:
+    """Host wrapper: PodBatch + NodeArrays in → async PackResult out.
+
+    Shares `prepare_solve_args` with the greedy paths (same dtype views,
+    same in-flight free/ports overlays, same node masking) so the pack
+    solver can never see different cluster state than the greedy solve it
+    is compared against. device_state: the persistent device mirror the
+    greedy dispatch used this cycle (read-only reuse — node tensors and the
+    row-store req gather then transfer O(changed), not O(M)+O(N·R), per
+    optimal cycle). Raises PackUnsupported for batches outside the model
+    (locality, host ports, non-bucketed shapes)."""
+    if batch.locality is not None:
+        raise PackUnsupported("locality batches take the greedy path")
+    if batch.g_ports.view(np.uint32).any():
+        raise PackUnsupported("host-port batches take the greedy path")
+    np_args, static_kwargs = prepare_solve_args(
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
+        ports_delta=ports_delta, device_state=device_state,
+        allow_req_device=device_state is not None)
+    from yunikorn_tpu.ops.assign import SOLVE_ARG_NAMES
+
+    N = np_args[SOLVE_ARG_NAMES.index("req")].shape[0]
+    M = np_args[SOLVE_ARG_NAMES.index("free")].shape[0]
+    if not shape_supported(N, M):
+        # empty, or a non-bucketed shape the partitioner cannot split
+        # (production shapes are power-of-two buckets and always split)
+        raise PackUnsupported(
+            f"shape ({N} pods, {M} nodes) is not packable within the "
+            "partitionable cell budget")
+    n_parts = pick_parts(N, M)
+    solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
+    assigned, free_after, feasible = pack_solve(
+        *solve_args, seed=jnp.int32(seed), n_parts=n_parts,
+        lp_iters=lp_iters, round_rounds=round_rounds,
+        repair_rounds=repair_rounds, chunk=chunk, policy=policy,
+        score_cols=static_kwargs["score_cols"])
+    return PackResult(assigned=assigned, free_after=free_after,
+                      feasible=feasible, n_parts=n_parts, seed=seed)
+
+
+def packed_utilization(assigned, req_i, valid, free0_i=None,
+                       score_cols: int = 0, cap_i=None) -> dict:
+    """Exact host-side packing objective of one plan.
+
+    placed      — valid asks the plan assigned
+    units       — int64 sum of placed requests over the scoring columns
+    units_norm  — the SOLVER's objective: placed requests normalized per
+                  column by mean node capacity (cap_i, the same inv_scale
+                  pack_solve optimizes) so incommensurable quantized scales
+                  (milliCPU vs bytes) cannot dominate the comparison; falls
+                  back to raw units when cap_i is not supplied
+    util        — units / total free units before the plan (0 when free0_i
+                  is not supplied)
+    nodes_used  — distinct nodes the plan touches (fewer = denser)
+    """
+    assigned = np.asarray(assigned)
+    n = assigned.shape[0]
+    req_i = np.asarray(req_i, dtype=np.int64)[:n]
+    sc = score_cols if score_cols > 0 else req_i.shape[1]
+    placed = np.asarray(valid, bool)[:n] & (assigned >= 0)
+    units = int(req_i[placed, :sc].sum())
+    if cap_i is not None:
+        inv = 1.0 / np.maximum(
+            np.asarray(cap_i, np.float64)[:, :sc].mean(axis=0), 1.0)
+        units_norm = float((req_i[placed, :sc].astype(np.float64)
+                            * inv[None, :]).sum())
+    else:
+        units_norm = float(units)
+    out = {
+        "placed": int(placed.sum()),
+        "units": units,
+        "units_norm": units_norm,
+        "nodes_used": int(np.unique(assigned[placed]).size),
+        "util": 0.0,
+    }
+    if free0_i is not None:
+        total_free = int(np.maximum(
+            np.asarray(free0_i, dtype=np.int64)[:, :sc], 0).sum())
+        out["util"] = round(units / max(total_free, 1), 6)
+    return out
+
+
+def choose_plan(greedy_assigned, pack_assigned, req_i, valid,
+                score_cols: int = 0, free0_i=None, cap_i=None,
+                priorities=None):
+    """The differential oracle's decision rule: the pack plan commits only
+    when its packed objective strictly beats greedy's, lexicographically on
+    (per-priority-class placed counts highest class first, placed asks,
+    capacity-normalized packed units, fewer nodes touched). Ties keep the
+    greedy plan so `solver.policy=optimal` can never regress default
+    behavior.
+
+    priorities: optional [n] per-ask priorities — with it, the pack plan
+    must match greedy class by class from the highest priority down before
+    packing quality decides ("Priority Matters"): a plan that packs more
+    units by displacing a higher-priority ask for bulkier low-priority ones
+    LOSES, so the optimal policy can never starve a high-priority ask the
+    greedy rank order would have placed. cap_i: [M, R] node capacities —
+    aligns the committed objective with the solver's capacity-normalized
+    one (see packed_utilization.units_norm).
+
+    Returns (use_pack: bool, stats: dict)."""
+    g = packed_utilization(greedy_assigned, req_i, valid, free0_i,
+                           score_cols, cap_i)
+    p = packed_utilization(pack_assigned, req_i, valid, free0_i,
+                           score_cols, cap_i)
+    # scale-free integer quantization of the float objective: two plans
+    # placing the SAME multiset of requests sum in different row orders,
+    # and float addition-order noise (~1e-16 relative) must never break
+    # the "ties keep greedy" contract
+    norm_scale = max(g["units_norm"], p["units_norm"], 1e-12)
+    g_units_q = round(g["units_norm"] / norm_scale * 1e9)
+    p_units_q = round(p["units_norm"] / norm_scale * 1e9)
+
+    def key(assigned, placed_u, units_q, nodes_used):
+        assigned = np.asarray(assigned)
+        n = assigned.shape[0]
+        pk = ()
+        if priorities is not None:
+            pr = np.asarray(priorities)[:n]
+            placed = np.asarray(valid, bool)[:n] & (assigned >= 0)
+            classes = np.unique(pr)[::-1]
+            pk = tuple(int((placed & (pr == c)).sum()) for c in classes)
+        return pk + (placed_u, units_q, -nodes_used)
+
+    use_pack = (key(pack_assigned, p["placed"], p_units_q, p["nodes_used"])
+                > key(greedy_assigned, g["placed"], g_units_q,
+                      g["nodes_used"]))
+    return use_pack, {
+        "greedy": g, "pack": p,
+        "pack_util": p["util"], "greedy_util": g["util"],
+    }
+
+
+def jit_cache_entries() -> int:
+    """Compiled-variant count of the pack entry point (compile-vs-cache-hit
+    telemetry, the ops.assign.jit_cache_entries convention)."""
+    try:
+        return pack_solve._cache_size()
+    except Exception:
+        return -1

@@ -34,6 +34,16 @@ from yunikorn_tpu.ops import assign as assign_mod
 
 NODE_AXIS = "nodes"
 
+# Explicit single-partition gating for the pack solver (solver.policy=
+# optimal, ops/pack_solve.py): its POP partitioning already re-permutes the
+# node dimension per seed, which fights GSPMD's static node sharding — a
+# sharded variant needs mesh-aligned partitions (part boundaries on shard
+# boundaries so each chip solves whole parts locally). Until that lands the
+# core skips the pack dispatch when a mesh is active (pack_plans_total
+# {outcome=skipped}); flipping this flag without the mesh-aligned
+# partitioner would resharded-gather every pack solve arg per cycle.
+PACK_SHARDED_SUPPORTED = False
+
 # Host bytes of the pod-side (replicated) solve args assembled by the LAST
 # solve_sharded call. Node-side tensors ride the persistent device mirror
 # (DeviceNodeState tracks those uploads); the replicated pod batch re-ships

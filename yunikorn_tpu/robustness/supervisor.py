@@ -27,7 +27,7 @@ attempt gets:
                    arXiv:2511.08373).
 
 Observability: every transition is visible — `solver_degradation_state{path}`
-gauge (tier index), `supervised_dispatch_total{path,outcome}`,
+gauge (tier index), `supervised_dispatch_total{path,outcome,policy}`,
 `circuit_transitions_total{path,tier,state}`, and a `degrade`/`recover`
 tracer span on the cycle timeline.
 """
@@ -220,6 +220,11 @@ class SupervisedExecutor:
         # the committing cycle id, stamped by the core per cycle so
         # degrade/recover spans land on the right cycle lane
         self.cycle_id = 0
+        # solver.policy of the cycle being dispatched ("greedy"/"optimal"),
+        # stamped by the core per dispatch: supervised_dispatch_total carries
+        # it as a label so dashboards separate the two solve paths without
+        # new series names
+        self.policy_label = "greedy"
         self._mu = threading.Lock()
         self._breakers: Dict[Tuple[str, str], CircuitBreaker] = {}
         self._ladders: Dict[str, Tuple[str, ...]] = {}
@@ -241,8 +246,9 @@ class SupervisedExecutor:
     def attach_metrics(self, registry) -> None:
         self._m_dispatch = registry.counter(
             "supervised_dispatch_total",
-            "supervised device-path attempts by path and outcome",
-            labelnames=("path", "outcome"))
+            "supervised device-path attempts by path, outcome and the "
+            "cycle's solver.policy (greedy | optimal)",
+            labelnames=("path", "outcome", "policy"))
         self._m_transitions = registry.counter(
             "circuit_transitions_total",
             "circuit-breaker state transitions by path/tier",
@@ -503,7 +509,8 @@ class SupervisedExecutor:
         if _call_abandoned():
             return  # a zombie's outcome must not move live circuits/metrics
         if self._m_dispatch is not None:
-            self._m_dispatch.inc(path=path, outcome=outcome)
+            self._m_dispatch.inc(path=path, outcome=outcome,
+                                 policy=self.policy_label)
         with self._mu:
             br = self._breaker(path, tier)
             if outcome == "ok":
