@@ -1,9 +1,9 @@
 # Developer entry points (reference Makefile analog).
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
-	chaos-smoke gate-smoke gate-device-smoke pack-smoke smoke lint \
-	run-scheduler run-admission dryrun clean image sched_image adm_image \
-	webtest_image
+	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke smoke \
+	lint run-scheduler run-admission dryrun clean image sched_image \
+	adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -84,7 +84,13 @@ pack-smoke:  ## optimal packing (solver.policy=optimal): feasibility-parity prop
 		python scripts/pack_bench.py --shapes 1024x128,2048x256 \
 		--assert-quality
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke  ## all tier-1 smoke targets
+aot-smoke:  ## AOT cold-start elimination: store/fingerprint unit suite, then build a store offline, restart a FRESH process and assert its first cycle hits the store (aot hits > 0, zero solver compiles), is placement-identical to a cold-compiled baseline, and lands within 3x the steady-state warm cycle at the 10k-pod bucket on CPU
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_aot_store.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/aot_smoke.py
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

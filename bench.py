@@ -239,6 +239,9 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                 "solver_policy": "greedy",
                 "pack_util": 0.0,
                 "pack_plan_ms": 0.0,
+                "cold_first_cycle_ms": 0.0,
+                "aot_hits": 0,
+                "aot_compiles": 0,
             }))
             sys.exit(1)
     platform = devs[0].platform
@@ -349,6 +352,38 @@ def _preempt_pressure_cycle(core, platform: str) -> float:
         return 0.0
 
 
+def _install_aot_store() -> None:
+    """Attach the AOT executable store named by YK_AOT_STORE (aot/): a
+    prebuilt store (scripts/aot_build.py) serves the first full-bucket cycle
+    from deserialized executables — cold_first_cycle_ms then measures
+    artifact-load + execute instead of the XLA compile stall."""
+    path = os.environ.get("YK_AOT_STORE", "")
+    if not path:
+        return
+    from yunikorn_tpu import aot
+
+    rt = aot.install(path,
+                     background=os.environ.get(
+                         "YK_AOT_BACKGROUND", "0") == "1")
+    print(f"# bench: aot store attached at {path} "
+          f"({rt.store.entry_count()} entries)", file=sys.stderr, flush=True)
+
+
+def _aot_stats() -> dict:
+    """AOT store evidence for the bench JSON: store hits this run (0 with
+    no store attached) and whether any dispatch compiled."""
+    try:
+        from yunikorn_tpu import aot
+
+        rt = aot.get_runtime()
+        if rt is None:
+            return {"aot_hits": 0, "aot_compiles": 0}
+        s = rt.stats()
+        return {"aot_hits": s["hits"], "aot_compiles": s["compiles"]}
+    except Exception:
+        return {"aot_hits": 0, "aot_compiles": 0}
+
+
 def _cache_entries() -> int:
     """Entry count of the persistent XLA compilation cache (cross-process
     cold-start evidence: a backend whose compiles don't serialize — e.g. a
@@ -449,6 +484,7 @@ def main() -> int:
 
     from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
 
+    _install_aot_store()
     ensure_compilation_cache()
     cache_entries_before = _cache_entries()
 
@@ -584,6 +620,11 @@ def main() -> int:
         "vs_baseline": round(pods_per_s / TARGET_PODS_PER_S, 3),
         "preempt_plan_ms": preempt_ms,
         "degradations": _degradations(core),
+        # cold-start evidence (round 13): the first full-bucket cycle's
+        # wall — with a prebuilt AOT store (YK_AOT_STORE) this is
+        # artifact-load + execute; without one it is the compile stall
+        "cold_first_cycle_ms": round(dt_cold * 1000, 1),
+        **_aot_stats(),
         **core_cycle_stats,
     }
 
@@ -596,13 +637,15 @@ def main() -> int:
         # the same line so the comparable number is never hidden.
         result = _shim_result(platform, core_pods_per_s=pods_per_s,
                               core_warm_s=dt_warm, preempt_ms=preempt_ms,
-                              core_cycle_stats=core_cycle_stats)
+                              core_cycle_stats=core_cycle_stats,
+                              cold_first_cycle_ms=round(dt_cold * 1000, 1))
     print(json.dumps(result))
     return 0
 
 
 def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
-                 preempt_ms=None, core_cycle_stats=None) -> dict:
+                 preempt_ms=None, core_cycle_stats=None,
+                 cold_first_cycle_ms: float = 0.0) -> dict:
     """Run the BindStats shim mode and build the bench JSON for it. With a
     core-cycle number, that stays the headline (north-star metric) and the
     shim e2e rides along; standalone shim mode publishes the shim number."""
@@ -620,6 +663,8 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             "shim_e2e_bound": bound,
             "preempt_plan_ms": shim_preempt_ms,
             "degradations": shim_degr,
+            "cold_first_cycle_ms": cold_first_cycle_ms,
+            **_aot_stats(),
             **shim_cycle_stats,
         }
     return {
@@ -636,6 +681,8 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         "preempt_plan_ms": (preempt_ms if preempt_ms is not None
                             else shim_preempt_ms),
         "degradations": shim_degr,
+        "cold_first_cycle_ms": cold_first_cycle_ms,
+        **_aot_stats(),
         # headline gate/encode stats stay the core cycle's (the north-star
         # comparable); the shim-phase numbers ride alongside
         **(core_cycle_stats or shim_cycle_stats),

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Offline AOT-store builder: compile + serialize the solver executables for
+the standard bucket ladder, so production processes start solve-ready.
+
+For each NODESxPODS bucket this builder populates the store two ways:
+
+  variants — jaxtools.warm_bucket compile_only coverage: both nodesort
+             policies x plain and soft/locality batch variants of
+             assign.solve / solve_chunked (the same variant matrix
+             --prewarm warms, now persisted instead of re-traced per
+             process).
+  cycles   — a REAL CoreScheduler trace at the bucket (the same synthetic
+             cluster shape bench.py and scripts/aot_smoke.py drive): two
+             scheduling cycles + release, so every jitted program a
+             production first cycle dispatches (gate/encode/solve) lands in
+             the store with exactly the fingerprint production will compute.
+             --with-preempt adds a preemption-pressure probe (the batched
+             victim-selection solve); --policy optimal adds the pack solver.
+
+The jax persistent-cache entries written during the build are mirrored into
+the store (store/xla_cache/) and restored by consumers before their first
+compile — the local half of the relay cache gap.
+
+The store is keyed by (jax/jaxlib version, backend platform + device count,
+shapes, dtype mode, solver statics): build on the SAME software + topology
+the consumer runs, e.g. on CPU for the CPU smoke, on the TPU host for
+production. Run with JAX_PLATFORMS=cpu for a CPU store.
+
+Usage:
+  python scripts/aot_build.py --store DIR [--buckets 1024x4096,...]
+      [--no-variants] [--no-cycles] [--with-preempt] [--policy optimal]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_BUCKETS = "1024x4096"
+
+
+def run_trace(n_nodes: int, n_pods: int, *, policy: str = "greedy",
+              preempt: bool = False, cycles: int = 2):
+    """Drive a real CoreScheduler against the synthetic bench-shaped cluster
+    (make_kwok_nodes / make_sleep_pods, 5 queues — the same generators
+    bench.py uses) for `cycles` full-bucket scheduling cycles.
+
+    Returns {"placements": {alloc_key: node}, "first_cycle_ms", "steady_ms",
+    "scheduled"}. Shared by the builder (to compile every program a first
+    cycle dispatches) and scripts/aot_smoke.py (to prove a store-hit first
+    cycle is placement-identical to a cold-compiled one) — one driver, so
+    the built fingerprints are exactly the replayed ones.
+    """
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest,
+        AllocationAsk,
+        AllocationRelease,
+        AllocationRequest,
+        ApplicationRequest,
+        NodeAction,
+        NodeInfo,
+        NodeRequest,
+        RegisterResourceManagerRequest,
+        TerminationType,
+        UserGroupInfo,
+    )
+    from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+
+    placements = {}
+
+    class Callback:
+        def update_allocation(self, response):
+            for alloc in getattr(response, "new", None) or []:
+                placements[alloc.allocation_key] = alloc.node_id
+
+        def __getattr__(self, name):
+            if name == "get_state_dump":
+                return lambda: "{}"
+            return lambda *a, **k: None
+
+    cache = SchedulerCache()
+    so = SolverOptions()
+    so.policy = "optimal" if policy == "optimal" else "greedy"
+    core = CoreScheduler(cache, solver_options=so)
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="aot", policy_group="queues"),
+        Callback())
+    nodes = make_kwok_nodes(n_nodes)
+    infos = []
+    for n in nodes:
+        cache.update_node(n)
+        infos.append(NodeInfo(node_id=n.name, action=NodeAction.CREATE))
+    core.update_node(NodeRequest(nodes=infos))
+    n_queues = 5
+    for q in range(n_queues):
+        core.update_application(ApplicationRequest(new=[AddApplicationRequest(
+            application_id=f"aot-app-{q}", queue_name=f"root.q{q}",
+            user=UserGroupInfo(user="aot"))]))
+    pods = []
+    for q in range(n_queues):
+        pods.extend(make_sleep_pods(n_pods // n_queues, f"aot-app-{q}",
+                                    queue=f"root.q{q}", name_prefix=f"aq{q}"))
+    asks = [AllocationAsk(p.uid, p.metadata.labels["applicationId"],
+                          get_pod_resource(p), pod=p) for p in pods]
+
+    first_ms = steady_ms = 0.0
+    scheduled = 0
+    first_placements = None
+    for c in range(max(cycles, 1)):
+        core.update_allocation(AllocationRequest(asks=list(asks)))
+        t0 = time.perf_counter()
+        scheduled = core.schedule_once()
+        dt = (time.perf_counter() - t0) * 1000
+        if c == 0:
+            first_ms = dt
+            first_placements = dict(placements)
+        steady_ms = dt
+        core.update_allocation(AllocationRequest(releases=[
+            AllocationRelease(a.application_id, a.allocation_key,
+                              TerminationType.STOPPED_BY_RM) for a in asks]))
+        core.schedule_once()
+    if preempt:
+        from yunikorn_tpu.common.objects import make_pod
+
+        # cluster refilled so victims exist, then one unplaceable
+        # high-priority ask drives the batched victim-selection solve
+        core.update_allocation(AllocationRequest(asks=list(asks)))
+        core.schedule_once()
+        hp = make_pod("aot-preempt-probe", cpu_milli=10**9, priority=1000)
+        core.update_allocation(AllocationRequest(asks=[AllocationAsk(
+            hp.uid, "aot-app-0", get_pod_resource(hp), priority=1000,
+            pod=hp)]))
+        core.schedule_once()
+    return {"placements": first_placements or {}, "first_cycle_ms": first_ms,
+            "steady_ms": steady_ms, "scheduled": scheduled}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", required=True,
+                    help="AOT store directory (created if missing)")
+    ap.add_argument("--buckets", default=DEFAULT_BUCKETS,
+                    help="comma-separated NODESxPODS pairs")
+    ap.add_argument("--no-variants", action="store_true",
+                    help="skip the prewarm variant matrix (policies x "
+                         "plain/locality)")
+    ap.add_argument("--no-cycles", action="store_true",
+                    help="skip the real-cycle trace (gate/encode coverage)")
+    ap.add_argument("--with-preempt", action="store_true",
+                    help="also build the preemption victim-selection solve")
+    ap.add_argument("--policy", default="greedy",
+                    choices=("greedy", "optimal"),
+                    help="optimal also builds the pack solver executables")
+    args = ap.parse_args()
+
+    from yunikorn_tpu import aot
+    from yunikorn_tpu.utils.jaxtools import (
+        backend_or_cpu,
+        ensure_compilation_cache,
+        warm_bucket,
+    )
+
+    t0 = time.time()
+    platform = backend_or_cpu()
+    rt = aot.install(args.store)
+    ensure_compilation_cache()
+
+    built = []
+    for pair in args.buckets.split(","):
+        pair = pair.strip().lower()
+        if not pair:
+            continue
+        n_nodes, n_pods = (int(x) for x in pair.split("x"))
+        t_b = time.time()
+        if not args.no_variants:
+            warm_bucket(n_nodes, n_pods)
+        if not args.no_cycles:
+            run_trace(n_nodes, n_pods, policy=args.policy,
+                      preempt=args.with_preempt)
+        built.append({"bucket": pair, "secs": round(time.time() - t_b, 1)})
+        print(f"# aot_build: bucket {pair} done in {built[-1]['secs']}s",
+              file=sys.stderr, flush=True)
+
+    rt.flush()  # join in-flight store writes before reading counts/exiting
+    mirrored = rt.store.save_persistent_cache()
+    out = {"store": os.path.abspath(args.store), "platform": platform,
+           "buckets": built, "entries": rt.store.entry_count(),
+           "persistent_cache_mirrored": mirrored, "aot": rt.stats(),
+           "total_secs": round(time.time() - t0, 1)}
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

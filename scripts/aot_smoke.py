@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""AOT cold-start smoke: prove a FRESH process with a prebuilt store serves
+its first scheduling cycle from stored executables.
+
+Phases (each child is its own process — cross-process is the whole point):
+
+  build   — scripts/aot_build.py populates a temp store at the smoke bucket.
+  hit     — a fresh child replays the same trace WITH the store. Asserts:
+              * aot hits > 0 and ZERO aot-path compiles (every solver
+                program the cycle dispatched came from the store),
+              * the core counted no solve compiles
+                (solve_compile_total == 0).
+  cold    — a fresh child replays the same trace WITHOUT the store
+            (the legacy --prewarm-style trace+compile cold start).
+  compare — placements of the hit child are IDENTICAL to the cold child's
+            (a deserialized executable is the same program, bit for bit),
+            and the store-hit first cycle is within --max-ratio x the
+            steady-state warm cycle (default 3, the acceptance bound)
+            while the cold child's first cycle shows the compile stall.
+
+Usage:
+  python scripts/aot_smoke.py [--bucket 1024x10240] [--max-ratio 3]
+  python scripts/aot_smoke.py --child run --store DIR --bucket NxP  (internal)
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(SCRIPTS_DIR))
+sys.path.insert(0, SCRIPTS_DIR)
+
+# the acceptance bucket: 10k pods (the documented CPU bucket's pod count,
+# docs/PERF.md) — big enough that the compile stall dominates a cold first
+# cycle and the ≤3x store-hit bound is a real statement
+DEFAULT_BUCKET = "1024x10240"
+
+
+def _digest(placements: dict) -> str:
+    blob = json.dumps(sorted(placements.items())).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def child_run(store: str, n_nodes: int, n_pods: int) -> int:
+    """One fresh-process trace replay; prints a single JSON line."""
+    from yunikorn_tpu.utils.jaxtools import force_cpu_platform
+
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        force_cpu_platform(1)
+    rt = None
+    if store:
+        from yunikorn_tpu import aot
+
+        rt = aot.install(store, background=False)
+    from aot_build import run_trace
+
+    t0 = time.time()
+    res = run_trace(n_nodes, n_pods)
+    out = {
+        "placements_digest": _digest(res["placements"]),
+        "placed": len(res["placements"]),
+        "first_cycle_ms": round(res["first_cycle_ms"], 1),
+        "steady_ms": round(res["steady_ms"], 1),
+        "wall_s": round(time.time() - t0, 1),
+        "aot_hits": rt.stats()["hits"] if rt else 0,
+        "aot_compiles": rt.stats()["compiles"] if rt else 0,
+        "aot_loads": rt.stats()["loads"] if rt else 0,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _spawn(store: str, bucket: str, timeout: float) -> dict:
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", "run",
+           "--store", store, "--bucket", bucket]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    if r.returncode != 0:
+        print(r.stdout, file=sys.stderr)
+        print(r.stderr, file=sys.stderr)
+        raise SystemExit(f"child failed rc={r.returncode}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bucket", default=DEFAULT_BUCKET)
+    ap.add_argument("--max-ratio", type=float, default=3.0,
+                    help="store-hit first cycle must be within this factor "
+                         "of the steady-state warm cycle")
+    ap.add_argument("--store", default="",
+                    help="reuse an existing store instead of building a "
+                         "temp one (skips the build phase)")
+    ap.add_argument("--child", default="", help="internal")
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    n_nodes, n_pods = (int(x) for x in args.bucket.lower().split("x"))
+    if args.child == "run":
+        return child_run(args.store, n_nodes, n_pods)
+
+    tmp = None
+    store = args.store
+    if not store:
+        tmp = tempfile.mkdtemp(prefix="aot-smoke-")
+        store = os.path.join(tmp, "store")
+        t0 = time.time()
+        env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, os.path.join(SCRIPTS_DIR, "aot_build.py"),
+             "--store", store, "--buckets", args.bucket, "--no-variants"],
+            capture_output=True, text=True, timeout=args.timeout, env=env)
+        if r.returncode != 0:
+            print(r.stdout, file=sys.stderr)
+            print(r.stderr, file=sys.stderr)
+            raise SystemExit(f"aot_build failed rc={r.returncode}")
+        print(f"# build: {r.stdout.strip().splitlines()[-1]} "
+              f"({time.time() - t0:.1f}s)", file=sys.stderr, flush=True)
+
+    hit = _spawn(store, args.bucket, args.timeout)
+    print(f"# store-hit fresh process: {json.dumps(hit)}",
+          file=sys.stderr, flush=True)
+    cold = _spawn("", args.bucket, args.timeout)
+    print(f"# cold-compile fresh process: {json.dumps(cold)}",
+          file=sys.stderr, flush=True)
+
+    failures = []
+    if hit["aot_hits"] <= 0:
+        failures.append(f"expected store hits, got {hit['aot_hits']}")
+    if hit["aot_compiles"] != 0:
+        failures.append(
+            f"store-hit run compiled {hit['aot_compiles']} solver programs "
+            "(store coverage gap)")
+    if hit["placements_digest"] != cold["placements_digest"]:
+        failures.append(
+            f"placement drift: store-hit {hit['placements_digest']} != "
+            f"cold {cold['placements_digest']}")
+    if hit["placed"] <= 0:
+        failures.append("store-hit run placed nothing")
+    ratio = (hit["first_cycle_ms"] / hit["steady_ms"]
+             if hit["steady_ms"] > 0 else float("inf"))
+    if ratio > args.max_ratio:
+        failures.append(
+            f"store-hit first cycle {hit['first_cycle_ms']}ms is "
+            f"{ratio:.2f}x steady {hit['steady_ms']}ms "
+            f"(> {args.max_ratio}x)")
+
+    result = {
+        "bucket": args.bucket,
+        "ok": not failures,
+        "store_hit_first_cycle_ms": hit["first_cycle_ms"],
+        "steady_ms": hit["steady_ms"],
+        "first_vs_steady": round(ratio, 2),
+        "cold_first_cycle_ms": cold["first_cycle_ms"],
+        "cold_speedup": round(cold["first_cycle_ms"]
+                              / max(hit["first_cycle_ms"], 0.1), 1),
+        "aot_hits": hit["aot_hits"],
+        "aot_compiles": hit["aot_compiles"],
+        "placement_identical":
+            hit["placements_digest"] == cold["placements_digest"],
+        "failures": failures,
+    }
+    print(json.dumps(result))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

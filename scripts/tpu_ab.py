@@ -11,8 +11,17 @@ die mid-run; earlier phases' evidence survives):
             paths; asserts identical assignments.
   phase 3 — solve-level A/B at the north-star bucket (50k x 10k), plain batch.
 
-Usage: python scripts/tpu_ab.py [--skip-big]
+Usage: python scripts/tpu_ab.py [--skip-big] [--aot-store DIR]
 Writes docs/PALLAS_AB.json with everything it measured.
+
+--aot-store: consume prebuilt AOT executables (scripts/aot_build.py run
+against the same jax/jaxlib + TPU topology). The solve-level phases then
+load their XLA-path executables from the store instead of paying the relay
+compile window — the historical blocker for this A/B (docs/PERF.md r5/r12:
+the 50k-bucket remote compile alone consumed the dial budget). With a warm
+store the phase-2/3 "compile_s" fields measure artifact-load, and the whole
+A/B fits a bounded budget. (The pallas kernel variants still compile on
+device: Mosaic kernels do not ride the PJRT executable serialization path.)
 """
 from __future__ import annotations
 
@@ -43,6 +52,11 @@ def emit(rec):
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-big", action="store_true")
+    ap.add_argument("--aot-store", type=str,
+                    default=os.environ.get("YK_AOT_STORE", ""),
+                    help="AOT executable store dir (scripts/aot_build.py): "
+                         "the XLA solve paths load prebuilt executables "
+                         "instead of compiling through the relay window")
     args = ap.parse_args()
 
     t0 = time.time()
@@ -88,6 +102,12 @@ def main() -> int:
 
     from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
 
+    if args.aot_store:
+        from yunikorn_tpu import aot
+
+        rt = aot.install(args.aot_store)
+        emit({"phase": "aot-store", "path": args.aot_store,
+              "entries": rt.store.entry_count()})
     ensure_compilation_cache()
 
     # ---------------------------------------------------------------- phase 1
@@ -204,7 +224,14 @@ def main() -> int:
                 emit({"phase": "solve-ab-50kx10k", "path": name,
                       "error": f"{type(e).__name__}: {e}"[:500]})
 
-    emit({"phase": "done", "total_secs": round(time.time() - t0, 1)})
+    done = {"phase": "done", "total_secs": round(time.time() - t0, 1)}
+    if args.aot_store:
+        from yunikorn_tpu import aot
+
+        rt = aot.get_runtime()
+        if rt is not None:
+            done["aot"] = rt.stats()
+    emit(done)
     return 0
 
 

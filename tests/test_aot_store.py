@@ -1,0 +1,482 @@
+"""AOT executable store (aot/): fingerprint invalidation, corrupt-entry
+quarantine, store-hit dispatch, and the offline-builder/runtime contract.
+
+The cross-process half (a FRESH process serving its first cycle from the
+store with zero compiles, placement-identical to a cold-compiled run) lives
+in scripts/aot_smoke.py (`make aot-smoke`); these tests pin the in-process
+invariants: any fingerprint component changing must MISS the store, a
+corrupt/truncated artifact must quarantine and fall through to a compile
+(never crash), and a hit must execute without any trace+compile.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yunikorn_tpu import aot
+from yunikorn_tpu.aot.runtime import AotRuntime
+from yunikorn_tpu.aot.store import AotStore
+
+
+@pytest.fixture(autouse=True)
+def _no_global_runtime():
+    """Every test starts and ends with AOT disabled; tests that install a
+    runtime do so explicitly and this teardown always clears it."""
+    prev = aot.set_runtime(None)
+    yield
+    rt = aot.get_runtime()
+    if rt is not None:
+        rt.flush(timeout=30.0)
+    aot.set_runtime(prev)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _toy(x, pair, opt=None, *, k=2):
+    a, b = pair
+    out = x * a + b * k
+    if opt is not None:
+        out = out + opt
+    return out, out.sum()
+
+
+def _toy_args(n=16, dtype=jnp.float32):
+    x = jnp.ones((n,), dtype)
+    return (x, (jnp.asarray(2, dtype), jnp.ones((n,), dtype)), None)
+
+
+# ---------------------------------------------------------------- store I/O
+
+def test_store_put_get_roundtrip(tmp_path):
+    store = AotStore(str(tmp_path))
+    manifest = {"path": "p", "x": 1}
+    ok = store.put("p", "k1", manifest, b"payload-bytes", ("it",), ("ot",))
+    assert ok
+    rec = store.get("p", "k1")
+    assert rec is not None
+    m2, payload, it, ot = rec
+    assert m2 == manifest and payload == b"payload-bytes"
+    assert it == ("it",) and ot == ("ot",)
+    assert store.entry_count() == 1
+    assert store.get("p", "unknown-key") is None
+
+
+def test_corrupt_entry_quarantined_and_missed(tmp_path):
+    store = AotStore(str(tmp_path))
+    store.put("p", "k1", {"m": 1}, b"data", None, None)
+    fp = store._entry_path("p", "k1")
+    # truncate: valid magic, mangled body — the digest check must catch it
+    blob = open(fp, "rb").read()
+    with open(fp, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert store.get("p", "k1") is None
+    assert store.corrupt_quarantined == 1
+    assert store.entry_count() == 0
+    assert len(os.listdir(store.quarantine_dir)) == 1
+    # a second lookup is a plain miss, not another quarantine
+    assert store.get("p", "k1") is None
+    assert store.corrupt_quarantined == 1
+
+
+def test_bad_magic_quarantined(tmp_path):
+    store = AotStore(str(tmp_path))
+    store.put("p", "k2", {}, b"x", None, None)
+    fp = store._entry_path("p", "k2")
+    with open(fp, "wb") as f:
+        f.write(b"NOT-AN-AOT-ENTRY")
+    assert store.get("p", "k2") is None
+    assert store.corrupt_quarantined == 1
+
+
+def test_lru_size_cap_evicts_oldest(tmp_path):
+    store = AotStore(str(tmp_path), max_bytes=1 << 20)
+    payload = b"z" * 1500
+    for i in range(4):
+        store.put("p", f"k{i}", {"i": i}, payload, None, None)
+        now = time.time() + i  # strictly increasing mtimes
+        os.utime(store._entry_path("p", f"k{i}"), (now, now))
+    store.max_bytes = 4096  # shrink the cap, then enforce
+    store._enforce_cap()
+    assert store.entry_count() < 4
+    assert store.evicted >= 1
+    # the newest entry survives
+    assert store.get("p", "k3") is not None
+
+
+def test_persistent_cache_mirror_roundtrip(tmp_path):
+    src = tmp_path / "live_cache"
+    src.mkdir()
+    (src / "entry-a").write_bytes(b"aaa")
+    (src / "entry-b").write_bytes(b"bbb")
+    store = AotStore(str(tmp_path / "store"))
+    assert store.save_persistent_cache(str(src)) == 2
+    # restore into an empty "fresh host" cache dir
+    dst = tmp_path / "fresh_cache"
+    assert store.restore_persistent_cache(str(dst)) == 2
+    assert sorted(os.listdir(dst)) == ["entry-a", "entry-b"]
+    # idempotent: nothing new to copy either way
+    assert store.save_persistent_cache(str(src)) == 0
+    assert store.restore_persistent_cache(str(dst)) == 0
+
+
+# --------------------------------------------------- runtime hit/miss logic
+
+def test_runtime_compiles_saves_then_fresh_runtime_hits(tmp_path):
+    store = AotStore(str(tmp_path))
+    rt1 = AotRuntime(store)
+    aot.set_runtime(rt1)
+    args = _toy_args()
+    out1, s1 = aot.aot_call("toy", _toy, args, {"k": 3})
+    assert rt1.stats()["misses"] == 1 and rt1.stats()["compiles"] == 1
+    rt1.flush(timeout=30.0)
+    assert store.entry_count() == 1
+
+    # a "fresh process": new runtime, empty memory cache, same store
+    rt2 = AotRuntime(store)
+    aot.set_runtime(rt2)
+    out2, s2 = aot.aot_call("toy", _toy, args, {"k": 3})
+    st = rt2.stats()
+    assert st["hits"] == 1 and st["compiles"] == 0 and st["loads"] == 1
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    assert float(s1) == float(s2)
+    # repeat call: in-memory hit, no second load
+    aot.aot_call("toy", _toy, args, {"k": 3})
+    assert rt2.stats()["hits"] == 2 and rt2.stats()["loads"] == 1
+
+
+def test_fingerprint_invalidation_components(tmp_path):
+    """Each fingerprint component must produce a distinct key: bucket shape,
+    dtype mode, static kwarg, jax/jaxlib version, backend topology, and the
+    caller extra (mesh tag)."""
+    store = AotStore(str(tmp_path))
+    rt = AotRuntime(store)
+    base = rt._key(rt.manifest("p", _toy_args(16), {"k": 2}))
+
+    variants = {
+        "shape": rt._key(rt.manifest("p", _toy_args(32), {"k": 2})),
+        "dtype": rt._key(rt.manifest(
+            "p", _toy_args(16, jnp.int32), {"k": 2})),
+        "static": rt._key(rt.manifest("p", _toy_args(16), {"k": 5})),
+        "extra": rt._key(rt.manifest("p", _toy_args(16), {"k": 2},
+                                     extra=("mesh", 8))),
+        "path": rt._key(rt.manifest("q", _toy_args(16), {"k": 2})),
+    }
+    rt_ver = AotRuntime(store, versions=("0.0.0-fake", "0.0.0-fake"))
+    variants["jaxlib"] = rt_ver._key(
+        rt_ver.manifest("p", _toy_args(16), {"k": 2}))
+    rt_topo = AotRuntime(store, backend=("tpu", 4))
+    variants["topology"] = rt_topo._key(
+        rt_topo.manifest("p", _toy_args(16), {"k": 2}))
+
+    for name, key in variants.items():
+        assert key != base, f"{name} change did not invalidate the key"
+    assert len(set(variants.values())) == len(variants)
+
+    # identical inputs reproduce the key (stable across runtimes)
+    rt_b = AotRuntime(store)
+    assert rt_b._key(rt_b.manifest("p", _toy_args(16), {"k": 2})) == base
+
+
+def test_x64_mode_in_fingerprint(tmp_path):
+    from jax.experimental import enable_x64
+
+    rt = AotRuntime(AotStore(str(tmp_path)))
+    args = (np.ones((8,), np.int64),)
+    k_plain = rt._key(rt.manifest("p", args, {}))
+    with enable_x64():
+        k_x64 = rt._key(rt.manifest("p", args, {}))
+    assert k_plain != k_x64
+
+
+def test_scalar_leaves_key_on_type_not_value(tmp_path):
+    """A traced scalar's VALUE must not mint new entries (the pack seed)."""
+    rt = AotRuntime(AotStore(str(tmp_path)))
+    k1 = rt._key(rt.manifest("p", (jnp.ones((4,)), 7), {}))
+    k2 = rt._key(rt.manifest("p", (jnp.ones((4,)), 12345), {}))
+    k3 = rt._key(rt.manifest("p", (jnp.ones((4,)), 1.5), {}))
+    assert k1 == k2
+    assert k1 != k3  # int vs float scalar changes the traced program
+
+
+def test_corrupt_artifact_falls_through_to_compile(tmp_path):
+    store = AotStore(str(tmp_path))
+    rt1 = AotRuntime(store)
+    aot.set_runtime(rt1)
+    args = _toy_args()
+    aot.aot_call("toy", _toy, args, {"k": 3})
+    rt1.flush(timeout=30.0)
+    assert store.entry_count() == 1
+    # bit-rot the artifact on disk
+    name = [n for n in os.listdir(store.entries_dir)
+            if n.endswith(".aotx")][0]
+    fp = os.path.join(store.entries_dir, name)
+    blob = bytearray(open(fp, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(fp, "wb") as f:
+        f.write(bytes(blob))
+
+    rt2 = AotRuntime(store)
+    aot.set_runtime(rt2)
+    out, s = aot.aot_call("toy", _toy, args, {"k": 3})  # must not raise
+    st = rt2.stats()
+    assert st["hits"] == 0 and st["misses"] == 1 and st["compiles"] == 1
+    assert store.corrupt_quarantined == 1
+    expected, _ = _toy(*args, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+
+
+def test_bypass_context_skips_runtime(tmp_path):
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    rt = AotRuntime(AotStore(str(tmp_path)))
+    aot.set_runtime(rt)
+    with aot_rt.bypass():
+        aot.aot_call("toy", _toy, _toy_args(), {"k": 3})
+    assert rt.stats()["misses"] == 0 and rt.stats()["hits"] == 0
+
+
+def test_no_runtime_is_passthrough():
+    out, s = aot.aot_call("toy", _toy, _toy_args(), {"k": 3})
+    expected, _ = _toy(*_toy_args(), k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+
+
+# --------------------------------------------- background compile (pending)
+
+def test_background_mode_raises_pending_then_serves(tmp_path):
+    store = AotStore(str(tmp_path))
+    rt = AotRuntime(store, background_compile=True)
+    aot.set_runtime(rt)
+    args = _toy_args()
+    with pytest.raises(aot.CompilePending):
+        aot.aot_call("toy", _toy, args, {"k": 3}, pending_ok=True)
+    # the compile thread lands the executable; later dispatches hit
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if rt.stats()["pending"] == 0 and rt.stats()["compiles"] >= 1:
+            break
+        time.sleep(0.02)
+    assert rt.stats()["compiles"] == 1
+    out, _ = aot.aot_call("toy", _toy, args, {"k": 3}, pending_ok=True)
+    assert rt.stats()["hits"] == 1
+    expected, _ = _toy(*args, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
+    # pending_ok=False callers (the cpu tier, scripts) never see the raise
+    aot.set_runtime(AotRuntime(AotStore(str(tmp_path / "s2")),
+                               background_compile=True))
+    out2, _ = aot.aot_call("toy", _toy, args, {"k": 3})
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(expected))
+
+
+def test_background_compile_preserves_x64_mode(tmp_path):
+    """A background compile spawned from inside enable_x64 (the gate scan)
+    must lower under the same mode — otherwise the int64 avals canonicalize
+    to int32 and a wrong-signature program lands under the fingerprint."""
+    from jax.experimental import enable_x64
+
+    f = jax.jit(lambda x: (x * 2).sum())
+    rt = AotRuntime(AotStore(str(tmp_path)), background_compile=True)
+    aot.set_runtime(rt)
+    with enable_x64():
+        args = (jnp.asarray(np.arange(8, dtype=np.int64)),)
+        with pytest.raises(aot.CompilePending):
+            aot.aot_call("x64prog", f, args, {}, pending_ok=True)
+    deadline = time.time() + 60
+    while time.time() < deadline and rt.stats()["pending"]:
+        time.sleep(0.02)
+    assert rt.stats()["compiles"] == 1 and rt.stats()["failed"] == 0
+    with enable_x64():
+        out = aot.aot_call("x64prog", f, args, {}, pending_ok=True)
+    assert rt.stats()["hits"] == 1
+    assert int(out) == int(np.arange(8, dtype=np.int64).sum() * 2)
+
+
+def test_code_version_in_fingerprint(tmp_path):
+    """A changed solver-source hash must miss the store (a store built
+    before a code change can never serve the old algorithm silently)."""
+    store = AotStore(str(tmp_path))
+    rt_a = AotRuntime(store, code_version="aaaa")
+    rt_b = AotRuntime(store, code_version="bbbb")
+    k_a = rt_a._key(rt_a.manifest("p", _toy_args(), {"k": 2}))
+    k_b = rt_b._key(rt_b.manifest("p", _toy_args(), {"k": 2}))
+    assert k_a != k_b
+    # the real hash is stable within a process
+    from yunikorn_tpu.aot.runtime import _code_version
+
+    assert _code_version() == _code_version()
+
+
+def test_pending_classified_persistent():
+    from yunikorn_tpu.robustness.supervisor import PERSISTENT, classify_error
+
+    assert classify_error(aot.CompilePending("x")) == PERSISTENT
+
+
+# ------------------------------------------------------- solver-path wiring
+
+def test_solver_options_static_fields_invalidate(tmp_path):
+    """A changed SolverOptions-driven static (max_rounds, policy) must miss
+    the store and recompile — through the real solve_batch wiring."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for node in make_kwok_nodes(16):
+        cache.update_node(node)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = make_sleep_pods(32, "a", queue="root.a")
+    batch = enc.build_batch([
+        AllocationAsk(p.uid, "a", get_pod_resource(p), pod=p) for p in pods])
+
+    rt = AotRuntime(AotStore(str(tmp_path)))
+    aot.set_runtime(rt)
+    r1 = solve_batch(batch, enc.nodes)
+    r1.block_until_ready()
+    assert rt.stats()["compiles"] == 1
+    # same variant again: in-memory hit, no trace
+    solve_batch(batch, enc.nodes).block_until_ready()
+    assert rt.stats()["compiles"] == 1 and rt.stats()["hits"] == 1
+    # changed statics miss
+    solve_batch(batch, enc.nodes, max_rounds=8).block_until_ready()
+    assert rt.stats()["compiles"] == 2
+    solve_batch(batch, enc.nodes, policy="spread").block_until_ready()
+    assert rt.stats()["compiles"] == 3
+
+
+def test_compile_only_build_loads_from_store(tmp_path):
+    """The prewarm/compile_only route must populate the store and, in a
+    fresh runtime, LOAD instead of compiling (what --prewarm + --aot-store
+    does at process start)."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for node in make_kwok_nodes(16):
+        cache.update_node(node)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = make_sleep_pods(32, "a", queue="root.a")
+    batch = enc.build_batch([
+        AllocationAsk(p.uid, "a", get_pod_resource(p), pod=p) for p in pods])
+
+    store = AotStore(str(tmp_path))
+    rt1 = AotRuntime(store)
+    aot.set_runtime(rt1)
+    solve_batch(batch, enc.nodes, compile_only=True)
+    assert rt1.stats()["compiles"] == 1
+    rt1.flush(timeout=30.0)
+    assert store.entry_count() == 1
+
+    rt2 = AotRuntime(store)
+    aot.set_runtime(rt2)
+    solve_batch(batch, enc.nodes, compile_only=True)   # prewarm: pure load
+    assert rt2.stats()["loads"] == 1 and rt2.stats()["compiles"] == 0
+    r = solve_batch(batch, enc.nodes)                  # production dispatch
+    r.block_until_ready()
+    assert rt2.stats()["hits"] == 1 and rt2.stats()["compiles"] == 0
+
+
+def test_jc_delta_accounting_sees_aot_compiles(tmp_path):
+    """aot compiles bypass the jit wrappers (fn.lower().compile() never
+    grows fn._cache_size()), so jit_cache_entries folds the runtime's
+    per-path compile tally in — the core's solve_compile_total / compiled
+    span accounting must not go dark for store-attached processes."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops import assign as assign_mod
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for node in make_kwok_nodes(16):
+        cache.update_node(node)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = make_sleep_pods(32, "a", queue="root.a")
+    batch = enc.build_batch([
+        AllocationAsk(p.uid, "a", get_pod_resource(p), pod=p) for p in pods])
+
+    aot.set_runtime(AotRuntime(AotStore(str(tmp_path))))
+    jc0 = assign_mod.jit_cache_entries()
+    assign_mod.solve_batch(batch, enc.nodes).block_until_ready()
+    jc1 = assign_mod.jit_cache_entries()
+    assert jc1 == jc0 + 1          # the aot compile is visible as a delta
+    assign_mod.solve_batch(batch, enc.nodes).block_until_ready()
+    assert assign_mod.jit_cache_entries() == jc1   # a hit is not
+
+
+def test_refused_variant_latches_without_backend_wide_fallout(tmp_path, monkeypatch):
+    """A variant failing to serialize permanently must latch ONLY that
+    fingerprint: other variants of the same path (e.g. the non-pallas
+    static combination) and other paths keep saving, the persistent cache
+    stays off, and a TRANSIENT failure latches nothing."""
+    import jax.experimental.serialize_executable as se
+
+    store = AotStore(str(tmp_path))
+    rt = AotRuntime(store)
+    aot.set_runtime(rt)
+    # a good save first (backend demonstrably serializes)
+    aot.aot_call("good", _toy, _toy_args(), {"k": 3})
+    rt.flush(timeout=30.0)
+    assert store.entry_count() == 1 and rt._saves_ok == 1
+
+    real = se.serialize
+
+    def unimplemented(compiled):
+        raise RuntimeError("UNIMPLEMENTED: no serialization for this kernel")
+
+    monkeypatch.setattr(se, "serialize", unimplemented)
+    aot.aot_call("mosaic", _toy, _toy_args(32), {"k": 3})
+    rt.flush(timeout=30.0)
+    assert len(rt._refused_keys) == 1         # that fingerprint, latched
+    assert not rt._serialize_refused          # NOT a backend-wide refusal
+    assert store.entry_count() == 1
+    monkeypatch.setattr(se, "serialize", real)
+    # a DIFFERENT variant of the refused path still serializes and saves
+    aot.aot_call("mosaic", _toy, _toy_args(64), {"k": 3})
+    rt.flush(timeout=30.0)
+    assert store.entry_count() == 2
+    # a transient failure (MemoryError class) latches nothing
+    def oom(compiled):
+        raise MemoryError("serialize ran out of memory")
+
+    monkeypatch.setattr(se, "serialize", oom)
+    aot.aot_call("big", _toy, _toy_args(128), {"k": 3})
+    rt.flush(timeout=30.0)
+    assert len(rt._refused_keys) == 1
+    monkeypatch.setattr(se, "serialize", real)
+    # other paths unaffected throughout
+    aot.aot_call("good2", _toy, _toy_args(256), {"k": 3})
+    rt.flush(timeout=30.0)
+    assert store.entry_count() == 3
+
+
+def test_metrics_attached(tmp_path):
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    rt = AotRuntime(AotStore(str(tmp_path)))
+    rt.attach(registry=reg)
+    aot.set_runtime(rt)
+    aot.aot_call("toy", _toy, _toy_args(), {"k": 3})
+    aot.aot_call("toy", _toy, _toy_args(), {"k": 3})
+    text = reg.expose()
+    assert "yunikorn_aot_store_misses_total" in text
+    assert 'path="toy"' in text
+    assert "yunikorn_aot_store_hits_total 1" in text
+    assert "yunikorn_jit_compile_ms_bucket" in text

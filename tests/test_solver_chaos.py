@@ -795,3 +795,107 @@ def test_encode_row_store_fault_falls_back_to_host_req():
     names = {}
     got = run_trace(core, two_waves(), names)
     assert got == gate_clean_placements()
+
+
+# --------------------------------------------------------------------------
+# AOT background compile (aot/): a store miss in background mode must raise
+# CompilePending out of the device tier, the ladder serves the cycle from
+# cpu/host (placement-identical), and once the compile thread lands the
+# executable the half-open probe reclaims the device tier — the cold
+# process is degraded for seconds, never wedged on an inline compile.
+
+def _aot_runtime(tmp_path, background=True):
+    from yunikorn_tpu import aot
+
+    rt = aot.AotRuntime(aot.AotStore(str(tmp_path)),
+                        background_compile=background)
+    aot.set_runtime(rt)
+    return rt
+
+
+def test_aot_pending_degrades_then_probe_reclaims_device(tmp_path):
+    from yunikorn_tpu import aot
+
+    try:
+        rt = _aot_runtime(tmp_path, background=True)
+        opts = dataclasses_replace(FAST)
+        opts.max_retries = 0
+        cache, core = make_core(options=opts)
+        names = {}
+        got = run_trace(core, two_waves(), names)
+        # the cycles placed identically to a fault-free run, served by a
+        # lower tier while the background compile ran
+        assert got == clean_placements()
+        assert rt.stats()["misses"] >= 1
+        assert outcome(core, "assign", "persistent") >= 1
+        # the background thread lands the executable
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            s = rt.stats()
+            if s["pending"] == 0 and s["compiles"] >= 1 and not s["failed"]:
+                break
+            time.sleep(0.05)
+        assert rt.stats()["compiles"] >= 1
+        assert rt.stats()["failed"] == 0
+        # past the probe interval, the next dispatch probes the device tier,
+        # hits the in-memory executable and re-closes the circuit
+        time.sleep(opts.probe_interval_s + 0.05)
+        extra = make_sleep_pods(5, "app", queue="root.q", name_prefix="rec",
+                                cpu_milli=100)
+        names.update({p.uid: p.name for p in extra})
+        core.update_allocation(AllocationRequest(asks=asks_of(extra)))
+        core.schedule_once()
+        snap = core.supervisor.snapshot()["assign"]
+        assert snap["circuits"]["device"]["state"] == "closed"
+        assert snap["tier"] == "device"
+        assert rt.stats()["hits"] >= 1
+    finally:
+        rt = aot.get_runtime()
+        if rt is not None:
+            rt.flush(timeout=30.0)
+        aot.set_runtime(None)
+
+
+def test_aot_corrupt_store_entry_never_breaks_the_ladder(tmp_path):
+    """A corrupt/truncated artifact quarantines and falls through to a
+    normal compile — the cycle still places, identically."""
+    import os as _os
+
+    from yunikorn_tpu import aot
+
+    try:
+        # build a store inline (background off: misses compile in place)
+        rt1 = _aot_runtime(tmp_path, background=False)
+        cache, core = make_core()
+        names = {}
+        got = run_trace(core, two_waves(), names)
+        assert got == clean_placements()
+        rt1.flush(timeout=60.0)
+        store = rt1.store
+        assert store.entry_count() >= 1
+        for name in _os.listdir(store.entries_dir):
+            if not name.endswith(".aotx"):
+                continue
+            fp = _os.path.join(store.entries_dir, name)
+            blob = bytearray(open(fp, "rb").read())
+            blob[len(blob) // 2] ^= 0xFF
+            with open(fp, "wb") as f:
+                f.write(bytes(blob))
+
+        # a "fresh process" over the now-corrupt store
+        rt2 = aot.AotRuntime(store)
+        aot.set_runtime(rt2)
+        cache2, core2 = make_core()
+        names2 = {}
+        got2 = run_trace(core2, two_waves(), names2)
+        assert got2 == clean_placements()
+        assert store.corrupt_quarantined >= 1
+        assert rt2.stats()["loads"] == 0       # nothing loadable survived
+        assert rt2.stats()["compiles"] >= 1
+        # no supervised failures: the fall-through is invisible to the ladder
+        assert outcome(core2, "assign", "persistent") == 0
+    finally:
+        rt = aot.get_runtime()
+        if rt is not None:
+            rt.flush(timeout=30.0)
+        aot.set_runtime(None)

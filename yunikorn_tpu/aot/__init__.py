@@ -1,0 +1,18 @@
+"""AOT executable store: cold-start elimination for the jitted solver paths.
+
+See aot/store.py (the on-disk artifact store) and aot/runtime.py (the
+dispatch runtime the solver call sites consult). Offline builder:
+scripts/aot_build.py; process wiring: cmd/scheduler.py `--aot-store`,
+bench.py `YK_AOT_STORE`; design note: docs/COMPONENTS.md.
+"""
+from yunikorn_tpu.aot.runtime import (  # noqa: F401
+    AotRuntime,
+    CompilePending,
+    aot_call,
+    aot_compile,
+    get_runtime,
+    install,
+    pending_enabled,
+    set_runtime,
+)
+from yunikorn_tpu.aot.store import AotStore  # noqa: F401

@@ -1,0 +1,703 @@
+"""AOT dispatch runtime: route jitted solver calls through stored executables.
+
+Every jitted solver entry point (assign solve + chunked, the gate scan,
+encode_rows, the preemption and pack solves, and their mesh-sharded
+variants) funnels its calls through `aot_call` / `aot_compile`. With no
+runtime installed both helpers are a no-op passthrough to the jitted
+function — production default, zero behavior change. With a runtime
+installed (`--aot-store` / conf solver.aotStore):
+
+  hit   — the call's fingerprint resolves to an executable, either already
+          in the in-memory cache or deserialized from the store in
+          milliseconds; the deserialized `Compiled` runs WITHOUT any
+          trace or XLA compile. First production cycle in a fresh process
+          costs artifact-load, not minutes of compile.
+  miss  — inline mode: lower+compile (timed into `jit_compile_ms{path}` and
+          a `compile` tracer span), install in memory, serialize into the
+          store in the background so the NEXT process hits.
+        — background mode (`pending_ok=True`, conf solver.aotBackground):
+          raise `CompilePending` immediately and compile on a daemon
+          thread. The supervised ladder classifies CompilePending as
+          persistent → the device tier's breaker opens and cycles keep
+          serving on the cpu/host tiers; once the thread finishes, the
+          breaker's half-open probe finds the executable in memory and
+          reclaims the device tier. A cold process is degraded for
+          seconds, never wedged for minutes.
+
+The fingerprint manifest keys everything that changes the compiled program:
+the path name, the dynamic-arg pytree structure + per-leaf (shape, dtype,
+weak_type), the static kwargs, jax/jaxlib versions, backend platform +
+device count (topology), the x64 mode, and any caller extra (the mesh
+tag). Changing any component misses the store and recompiles — pinned by
+tests/test_aot_store.py.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+from yunikorn_tpu.log.logger import log
+
+logger = log("aot.runtime")
+
+
+class CompilePending(RuntimeError):
+    """The executable for this dispatch is being compiled in the background;
+    the supervised ladder should serve this cycle from a lower tier."""
+
+
+# jit_compile_ms histogram ladder: XLA solver compiles run seconds to
+# MINUTES (~400 s at the 50k bucket through the relay) — the generic
+# MS_BUCKETS top out at 10 s and would clamp every real compile into +Inf
+COMPILE_MS_BUCKETS = (100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0,
+                      10000.0, 30000.0, 60000.0, 120000.0, 300000.0,
+                      600000.0)
+
+
+def _to_specs(args):
+    """Array leaves → ShapeDtypeStructs (shape/dtype/sharding), other
+    leaves kept: the background/retry compile threads must not pin the
+    cycle's live tensors, and both must capture shardings identically."""
+    return jax.tree_util.tree_map(
+        lambda a: (jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=getattr(a, "sharding",
+                                                         None))
+                   if hasattr(a, "shape") and hasattr(a, "dtype") else a),
+        tuple(args))
+
+
+_CODE_VERSION: Optional[str] = None
+
+
+def _code_version() -> str:
+    """Hash of the solver-bearing source files, computed once per process.
+
+    The fingerprint manifest must invalidate when the CODE that traces a
+    program changes, not only when shapes/statics/jax versions do — a store
+    surviving a scheduler upgrade would otherwise silently serve the OLD
+    algorithm's executables forever, with every compile counter reading
+    zero ("healthy"). Hashing the ops/models/parallel sources (plus the
+    locality encoding constants) is deliberately broad: a code change that
+    did NOT alter the traced programs costs one store rebuild; a stale
+    executable serving stale placements is unbounded.
+    """
+    global _CODE_VERSION
+    if _CODE_VERSION is not None:
+        return _CODE_VERSION
+    import os
+
+    import yunikorn_tpu
+
+    pkg = os.path.dirname(os.path.abspath(yunikorn_tpu.__file__))
+    h = hashlib.sha256()
+    targets = []
+    for sub in ("ops", "models", "parallel"):
+        d = os.path.join(pkg, sub)
+        try:
+            targets.extend(os.path.join(d, n) for n in os.listdir(d)
+                           if n.endswith(".py"))
+        except OSError:
+            pass
+    targets.append(os.path.join(pkg, "snapshot", "locality.py"))
+    for fp in sorted(targets):
+        try:
+            with open(fp, "rb") as f:
+                h.update(os.path.basename(fp).encode())
+                h.update(f.read())
+        except OSError:
+            continue
+    _CODE_VERSION = h.hexdigest()[:16]
+    return _CODE_VERSION
+
+
+def _leaf_sig(x) -> tuple:
+    """Stable signature of one dynamic-arg leaf. Arrays (numpy, jax, and
+    ShapeDtypeStruct specs) key on (shape, dtype, weak_type); Python scalars
+    key on their TYPE only — a traced scalar's value never changes the
+    program, and keying on it would mint one store entry per seed."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype),
+                bool(getattr(x, "weak_type", False)))
+    return ("py", type(x).__name__)
+
+
+class AotRuntime:
+    def __init__(self, store, *, background_compile: bool = False,
+                 versions: Optional[Tuple[str, str]] = None,
+                 backend: Optional[Tuple[str, int]] = None,
+                 code_version: Optional[str] = None):
+        """store: an aot.store.AotStore. background_compile: misses raise
+        CompilePending (when the caller allows) instead of compiling inline.
+        versions/backend/code_version are injectable for invalidation
+        tests; by default they are read from the live jax install/backend
+        and the solver sources (_code_version)."""
+        self.store = store
+        self.background = bool(background_compile)
+        if versions is None:
+            import jaxlib
+
+            versions = (jax.__version__, jaxlib.__version__)
+        self._versions = versions
+        self._code_version = code_version or _code_version()
+        self._backend = backend  # resolved lazily: reading it dials the backend
+        self._mu = threading.Lock()
+        self._mem: Dict[str, object] = {}         # key -> stages.Compiled
+        self._pending: set = set()                # keys compiling in background
+        self._failed: set = set()                 # background compile failed →
+                                                  # later calls compile inline
+        self._refused_keys: set = set()           # fingerprints that won't
+                                                  # serialize (permanent)
+        self._refused_logged: set = set()         # paths already diagnosed
+        self._serialize_refused = False           # backend-wide latch
+        self._saves_ok = 0                        # successful store writes
+        self._bg_threads: list = []               # in-flight saves AND
+                                                  # background compiles
+        # per-path compile tally: feeds the modules' jit_cache_entries so
+        # the core's jc-delta accounting (solve_compile_total etc.) still
+        # sees aot compiles, which bypass the jit wrappers' caches
+        self.compiles_by_path: Dict[str, int] = {}
+        # plain counters: always live, whether or not a registry is attached
+        # (bench + smoke read these; /metrics reads the registry mirrors)
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+        self.loads = 0
+        self._m_hits = self._m_misses = self._h_compile_ms = None
+        self._tracer = None
+        self._cycle_id_fn: Callable[[], int] = lambda: 0
+
+    # ---------------------------------------------------------------- wiring
+    def attach(self, registry=None, tracer=None,
+               cycle_id_fn: Optional[Callable[[], int]] = None) -> None:
+        """Bind the process's metrics registry / cycle tracer (re-binding to
+        a newer core is fine — last writer wins)."""
+        if registry is not None:
+            self._m_hits = registry.counter(
+                "aot_store_hits_total",
+                "solver dispatches served from an AOT-stored executable "
+                "(memory or disk) with zero trace+compile")
+            self._m_misses = registry.counter(
+                "aot_store_misses_total",
+                "solver dispatches whose fingerprint missed the AOT store, "
+                "by path", labelnames=("path",))
+            self._h_compile_ms = registry.histogram(
+                "jit_compile_ms",
+                "XLA trace+compile latency of AOT-managed solver paths (ms)",
+                labelnames=("path",), buckets=COMPILE_MS_BUCKETS)
+        if tracer is not None:
+            self._tracer = tracer
+        if cycle_id_fn is not None:
+            self._cycle_id_fn = cycle_id_fn
+
+    # ----------------------------------------------------------- fingerprint
+    def _backend_sig(self) -> Tuple[str, int]:
+        if self._backend is None:
+            devs = jax.devices()
+            self._backend = (devs[0].platform, len(devs))
+        return self._backend
+
+    def manifest(self, path: str, args, static_kwargs: dict,
+                 extra: tuple = ()) -> dict:
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        platform, n_dev = self._backend_sig()
+        return {
+            "path": path,
+            "jax": self._versions[0],
+            "jaxlib": self._versions[1],
+            "code": self._code_version,
+            "backend": platform,
+            "topology": n_dev,
+            # thread-local-aware: int64 only canonicalizes to itself under
+            # the x64 mode the caller (e.g. the gate's enable_x64) is in
+            "x64": str(jax.dtypes.canonicalize_dtype(np.int64)) == "int64",
+            "tree": str(treedef),
+            "leaves": [_leaf_sig(x) for x in leaves],
+            "static": sorted((k, repr(v)) for k, v in static_kwargs.items()),
+            "extra": [repr(e) for e in extra],
+        }
+
+    @staticmethod
+    def _key(manifest: dict) -> str:
+        return hashlib.sha256(repr(sorted(
+            (k, str(v)) for k, v in manifest.items()
+        )).encode()).hexdigest()[:24]
+
+    # -------------------------------------------------------------- dispatch
+    def dispatch(self, path: str, fn, args: tuple, static_kwargs: dict,
+                 *, pending_ok: bool = False, extra: tuple = (),
+                 lower_cm=None):
+        """Run one solver call through the store. Returns fn's result (the
+        exact out_tree the jitted function produces). lower_cm: optional
+        context manager (the GSPMD mesh) entered around lower()."""
+        manifest = self.manifest(path, args, static_kwargs, extra)
+        key = self._key(manifest)
+        comp = self._mem.get(key)
+        if comp is None:
+            comp = self._load(path, key)
+        if comp is not None:
+            try:
+                out = comp(*args)
+            except TypeError as e:
+                # aval/pytree mismatch = fingerprint bug or stale artifact:
+                # drop it and compile — never fail the dispatch
+                with self._mu:
+                    self._mem.pop(key, None)
+                logger.warning(
+                    "aot executable for %s (%s) rejected its args (%s); "
+                    "dropping the entry and recompiling", path, key, e)
+            else:
+                self._count_hit()
+                return out
+        self._count_miss(path)
+        if (pending_ok and self.background and key not in self._failed):
+            self._spawn_compile(path, key, manifest, fn, args, static_kwargs,
+                                lower_cm)
+            raise CompilePending(
+                f"aot: no stored executable for {path} (key {key}); "
+                "background compile started — serve from a lower tier")
+        comp = self._compile(path, key, manifest, fn, args, static_kwargs,
+                             lower_cm)
+        return comp(*args)
+
+    def build(self, path: str, fn, args: tuple, static_kwargs: dict,
+              *, extra: tuple = (), lower_cm=None) -> bool:
+        """compile_only entry (prewarm / offline builder): ensure the
+        fingerprint's executable exists in memory, loading from the store
+        when possible, compiling+persisting otherwise. args may be
+        ShapeDtypeStructs. Returns True when the store (not a compile)
+        supplied it."""
+        manifest = self.manifest(path, args, static_kwargs, extra)
+        key = self._key(manifest)
+        if key in self._mem:
+            return True
+        if self._load(path, key) is not None:
+            return True
+        self._compile(path, key, manifest, fn, args, static_kwargs, lower_cm)
+        return False
+
+    # ------------------------------------------------------------- internals
+    def _load(self, path: str, key: str):
+        rec = self.store.get(path, key) if self.store is not None else None
+        if rec is None:
+            return None
+        manifest, payload, in_tree, out_tree = rec
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            t0 = time.perf_counter()
+            comp = deserialize_and_load(payload, in_tree, out_tree)
+            load_ms = (time.perf_counter() - t0) * 1000
+        except Exception as e:
+            logger.warning("aot: deserialize of %s (%s) failed (%s: %s); "
+                           "recompiling", path, key, type(e).__name__, e)
+            return None
+        with self._mu:
+            self._mem[key] = comp
+            self.loads += 1
+        logger.info("aot: loaded %s (%s) from store in %.1f ms",
+                    path, key, load_ms)
+        return comp
+
+    @staticmethod
+    def _lower_compile(fn, args, static_kwargs, lower_cm, *,
+                       x64: bool = False, no_cache: bool = False):
+        from contextlib import nullcontext
+
+        from jax.experimental import enable_x64
+
+        with (enable_x64() if x64 else nullcontext()), \
+                (_no_persistent_cache() if no_cache else nullcontext()), \
+                (lower_cm if lower_cm is not None else nullcontext()):
+            return fn.lower(*args, **static_kwargs).compile()
+
+    def _compile(self, path: str, key: str, manifest: dict, fn, args,
+                 static_kwargs, lower_cm):
+        t0 = time.perf_counter()
+        compiled = self._lower_compile(fn, args, static_kwargs, lower_cm)
+        dt_ms = (time.perf_counter() - t0) * 1000
+        with self._mu:
+            self._mem[key] = compiled
+            self.compiles += 1
+            self.compiles_by_path[path] = \
+                self.compiles_by_path.get(path, 0) + 1
+        if self._h_compile_ms is not None:
+            self._h_compile_ms.observe(dt_ms, path=path)
+        if self._tracer is not None:
+            try:
+                now = time.time()
+                self._tracer.add("compile", self._cycle_id_fn(),
+                                 now - dt_ms / 1000, now, path=path, key=key)
+            except Exception:
+                pass
+        logger.info("aot: compiled %s (%s) in %.0f ms", path, key, dt_ms)
+        # persist off-thread: serialization of a big executable is pure CPU
+        # + disk and must not sit on the scheduling path. The save thread
+        # gets the material for a forced-true-compile retry (specs, not the
+        # caller's live arrays): an executable SERVED from the jax
+        # persistent cache serializes without its object code, and only a
+        # fresh compile can produce a storable artifact then.
+        retry = (fn, _to_specs(args), static_kwargs, lower_cm,
+                 bool(manifest.get("x64")))
+        t = threading.Thread(target=self._save, name="aot-save", daemon=True,
+                             args=(path, key, manifest, compiled, retry))
+        self._track(t)
+        t.start()
+        return compiled
+
+    def _track(self, t: threading.Thread) -> None:
+        with self._mu:
+            self._bg_threads = [x for x in self._bg_threads if x.is_alive()]
+            self._bg_threads.append(t)
+
+    def _spawn_compile(self, path, key, manifest, fn, args, static_kwargs,
+                       lower_cm) -> None:
+        with self._mu:
+            if key in self._pending:
+                return
+            self._pending.add(key)
+        # hold specs, not the cycle's real arrays: the thread outlives the
+        # dispatch and must not pin hundreds of MB of batch tensors
+        specs = _to_specs(args)
+
+        # the dispatch may be running under a thread-local dtype mode (the
+        # gate scan lowers int64 programs inside enable_x64); the compile
+        # thread must re-enter it or lowering would canonicalize the int64
+        # avals down to int32 and bake a wrong-signature program under this
+        # fingerprint
+        x64 = bool(manifest.get("x64"))
+
+        def run():
+            from contextlib import nullcontext
+
+            from jax.experimental import enable_x64
+
+            try:
+                with (enable_x64() if x64 else nullcontext()):
+                    self._compile(path, key, manifest, fn, specs,
+                                  static_kwargs, lower_cm)
+            except Exception:
+                with self._mu:
+                    self._failed.add(key)
+                logger.exception(
+                    "aot: background compile of %s (%s) failed; later "
+                    "dispatches will compile inline", path, key)
+            finally:
+                with self._mu:
+                    self._pending.discard(key)
+
+        t = threading.Thread(target=run, name="aot-compile", daemon=True)
+        self._track(t)
+        t.start()
+
+    @staticmethod
+    def _refusal_permanent(exc: BaseException) -> bool:
+        """Whether a serialize/validate failure will repeat for this exact
+        program (latch it) vs a transient condition (just skip this save).
+        A permanent latch on a transient MemoryError/OSError would strip a
+        whole variant's cold-start coverage for the process lifetime."""
+        if isinstance(exc, (NotImplementedError, TypeError, ValueError)):
+            return True
+        if isinstance(exc, (MemoryError, OSError)):
+            return False
+        msg = str(exc)
+        return any(tok in msg for tok in
+                   ("UNIMPLEMENTED", "INVALID_ARGUMENT", "Symbols not found",
+                    "not supported", "unsupported"))
+
+    def _serialize_validated(self, compiled):
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+            serialize,
+        )
+
+        payload, in_tree, out_tree = serialize(compiled)
+        # round-trip validation BEFORE the artifact can reach another
+        # process: a backend may serialize without error yet emit a
+        # payload that cannot load (the persistent-cache "Symbols not
+        # found" class) — such an entry must never be written
+        deserialize_and_load(payload, in_tree, out_tree)
+        return payload, in_tree, out_tree
+
+    def _save(self, path: str, key: str, manifest: dict, compiled,
+              retry=None) -> None:
+        if self.store is None or key in self._refused_keys:
+            # a variant that already refused never re-pays the (potentially
+            # multi-GB) serialize+validate just to drop the result again
+            return
+        try:
+            try:
+                rec = self._serialize_validated(compiled)
+            except Exception as e:
+                # ONE specific failure class earns a retry: an executable
+                # SERVED from the jax persistent cache carries no object
+                # code and loads back with "Symbols not found" — a fresh
+                # compile with cache lookups suppressed produces a storable
+                # artifact; pay it once, here on the save thread, off the
+                # scheduling path. Anything else (a genuinely
+                # unserializable Mosaic variant, transient OOM/IO) must NOT
+                # burn a full recompile just to fail again.
+                if retry is None or "Symbols not found" not in str(e):
+                    raise
+                fn, specs, static_kwargs, lower_cm, x64 = retry
+                logger.info(
+                    "aot: %s (%s) did not serialize (%s: %s); retrying "
+                    "with a forced true compile (persistent-cache-served "
+                    "executables carry no object code)", path, key,
+                    type(e).__name__, str(e)[:120])
+                fresh = self._lower_compile(fn, specs, static_kwargs,
+                                            lower_cm, x64=x64,
+                                            no_cache=True)
+                rec = self._serialize_validated(fresh)
+        except Exception as e:
+            # the relay cache gap's OTHER half: a program that refuses
+            # serialization (e.g. a Mosaic-kernel variant). Latched per
+            # FINGERPRINT and only for permanent failures — a refusing
+            # pallas variant must not stop the plain-XLA variants of the
+            # same path from persisting, and a transient MemoryError must
+            # not latch anything. Loud once per path, instead of the old
+            # silent recompile-per-process.
+            permanent = self._refusal_permanent(e)
+            with self._mu:
+                if permanent:
+                    self._refused_keys.add(key)
+                first_for_path = path not in self._refused_logged
+                self._refused_logged.add(path)
+                backend_wide = (permanent and self._saves_ok == 0
+                                and not self._serialize_refused)
+                if backend_wide:
+                    self._serialize_refused = True
+            if first_for_path:
+                logger.warning(
+                    "aot: %s (%s) failed executable serialization on "
+                    "backend %r (%s: %s) — its cold starts will pay the "
+                    "compile%s", path, key, self._backend_sig()[0],
+                    type(e).__name__, str(e)[:200],
+                    "; variant latched, will not re-attempt" if permanent
+                    else "; transient, later compiles will retry")
+            if backend_wide:
+                logger.warning(
+                    "aot: no program has serialized on backend %r — "
+                    "exported-executable cold starts are unavailable; the "
+                    "jax persistent cache (mirrored via store xla_cache/) "
+                    "is the only remaining cold-start softener",
+                    self._backend_sig()[0])
+            return
+        if self.store.put(path, key, manifest, *rec):
+            with self._mu:
+                self._saves_ok += 1
+
+    def _count_hit(self) -> None:
+        with self._mu:
+            self.hits += 1
+        if self._m_hits is not None:
+            self._m_hits.inc()
+
+    def _count_miss(self, path: str) -> None:
+        with self._mu:
+            self.misses += 1
+        if self._m_misses is not None:
+            self._m_misses.inc(path=path)
+
+    def flush(self, timeout: Optional[float] = 60.0) -> None:
+        """Join in-flight background work — store writes AND background
+        compiles (a compile that finishes spawns a fresh save thread, so
+        the snapshot is re-taken until quiescent or the deadline passes).
+        The offline builder (and the atexit hook install() registers) calls
+        this before process exit: a daemon thread inside XLA during
+        interpreter teardown aborts the process, and its work would be
+        lost anyway."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            with self._mu:
+                threads = [t for t in self._bg_threads if t.is_alive()]
+            if not threads:
+                return
+            for t in threads:
+                t.join(None if deadline is None
+                       else max(deadline - time.time(), 0.01))
+            if deadline is not None and time.time() >= deadline:
+                return
+
+    def stats(self) -> dict:
+        with self._mu:
+            out = {"hits": self.hits, "misses": self.misses,
+                   "compiles": self.compiles, "loads": self.loads,
+                   "pending": len(self._pending), "failed": len(self._failed)}
+        if self.store is not None:
+            out["store"] = self.store.stats()
+        return out
+
+
+# ---------------------------------------------------------------- singleton
+# One process-wide runtime: the solver call sites consult it through the
+# helpers below. None (the default) = AOT disabled, zero-overhead
+# passthrough; installed by cmd/scheduler.py, bench.py, scripts/aot_build.py
+# or a test.
+_runtime: Optional[AotRuntime] = None
+_tls = threading.local()
+
+
+def get_runtime() -> Optional[AotRuntime]:
+    return _runtime
+
+
+class bypass:
+    """Context manager: make aot_call a plain passthrough on THIS thread.
+
+    The supervised cpu re-jit tier runs the same program with identical
+    avals under jax.default_device(cpu) — its fingerprint would collide
+    with the device tier's stored executable and a "hit" would silently
+    run the dispatch on the device being degraded away from. Thread-local
+    because supervised dispatches execute on per-call watchdog threads.
+    """
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "bypass", False)
+        _tls.bypass = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls.bypass = self._prev
+        return False
+
+
+def set_runtime(rt: Optional[AotRuntime]) -> Optional[AotRuntime]:
+    global _runtime
+    prev, _runtime = _runtime, rt
+    return prev
+
+
+_cache_flip_mu = threading.Lock()
+
+
+class _no_persistent_cache:
+    """Context manager: suppress jax persistent-compilation-cache lookups
+    for compiles inside the block, process-wide but scoped and restored.
+
+    Why not simply flip the flag: compilation_cache.is_cache_used memoizes
+    its decision at the first compile, so reset_cache() (files untouched)
+    must clear the memo on BOTH transitions. Why at all: an executable
+    SERVED from the persistent cache serializes without its object code on
+    XLA:CPU ("Symbols not found" in the consuming process) — a storable
+    artifact requires a true compile. Scoped (vs disabling the cache for
+    the whole process) so every program NOT routed through the AOT layer
+    keeps its persistent-cache cold-start softening, and the store's
+    xla_cache/ mirror stays meaningful. Serialized by a lock: concurrent
+    unscoped compiles during the window merely skip the cache (harmless);
+    two scoped blocks must not interleave their restores."""
+
+    def __enter__(self):
+        _cache_flip_mu.acquire()
+        self._prev = None
+        try:
+            self._prev = bool(jax.config.jax_enable_compilation_cache)
+            if self._prev:
+                from jax._src import compilation_cache as cc
+
+                cc.reset_cache()
+                jax.config.update("jax_enable_compilation_cache", False)
+        except Exception:
+            self._prev = None
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            if self._prev:
+                from jax._src import compilation_cache as cc
+
+                jax.config.update("jax_enable_compilation_cache", True)
+                cc.reset_cache()
+        except Exception:
+            pass
+        finally:
+            _cache_flip_mu.release()
+        return False
+
+
+def install(store_path: str, *, background: bool = False,
+            max_bytes: int = 0) -> AotRuntime:
+    """Create store + runtime at store_path and install as the process
+    singleton. Also seeds the live jax persistent cache from the store's
+    mirror BEFORE the first compile — the cache stays enabled for every
+    program the AOT layer does not manage (tiny jit ops, overlay programs),
+    while AOT-managed artifacts that fail to serialize because their
+    executable was cache-served are re-compiled true on the save thread
+    (see _save / _no_persistent_cache)."""
+    from yunikorn_tpu.aot.store import AotStore
+
+    store = AotStore(store_path, max_bytes=max_bytes)
+    restored = store.restore_persistent_cache()
+    if restored:
+        logger.info("aot: restored %d persistent-cache entries from the "
+                    "store mirror", restored)
+    rt = AotRuntime(store, background_compile=background)
+    set_runtime(rt)
+    # in-flight store writes serialize through XLA; letting them race
+    # interpreter teardown aborts the process (observed SIGABRT)
+    import atexit
+
+    atexit.register(rt.flush, 120.0)
+    return rt
+
+
+def compile_count(*prefixes: str) -> int:
+    """Total aot-layer compiles whose path starts with any prefix (0 with no
+    runtime). The ops modules fold this into their jit_cache_entries() so
+    the core's jc-delta compile accounting (solve_compile_total, the gate's
+    and preempt's `compiled` span args) keeps working when AOT routes
+    around the jit wrappers — fn.lower().compile() never populates
+    fn._cache_size(), so without this every store-miss compile would be
+    mislabelled a cache hit."""
+    rt = _runtime
+    if rt is None:
+        return 0
+    with rt._mu:
+        return sum(v for p, v in rt.compiles_by_path.items()
+                   if p.startswith(prefixes))
+
+
+def pending_enabled() -> bool:
+    """Whether supervised device-tier callers should opt into
+    CompilePending degradation (runtime installed AND background mode)."""
+    rt = _runtime
+    return rt is not None and rt.background
+
+
+def aot_call(path: str, fn, args: tuple, static_kwargs: Optional[dict] = None,
+             *, pending_ok: bool = False, extra: tuple = (), lower_cm=None):
+    """Call a jitted solver entry point through the AOT runtime (store-hit
+    executables skip trace+compile entirely). No runtime installed → plain
+    passthrough call."""
+    static_kwargs = static_kwargs or {}
+    rt = _runtime
+    if rt is None or getattr(_tls, "bypass", False):
+        return fn(*args, **static_kwargs)
+    return rt.dispatch(path, fn, args, static_kwargs, pending_ok=pending_ok,
+                       extra=extra, lower_cm=lower_cm)
+
+
+def aot_compile(path: str, fn, args: tuple,
+                static_kwargs: Optional[dict] = None, *, extra: tuple = (),
+                lower_cm=None) -> None:
+    """compile_only analog of aot_call (prewarm/builder path): ensure the
+    executable exists, loading it from the store instead of compiling when
+    possible. No runtime → classic lower().compile() into the jit caches."""
+    from contextlib import nullcontext
+
+    static_kwargs = static_kwargs or {}
+    rt = _runtime
+    if rt is None:
+        with (lower_cm if lower_cm is not None else nullcontext()):
+            fn.lower(*args, **static_kwargs).compile()
+        return
+    rt.build(path, fn, args, static_kwargs, extra=extra, lower_cm=lower_cm)

@@ -12,6 +12,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
 import threading
@@ -41,13 +42,24 @@ def main(argv=None) -> int:
                         help="schedule against a real cluster via this "
                              "kubeconfig (kind/kwok); default: FakeCluster")
     parser.add_argument("--prewarm", type=str, default="",
-                        help="compile standard solve buckets at startup in "
+                        help="warm standard solve buckets at startup in "
                              "the background, e.g. '1024x4096,16384x65536' "
                              "(nodes x pods); removes the first-cycle XLA "
                              "compile stall (persistent cache fills too). "
                              "Covers the resolved runtime variant: policy x "
                              "mesh x pallas gate x the pipelined cycle's "
-                             "persistent device-resident node buffers")
+                             "persistent device-resident node buffers. With "
+                             "--aot-store the warmup LOADS prebuilt "
+                             "executables instead of compiling")
+    parser.add_argument("--aot-store", type=str,
+                        default=os.environ.get("YK_AOT_STORE", ""),
+                        help="AOT executable store directory (see "
+                             "scripts/aot_build.py): serialized compiled "
+                             "solver executables keyed by fingerprint — a "
+                             "fresh process with a prebuilt store serves "
+                             "its first cycle with zero XLA compiles. "
+                             "Default: $YK_AOT_STORE, else conf "
+                             "solver.aotStore")
     parser.add_argument("--trace-out", type=str, default="",
                         help="dump the cycle tracer as Chrome trace-event "
                              "JSON to this path at shutdown (the live ring "
@@ -95,12 +107,32 @@ def main(argv=None) -> int:
     from yunikorn_tpu.core.scheduler import SolverOptions
     from yunikorn_tpu.robustness.supervisor import SupervisorOptions
 
+    # AOT executable store (aot/): install BEFORE the core so the first
+    # scheduling cycle already dispatches through it; seeds the jax
+    # persistent cache from the store mirror before any compile
+    aot_rt = None
+    store_path = args.aot_store or holder.get().solver_aot_store
+    if store_path:
+        from yunikorn_tpu import aot
+
+        aot_rt = aot.install(
+            store_path,
+            background=holder.get().solver_aot_background != "false")
+        logger.info("aot store attached at %s (%d entries, background "
+                    "compile %s)", store_path, aot_rt.store.entry_count(),
+                    "on" if aot_rt.background else "off")
+
     cache = SchedulerCache()
     core = CoreScheduler(cache,
                          solver_options=SolverOptions.from_conf(holder.get()),
                          trace_spans=holder.get().obs_trace_spans,
                          supervisor_options=SupervisorOptions.from_conf(
                              holder.get()))
+    if aot_rt is not None:
+        # hit/miss/compile metrics land in this core's /metrics; compile
+        # spans land on its cycle timeline
+        aot_rt.attach(registry=core.obs, tracer=core.tracer,
+                      cycle_id_fn=lambda: core.supervisor.cycle_id)
     context = Context(cluster, core, cache=cache)
     shim = KubernetesShim(cluster, core, context=context)
     rest = RestServer(core, context, port=args.rest_port)

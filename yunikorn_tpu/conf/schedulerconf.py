@@ -63,6 +63,8 @@ CM_SOLVER_GATE = PREFIX_SOLVER + "gateVectorized"       # auto | true | false
 CM_SOLVER_GATE_DEVICE = PREFIX_SOLVER + "gateDevice"    # auto | true | false
 CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
 CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optimal
+CM_SOLVER_AOT_STORE = PREFIX_SOLVER + "aotStore"        # dir path; "" = off
+CM_SOLVER_AOT_BACKGROUND = PREFIX_SOLVER + "aotBackground"  # auto | true | false
 
 # the tri-state device-path gates share one value domain; solver.policy and
 # solver.gateVerify have their own. All parse through _parse_choice: an
@@ -161,6 +163,15 @@ class SchedulerConf:
     # pack plan does not beat it); "auto" = greedy for now (flips when the
     # hardware A/B lands, like PALLAS_TPU_DEFAULT)
     solver_policy: str = "auto"
+    # AOT executable store (aot/): directory holding serialized compiled
+    # solver executables per fingerprint; "" = disabled. A fresh process
+    # with a prebuilt store serves its first cycle without XLA compiles.
+    solver_aot_store: str = ""
+    # on a store miss in a supervised device dispatch: "auto"/"true" =
+    # raise CompilePending and compile in the background (the ladder serves
+    # from cpu/host until the half-open probe reclaims the tier); "false" =
+    # compile inline (the legacy first-cycle stall)
+    solver_aot_background: str = "auto"
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -266,6 +277,7 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     conf.instance_type_node_label_key = s(CM_SVC_INSTANCE_TYPE_LABEL, conf.instance_type_node_label_key)
     conf.solver_scoring_policy = s(CM_SOLVER_SCORING_POLICY, conf.solver_scoring_policy)
     conf.solver_platform = s(CM_SOLVER_DEVICE_PLATFORM, conf.solver_platform)
+    conf.solver_aot_store = s(CM_SOLVER_AOT_STORE, conf.solver_aot_store)
     if CM_SVC_SCHEDULING_INTERVAL in data:
         conf.interval = _parse_duration(data[CM_SVC_SCHEDULING_INTERVAL], conf.interval)
     if CM_SVC_VOLUME_BIND_TIMEOUT in data:
@@ -325,6 +337,7 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             (CM_SOLVER_GATE, "solver_gate", TRI_STATE),
             (CM_SOLVER_GATE_DEVICE, "solver_gate_device", TRI_STATE),
             (CM_SOLVER_GATE_VERIFY, "solver_gate_verify", ("true", "false")),
+            (CM_SOLVER_AOT_BACKGROUND, "solver_aot_background", TRI_STATE),
             (CM_SOLVER_POLICY, "solver_policy", SOLVER_POLICIES)):
         if key in data:
             setattr(conf, attr, _parse_choice(key, data[key], allowed))

@@ -253,12 +253,17 @@ def dispatch_preemption_solve(
     candidate_nodes: Optional[List[str]] = None,
     mesh=None,
     mirror_epoch: Optional[int] = None,
+    aot_pending: bool = False,
 ) -> Optional[PreemptSolveHandle]:
     """Encode + async-dispatch the batched victim-selection solve.
 
     Returns None when nothing is eligible (the caller should skip planning
     entirely) — asks in groups the device cannot model still ride the handle
     and are re-planned on the host at finish, sharing the claimed-victim set.
+    aot_pending: only SUPERVISED callers opt in — an AOT-store miss in
+    background mode then raises CompilePending for the ladder to absorb;
+    unsupervised convenience callers (plan_preemptions_batched, scripts)
+    keep the inline compile so the raise cannot escape them.
     """
     import numpy as np
 
@@ -333,10 +338,15 @@ def dispatch_preemption_solve(
         from yunikorn_tpu.parallel.mesh import preempt_solve_sharded
 
         node_idx, victim_mask = preempt_solve_sharded(
-            np_args, mesh, max_candidates=MAX_CANDIDATE_NODES)
+            np_args, mesh, max_candidates=MAX_CANDIDATE_NODES,
+            aot_pending=aot_pending)
     else:
-        node_idx, victim_mask = ps_mod.preempt_solve(
-            *np_args, max_candidates=MAX_CANDIDATE_NODES)
+        from yunikorn_tpu.aot import runtime as aot_rt
+
+        node_idx, victim_mask = aot_rt.aot_call(
+            "preempt.solve", ps_mod.preempt_solve, tuple(np_args),
+            {"max_candidates": MAX_CANDIDATE_NODES},
+            pending_ok=aot_pending)
     jc1 = ps_mod.preempt_jit_cache_entries()
     stats = {
         "asks": len(asks),

@@ -1176,6 +1176,13 @@ class CoreScheduler(SchedulerAPI):
         # mesh mirror is sharded; pack is ineligible under a mesh anyway)
         self._last_solve_device_state = device_state if not use_mesh else None
         jc0 = assign_mod.jit_cache_entries()
+        # AOT background mode: a store miss on this (device) tier raises
+        # CompilePending instead of stalling the cycle on an XLA compile —
+        # the ladder serves from cpu/host while the compile thread populates
+        # the store, and the half-open probe reclaims the tier (aot/)
+        from yunikorn_tpu.aot import pending_enabled
+
+        aot_pending = pending_enabled()
         result = None
         if use_mesh:
             from yunikorn_tpu.parallel.mesh import solve_sharded
@@ -1188,7 +1195,8 @@ class CoreScheduler(SchedulerAPI):
                         max_rounds=so.max_rounds, chunk=so.chunk,
                         policy=policy, free_delta=overlay,
                         node_mask=node_mask, ports_delta=inflight_ports,
-                        max_batch=so.max_batch, device_state=device_state))
+                        max_batch=so.max_batch, device_state=device_state,
+                        aot_pending=aot_pending))
             except AbandonedDispatch:
                 raise  # zombie thread: stop, don't run a pointless solve
             except Exception:
@@ -1202,7 +1210,8 @@ class CoreScheduler(SchedulerAPI):
                                  ports_delta=inflight_ports,
                                  max_batch=so.max_batch,
                                  device_state=(None if use_mesh
-                                               else device_state))
+                                               else device_state),
+                                 aot_pending=aot_pending)
         jc1 = assign_mod.jit_cache_entries()
         stats = {"pods": int(batch.num_pods)}
         if jc0 >= 0 and jc1 >= 0:
@@ -1258,9 +1267,14 @@ class CoreScheduler(SchedulerAPI):
         platform — the first fallback when the device runtime is failing."""
         import jax
 
+        from yunikorn_tpu.aot import runtime as aot_rt
+
         so = self.solver
         cpu = jax.local_devices(backend="cpu")[0]
-        with jax.default_device(cpu):
+        # aot bypass: the re-jitted cpu program shares the device variant's
+        # avals — a store "hit" here would run the dispatch on the backend
+        # this tier exists to avoid
+        with jax.default_device(cpu), aot_rt.bypass():
             result = solve_batch(h.batch, self.encoder.nodes, policy=h.policy,
                                  max_rounds=so.max_rounds, chunk=so.chunk,
                                  use_pallas=False, free_delta=h.overlay,
@@ -1378,6 +1392,8 @@ class CoreScheduler(SchedulerAPI):
 
         h.pack_t0 = time.perf_counter()
         try:
+            from yunikorn_tpu.aot import pending_enabled
+
             h.pack = self.supervisor.run(
                 "pack",
                 lambda: pack_mod.pack_solve_batch(
@@ -1385,7 +1401,8 @@ class CoreScheduler(SchedulerAPI):
                     free_delta=h.overlay, node_mask=h.node_mask,
                     ports_delta=h.inflight_ports, seed=self._cycle_seq,
                     chunk=self.solver.chunk,
-                    device_state=h.device_state),
+                    device_state=h.device_state,
+                    aot_pending=pending_enabled()),
                 commit_success=False)
         except AbandonedDispatch:
             raise  # zombie thread: stop, don't continue a stale cycle
@@ -1685,6 +1702,8 @@ class CoreScheduler(SchedulerAPI):
         try:
             # dispatch success alone must not re-close a half-open circuit:
             # the materialized finish is what proves the path healthy
+            from yunikorn_tpu.aot import pending_enabled
+
             handle = self.supervisor.run(
                 "preempt",
                 lambda: dispatch_preemption_solve(
@@ -1692,7 +1711,11 @@ class CoreScheduler(SchedulerAPI):
                     inflight_by_node=self._inflight_by_node(),
                     candidate_nodes=self._preempt_candidate_nodes(),
                     mesh=self._mesh if use_mesh else None,
-                    mirror_epoch=epoch),
+                    mirror_epoch=epoch,
+                    # supervised: a background-mode store miss raises
+                    # CompilePending here and the host planner covers the
+                    # cycle; unsupervised callers keep the inline compile
+                    aot_pending=pending_enabled()),
                 commit_success=False)
         except Exception:
             logger.exception("batched preemption dispatch failed; "

@@ -1241,7 +1241,9 @@ def jit_cache_entries() -> int:
     `compiled: true` arg on its trace span instead of an anonymous stall.
     Returns -1 when the jit internals don't expose cache sizes.
     """
-    total = 0
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    total = aot_rt.compile_count("assign.", "mesh.solve")
     for fn in (solve, solve_chunked):
         try:
             total += fn._cache_size()
@@ -1254,7 +1256,7 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
                 free_delta=None, use_pallas=False, pallas_interpret=False,
                 device=None, node_mask=None, ports_delta=None,
                 compile_only=False, max_batch=MAX_SOLVE_PODS,
-                device_state=None) -> Optional[SolveResult]:
+                device_state=None, aot_pending=False) -> Optional[SolveResult]:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     See prepare_solve_args for free_delta / node_mask / device_state
@@ -1263,11 +1265,19 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     change, not once per cycle).
     compile_only: AOT-lower and compile this shape/static-variant without
     executing (bucket prewarm) — fills the jit + persistent caches at zero
-    device time; returns None.
+    device time; returns None. With an AOT runtime installed (aot/), the
+    executable is loaded from the store instead of compiled when the
+    fingerprint matches, and persisted after a fresh compile.
     max_batch: batches above this run as ONE compiled chained chunk program
     (solve_chunked: lax.scan over rank-ordered [max_batch]-pod slices with
     capacity + locality-count carry) — see MAX_SOLVE_PODS.
+    aot_pending: supervised device-tier callers opt in — an AOT-store miss
+    in background-compile mode raises aot.CompilePending instead of paying
+    the XLA compile inline, and the caller's ladder serves the cycle from a
+    lower tier while the compile thread populates the store.
     """
+    from yunikorn_tpu.aot import runtime as aot_rt
+
     mb = 1 << (max(int(max_batch), 64).bit_length() - 1)
     np_args, static_kwargs = prepare_solve_args(
         batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
@@ -1292,14 +1302,17 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         # N and mb are both powers of two (encoder bucket / rounding above):
         # one compiled lax.scan program over [mb]-pod rank-ordered slices
         np_args_s, order = _sort_pods_by_rank(np_args)
+        ck = dict(solve_kwargs, chunk_pods=mb)
         if compile_only:
             specs = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args_s)
-            solve_chunked.lower(*specs, chunk_pods=mb, **solve_kwargs).compile()
+            aot_rt.aot_compile("assign.solve_chunked", solve_chunked,
+                               specs, ck)
             return None
         solve_args = jax.tree_util.tree_map(jnp.asarray, np_args_s)
-        assigned, around, free_after, rounds, _ = solve_chunked(
-            *solve_args, chunk_pods=mb, **solve_kwargs)
+        assigned, around, free_after, rounds, _ = aot_rt.aot_call(
+            "assign.solve_chunked", solve_chunked, solve_args, ck,
+            pending_ok=aot_pending)
         if order is not None:
             assigned, around = _unsort(order, assigned, around)
         return SolveResult(assigned=assigned, free_after=free_after,
@@ -1308,9 +1321,11 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         # specs instead of arrays: no host->device transfer at all
         specs = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args)
-        solve.lower(*specs, **solve_kwargs).compile()
+        aot_rt.aot_compile("assign.solve", solve, specs, solve_kwargs)
         return None
     solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
-    assigned, around, free_after, rounds, _ = solve(*solve_args, **solve_kwargs)
+    assigned, around, free_after, rounds, _ = aot_rt.aot_call(
+        "assign.solve", solve, solve_args, solve_kwargs,
+        pending_ok=aot_pending)
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds,
                        accept_round=around)

@@ -258,12 +258,23 @@ def device_admit(problem: "gate_mod.GateProblem", *, backend=None):
 
     host_arrays = (reqw, bm2, rstatus0, seg_first_t, perm_a, inv_perm_a,
                    seg_first_a, seg_last_a)
+    from yunikorn_tpu.aot import runtime as aot_rt
+
     with enable_x64():
         args = [jnp.asarray(a) for a in host_arrays]
         if backend is not None:
             dev = jax.local_devices(backend=backend)[0]
             args = [jax.device_put(a, dev) for a in args]
-        jrstatus, jpasses = _gate_scan(*args, max_passes=max_passes)
+        # AOT-store routed (fingerprint includes the x64 mode + the exact
+        # int32/int64 bucketed avals): a store hit serves the scan with
+        # zero trace+compile in a fresh process. Background mode raises
+        # CompilePending out of the supervised gate's device tier — the
+        # host-vectorized tier (placement-equivalent) serves the cycle
+        # while the compile thread populates the store.
+        jrstatus, jpasses = aot_rt.aot_call(
+            "gate.scan", _gate_scan, tuple(args),
+            {"max_passes": max_passes},
+            pending_ok=aot_rt.pending_enabled())
         rstatus = np.asarray(jrstatus)[:M]
         passes = int(jpasses)
 
@@ -327,7 +338,9 @@ def gather_rows(pool, idx):
 def jit_cache_entries() -> int:
     """Compiled-variant count of the gate scan (CoreScheduler reads this to
     tell a first-bucket compile from a cache hit). -1 when unavailable."""
+    from yunikorn_tpu.aot import runtime as aot_rt
+
     try:
-        return _gate_scan._cache_size()
+        return _gate_scan._cache_size() + aot_rt.compile_count("gate.scan")
     except Exception:
         return -1

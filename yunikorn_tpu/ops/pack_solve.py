@@ -352,7 +352,8 @@ def pack_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
                      seed: int = 0, lp_iters: int = LP_ITERS,
                      round_rounds: int = ROUND_ROUNDS,
                      repair_rounds: int = REPAIR_ROUNDS,
-                     chunk: int = 512, device_state=None) -> PackResult:
+                     chunk: int = 512, device_state=None,
+                     aot_pending: bool = False) -> PackResult:
     """Host wrapper: PodBatch + NodeArrays in → async PackResult out.
 
     Shares `prepare_solve_args` with the greedy paths (same dtype views,
@@ -383,11 +384,16 @@ def pack_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
             "partitionable cell budget")
     n_parts = pick_parts(N, M)
     solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
-    assigned, free_after, feasible = pack_solve(
-        *solve_args, seed=jnp.int32(seed), n_parts=n_parts,
-        lp_iters=lp_iters, round_rounds=round_rounds,
-        repair_rounds=repair_rounds, chunk=chunk, policy=policy,
-        score_cols=static_kwargs["score_cols"])
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    # seed rides positionally (it is a traced int32, reseeding never
+    # recompiles — the AOT fingerprint keys scalar leaves on dtype only)
+    assigned, free_after, feasible = aot_rt.aot_call(
+        "pack.solve", pack_solve, (*solve_args, jnp.int32(seed)),
+        dict(n_parts=n_parts, lp_iters=lp_iters, round_rounds=round_rounds,
+             repair_rounds=repair_rounds, chunk=chunk, policy=policy,
+             score_cols=static_kwargs["score_cols"]),
+        pending_ok=aot_pending)
     return PackResult(assigned=assigned, free_after=free_after,
                       feasible=feasible, n_parts=n_parts, seed=seed)
 
@@ -489,7 +495,9 @@ def choose_plan(greedy_assigned, pack_assigned, req_i, valid,
 def jit_cache_entries() -> int:
     """Compiled-variant count of the pack entry point (compile-vs-cache-hit
     telemetry, the ops.assign.jit_cache_entries convention)."""
+    from yunikorn_tpu.aot import runtime as aot_rt
+
     try:
-        return pack_solve._cache_size()
+        return pack_solve._cache_size() + aot_rt.compile_count("pack.")
     except Exception:
         return -1

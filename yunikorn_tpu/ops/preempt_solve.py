@@ -241,7 +241,10 @@ def prepare_preempt_args(batch, n_asks, prios, node_arrays, node_order, *,
 def preempt_jit_cache_entries() -> int:
     """Compiled-variant count of the preemption kernel (compile-vs-hit
     accounting, same contract as ops.assign.jit_cache_entries)."""
+    from yunikorn_tpu.aot import runtime as aot_rt
+
     try:
-        return preempt_solve._cache_size()
+        return (preempt_solve._cache_size()
+                + aot_rt.compile_count("preempt.", "mesh.preempt"))
     except Exception:
         return -1

@@ -70,7 +70,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                   free_delta=None, node_mask=None, ports_delta=None,
                   compile_only: bool = False,
                   max_batch: int = assign_mod.MAX_SOLVE_PODS,
-                  device_state=None,
+                  device_state=None, aot_pending: bool = False,
                   ) -> Optional[assign_mod.SolveResult]:
     """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
 
@@ -153,20 +153,29 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         policy=policy, has_loc_soft=static_kwargs["has_loc_soft"],
         score_cols=static_kwargs["score_cols"],
     )
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    # the mesh tag keeps sharded programs in their own AOT-fingerprint space:
+    # a single-device executable and a sharded one can share identical avals
+    # (same shapes/dtypes) but are different compiled programs
+    aot_extra = ("mesh", n_dev)
     if N > mb:
         # one compiled lax.scan program over [mb]-pod rank-ordered slices
         # (assign.solve_chunked) — same sharding layout, group state hoisted
         np_args_s, order = assign_mod._sort_pods_by_rank(np_args)
         args, mask_arg, soft_arg, loc_arg = build_args(np_args_s)
+        ck = dict(solve_kwargs, chunk_pods=mb)
         with mesh:
             if compile_only:
-                assign_mod.solve_chunked.lower(
-                    *args, mask_arg, soft_arg, loc_arg, chunk_pods=mb,
-                    **solve_kwargs).compile()
+                aot_rt.aot_compile(
+                    "mesh.solve_chunked", assign_mod.solve_chunked,
+                    (*args, mask_arg, soft_arg, loc_arg), ck,
+                    extra=aot_extra, lower_cm=mesh)
                 return None
-            assigned, around, free_after, rounds, _ = assign_mod.solve_chunked(
-                *args, mask_arg, soft_arg, loc_arg, chunk_pods=mb,
-                **solve_kwargs)
+            assigned, around, free_after, rounds, _ = aot_rt.aot_call(
+                "mesh.solve_chunked", assign_mod.solve_chunked,
+                (*args, mask_arg, soft_arg, loc_arg), ck,
+                pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
         if order is not None:
             assigned, around = assign_mod._unsort(order, assigned, around)
         return assign_mod.SolveResult(
@@ -176,16 +185,21 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     args, mask_arg, soft_arg, loc_arg = build_args(np_args)
     with mesh:
         if compile_only:
-            assign_mod.solve.lower(
-                *args, mask_arg, soft_arg, loc_arg, **solve_kwargs).compile()
+            aot_rt.aot_compile(
+                "mesh.solve", assign_mod.solve,
+                (*args, mask_arg, soft_arg, loc_arg), solve_kwargs,
+                extra=aot_extra, lower_cm=mesh)
             return None
-        assigned, around, free_after, rounds, _ = assign_mod.solve(
-            *args, mask_arg, soft_arg, loc_arg, **solve_kwargs)
+        assigned, around, free_after, rounds, _ = aot_rt.aot_call(
+            "mesh.solve", assign_mod.solve,
+            (*args, mask_arg, soft_arg, loc_arg), solve_kwargs,
+            pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after,
                                   rounds=rounds, accept_round=around)
 
 
-def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int):
+def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int,
+                          aot_pending: bool = False):
     """Node-dimension sharded dispatch of ops.preempt_solve.preempt_solve.
 
     Same layout contract as solve_sharded: ask/group args replicate (tiny —
@@ -215,5 +229,11 @@ def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int):
         put(victim_req, node_s3), put(victim_prio, node_s2),
         put(victim_valid, node_s2),
     )
+    from yunikorn_tpu.aot import runtime as aot_rt
+
     with mesh:
-        return ps_mod.preempt_solve(*args, max_candidates=max_candidates)
+        return aot_rt.aot_call(
+            "mesh.preempt_solve", ps_mod.preempt_solve, args,
+            {"max_candidates": max_candidates},
+            pending_ok=aot_pending,
+            extra=("mesh", mesh.devices.size), lower_cm=mesh)

@@ -102,6 +102,13 @@ def classify_error(exc: BaseException) -> str:
             or type(exc).__name__ == "MirrorDiscarded"):
         # zombie-thread bailouts: retrying replays the same stale epoch
         return PERSISTENT
+    if type(exc).__name__ == "CompilePending":
+        # aot background compile in flight: same-tier retries cannot succeed
+        # until the compile thread lands the executable — open the breaker
+        # now (hard) so cycles serve from the cpu/host tiers, and let the
+        # half-open probe reclaim the tier once the store/memory cache is
+        # populated (name check: aot must stay importable without jax init)
+        return PERSISTENT
     name = type(exc).__name__
     if name in ("XlaRuntimeError", "JaxRuntimeError", "XlaError"):
         msg = str(exc)

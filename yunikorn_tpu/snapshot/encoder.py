@@ -864,9 +864,18 @@ class DeviceRowStore:
                 scales = np.ones((R,), np.float64)
                 for name, slot, scale in rv.items():
                     scales[slot] = float(scale)
-                self.pool = gate_solve.encode_rows(
-                    self.pool, jnp.asarray(raw_m), jnp.asarray(scales),
-                    jnp.asarray(slots_m))
+                from yunikorn_tpu.aot import runtime as aot_rt
+
+                # deliberately NO pending_ok: the slot bookkeeping above
+                # already recorded these rows as uploaded, so a
+                # CompilePending raise here would leave the pool without
+                # rows later gathers believe are present. The encode
+                # program is tiny (~tens of ms) — a store miss compiles
+                # inline and still persists for the next process.
+                self.pool = aot_rt.aot_call(
+                    "gate.encode_rows", gate_solve.encode_rows,
+                    (self.pool, jnp.asarray(raw_m), jnp.asarray(scales),
+                     jnp.asarray(slots_m)), {})
                 self.last_upload_bytes = int(raw_m.nbytes + slots_m.nbytes
                                              + scales.nbytes)
                 self.upload_rows_total += len(changed)

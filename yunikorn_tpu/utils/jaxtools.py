@@ -114,20 +114,93 @@ def backend_or_cpu() -> str:
         return jax.devices("cpu")[0].platform
 
 
+def warm_bucket(n_nodes: int, n_pods: int, core=None) -> None:
+    """Compile (or AOT-store-load) one standard solve bucket's variants.
+
+    Builds throwaway synthetic problems through the real encoder and
+    compile_only-routes the solve for the static variants production uses —
+    both nodesort policies, with and without soft/locality constraints.
+    With an AOT runtime installed (aot/), compile_only checks the store
+    first: a prebuilt bucket LOADS its executables in milliseconds instead
+    of re-compiling, and a fresh compile is serialized back into the store.
+    Isolated caches/encoders; never touches live state. Shared by the
+    background prewarm thread (prewarm_buckets) and the offline builder
+    (scripts/aot_build.py), so the two cannot drift on variant coverage."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
+    from yunikorn_tpu.common.objects import (Affinity, NodeSelectorRequirement,
+                                             NodeSelectorTerm,
+                                             TopologySpreadConstraint)
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    cache = SchedulerCache()
+    for node in make_kwok_nodes(n_nodes):
+        cache.update_node(node)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pods = make_sleep_pods(n_pods, "prewarm", queue="root.prewarm")
+    # make the last pod carry soft + locality constraints so the
+    # locality/soft static variants of the solve compile too — those are
+    # exactly the configurations whose first cycle hurts the most
+    rich = pods[-1]
+    rich.spec.topology_spread_constraints = [TopologySpreadConstraint(
+        max_skew=1, topology_key="zone", when_unsatisfiable="ScheduleAnyway",
+        label_selector={"matchLabels": {"prewarm": "1"}})]
+    rich.metadata.labels["prewarm"] = "1"
+    rich.spec.affinity = Affinity(node_preferred_terms=[
+        (10, NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement("zone", "In", ["z0"])]))])
+    asks = [AllocationAsk(p.uid, "prewarm", get_pod_resource(p), pod=p)
+            for p in pods]
+    plain = enc.build_batch(asks[:-1])
+    rich_batch = enc.build_batch(asks)
+    # resolve the production variant when a core was handed in; the
+    # no-core fallback takes SolverOptions() so defaults cannot drift
+    from yunikorn_tpu.core.scheduler import SolverOptions
+
+    so = SolverOptions()
+    use_pallas, mesh = False, None
+    if core is not None:
+        core._resolve_solver_runtime()
+        so = core.solver
+        use_pallas, mesh = core._use_pallas, core._mesh
+    max_rounds, chunk = so.max_rounds, so.chunk
+    use_mesh = (mesh is not None
+                and enc.nodes.capacity % mesh.devices.size == 0)
+    # AOT compile (no execution): both nodesort policies × plain and
+    # soft/locality variants — the static combinations production uses.
+    # This also covers the pipelined cycle's persistent-device-buffer
+    # path with no extra work: device-resident and host node inputs have
+    # identical avals (ops.assign._finish_solve_args), so they share one
+    # compiled program — there is no separate variant to warm, and
+    # production's own DeviceNodeState does its first upload lazily.
+    for policy in ("binpacking", "spread"):
+        for b in (plain, rich_batch):
+            if use_mesh:
+                from yunikorn_tpu.parallel.mesh import solve_sharded
+
+                solve_sharded(b, enc.nodes, mesh, max_rounds=max_rounds,
+                              chunk=chunk, policy=policy, compile_only=True,
+                              max_batch=so.max_batch)
+            else:
+                solve_batch(b, enc.nodes, policy=policy,
+                            max_rounds=max_rounds, chunk=chunk,
+                            use_pallas=use_pallas, compile_only=True,
+                            max_batch=so.max_batch)
+
+
 def prewarm_buckets(spec: str, results: "list | None" = None,
                     core=None) -> "object":
-    """Compile standard solve buckets in a background thread.
+    """Warm standard solve buckets in a background thread (see warm_bucket).
 
     spec: comma-separated "NODESxPODS" pairs (e.g. "1024x4096,16384x65536").
-    Each bucket builds throwaway synthetic problems through the real encoder
-    and AOT-compiles the solve (no execution, zero device time) for the
-    static variants production uses — both nodesort policies, with and
-    without soft/locality constraints. The jit cache (and the persistent
-    compilation cache) then covers the production cycle's shapes, removing
-    the first-cycle compile stall (~minutes at the 50k bucket). Exotic
-    configurations (e.g. unusual locality domain counts) may still trigger a
-    compile. Isolated caches/encoders; never touches live state. Returns the
-    daemon thread (join it in tests).
+    With an AOT store attached the warmup is artifact LOADS, not compiles —
+    a prebuilt process is solve-ready in seconds. Without one this is the
+    legacy trace+compile per process. Returns the daemon thread (join it in
+    tests).
 
     core: the production CoreScheduler, when available — prewarm then
     compiles the VARIANT production will run (conf-driven max_rounds/chunk,
@@ -136,72 +209,6 @@ def prewarm_buckets(spec: str, results: "list | None" = None,
     defaults, so the warmed cache entries actually match the first cycle's
     program."""
     import threading
-
-    def warm_bucket(n_nodes: int, n_pods: int) -> None:
-        from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
-        from yunikorn_tpu.client.synthetic import make_kwok_nodes, make_sleep_pods
-        from yunikorn_tpu.common.objects import (Affinity, NodeSelectorRequirement,
-                                                 NodeSelectorTerm,
-                                                 TopologySpreadConstraint)
-        from yunikorn_tpu.common.resource import get_pod_resource
-        from yunikorn_tpu.common.si import AllocationAsk
-        from yunikorn_tpu.ops.assign import solve_batch
-        from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
-
-        cache = SchedulerCache()
-        for node in make_kwok_nodes(n_nodes):
-            cache.update_node(node)
-        enc = SnapshotEncoder(cache)
-        enc.sync_nodes(full=True)
-        pods = make_sleep_pods(n_pods, "prewarm", queue="root.prewarm")
-        # make the last pod carry soft + locality constraints so the
-        # locality/soft static variants of the solve compile too — those are
-        # exactly the configurations whose first cycle hurts the most
-        rich = pods[-1]
-        rich.spec.topology_spread_constraints = [TopologySpreadConstraint(
-            max_skew=1, topology_key="zone", when_unsatisfiable="ScheduleAnyway",
-            label_selector={"matchLabels": {"prewarm": "1"}})]
-        rich.metadata.labels["prewarm"] = "1"
-        rich.spec.affinity = Affinity(node_preferred_terms=[
-            (10, NodeSelectorTerm(match_expressions=[
-                NodeSelectorRequirement("zone", "In", ["z0"])]))])
-        asks = [AllocationAsk(p.uid, "prewarm", get_pod_resource(p), pod=p)
-                for p in pods]
-        plain = enc.build_batch(asks[:-1])
-        rich_batch = enc.build_batch(asks)
-        # resolve the production variant when a core was handed in; the
-        # no-core fallback takes SolverOptions() so defaults cannot drift
-        from yunikorn_tpu.core.scheduler import SolverOptions
-
-        so = SolverOptions()
-        use_pallas, mesh = False, None
-        if core is not None:
-            core._resolve_solver_runtime()
-            so = core.solver
-            use_pallas, mesh = core._use_pallas, core._mesh
-        max_rounds, chunk = so.max_rounds, so.chunk
-        use_mesh = (mesh is not None
-                    and enc.nodes.capacity % mesh.devices.size == 0)
-        # AOT compile (no execution): both nodesort policies × plain and
-        # soft/locality variants — the static combinations production uses.
-        # This also covers the pipelined cycle's persistent-device-buffer
-        # path with no extra work: device-resident and host node inputs have
-        # identical avals (ops.assign._finish_solve_args), so they share one
-        # compiled program — there is no separate variant to warm, and
-        # production's own DeviceNodeState does its first upload lazily.
-        for policy in ("binpacking", "spread"):
-            for b in (plain, rich_batch):
-                if use_mesh:
-                    from yunikorn_tpu.parallel.mesh import solve_sharded
-
-                    solve_sharded(b, enc.nodes, mesh, max_rounds=max_rounds,
-                                  chunk=chunk, policy=policy, compile_only=True,
-                                  max_batch=so.max_batch)
-                else:
-                    solve_batch(b, enc.nodes, policy=policy,
-                                max_rounds=max_rounds, chunk=chunk,
-                                use_pallas=use_pallas, compile_only=True,
-                                max_batch=so.max_batch)
 
     def run():
         ensure_compilation_cache()
@@ -219,7 +226,7 @@ def prewarm_buckets(spec: str, results: "list | None" = None,
                     "invalid prewarm bucket %r (want NODESxPODS)", pair)
                 continue
             try:  # per bucket: one failure must not abort the rest
-                warm_bucket(n_nodes, n_pods)
+                warm_bucket(n_nodes, n_pods, core=core)
                 if results is not None:
                     results.append((n_nodes, n_pods, True))
             except Exception:
