@@ -1,9 +1,9 @@
 # Developer entry points (reference Makefile analog).
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
-	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke smoke \
-	lint run-scheduler run-admission dryrun clean image sched_image \
-	adm_image webtest_image
+	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke \
+	slo-smoke smoke lint run-scheduler run-admission dryrun clean image \
+	sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -90,7 +90,18 @@ aot-smoke:  ## AOT cold-start elimination: store/fingerprint unit suite, then bu
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		python scripts/aot_smoke.py
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke  ## all tier-1 smoke targets
+slo-smoke:  ## SLO engine + trace replay: unit suite, then a short seeded gang-storm replay through the full shim path over the fake API server — the fault-free run must show zero SLO violations, and a scripted robustness/faults.py hang on the assign path must be DETECTED as a violation (nonzero exit naming the objective)
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_slo.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --assert-slo
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --fault hang \
+		--slo-staleness 4 --expect-violation
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -242,6 +242,7 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                 "cold_first_cycle_ms": 0.0,
                 "aot_hits": 0,
                 "aot_compiles": 0,
+                "slo": {},
             }))
             sys.exit(1)
     platform = devs[0].platform
@@ -300,6 +301,26 @@ def _cycle_stats(core) -> dict:
                 "gate_passes": 0, "encode_device_rows": 0,
                 "encode_device_bytes": 0, "solver_policy": "greedy",
                 "pack_util": 0.0, "pack_plan_ms": 0.0}
+
+
+def _slo_block(core) -> dict:
+    """Per-objective SLO summary for the bench JSON (round 14): verdict +
+    worst burn rate across the fast/slow windows, from the streaming engine
+    (obs/slo.py). The microbench's own SLO story is thin (one process, two
+    cycles) — the block's job is making the engine's verdicts ride every
+    published number so a bench run that violated an objective (e.g. the
+    cold-start budget) can never publish a clean-looking line."""
+    try:
+        rep = core.slo.report()
+        return {name: {"verdict": o["verdict"],
+                       "worst_burn": core.slo.worst_burn(name)}
+                for name, o in rep["objectives"].items()}
+    except Exception as e:
+        # a broken engine must be distinguishable from a passing one: an
+        # empty block is the backend-unavailable shape, not an error
+        print(f"# bench: slo block unavailable: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _preempt_stat(core) -> float:
@@ -474,7 +495,7 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
         _dump_trace(ms.core, "shim e2e")
         return (stats.throughput(), wall, stats.success_count, len(pods),
                 _preempt_stat(ms.core), _degradations(ms.core),
-                _cycle_stats(ms.core))
+                _cycle_stats(ms.core), _slo_block(ms.core))
     finally:
         ms.stop()
 
@@ -626,6 +647,7 @@ def main() -> int:
         "cold_first_cycle_ms": round(dt_cold * 1000, 1),
         **_aot_stats(),
         **core_cycle_stats,
+        "slo": _slo_block(core),
     }
 
     if MODE == "both":
@@ -650,7 +672,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
     core-cycle number, that stays the headline (north-star metric) and the
     shim e2e rides along; standalone shim mode publishes the shim number."""
     (shim_tp, shim_wall, bound, total, shim_preempt_ms, shim_degr,
-     shim_cycle_stats) = run_shim_mode(N_PODS, N_NODES)
+     shim_cycle_stats, shim_slo) = run_shim_mode(N_PODS, N_NODES)
     print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
           f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
     if core_pods_per_s is None:
@@ -666,6 +688,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             "cold_first_cycle_ms": cold_first_cycle_ms,
             **_aot_stats(),
             **shim_cycle_stats,
+            "slo": shim_slo,
         }
     return {
         "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
@@ -688,6 +711,9 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         **(core_cycle_stats or shim_cycle_stats),
         "shim_gate_ms": shim_cycle_stats["gate_ms"],
         "shim_pod_encode_ms": shim_cycle_stats["pod_encode_ms"],
+        # the shim phase ran last and bound real pods — its engine carries
+        # the run's delivered-latency verdicts
+        "slo": shim_slo,
     }
 
 

@@ -86,8 +86,32 @@ def main() -> int:
             "yunikorn_unschedulable_total",
             "yunikorn_dispatcher_events_total",
             "yunikorn_preemption_plan_ms",
+            "yunikorn_slo_burn_rate",
+            "yunikorn_slo_violations_total",
+            "yunikorn_slo_verdict",
+            "yunikorn_slo_objective_value",
         ))
         fams = parse_exposition(text)
+        # the slo_* series must carry the declared TYPEs and labels (a
+        # mistyped burn-rate gauge would silently break every dashboard
+        # rate()/threshold rule built on it)
+        for name, kind in (("yunikorn_slo_burn_rate", "gauge"),
+                           ("yunikorn_slo_violations_total", "counter"),
+                           ("yunikorn_slo_verdict", "gauge"),
+                           ("yunikorn_slo_objective_value", "gauge")):
+            fam = fams.get(name)
+            if fam is None:
+                continue  # missing already reported by `required` above
+            if fam.kind != kind:
+                errors.append(f"{name}: TYPE {fam.kind!r}, expected {kind!r}")
+            if not all(s.labels.get("objective") for s in fam.samples):
+                errors.append(f"{name}: samples missing the objective label")
+        burn = fams.get("yunikorn_slo_burn_rate")
+        if burn:
+            windows = {s.labels.get("window") for s in burn.samples}
+            if windows != {"fast", "slow"}:
+                errors.append(f"slo_burn_rate windows {sorted(windows)} != "
+                              "fast/slow")
         e2e = fams.get("yunikorn_pod_e2e_latency_seconds")
         bound_obs = next(
             (s.value for s in (e2e.samples if e2e else [])

@@ -13,7 +13,18 @@ from yunikorn_tpu.admission.admission_controller import (
 )
 from yunikorn_tpu.admission.caches import NamespaceCache, PriorityClassCache
 from yunikorn_tpu.admission.conf import AdmissionConf, parse_admission_conf
+from yunikorn_tpu.admission.pki import HAVE_CRYPTOGRAPHY
 from yunikorn_tpu.common import constants
+
+# The PKI/webhook tier needs the `cryptography` package, which the baked
+# build environment does not ship (and cannot install); admission/pki.py
+# gates its import so everything else here runs regardless. These six tests
+# skip-with-reason instead of failing collection — documented in TESTING.md,
+# so the tier-1 dots count carries no known noise into SLO gating.
+requires_cryptography = pytest.mark.skipif(
+    not HAVE_CRYPTOGRAPHY,
+    reason="cryptography package not installed (environmental): the "
+           "PKI/webhook tests exercise real X.509 generation/rotation")
 
 
 def make_review(pod=None, kind="Pod", operation="CREATE", namespace="default",
@@ -196,6 +207,7 @@ def test_validate_conf():
 # PKI + live webhook server
 # ---------------------------------------------------------------------------
 
+@requires_cryptography
 def test_pki_generation_and_rotation():
     from yunikorn_tpu.admission.pki import CACollection, generate_server_cert
 
@@ -207,6 +219,7 @@ def test_pki_generation_and_rotation():
     assert cas.rotate_if_needed() is False  # fresh CAs, no rotation
 
 
+@requires_cryptography
 def test_webhook_server_http_roundtrip():
     import urllib.request
 
@@ -231,6 +244,7 @@ def test_webhook_server_http_roundtrip():
         server.stop()
 
 
+@requires_cryptography
 def test_webhook_manager_manifests():
     from yunikorn_tpu.admission.webhook import WebhookManager
 
@@ -337,6 +351,7 @@ def test_admission_informer_attachment_feeds_conf_and_caches():
     assert not pc_cache.is_preemption_allowed("no-preempt")
 
 
+@requires_cryptography
 def test_certificate_expiration_loop_rotates():
     import threading
     import time as _time
@@ -382,6 +397,7 @@ def test_all_workload_kinds_get_user_info(ac, kind):
     assert info["user"] == "carol"
 
 
+@requires_cryptography
 def test_webhook_install_and_repatch_against_api():
     """InstallWebhooks through the HTTP client: create when absent, no-op
     when current, PUT (preserving resourceVersion) after a caBundle rotation
@@ -433,6 +449,7 @@ def test_webhook_install_and_repatch_against_api():
         server.stop()
 
 
+@requires_cryptography
 def test_webhook_drift_ignores_server_defaults():
     """A stored object that differs only by server-side defaulting
     (matchPolicy/timeoutSeconds on the webhook, scope on rules, port on the

@@ -11,10 +11,32 @@ import dataclasses
 import datetime
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import rsa
-from cryptography.x509.oid import NameOID
+# The PKI needs the `cryptography` package, which is not part of the baked
+# build environment (the scheduler path never touches it; only the admission
+# webhook binary does). Importing this MODULE stays safe either way — the
+# first actual PKI operation raises a clear RuntimeError instead of a deep
+# ModuleNotFoundError at import time (see TESTING.md: the webhook/PKI test
+# tier skips when the package is absent).
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    HAVE_CRYPTOGRAPHY = True
+    _IMPORT_ERROR: Optional[BaseException] = None
+except ImportError as _e:  # pragma: no cover - environment-dependent
+    HAVE_CRYPTOGRAPHY = False
+    _IMPORT_ERROR = _e
+    x509 = hashes = serialization = rsa = NameOID = None  # type: ignore
+
+
+def _require_cryptography() -> None:
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            "the admission webhook's PKI requires the 'cryptography' "
+            f"package, which is not installed: {_IMPORT_ERROR}")
+
 
 CA_VALIDITY_DAYS = 365        # 12-month expiry (reference webhook_manager.go)
 SERVER_VALIDITY_DAYS = 365
@@ -37,6 +59,7 @@ class CertPair:
 
 
 def _new_key() -> rsa.RSAPrivateKey:
+    _require_cryptography()
     return rsa.generate_private_key(public_exponent=65537, key_size=2048)
 
 
@@ -104,6 +127,7 @@ class CACollection:
     ROTATE_BEFORE_SECONDS = 90 * 24 * 3600.0
 
     def __init__(self, pairs: Optional[List[CertPair]] = None):
+        _require_cryptography()
         self.pairs: List[CertPair] = pairs or [generate_ca(), generate_ca()]
 
     def best(self) -> CertPair:

@@ -122,12 +122,15 @@ def main(argv=None) -> int:
                     "compile %s)", store_path, aot_rt.store.entry_count(),
                     "on" if aot_rt.background else "off")
 
+    from yunikorn_tpu.obs.slo import SloOptions
+
     cache = SchedulerCache()
     core = CoreScheduler(cache,
                          solver_options=SolverOptions.from_conf(holder.get()),
                          trace_spans=holder.get().obs_trace_spans,
                          supervisor_options=SupervisorOptions.from_conf(
-                             holder.get()))
+                             holder.get()),
+                         slo_options=SloOptions.from_conf(holder.get()))
     if aot_rt is not None:
         # hit/miss/compile metrics land in this core's /metrics; compile
         # spans land on its cycle timeline

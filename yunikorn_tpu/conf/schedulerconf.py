@@ -73,8 +73,15 @@ CM_SOLVER_AOT_BACKGROUND = PREFIX_SOLVER + "aotBackground"  # auto | true | fals
 TRI_STATE = ("auto", "true", "false")
 SOLVER_POLICIES = ("auto", "greedy", "optimal")
 
-# observability.* keys (the obs/ registry + tracer)
+# observability.* keys (the obs/ registry + tracer + SLO engine)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
+CM_OBS_SLO_FAST_WINDOW = PREFIX_OBS + "sloFastWindowSeconds"
+CM_OBS_SLO_SLOW_WINDOW = PREFIX_OBS + "sloSlowWindowSeconds"
+CM_OBS_SLO_POD_E2E_P99 = PREFIX_OBS + "sloPodE2eP99Seconds"
+CM_OBS_SLO_STALENESS = PREFIX_OBS + "sloCycleStalenessSeconds"
+CM_OBS_SLO_DWELL_BUDGET = PREFIX_OBS + "sloDegradedDwellBudget"
+CM_OBS_SLO_COLD_BUDGET = PREFIX_OBS + "sloColdStartBudgetMs"
+CM_OBS_SLO_BURN_FAST = PREFIX_OBS + "sloBurnFastThreshold"
 
 # robustness.* keys (supervised device dispatches, robustness/supervisor.py)
 PREFIX_ROBUSTNESS = "robustness."
@@ -175,6 +182,17 @@ class SchedulerConf:
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
+    # --- SLO engine knobs (obs/slo.py) --- windows + per-objective targets
+    # for the streaming multi-window burn-rate evaluation; the trace-replay
+    # proving ground compresses the windows to seconds through these same
+    # keys (scripts/trace_replay.py)
+    obs_slo_fast_window_s: float = 300.0
+    obs_slo_slow_window_s: float = 3600.0
+    obs_slo_pod_e2e_p99_s: float = 30.0
+    obs_slo_cycle_staleness_s: float = 60.0
+    obs_slo_degraded_dwell_budget: float = 0.05
+    obs_slo_cold_start_budget_ms: float = 15000.0
+    obs_slo_burn_fast_threshold: float = 6.0
     # --- robustness knobs --- (SupervisedExecutor: every device dispatch
     # gets a deadline, classified bounded retry, and a per-path circuit
     # breaker degrading device → cpu → host; see robustness/supervisor.py)
@@ -250,6 +268,14 @@ def _parse_int(v: str, default: int) -> int:
         return default
 
 
+def _parse_float(v: str, default: float) -> float:
+    try:
+        return float(v.strip())
+    except ValueError:
+        logger.warning("invalid float value %r, keeping %s", v, default)
+        return default
+
+
 def _parse_choice(key: str, v: str, allowed: Tuple[str, ...]) -> str:
     """Validated enumerated option (the tri-state device-path gates,
     solver.gateVerify, solver.policy). Unknown values raise — the whole
@@ -314,6 +340,19 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     if CM_OBS_TRACE_SPANS in data:
         conf.obs_trace_spans = _parse_int(
             data[CM_OBS_TRACE_SPANS], conf.obs_trace_spans)
+    for key, attr in ((CM_OBS_SLO_FAST_WINDOW, "obs_slo_fast_window_s"),
+                      (CM_OBS_SLO_SLOW_WINDOW, "obs_slo_slow_window_s"),
+                      (CM_OBS_SLO_POD_E2E_P99, "obs_slo_pod_e2e_p99_s"),
+                      (CM_OBS_SLO_STALENESS, "obs_slo_cycle_staleness_s")):
+        if key in data:
+            setattr(conf, attr,
+                    _parse_duration(data[key], getattr(conf, attr)))
+    for key, attr in ((CM_OBS_SLO_DWELL_BUDGET,
+                       "obs_slo_degraded_dwell_budget"),
+                      (CM_OBS_SLO_COLD_BUDGET, "obs_slo_cold_start_budget_ms"),
+                      (CM_OBS_SLO_BURN_FAST, "obs_slo_burn_fast_threshold")):
+        if key in data:
+            setattr(conf, attr, _parse_float(data[key], getattr(conf, attr)))
     if CM_ROBUST_DEADLINE in data:
         conf.robustness_dispatch_deadline_s = _parse_duration(
             data[CM_ROBUST_DEADLINE], conf.robustness_dispatch_deadline_s)

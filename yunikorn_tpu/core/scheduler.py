@@ -78,6 +78,7 @@ from yunikorn_tpu.obs.metrics import (
     MS_BUCKETS,
     MetricsRegistry,
 )
+from yunikorn_tpu.obs.slo import SloEngine, SloOptions
 from yunikorn_tpu.obs.trace import CycleTracer
 from yunikorn_tpu.ops import assign as assign_mod
 from yunikorn_tpu.ops.assign import solve_batch
@@ -262,7 +263,8 @@ class CoreScheduler(SchedulerAPI):
                  solver_policy: Optional[str] = None,
                  solver_options: Optional[SolverOptions] = None,
                  trace_spans: int = 4096,
-                 supervisor_options: Optional[SupervisorOptions] = None):
+                 supervisor_options: Optional[SupervisorOptions] = None,
+                 slo_options: Optional[SloOptions] = None):
         self._lock = locking.RMutex()
         self.cache = cache
         self.encoder = SnapshotEncoder(cache)
@@ -391,6 +393,15 @@ class CoreScheduler(SchedulerAPI):
             "preemption_device_fallback_total",
             "device plans re-planned on the host (stale victim table, "
             "confirmation failure, or victim collision)")
+        self._m_mis_evictions = m.counter(
+            "preemption_mis_evictions_total",
+            "victims evicted for an ask that still had not placed when its "
+            "preemption cooldown expired — wasted evictions the confirm "
+            "path could not prevent (zero-tolerance SLO objective)")
+        # allocation_key -> victims actually released for it; entries are
+        # dropped when the ask places (eviction paid off) and counted as
+        # mis-evictions when the cooldown expires with the ask still unplaced
+        self._evicted_for: Dict[str, int] = {}
         self._g_preempt_last_ms = m.gauge(
             "preemption_last_plan_ms",
             "planning latency of the most recent preemption pass (ms)")
@@ -514,6 +525,18 @@ class CoreScheduler(SchedulerAPI):
         from collections import deque
 
         self._recent_preemptions = deque(maxlen=128)
+        # ---- SLO engine (round 14, obs/slo.py) ----
+        # per-partition completion stamps feeding the cycle-staleness
+        # objective; written by _note_cycle_success (run-loop ticks only —
+        # staleness is a property of the LOOP, so direct schedule_once
+        # callers never arm it)
+        self._cycle_done_at: Dict[str, float] = {}
+        self._slo_started_at: Optional[float] = None
+        # wall of the first cycle with admitted pods (the AOT cold-start
+        # objective's measured value); stamped once per process lifetime
+        self._first_cycle_ms: Optional[float] = None
+        self.slo = SloEngine(slo_options, registry=m)
+        self.slo.attach_core(self)
 
     # ------------------------------------------------------------ SchedulerAPI
     def register_resource_manager(self, request: RegisterResourceManagerRequest,
@@ -919,6 +942,9 @@ class CoreScheduler(SchedulerAPI):
     def start(self) -> None:
         if self._running.is_set():
             return
+        # staleness clock base: partitions that have not completed a cycle
+        # yet age from loop start, not from some stale previous epoch
+        self._slo_started_at = time.time()
         self._running.set()
         self._thread = threading.Thread(target=self._run_loop, name="core-scheduler", daemon=True)
         self._thread.start()
@@ -992,6 +1018,10 @@ class CoreScheduler(SchedulerAPI):
                     self._note_cycle_failure(self._cycle_stage or "cycle", e)
                 logger.exception("scheduling cycle failed (stage=%s)",
                                  self._cycle_stage or "cycle")
+            # SLO evaluation rides every loop tick, INCLUDING failed ones:
+            # a failing loop is exactly when the staleness objective must
+            # keep evaluating (rate-limited inside)
+            self.slo.maybe_tick()
 
     def _pipeline_enabled(self) -> bool:
         """The two-stage pipeline engages for the single-partition case (the
@@ -1607,15 +1637,31 @@ class CoreScheduler(SchedulerAPI):
         self._record_committed_spans([a.allocation_key for a in new_allocs],
                                      cycle_id=cycle_id)
         self._account_unschedulable(unplaced_asks)
+        if self._evicted_for:
+            # asks that placed paid their evictions off — they are no
+            # longer mis-eviction candidates
+            for a in new_allocs:
+                self._evicted_for.pop(a.allocation_key, None)
         return new_allocs, skipped_keys, unplaced_asks, fallback_keys, fb_rounds
 
     PREEMPT_COOLDOWN_S = 30.0
 
     def _purge_preempt_cooldown(self, now: float) -> None:
-        self._preempted_for = {
-            k: ts for k, ts in self._preempted_for.items()
-            if now - ts < self.PREEMPT_COOLDOWN_S
-        }
+        expired = [k for k, ts in self._preempted_for.items()
+                   if now - ts >= self.PREEMPT_COOLDOWN_S]
+        for k in expired:
+            del self._preempted_for[k]
+            # the ask had victims evicted for it (entry survives until the
+            # ask places, _commit_solve pops it) and a whole cooldown's
+            # worth of cycles still couldn't place it: those evictions were
+            # wasted — the mis-eviction the SLO gates at zero
+            victims = self._evicted_for.pop(k, 0)
+            if victims:
+                self._m_mis_evictions.inc(victims)
+                logger.warning(
+                    "mis-eviction: %d victim(s) evicted for ask %s which "
+                    "never placed within the %.0fs cooldown", victims, k,
+                    self.PREEMPT_COOLDOWN_S)
 
     def _app_of_pod(self) -> Dict[str, str]:
         return {
@@ -1806,10 +1852,19 @@ class CoreScheduler(SchedulerAPI):
             # rescan the cluster every cycle
             self._preempted_for[key] = now
         for plan in plans:
+            released = 0
             for rel in plan.releases(app_of_pod):
                 confirmed = self._release_allocation(rel)
                 if confirmed is not None:
                     preempt_releases.append(confirmed)
+                    released += 1
+            if released:
+                # mis-eviction ledger: victims actually evicted for this
+                # ask; cleared when the ask places, counted by the cooldown
+                # purge if it never does
+                self._evicted_for[plan.ask.allocation_key] = (
+                    self._evicted_for.get(plan.ask.allocation_key, 0)
+                    + released)
         plan_ms = (time.time() - t0) * 1000 + float(stats.get("dispatch_ms", 0.0))
         if attempted or plans:
             # declared lazily at first pressure cycle: a histogram family
@@ -1865,6 +1920,10 @@ class CoreScheduler(SchedulerAPI):
         self.supervisor.cycle_id = cid
         self.supervisor.policy_label = ("optimal" if self._pack_on()
                                         else "greedy")
+        # unconditional cooldown purge: a wasted eviction must settle its
+        # mis-eviction ledger on schedule even if this cluster never feels
+        # preemption pressure again (the pressure paths also purge)
+        self._purge_preempt_cooldown(t0)
         self._check_app_completion()
         self._check_placeholder_timeouts()
         replaced = self._replace_placeholders()
@@ -2105,6 +2164,10 @@ class CoreScheduler(SchedulerAPI):
         pending-check, so nothing double-allocates."""
         with self._lock:
             self._use_partition("default")
+            # unconditional: expired cooldowns must settle their
+            # mis-eviction ledger even when no later cycle ever feels
+            # preemption pressure (the only other purge call sites)
+            self._purge_preempt_cooldown(time.time())
             self._check_app_completion()
             self._check_placeholder_timeouts()
             replaced = self._replace_placeholders()
@@ -2952,7 +3015,14 @@ class CoreScheduler(SchedulerAPI):
         return snap
 
     def _note_cycle_success(self) -> None:
-        self._last_cycle_success_at = time.time()
+        now = time.time()
+        self._last_cycle_success_at = now
+        # a successful run-loop tick completed a cycle for EVERY live
+        # partition (schedule_once iterates them; the pipelined tick is
+        # single-partition mode) — a failed or abandoned tick deliberately
+        # does not stamp, so the staleness objective's age grows
+        for pname in list(self.partitions):
+            self._cycle_done_at[pname] = now
         self._failure_streak = 0
         self._cycle_stage = None
 
@@ -2997,12 +3067,40 @@ class CoreScheduler(SchedulerAPI):
         """The /ws/v1/health payload (robustness/health.py aggregation)."""
         return self.health.report()
 
+    def _slo_staleness(self) -> Optional[Dict[str, float]]:
+        """Cycle-staleness probe (obs/slo.py): per-partition age since the
+        last successfully completed run-loop cycle. None (objective not
+        applicable) while the loop is not running — direct schedule_once
+        callers are driving cycles by hand, and an idle test core must not
+        read as a stalled production loop."""
+        if not self._running.is_set():
+            return None
+        now = time.time()
+        base = self._slo_started_at or now
+        done = self._cycle_done_at
+        # clamp to loop start: stamps from before a stop()/start() cycle
+        # must not read as staleness the restarted loop never caused
+        return {pname: now - max(done.get(pname, base), base)
+                for pname in list(self.partitions)}
+
     def _record_cycle_entry(self, pname: str, entry: dict) -> None:
         """Publish one cycle's stage breakdown (core lock held): the
         last_cycle dict (DAO/JSON surface), the per-partition cycle_* gauges
         (Prometheus), and the stage-latency histograms (tail behavior —
         single-number gauges can't show a pipelined stage's distribution)."""
         self._last_cycle = {**self._last_cycle, pname: entry}
+        if self._first_cycle_ms is None and entry.get("pods"):
+            # AOT cold-start objective: the first cycle that actually
+            # admitted pods (idle ticks don't pay the compile/load cost
+            # the budget is about)
+            self._first_cycle_ms = float(entry.get("total_ms", 0.0))
+            self.obs.gauge(
+                "cold_first_cycle_ms",
+                "wall time of this process's first scheduling cycle with "
+                "admitted pods (ms) — the AOT cold-start budget's measured "
+                "value; with a prebuilt store this is artifact-load + "
+                "execute, without one the XLA compile stall",
+            ).set(self._first_cycle_ms)
         for k, v in entry.items():
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue

@@ -192,22 +192,37 @@ class Histogram(_Metric):
     def _new_child(self):
         return _HistChild(len(self.buckets))
 
+    def add_observer(self, fn) -> None:
+        """Tee raw observations to `fn(values)` (called OUTSIDE the metric
+        lock with the same batch observe_batch recorded). The SLO engine's
+        streaming quantile sketch consumes the exact values this way —
+        exposition-bucket interpolation would cap its precision at the
+        coarse LATENCY_BUCKETS_S ladder."""
+        with self._lock:
+            self._observers = getattr(self, "_observers", []) + [fn]
+
     def observe(self, value: float, **labels) -> None:
         self.observe_batch((value,), **labels)
 
     def observe_batch(self, values: Iterable[float], **labels) -> None:
         """One lock round-trip for a whole wave of observations."""
         key = self._key(labels)
+        values = [float(v) for v in values]
         with self._lock:
             child = self._children.get(key)
             if child is None:
                 child = self._children[key] = self._new_child()
             counts, buckets = child.counts, self.buckets
             for v in values:
-                v = float(v)
                 counts[bisect.bisect_left(buckets, v)] += 1
                 child.sum += v
                 child.count += 1
+            observers = getattr(self, "_observers", ())
+        for fn in observers:
+            try:
+                fn(values)
+            except Exception:
+                pass  # a sketch feeder must never fail the hot path
 
     def _child_samples(self, labels, child: _HistChild):
         out = []

@@ -194,6 +194,66 @@ def validate_exposition(text: str,
     return errors
 
 
+def quantile_from_buckets(q: float,
+                          buckets: List[Tuple[float, float]]) -> Optional[float]:
+    """Prometheus-style histogram_quantile over cumulative (le, count)
+    pairs: linear interpolation inside the bucket holding the q-rank, with
+    the conventional edge rules — rank in the first bucket interpolates
+    from 0, rank in the +Inf bucket clamps to the highest finite edge.
+
+    This is the EXPOSITION-side estimator (error = the bucket's full
+    width): the SLO engine's streaming sketch exists precisely because this
+    interpolation cannot tell a 1.1 s p99 from a 2.4 s one on the default
+    LATENCY_BUCKETS_S ladder. Use this helper for dashboards/tests over
+    scraped text; use the sketch for objectives.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    bl = sorted(buckets)
+    if not bl or bl[-1][0] != math.inf:
+        return None
+    total = bl[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_le, prev_count = 0.0, 0.0
+    for le, count in bl:
+        if count >= rank:
+            if le == math.inf:
+                # conventional clamp: the estimate cannot exceed the
+                # highest finite bucket edge
+                return prev_le if len(bl) > 1 else None
+            width = le - prev_le
+            in_bucket = count - prev_count
+            if in_bucket <= 0 or width <= 0:
+                return le
+            return prev_le + width * (rank - prev_count) / in_bucket
+        prev_le, prev_count = le, count
+    return prev_le
+
+
+def histogram_quantile(q: float, fam: Family,
+                       labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+    """histogram_quantile over a parsed exposition Family: collects the
+    `_bucket` samples of the child matching `labels` (ignoring `le`) and
+    interpolates. None when the family has no matching buckets."""
+    if fam.kind != "histogram":
+        raise ValueError(f"{fam.name}: not a histogram family")
+    want = dict(labels or {})
+    pairs: List[Tuple[float, float]] = []
+    for s in fam.samples:
+        if s.name != fam.name + "_bucket" or "le" not in s.labels:
+            continue
+        rest = {k: v for k, v in s.labels.items() if k != "le"}
+        if rest != want:
+            continue
+        le = math.inf if s.labels["le"] == "+Inf" else float(s.labels["le"])
+        pairs.append((le, s.value))
+    if not pairs:
+        return None
+    return quantile_from_buckets(q, pairs)
+
+
 def _validate_histogram(fam: Family) -> List[str]:
     errors: List[str] = []
     # group the samples per child (labelset minus `le`)

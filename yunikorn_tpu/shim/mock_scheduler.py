@@ -46,12 +46,14 @@ class MockScheduler:
         from yunikorn_tpu.core.scheduler import SolverOptions
 
         self._solver_policy = solver_policy
+        from yunikorn_tpu.obs.slo import SloOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
 
         self.core = CoreScheduler(
             cache, interval=core_interval, solver_policy=solver_policy,
             solver_options=SolverOptions.from_conf(holder.get()),
-            supervisor_options=SupervisorOptions.from_conf(holder.get()))
+            supervisor_options=SupervisorOptions.from_conf(holder.get()),
+            slo_options=SloOptions.from_conf(holder.get()))
         self.context = Context(self.cluster, self.core, cache=cache)
         self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
 

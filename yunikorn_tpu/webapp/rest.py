@@ -118,6 +118,15 @@ class RestServer:
                 if path == "/ws/v1/metrics":
                     # same registry snapshot that backs /metrics, as JSON
                     return self._reply(200, core.metrics_snapshot())
+                if path == "/ws/v1/slo":
+                    # streaming SLO engine (obs/slo.py): per-objective
+                    # verdict (ok | burning | violated), measured value vs
+                    # target, and fast/slow-window burn rates — the same
+                    # report the trace-replay proving ground gates on
+                    if hasattr(core, "slo"):
+                        return self._reply(200, core.slo.report())
+                    return self._reply(404, {"error": "slo engine "
+                                                      "unavailable"})
                 if path == "/ws/v1/preemptions":
                     # recent preemption plans (ring-buffered): which ask
                     # evicted which victims on which node, by which planner
