@@ -2,8 +2,8 @@
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke \
-	slo-smoke smoke lint run-scheduler run-admission dryrun clean image \
-	sched_image adm_image webtest_image
+	slo-smoke topology-smoke smoke lint run-scheduler run-admission dryrun \
+	clean image sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -101,7 +101,14 @@ slo-smoke:  ## SLO engine + trace replay: unit suite, then a short seeded gang-s
 		--pods 320 --tenants 4 --duration 12 --fault hang \
 		--slo-staleness 4 --expect-violation
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke  ## all tier-1 smoke targets
+topology-smoke:  ## topology-aware placement: model/steering/pack-partitioner suite (incl. the sharded-pack parity and topology-off identity contracts) + the fragmented-ICI A/B asserting >=90% of gangs land in one ICI domain within a 2x warm-latency bound
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_topology.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/topology_bench.py --shapes 384x512x16 \
+		--assert-quality
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -243,6 +243,8 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                 "aot_hits": 0,
                 "aot_compiles": 0,
                 "slo": {},
+                "topology": {"mode": "off", "gangs_total": 0,
+                             "cross_domain_gangs": 0, "fragmentation": 0.0},
             }))
             sys.exit(1)
     platform = devs[0].platform
@@ -321,6 +323,33 @@ def _slo_block(core) -> dict:
         print(f"# bench: slo block unavailable: {type(e).__name__}: {e}",
               file=sys.stderr, flush=True)
         return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _topology_block(core) -> dict:
+    """Topology-aware-placement evidence for the bench JSON (round 15):
+    whether steering was active this run, the gang-contiguity counters and
+    the final ICI-domain fragmentation gauge. The microbench's synthetic
+    nodes carry no topology labels, so the default shape is mode
+    "unlabeled" with zero counts — scripts/topology_bench.py is where the
+    steering quality is measured and gated."""
+    try:
+        na = core.encoder.nodes
+        t = getattr(core.solver, "topology", None)
+        mode = ("off" if t is False
+                else ("on" if na.has_topology else "unlabeled"))
+        return {
+            "mode": mode,
+            "gangs_total": int(core.obs.get("topology_gangs_total").value()),
+            "cross_domain_gangs": int(
+                core.obs.get("topology_cross_domain_gangs_total").value()),
+            "fragmentation": float(
+                core.obs.get("topology_domain_fragmentation").value()),
+        }
+    except Exception as e:
+        # a broken evidence path must not masquerade as topology-disabled
+        # (same contract as _slo_block): the block stays present in every
+        # JSON shape, carrying the error instead of fabricated zeros
+        return {"mode": "error", "error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def _preempt_stat(core) -> float:
@@ -495,7 +524,8 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
         _dump_trace(ms.core, "shim e2e")
         return (stats.throughput(), wall, stats.success_count, len(pods),
                 _preempt_stat(ms.core), _degradations(ms.core),
-                _cycle_stats(ms.core), _slo_block(ms.core))
+                _cycle_stats(ms.core), _slo_block(ms.core),
+                _topology_block(ms.core))
     finally:
         ms.stop()
 
@@ -648,6 +678,7 @@ def main() -> int:
         **_aot_stats(),
         **core_cycle_stats,
         "slo": _slo_block(core),
+        "topology": _topology_block(core),
     }
 
     if MODE == "both":
@@ -672,7 +703,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
     core-cycle number, that stays the headline (north-star metric) and the
     shim e2e rides along; standalone shim mode publishes the shim number."""
     (shim_tp, shim_wall, bound, total, shim_preempt_ms, shim_degr,
-     shim_cycle_stats, shim_slo) = run_shim_mode(N_PODS, N_NODES)
+     shim_cycle_stats, shim_slo, shim_topo) = run_shim_mode(N_PODS, N_NODES)
     print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
           f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
     if core_pods_per_s is None:
@@ -689,6 +720,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             **_aot_stats(),
             **shim_cycle_stats,
             "slo": shim_slo,
+            "topology": shim_topo,
         }
     return {
         "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
@@ -714,6 +746,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         # the shim phase ran last and bound real pods — its engine carries
         # the run's delivered-latency verdicts
         "slo": shim_slo,
+        "topology": shim_topo,
     }
 
 

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Topology-steering microbench: gang contiguity A/B on a fragmented ICI
+fleet (round 15, solver.topology).
+
+Builds the shape the topology-aware score exists for — a fleet of ICI
+domains whose free capacity is pre-fragmented by co-tenant load, under a
+wave of mixed-size gangs plus single-pod fillers — and A/Bs the batched
+solve with and without the topology fold (topology/score.build_topo_args):
+
+  one_domain_ratio   fraction of gangs whose every member landed inside a
+                     single ICI domain (the metric the ≥0.9 acceptance
+                     criterion gates)
+  warm latency       steered solve wall (INCLUDING the host-side topology
+                     fold) vs the un-steered solve — the ≤2x bound
+
+Per shape prints one JSON line; --assert-quality gates the LAST shape.
+
+--shapes 384x512x16,...   podsXnodesXdomains (default two shapes)
+--assert-quality          exit 1 unless one_domain_ratio(on) >= --min-ratio,
+                          it beats the off baseline, and the warm latency
+                          ratio stays within --max-latency-ratio
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(n_pods: int, n_nodes: int, n_domains: int, seed: int = 0):
+    """Fragmented topology fleet + a mixed gang/filler ask wave."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+    from yunikorn_tpu.topology.model import (LABEL_ICI_DOMAIN, LABEL_RACK,
+                                             LABEL_SLICE)
+
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    per = max(n_nodes // n_domains, 1)
+    for i in range(n_nodes):
+        dom = i // per
+        cache.update_node(make_node(
+            f"n{i:05d}", cpu_milli=8000, memory=8 * 2**30,
+            labels={LABEL_SLICE: f"slice-{dom // 8}",
+                    LABEL_RACK: f"rack-{dom // 4}",
+                    LABEL_ICI_DOMAIN: f"ici-{dom % 8}"}))
+    # pre-fragment: co-tenant pods scattered over ~60% of the nodes, with a
+    # load that leaves room for ~1 gang member — free capacity everywhere,
+    # a whole gang's worth of contiguous capacity only in some domains
+    busy = 0
+    for i in range(n_nodes):
+        if rng.random() < 0.6:
+            cache.update_pod(make_pod(
+                f"cot{i}", cpu_milli=rng.choice([4000, 6000]),
+                memory=2**30, node_name=f"n{i:05d}"))
+            busy += 1
+    gangs = []
+    pods = []
+    g = 0
+    while len(pods) < n_pods:
+        size = rng.choice([2, 3, 4, 6, 8]) if rng.random() < 0.7 else 1
+        size = min(size, n_pods - len(pods))
+        app = f"gang-{g}" if size >= 2 else f"solo-{g}"
+        members = [make_pod(f"p{g}-{j}", cpu_milli=1900, memory=2**28)
+                   for j in range(size)]
+        pods.extend((p, app) for p in members)
+        if size >= 2:
+            gangs.append((app, size))
+        g += 1
+    asks = [AllocationAsk(p.uid, app, get_pod_resource(p), pod=p)
+            for p, app in pods]
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return enc, asks, gangs, busy
+
+
+def run_shape(n_pods: int, n_nodes: int, n_domains: int) -> dict:
+    import numpy as np
+
+    from yunikorn_tpu.ops.assign import solve_batch
+    from yunikorn_tpu.topology.model import fleet_fragmentation
+    from yunikorn_tpu.topology.score import build_topo_args
+
+    enc, asks, gangs, busy = build(n_pods, n_nodes, n_domains)
+    na = enc.nodes
+    batch = enc.build_batch(asks)
+    frag = fleet_fragmentation(na)
+
+    app_of_row = {i: a.application_id for i, a in enumerate(asks)}
+
+    def one_domain_ratio(assigned) -> float:
+        doms_of = {}
+        for i, node_row in enumerate(assigned.tolist()):
+            app = app_of_row[i]
+            if node_row >= 0:
+                doms_of.setdefault(app, set()).add(int(na.topo[node_row, 2]))
+            else:
+                doms_of.setdefault(app, set()).add(-2)  # unplaced = split
+        whole = sum(1 for app, _n in gangs
+                    if len(doms_of.get(app, {-2})) == 1
+                    and -2 not in doms_of[app])
+        return whole / max(len(gangs), 1)
+
+    def run_off():
+        batch.topo = None
+        r = solve_batch(batch, na)
+        return np.asarray(r.assigned)[: batch.num_pods]
+
+    def run_on():
+        # the fold is part of the steered path's cost: include it
+        batch.topo = build_topo_args(asks, batch, na, app_rows={})
+        r = solve_batch(batch, na)
+        return np.asarray(r.assigned)[: batch.num_pods]
+
+    a_off = run_off()                         # cold
+    t0 = time.time()
+    a_off = run_off()
+    off_ms = (time.time() - t0) * 1000
+    a_on = run_on()                           # cold
+    t0 = time.time()
+    a_on = run_on()
+    on_ms = (time.time() - t0) * 1000
+
+    return {
+        "pods": n_pods, "nodes": n_nodes, "domains": n_domains,
+        "gangs": len(gangs), "busy_nodes": busy,
+        "fragmentation": frag,
+        "placed_off": int((a_off >= 0).sum()),
+        "placed_on": int((a_on >= 0).sum()),
+        "one_domain_ratio_off": round(one_domain_ratio(a_off), 4),
+        "one_domain_ratio_on": round(one_domain_ratio(a_on), 4),
+        "off_warm_ms": round(off_ms, 1),
+        "on_warm_ms": round(on_ms, 1),
+        "latency_ratio": round(on_ms / max(off_ms, 1e-6), 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="384x512x16,768x1024x32",
+                    help="podsXnodesXdomains, comma-separated")
+    ap.add_argument("--assert-quality", action="store_true",
+                    help="exit 1 unless the last shape's steered solve "
+                         "places >= --min-ratio of gangs in one ICI domain, "
+                         "beats the un-steered baseline, and stays within "
+                         "the warm-latency bound")
+    ap.add_argument("--min-ratio", type=float, default=0.9)
+    ap.add_argument("--max-latency-ratio", type=float, default=2.0)
+    args = ap.parse_args()
+
+    last = None
+    for shape in args.shapes.split(","):
+        n_pods, n_nodes, n_dom = (int(x) for x in shape.strip().split("x"))
+        last = run_shape(n_pods, n_nodes, n_dom)
+        print(json.dumps(last), flush=True)
+
+    if args.assert_quality and last is not None:
+        ok_ratio = last["one_domain_ratio_on"] >= args.min_ratio
+        ok_beats = (last["one_domain_ratio_on"]
+                    >= last["one_domain_ratio_off"])
+        ok_lat = last["latency_ratio"] <= args.max_latency_ratio
+        ok_placed = last["placed_on"] >= last["placed_off"] * 0.98
+        if not (ok_ratio and ok_beats and ok_lat and ok_placed):
+            print(f"FAIL: one_domain_ratio on={last['one_domain_ratio_on']} "
+                  f"off={last['one_domain_ratio_off']} "
+                  f"(need >= {args.min_ratio} and >= off), latency "
+                  f"{last['latency_ratio']}x (bound "
+                  f"{args.max_latency_ratio}x), placed "
+                  f"{last['placed_on']} vs {last['placed_off']}",
+                  file=sys.stderr)
+            return 1
+        print(f"OK: {last['one_domain_ratio_on']:.0%} of gangs in one ICI "
+              f"domain (off baseline {last['one_domain_ratio_off']:.0%}), "
+              f"warm latency {last['latency_ratio']}x <= "
+              f"{args.max_latency_ratio}x", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

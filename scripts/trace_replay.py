@@ -28,6 +28,15 @@ identical report modulo the `timings` section):
                  scheduler serves its first cycle from the prebuilt
                  executable store; TRUE fresh-process cold start stays
                  covered by scripts/aot_smoke.py.
+  slice-fragmentation
+                 mixed-size gangs churning across ICI domains: nodes carry
+                 synthesized topology labels (fake_apiserver.topology_labels)
+                 and ~60%% of each wave completes before the next lands, so
+                 free capacity fragments across domains and late gangs must
+                 find contiguous slots. The report fingerprint gains a
+                 `topology` block (mode, gangs, cross-domain-gang count,
+                 final fragmentation) — the round-15 A/B artifact
+                 (--topology false replays the identical trace un-steered).
 
 Chaos coupling (--fault hang|fail): a scripted robustness/faults.py fault
 poisons the supervised assign path mid-trace — the staleness objective must
@@ -58,7 +67,7 @@ from typing import Dict, List, Optional, Tuple
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 TRACES = ("diurnal", "gang-storm", "quota-churn", "drain-upgrade",
-          "restart-storm")
+          "restart-storm", "slice-fragmentation")
 
 
 # ---------------------------------------------------------------------------
@@ -98,12 +107,13 @@ def generate_trace(trace: str, *, seed: int, nodes: int, pods: int,
     events: List[tuple] = []
     counter = [0]
 
-    def mk_pods(n: int, t: float, prio_of=None, app_of=None) -> int:
+    def mk_pods(n: int, t: float, prio_of=None, app_of=None,
+                tenant_of=None) -> int:
         batch = []
         for _ in range(n):
             i = counter[0]
             counter[0] += 1
-            tn = tnames[i % len(tnames)]
+            tn = tenant_of(i) if tenant_of else tnames[i % len(tnames)]
             app = app_of(i, tn) if app_of else f"rapp-{tn}"
             prio = prio_of(i) if prio_of else 0
             batch.append((f"rp-{i}", app, f"root.{tn}", 100, 64, prio))
@@ -168,6 +178,35 @@ def generate_trace(trace: str, *, seed: int, nodes: int, pods: int,
             events.append((t, "configmap", data))
             flip = not flip
             t += churn_every
+    elif trace == "slice-fragmentation":
+        # mixed gang sizes churning: waves of gangs sized 2/3/5/8 land per
+        # tenant; most of each wave completes before the next arrives, so
+        # the free capacity the next wave sees is scattered across ICI
+        # domains — exactly the fragmentation the topology-aware score must
+        # defragment (gangs into one domain) instead of amplifying
+        waves = 4
+        per_wave = max(pods // waves, 1)
+        sizes = (2, 3, 5, 8)
+        for w in range(waves):
+            t_w = duration * (w + 0.12) / waves
+            left = per_wave
+            g_i = 0
+            while left > 0:
+                n = min(sizes[(g_i + w) % len(sizes)], left)
+                left -= n
+                jitter = rng.random() * min(1.5, duration / 20)
+                # one tenant per GANG (not the per-pod round-robin): a gang
+                # is one application, and an application lives in one queue
+                # — the per-pod tenant stripe would shatter every gang into
+                # singleton apps and empty the contiguity denominator
+                tn_g = tnames[(g_i + w) % len(tnames)]
+                mk_pods(n, t_w + jitter,
+                        app_of=lambda i, tn, w=w, g=g_i: f"frag-{w}-{g}-{tn}",
+                        tenant_of=lambda i, tn=tn_g: tn)
+                g_i += 1
+            max_wave = max(max_wave, per_wave)
+            events.append((t_w + duration / waves * 0.55, "complete",
+                           int(per_wave * 0.6)))
     elif trace == "drain-upgrade":
         steps = max(6, min(40, int(duration)))
         dt = duration / steps
@@ -337,8 +376,29 @@ def run_replay(args, policy: str) -> dict:
     t_run0 = time.time()
     server = FakeAPIServer()
     port = server.start()
+    with_topology = (args.trace == "slice-fragmentation"
+                     or args.topology_labels)
+    # ICI domain per node, recorded at ADD time: the contiguity ground
+    # truth must survive node deletion (drain/upgrade traces) — reading
+    # the final store would count a gang on since-drained nodes as
+    # cross-domain
+    dom_of_node: Dict[str, str] = {}
+
+    def _add_node(name: str, idx: int) -> None:
+        server.add_node_doc(name, cpu="8", memory="16Gi",
+                            topology_index=idx if with_topology else None,
+                            nodes_per_domain=args.nodes_per_domain)
+        if with_topology:
+            from yunikorn_tpu.topology.model import (LABEL_ICI_DOMAIN,
+                                                     LABEL_SLICE)
+
+            lbl = FakeAPIServer.topology_labels(
+                idx, nodes_per_domain=args.nodes_per_domain)
+            dom_of_node[name] = (f"{lbl[LABEL_SLICE]}/"
+                                 f"{lbl[LABEL_ICI_DOMAIN]}")
+
     for i in range(args.nodes):
-        server.add_node_doc(f"rn-{i}", cpu="8", memory="16Gi")
+        _add_node(f"rn-{i}", i)
     print(f"[replay] fake apiserver on :{port} with {args.nodes} nodes "
           f"({args.trace}, seed={args.seed}, policy={policy})",
           file=sys.stderr, flush=True)
@@ -365,6 +425,7 @@ def run_replay(args, policy: str) -> dict:
         "robustness.maxRetries": "0",
         "robustness.breakerThreshold": "2",
         "robustness.probeIntervalSeconds": "1",
+        "solver.topology": args.topology,
     }
     if args.aot_store:
         from yunikorn_tpu import aot
@@ -478,7 +539,7 @@ def run_replay(args, policy: str) -> dict:
                     server.delete("nodes", "", name)
             elif kind == "add_nodes":
                 for name in payload:
-                    server.add_node_doc(name, cpu="8", memory="16Gi")
+                    _add_node(name, int(name.rsplit("-", 1)[-1]))
             elif kind == "configmap":
                 server.add("configmaps", {
                     "metadata": {"name": "yunikorn-configs",
@@ -525,6 +586,41 @@ def run_replay(args, policy: str) -> dict:
         slo_report = stack.core.slo.report()
         violations = stack.merged_violations()
         core = stack.core
+        # topology block (round 15): gang contiguity measured from the
+        # FINAL bindings (placement-level ground truth, not per-cycle
+        # commit groupings) + the engine-side counters/gauge
+        app_of_name: Dict[str, str] = {}
+        for _t, kind, payload in events:
+            if kind == "pods":
+                for (name, app, _q, _c, _m, _p) in payload:
+                    app_of_name[name] = app
+        gang_doms: Dict[str, set] = {}
+        gang_sizes: Dict[str, int] = {}
+        for pod_name, node in server.bindings:
+            app = app_of_name.get(pod_name)
+            if app is None:
+                continue
+            gang_doms.setdefault(app, set()).add(dom_of_node.get(node))
+            gang_sizes[app] = gang_sizes.get(app, 0) + 1
+        gangs = {a: d for a, d in gang_doms.items() if gang_sizes[a] >= 2}
+        cross = sum(1 for d in gangs.values()
+                    if len(d) != 1 or None in d)
+        # fragmentation from the encoder's live node state, NOT the gauge:
+        # with --topology false the steering path (and its gauge) never
+        # runs, but the A/B artifact still needs the off-side's real
+        # fragmentation or the comparison reads inverted
+        from yunikorn_tpu.topology.model import fleet_fragmentation
+
+        frag = fleet_fragmentation(core.encoder.nodes)
+        topo_block = {
+            "mode": ("off" if args.topology == "false"
+                     else ("on" if with_topology else "unlabeled")),
+            "gangs": len(gangs),
+            "cross_domain_gangs": cross,
+            "one_domain_ratio": (round(1.0 - cross / len(gangs), 4)
+                                 if gangs else 1.0),
+            "fragmentation": frag,
+        }
         preempt_total = int(core.obs.get("preempted_total").value())
         mis_evict = int(
             core.obs.get("preemption_mis_evictions_total").value())
@@ -568,6 +664,7 @@ def run_replay(args, policy: str) -> dict:
                 "preempted_total": preempt_total,
                 "mis_evictions": mis_evict,
                 "restarts": stack.restarts,
+                "topology": topo_block,
             },
             "slo": slo_report,
             "violations": violations,
@@ -602,6 +699,16 @@ def main() -> int:
     ap.add_argument("--ab", action="store_true",
                     help="replay twice (greedy, then optimal) and record "
                          "preemption volume for both policies")
+    ap.add_argument("--topology", choices=("auto", "true", "false"),
+                    default="auto",
+                    help="solver.topology for the replay (the round-15 A/B "
+                         "dial: false replays the identical trace with the "
+                         "pre-topology programs)")
+    ap.add_argument("--topology-labels", action="store_true",
+                    help="synthesize topology labels on the replay nodes "
+                         "for ANY trace (slice-fragmentation always does)")
+    ap.add_argument("--nodes-per-domain", type=int, default=16,
+                    help="nodes per synthesized ICI domain")
     ap.add_argument("--aot-store", default=os.environ.get("YK_AOT_STORE", ""),
                     help="attach a prebuilt AOT executable store (the "
                          "restart-storm rebuild serves from it)")

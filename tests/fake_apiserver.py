@@ -437,10 +437,35 @@ class FakeAPIServer:
             self._emit("pods", "MODIFIED", doc)
 
     # ------------------------------------------------------ document helpers
+    @staticmethod
+    def topology_labels(index: int, *, nodes_per_domain: int = 16,
+                        domains_per_slice: int = 4,
+                        racks_per_slice: int = 2) -> dict:
+        """Synthesized fleet-topology labels for node `index`: a regular
+        (slice, rack, ICI-domain) grid in the canonical topology.yunikorn.io
+        label vocabulary (topology/model.py). Deterministic in the index, so
+        seeded traces get a stable topology and the replay fingerprint can
+        pin domain-level counts."""
+        dom = index // max(nodes_per_domain, 1)
+        sl = dom // max(domains_per_slice, 1)
+        rack = (dom // max(domains_per_slice // max(racks_per_slice, 1), 1)
+                % max(racks_per_slice, 1))
+        return {
+            "topology.yunikorn.io/slice": f"slice-{sl}",
+            "topology.yunikorn.io/rack": f"rack-{sl}-{rack}",
+            "topology.yunikorn.io/ici-domain": f"ici-{dom % domains_per_slice}",
+        }
+
     def add_node_doc(self, name: str, cpu: str = "8", memory: str = "16Gi",
-                     pods: int = 110, labels: Optional[dict] = None) -> dict:
+                     pods: int = 110, labels: Optional[dict] = None,
+                     topology_index: Optional[int] = None,
+                     nodes_per_domain: int = 16) -> dict:
+        lbl = dict(labels or {})
+        if topology_index is not None:
+            lbl.update(self.topology_labels(
+                topology_index, nodes_per_domain=nodes_per_domain))
         return self.add("nodes", {
-            "metadata": {"name": name, "labels": dict(labels or {})},
+            "metadata": {"name": name, "labels": lbl},
             "spec": {},
             "status": {"allocatable": {"cpu": cpu, "memory": memory, "pods": str(pods)},
                        "capacity": {"cpu": cpu, "memory": memory, "pods": str(pods)}},

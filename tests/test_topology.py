@@ -1,0 +1,433 @@
+"""Topology-aware placement (round 15): the ICI-domain model, the
+contention/gang score steering, the topology-off identity contract, the
+mesh-aligned pack partitioner, and the preemption domain ordering.
+"""
+import numpy as np
+import pytest
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+from yunikorn_tpu.topology.model import (
+    LABEL_ICI_DOMAIN,
+    LABEL_RACK,
+    LABEL_SLICE,
+    domain_free_units,
+    fragmentation,
+    normalize_topology_labels,
+    parse_topology_labels,
+)
+from yunikorn_tpu.topology.score import (
+    build_topo_args,
+    plan_gang_domains,
+    preempt_node_order,
+)
+
+
+def topo_labels(dom: int, sl: int = 0) -> dict:
+    return {LABEL_SLICE: f"slice-{sl}", LABEL_RACK: f"rack-{sl}-{dom // 2}",
+            LABEL_ICI_DOMAIN: f"ici-{dom}"}
+
+
+def make_cluster(n_nodes=32, domains=4, cpu_milli=8000, mem=8 * 2**30,
+                 labeled=True):
+    """Cache + encoder over a regular topology grid."""
+    cache = SchedulerCache()
+    per = n_nodes // domains
+    for i in range(n_nodes):
+        labels = topo_labels(i // per) if labeled else {}
+        cache.update_node(make_node(f"n{i}", cpu_milli=cpu_milli, memory=mem,
+                                    labels=labels))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return cache, enc
+
+
+# ---------------------------------------------------------------- model
+def test_parse_and_normalize_labels():
+    sl, rack, ici = parse_topology_labels(topo_labels(3))
+    assert sl == "slice-0" and rack == "rack-0-1"
+    assert ici == ("slice-0", "ici-3")
+    # domain names are slice-scoped: same ici label, different slice
+    assert parse_topology_labels(topo_labels(3, sl=1))[2] == ("slice-1", "ici-3")
+    # ici without slice still yields a (scoped) domain
+    assert parse_topology_labels({LABEL_ICI_DOMAIN: "x"})[2] == ("", "x")
+    assert parse_topology_labels({}) == (None, None, None)
+    # provider aliases fold into the canonical set; canonical wins
+    lbl = normalize_topology_labels(
+        {"cloud.google.com/gke-tpu-slice": "s7",
+         "topology.kubernetes.io/rack": "r1"})
+    assert lbl[LABEL_SLICE] == "s7" and lbl[LABEL_RACK] == "r1"
+    both = normalize_topology_labels(
+        {"cloud.google.com/gke-tpu-slice": "alias", LABEL_SLICE: "canon"})
+    assert both[LABEL_SLICE] == "canon"
+    plain = {"zone": "z1"}
+    assert normalize_topology_labels(plain) is plain  # allocation-free path
+
+
+def test_encoder_interns_topology_coordinates():
+    cache, enc = make_cluster(n_nodes=8, domains=2)
+    na = enc.nodes
+    assert na.has_topology and na.num_ici_domains == 2
+    for i in range(8):
+        idx = na.index_of(f"n{i}")
+        assert na.topo[idx, 2] == i // 4          # dense domain ids
+        assert na.topo[idx, 0] == 0               # one slice
+    # unlabeled node stays -1 everywhere
+    cache.update_node(make_node("plain", cpu_milli=1000, memory=2**30))
+    enc.sync_nodes()
+    assert (na.topo[na.index_of("plain")] == -1).all()
+    # removal clears the row so a reused slot can't leak a domain
+    cache.remove_node("n0")
+    enc.sync_nodes()
+    assert (na.topo[0 if na.index_of("n1") != 0 else 1] != -2).all()  # sanity
+    removed_row = [i for i in range(na.capacity)
+                   if na._idx_to_name.get(i) is None and i < 9]
+    assert all((na.topo[i] == -1).all() for i in removed_row)
+
+
+def test_device_mirror_carries_topo_field():
+    _cache, enc = make_cluster(n_nodes=8, domains=2)
+    arrays = enc.device_arrays()
+    assert "topo" in arrays
+    np.testing.assert_array_equal(np.asarray(arrays["topo"]), enc.nodes.topo)
+    # incremental: a node-object change re-uploads topo with the full field
+    # set; pod churn does not touch it (update_free_row marks free_i/ports)
+    dev = enc.device
+    enc.device_arrays()
+    assert dev.last_refresh == "clean"
+
+
+def test_domain_units_and_fragmentation():
+    node_dom = np.array([0, 0, 1, -1])
+    free = np.array([[4, 0], [4, 0], [8, 0], [100, 0]], np.int64)
+    cap = np.array([[8, 0], [8, 0], [8, 0], [100, 0]], np.int64)
+    free_d, cap_d = domain_free_units(node_dom, free, cap, 2)
+    assert free_d.shape == (2,)
+    assert cap_d[0] == 2 * cap_d[1] // 2 * 2  # two nodes vs one
+    # unlabeled node's capacity never lands in any domain
+    assert free_d.sum() < 100 * 1024
+    assert fragmentation(np.array([10, 0])) == 0.0
+    assert fragmentation(np.array([5, 5])) == 0.5
+    assert fragmentation(np.array([], np.int64)) == 0.0
+
+
+# ---------------------------------------------------------------- planner
+def test_plan_gang_domains_prefers_fit_presence_and_empty():
+    free_d = np.array([100, 300, 300], np.int64)
+    cap_d = np.array([400, 400, 300], np.int64)
+    # gang A (demand 200): domain 0 does not fit; 1 is busier than 2;
+    # domain 2 is co-tenant-free -> picks 2
+    plan = plan_gang_domains(["A"], {"A": 200}, {}, free_d, cap_d)
+    assert plan["A"] == 2
+    # presence beats emptiness among fitting domains
+    pres = {"B": np.array([0, 5, 0], np.int64)}
+    plan = plan_gang_domains(["B"], {"B": 200}, pres, free_d, cap_d)
+    assert plan["B"] == 1
+    # capacity charging: two 200-demand gangs cannot stampede domain 2
+    plan = plan_gang_domains(["A", "C"], {"A": 200, "C": 200}, {},
+                             free_d, cap_d)
+    assert plan["A"] == 2 and plan["C"] == 1
+    assert plan_gang_domains(["A"], {"A": 1}, {}, np.array([], np.int64),
+                             np.array([], np.int64)) == {}
+
+
+def _asks(pods, app="app"):
+    return [AllocationAsk(p.uid, app, get_pod_resource(p), pod=p)
+            for p in pods]
+
+
+def test_build_topo_args_plans_gang_targets():
+    _cache, enc = make_cluster(n_nodes=32, domains=4)
+    pods = [make_pod(f"g{i}", cpu_milli=1000, memory=2**27) for i in range(6)]
+    pods += [make_pod("solo", cpu_milli=500, memory=2**26)]
+    asks = _asks(pods[:6], app="gang") + _asks(pods[6:], app="solo")
+    batch = enc.build_batch(asks)
+    ta = build_topo_args(asks, batch, enc.nodes, app_rows={})
+    assert ta is not None
+    assert ta.stats["domains"] == 4 and ta.stats["gangs"] == 1
+    # gang rows share one planned target domain; the solo ask (and the
+    # padding rows) stay unsteered
+    prefs = set(ta.pref_pod[:6].tolist())
+    assert len(prefs) == 1 and prefs.pop() >= 0
+    assert ta.pref_pod[6] == -1
+    assert (ta.pref_pod[batch.num_pods:] == -1).all()
+    assert ta.node_dom.shape[0] == enc.nodes.capacity
+    # no labels -> no args (the auto-off identity path)
+    _c2, enc2 = make_cluster(n_nodes=8, domains=2, labeled=False)
+    b2 = enc2.build_batch(asks)
+    assert build_topo_args(asks, b2, enc2.nodes, app_rows={}) is None
+
+
+# ---------------------------------------------------------------- solve
+def test_gang_lands_in_one_ici_domain():
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    _cache, enc = make_cluster(n_nodes=32, domains=4)
+    na = enc.nodes
+    pods = [make_pod(f"g{i}", cpu_milli=2000, memory=2**28) for i in range(8)]
+    asks = _asks(pods, app="gang")
+    batch = enc.build_batch(asks)
+    batch.topo = build_topo_args(asks, batch, enc.nodes, app_rows={})
+    assert batch.topo is not None
+    res = solve_batch(batch, na)
+    assigned = np.asarray(res.assigned)[:8]
+    assert (assigned >= 0).all()
+    doms = {int(na.topo[i, 2]) for i in assigned}
+    assert len(doms) == 1, f"gang spread across domains {doms}"
+    assert doms == {int(batch.topo.pref_pod[0])}
+
+
+def test_empty_domain_bonus_steers_equal_scores():
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    cache = SchedulerCache()
+    # two domains, equal-fill nodes; domain 0 is made busy by loading its
+    # OTHER node, so its free node carries a contention penalty
+    cache.update_node(make_node("a0", cpu_milli=4000, memory=4 * 2**30,
+                                labels=topo_labels(0)))
+    cache.update_node(make_node("a1", cpu_milli=4000, memory=4 * 2**30,
+                                labels=topo_labels(0)))
+    cache.update_node(make_node("b0", cpu_milli=4000, memory=4 * 2**30,
+                                labels=topo_labels(1)))
+    filler = make_pod("filler", cpu_milli=3000, memory=2**28,
+                      node_name="a1")
+    cache.update_pod(filler)
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    pod = make_pod("p", cpu_milli=1000, memory=2**27)
+    asks = _asks([pod], app="solo")
+    batch = enc.build_batch(asks)
+    base = solve_batch(batch, enc.nodes)
+    batch.topo = build_topo_args(asks, batch, enc.nodes,
+                                 app_rows={"solo": []})
+    res = solve_batch(batch, enc.nodes)
+    na = enc.nodes
+    topo_dom = int(na.topo[int(np.asarray(res.assigned)[0]), 2])
+    assert topo_dom == 1  # the co-tenant-free domain
+    # sanity: the un-steered program exists and places somewhere valid
+    assert int(np.asarray(base.assigned)[0]) >= 0
+
+
+def test_topology_off_is_bit_identical_to_unlabeled():
+    """The differential oracle: a labeled cluster with solver.topology=off
+    places EXACTLY like the same cluster with no topology labels at all
+    (topology labels reach the solver only through the topo args)."""
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    rng = np.random.default_rng(7)
+    sizes = rng.integers(200, 2000, size=40).tolist()
+
+    def run(labeled):
+        _cache, enc = make_cluster(n_nodes=16, domains=4, labeled=labeled)
+        pods = [make_pod(f"p{i}", cpu_milli=int(s), memory=2**26)
+                for i, s in enumerate(sizes)]
+        asks = _asks(pods)
+        batch = enc.build_batch(asks)
+        assert getattr(batch, "topo", None) is None  # off: never attached
+        res = solve_batch(batch, enc.nodes)
+        return np.asarray(res.assigned)[: batch.num_pods]
+
+    np.testing.assert_array_equal(run(True), run(False))
+
+
+# ------------------------------------------------------------- pack/topo
+def test_pack_topo_partitioner_parts_are_domain_aligned():
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import prepare_solve_args
+
+    _cache, enc = make_cluster(n_nodes=64, domains=8, cpu_milli=16000,
+                               mem=16 * 2**30)
+    pods = [make_pod(f"p{i}", cpu_milli=400 + 100 * (i % 5), memory=2**26)
+            for i in range(256)]
+    asks = _asks(pods)
+    batch = enc.build_batch(asks)
+    batch.topo = build_topo_args(asks, batch, enc.nodes, app_rows={})
+    res = pack_mod.pack_solve_batch(batch, enc.nodes, seed=3)
+    assert res.partitioner == "topo"
+    assigned = np.asarray(res.assigned)[: batch.num_pods]
+    assert (assigned >= 0).all()
+    assert bool(np.asarray(res.feasible))
+    # determinism: same inputs, same seed -> identical plan
+    res2 = pack_mod.pack_solve_batch(batch, enc.nodes, seed=3)
+    np.testing.assert_array_equal(assigned,
+                                  np.asarray(res2.assigned)[: batch.num_pods])
+
+
+def test_pick_parts_floors_at_shard_count():
+    from yunikorn_tpu.ops.pack_solve import pick_parts, shape_supported
+
+    assert pick_parts(256, 64) == 1
+    assert pick_parts(256, 64, n_shards=8) == 8
+    assert pick_parts(256, 64, n_shards=8) % 8 == 0
+    assert shape_supported(256, 64, n_shards=8)
+    # shapes that cannot split into whole parts per shard are refused
+    assert not shape_supported(3, 64, n_shards=8)
+    # pick_parts doubles in powers of two, so a non-power-of-two shard
+    # count can never be honored — the same shape stays packable
+    # single-device (the core's "mesh-shape" vs "shape" skip distinction)
+    assert not shape_supported(256, 64, n_shards=6)
+    assert shape_supported(256, 64)
+
+
+def test_pack_sharded_parity_vs_single_shard():
+    """The PACK_SHARDED_SUPPORTED contract: the mesh-sharded pack solve is
+    placement-identical to the single-device solve of the SAME program
+    (same mesh-aligned partition, same seed, same args)."""
+    import jax
+
+    from yunikorn_tpu.aot import runtime as aot_rt
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import prepare_solve_args
+    from yunikorn_tpu.parallel import mesh as mesh_mod
+
+    assert mesh_mod.PACK_SHARDED_SUPPORTED
+    _cache, enc = make_cluster(n_nodes=64, domains=8, cpu_milli=16000,
+                               mem=16 * 2**30)
+    pods = [make_pod(f"p{i}", cpu_milli=400 + 100 * (i % 5), memory=2**26)
+            for i in range(256)]
+    # a couple of gangs so the topo args are non-trivial
+    asks = (_asks(pods[:120], app="gang-a") + _asks(pods[120:240], app="gang-b")
+            + _asks(pods[240:], app="solo"))
+    batch = enc.build_batch(asks)
+    batch.topo = build_topo_args(asks, batch, enc.nodes, app_rows={})
+    mesh = mesh_mod.make_mesh()
+    n_dev = mesh.devices.size
+    sharded = mesh_mod.pack_solve_sharded(batch, enc.nodes, mesh, seed=11)
+
+    np_args, static_kwargs = prepare_solve_args(batch, enc.nodes)
+    import jax.numpy as jnp
+
+    single = pack_mod.pack_solve(
+        *jax.tree_util.tree_map(jnp.asarray, np_args), jnp.int32(11),
+        n_parts=sharded.n_parts, partitioner="topo", n_shards=n_dev,
+        score_cols=static_kwargs["score_cols"])
+    a_sharded = np.asarray(sharded.assigned)[: batch.num_pods]
+    a_single = np.asarray(single[0])[: batch.num_pods]
+    np.testing.assert_array_equal(a_sharded, a_single)
+    np.testing.assert_array_equal(np.asarray(sharded.free_after),
+                                  np.asarray(single[1]))
+
+
+# ------------------------------------------------------------- preempt
+def test_preempt_node_order_prefers_open_domains():
+    cache, enc = make_cluster(n_nodes=8, domains=2, cpu_milli=4000)
+    # load domain 0 heavily: its nodes hold pods, domain 1 stays free
+    for i in range(4):
+        p = make_pod(f"busy{i}", cpu_milli=3000, memory=2**27,
+                     node_name=f"n{i}")
+        cache.update_pod(p)
+    enc.sync_nodes()
+    names = [f"n{i}" for i in range(8)]
+    ordered = preempt_node_order(names, enc.nodes)
+    # domain 1 (most free capacity) first, stable order within each domain
+    assert ordered[:4] == ["n4", "n5", "n6", "n7"]
+    assert ordered[4:] == ["n0", "n1", "n2", "n3"]
+    # unlabeled clusters pass through untouched
+    _c2, enc2 = make_cluster(n_nodes=4, domains=2, labeled=False)
+    assert preempt_node_order(["n1", "n0"], enc2.nodes) == ["n1", "n0"]
+
+
+# ------------------------------------------------------------------ conf
+def test_solver_topology_tri_state():
+    from yunikorn_tpu.conf.schedulerconf import (CM_SOLVER_TOPOLOGY,
+                                                 parse_config_map)
+    from yunikorn_tpu.core.scheduler import SolverOptions
+
+    conf = parse_config_map({CM_SOLVER_TOPOLOGY: "false"})
+    assert SolverOptions.from_conf(conf).topology is False
+    conf = parse_config_map({CM_SOLVER_TOPOLOGY: "true"})
+    assert SolverOptions.from_conf(conf).topology is True
+    conf = parse_config_map({})
+    assert SolverOptions.from_conf(conf).topology is None
+    with pytest.raises(ValueError):
+        parse_config_map({CM_SOLVER_TOPOLOGY: "bogus"})
+
+
+# -------------------------------------------------------------------- e2e
+def _register(core):
+    from yunikorn_tpu.common.si import RegisterResourceManagerRequest
+
+    class CB:
+        def __init__(self):
+            self.allocs = {}
+
+        def update_allocation(self, response):
+            for a in response.new:
+                self.allocs[a.allocation_key] = a.node_id
+
+        def update_application(self, r): pass
+        def update_node(self, r): pass
+        def predicates(self, a): return None
+        def preemption_predicates(self, a): return None
+        def send_event(self, e): pass
+        def update_container_scheduling_state(self, r): pass
+        def get_state_dump(self): return "{}"
+
+    cb = CB()
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues"), cb)
+    return cb
+
+
+def _submit(core, cache, asks_spec):
+    from yunikorn_tpu.common.si import (AddApplicationRequest,
+                                        AllocationRequest, ApplicationRequest,
+                                        NodeAction, NodeInfo, NodeRequest,
+                                        UserGroupInfo)
+
+    infos = [NodeInfo(node_id=n, action=NodeAction.CREATE)
+             for n in cache.node_names()]
+    core.update_node(NodeRequest(nodes=infos))
+    apps = sorted({app for _p, app in asks_spec})
+    core.update_application(ApplicationRequest(new=[
+        AddApplicationRequest(application_id=a, queue_name="root.default",
+                              user=UserGroupInfo(user="u")) for a in apps]))
+    asks = [AllocationAsk(p.uid, app, get_pod_resource(p), pod=p)
+            for p, app in asks_spec]
+    core.update_allocation(AllocationRequest(asks=asks))
+
+
+def test_core_cycle_places_gang_in_one_domain_and_counts():
+    from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+
+    cache, enc = make_cluster(n_nodes=32, domains=4)
+    core = CoreScheduler(cache, solver_options=SolverOptions())
+    core.encoder = enc  # reuse the synced encoder's interning
+    cb = _register(core)
+    spec = [(make_pod(f"g{i}", cpu_milli=2000, memory=2**28), "gangapp")
+            for i in range(8)]
+    _submit(core, cache, spec)
+    n = core.schedule_once()
+    assert n == 8
+    na = core.encoder.nodes
+    doms = {int(na.topo[na.index_of(node), 2]) for node in cb.allocs.values()}
+    assert len(doms) == 1
+    ms = core.metrics
+    assert ms.get("topology_gangs_total", 0) >= 1
+    assert ms.get("topology_cross_domain_gangs_total", 0) == 0
+    entry = core.metrics["last_cycle"]["default"]
+    assert "topo_fragmentation" in entry
+    assert entry.get("topo_cycle_gangs", 0) >= 1
+    # the fold must actually have ENGAGED (batch.topo built, plan stats
+    # recorded) — a silently-failing fold still commits plausible-looking
+    # gang counts on an uncontended cluster (caught by the e2e drive)
+    assert entry.get("topo_gangs", 0) >= 1
+    assert entry.get("topo_domains", 0) == 4
+
+
+def test_core_topology_off_never_attaches():
+    from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+
+    cache, _enc = make_cluster(n_nodes=8, domains=2)
+    core = CoreScheduler(cache,
+                         solver_options=SolverOptions(topology=False))
+    _register(core)
+    spec = [(make_pod(f"p{i}", cpu_milli=500, memory=2**26), "app")
+            for i in range(4)]
+    _submit(core, cache, spec)
+    assert core.schedule_once() == 4
+    assert not core._topology_active
+    assert core.metrics.get("topology_gangs_total", 0) == 0

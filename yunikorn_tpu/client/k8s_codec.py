@@ -230,10 +230,18 @@ def encode_pod(pod: Pod) -> Dict[str, Any]:
 
 
 def decode_node(doc: Dict[str, Any]) -> Node:
+    from yunikorn_tpu.topology.model import normalize_topology_labels
+
     spec_doc = doc.get("spec") or {}
     status_doc = doc.get("status") or {}
+    meta = _meta(doc)
+    # fold provider-specific topology labels (GKE TPU slice/ICI labels,
+    # topology.kubernetes.io/rack) into the canonical topology.yunikorn.io/*
+    # set here, at the adapter boundary, so the snapshot encoder and the
+    # topology scorer only ever parse one label vocabulary
+    meta.labels = normalize_topology_labels(meta.labels)
     return Node(
-        metadata=_meta(doc),
+        metadata=meta,
         spec=NodeSpec(
             unschedulable=bool(spec_doc.get("unschedulable", False)),
             taints=[Taint(key=t.get("key", ""), value=t.get("value", ""),

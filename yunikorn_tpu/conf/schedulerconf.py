@@ -65,6 +65,7 @@ CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
 CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optimal
 CM_SOLVER_AOT_STORE = PREFIX_SOLVER + "aotStore"        # dir path; "" = off
 CM_SOLVER_AOT_BACKGROUND = PREFIX_SOLVER + "aotBackground"  # auto | true | false
+CM_SOLVER_TOPOLOGY = PREFIX_SOLVER + "topology"         # auto | true | false
 
 # the tri-state device-path gates share one value domain; solver.policy and
 # solver.gateVerify have their own. All parse through _parse_choice: an
@@ -179,6 +180,12 @@ class SchedulerConf:
     # from cpu/host until the half-open probe reclaims the tier); "false" =
     # compile inline (the legacy first-cycle stall)
     solver_aot_background: str = "auto"
+    # topology-aware placement (topology/): ICI-domain contention penalty +
+    # gang-contiguous steering in the batched score, topology-ordered
+    # preemption candidates, mesh-aligned pack partitioning. "auto" = on
+    # when the fleet carries topology labels (a no-op otherwise); "false"
+    # keeps every solver path bit-identical to the pre-topology programs.
+    solver_topology: str = "auto"
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -377,6 +384,7 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             (CM_SOLVER_GATE_DEVICE, "solver_gate_device", TRI_STATE),
             (CM_SOLVER_GATE_VERIFY, "solver_gate_verify", ("true", "false")),
             (CM_SOLVER_AOT_BACKGROUND, "solver_aot_background", TRI_STATE),
+            (CM_SOLVER_TOPOLOGY, "solver_topology", TRI_STATE),
             (CM_SOLVER_POLICY, "solver_policy", SOLVER_POLICIES)):
         if key in data:
             setattr(conf, attr, _parse_choice(key, data[key], allowed))

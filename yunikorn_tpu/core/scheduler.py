@@ -165,6 +165,13 @@ class SolverOptions:
     # proves infeasible falls back for the cycle). "greedy" = the
     # rank-ordered argmin only.
     policy: str = "greedy"
+    # topology-aware placement (solver.topology): ICI-domain contention
+    # penalty + per-gang preferred-domain steering in the batched score,
+    # topology-ordered preemption candidates, and the mesh-aligned pack
+    # partitioner (topology/ package). Tri-state: None = "auto" = on when
+    # the fleet carries topology labels, a no-op otherwise; "false" keeps
+    # every solver path bit-identical to the pre-topology programs.
+    topology: Optional[bool] = None
 
     @classmethod
     def from_conf(cls, conf) -> "SolverOptions":
@@ -195,6 +202,8 @@ class SolverOptions:
             policy=("optimal"
                     if str(getattr(conf, "solver_policy", "auto")).lower()
                     == "optimal" else "greedy"),
+            topology=tri.get(
+                getattr(conf, "solver_topology", "auto"), None),
         )
 
 
@@ -254,6 +263,11 @@ class _SolveHandle:
     # cycle ships O(changed) node state + the row-store req gather, not a
     # full re-upload (None when greedy ran on cpu/host or mesh-sharded)
     device_state: Optional[dict] = None
+    # mesh-sharded counterpart + whether the greedy solve actually ran on
+    # the mesh this cycle (the sharded pack dispatch follows the greedy
+    # solve's layout so the two plans see identical committed state)
+    mesh_state: Optional[dict] = None
+    used_mesh: bool = False
 
 
 class CoreScheduler(SchedulerAPI):
@@ -456,12 +470,42 @@ class CoreScheduler(SchedulerAPI):
         self._g_pack_ms = m.gauge(
             "pack_last_plan_ms",
             "dispatch-to-decision latency of the most recent pack plan (ms)")
+        # ---- topology-aware placement (round 15, solver.topology) ----
+        self._m_topo_cross = m.counter(
+            "topology_cross_domain_gangs_total",
+            "gangs (applications placing >= 2 pods in one cycle) whose "
+            "placements spanned more than one ICI domain — the cost the "
+            "topology-aware score exists to minimize")
+        self._m_topo_gangs = m.counter(
+            "topology_gangs_total",
+            "gangs (applications placing >= 2 pods in one cycle) committed "
+            "while topology accounting was active — the denominator for the "
+            "cross-domain ratio")
+        self._g_topo_frag = m.gauge(
+            "topology_domain_fragmentation",
+            "ICI-domain fragmentation of the fleet's free capacity in "
+            "[0, 1]: 0 = all free capacity in one domain, rising toward 1 "
+            "as it scatters (topology/model.fragmentation)")
+        self._m_pack_partitioner = m.counter(
+            "pack_partitioner_total",
+            "pack-solver dispatches by partitioner mode (random = POP "
+            "seeded permutation, topo = mesh-aligned ICI-domain-boundary "
+            "partitioning)", labelnames=("mode",))
+        # stats of the most recent topology fold (domains, gangs planned,
+        # refined groups, fragmentation); ride the cycle entry
+        self._last_topo_stats: dict = {}
+        # resolved solver.topology tri-state for the current cycle (set per
+        # cycle: "auto" follows whether the fleet carries topology labels)
+        self._topology_active = False
         # stats of the most recent pack comparison (chosen policy, util
         # ratio, plan ms); ride the cycle entry and the solve tracer span
         self._last_pack_stats: dict = {}
         # single-device mirror used by the most recent greedy device
-        # dispatch (stashed by _dispatch_solve for the pack dispatch)
+        # dispatch (stashed by _dispatch_solve for the pack dispatch),
+        # plus its mesh-sharded counterpart and whether the mesh ran
         self._last_solve_device_state = None
+        self._last_solve_mesh_state = None
+        self._last_solve_used_mesh = False
         # stats of the most recent gate pass (path, passes, sub-stage ms);
         # ride the cycle entry and the gate tracer span
         self._last_gate_stats: dict = {}
@@ -1202,9 +1246,13 @@ class CoreScheduler(SchedulerAPI):
             except Exception:
                 logger.exception("device node-state refresh failed; "
                                  "falling back to per-cycle upload")
-        # single-device mirror stashed for the cycle's pack dispatch (the
-        # mesh mirror is sharded; pack is ineligible under a mesh anyway)
+        # mirror stashed for the cycle's pack dispatch: single-device and
+        # mesh-sharded separately — the sharded pack wrapper reuses the
+        # mesh mirror's committed shardings (device_put recognizes them and
+        # skips the transfer), the single-device pack the unsharded one
         self._last_solve_device_state = device_state if not use_mesh else None
+        self._last_solve_mesh_state = device_state if use_mesh else None
+        self._last_solve_used_mesh = use_mesh
         jc0 = assign_mod.jit_cache_entries()
         # AOT background mode: a store miss on this (device) tier raises
         # CompilePending instead of stalling the cycle on an XLA compile —
@@ -1232,6 +1280,11 @@ class CoreScheduler(SchedulerAPI):
             except Exception:
                 logger.exception("sharded-mesh dispatch failed; this cycle "
                                  "solves single-device")
+                # the pack dispatch follows the greedy solve's layout: a
+                # failed mesh must not route pack onto the mesh it just
+                # watched fail (h.used_mesh contract)
+                self._last_solve_used_mesh = False
+                self._last_solve_mesh_state = None
         if result is None:
             result = solve_batch(batch, self.encoder.nodes, policy=policy,
                                  max_rounds=so.max_rounds, chunk=so.chunk,
@@ -1352,12 +1405,16 @@ class CoreScheduler(SchedulerAPI):
             return lambda: self._solve_tier_dispatch(h, tier)
 
         self._last_solve_device_state = None
+        self._last_solve_mesh_state = None
+        self._last_solve_used_mesh = False
         result, tier = self.supervisor.execute(
             "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
             commit_success=False)
         h.result, h.tier = result, tier
         if tier == "device":
             h.device_state = self._last_solve_device_state
+            h.mesh_state = self._last_solve_mesh_state
+            h.used_mesh = self._last_solve_used_mesh
         if allow_mesh:
             self._pack_dispatch(h)
         return h
@@ -1390,17 +1447,31 @@ class CoreScheduler(SchedulerAPI):
 
         from yunikorn_tpu.ops import pack_solve as pack_mod
 
+        n_shards = 1
         if self._mesh is not None:
             from yunikorn_tpu.parallel import mesh as mesh_mod
 
             if not mesh_mod.PACK_SHARDED_SUPPORTED:
                 return "mesh"
+            # the sharded pack (mesh-aligned partitioner) needs whole parts
+            # per shard; shape_supported verifies with the shard count
+            n_shards = self._mesh.devices.size
         if batch.locality is not None:
             return "locality"
         if batch.g_ports.view(np.uint32).any():
             return "ports"
         if not pack_mod.shape_supported(batch.req.shape[0],
-                                        self.encoder.nodes.capacity):
+                                        self.encoder.nodes.capacity,
+                                        n_shards=n_shards):
+            # under a mesh the shard-count requirement is the binding one
+            # (pick_parts doubles in powers of two, so e.g. a 6-device mesh
+            # can never split into whole parts per shard) — name it
+            # distinctly; single-device pack under a live mesh stays off by
+            # design (it would resharded-gather every solve arg per cycle,
+            # the round-12 rationale)
+            if n_shards > 1 and pack_mod.shape_supported(
+                    batch.req.shape[0], self.encoder.nodes.capacity):
+                return "mesh-shape"
             return "shape"
         return None
 
@@ -1420,20 +1491,49 @@ class CoreScheduler(SchedulerAPI):
             return
         from yunikorn_tpu.ops import pack_solve as pack_mod
 
+        # sharded pack follows the greedy solve onto the mesh (same layout,
+        # same committed mirror); otherwise single-device, with the
+        # mesh-aligned "topo" partitioner whenever topology steering is on.
+        # A mesh cycle whose greedy solve did NOT run on the mesh (degraded
+        # tier, failed mesh dispatch) skips pack outright: the single-device
+        # fallback would re-upload the full node tensors per cycle — the
+        # round-12 transfer cost the mesh gate exists to avoid
+        use_mesh_pack = h.used_mesh and self._mesh is not None
+        if self._mesh is not None and not use_mesh_pack:
+            self._m_pack.inc(outcome="skipped")
+            self._last_pack_stats = {"policy": "greedy", "skip": "mesh"}
+            return
         h.pack_t0 = time.perf_counter()
+        mode = ("topo" if (use_mesh_pack
+                           or getattr(h.batch, "topo", None) is not None)
+                else "random")
+        if use_mesh_pack:
+            from yunikorn_tpu.parallel import mesh as mesh_mod
+
+            def pack_fn(pending):
+                return mesh_mod.pack_solve_sharded(
+                    h.batch, self.encoder.nodes, self._mesh,
+                    policy=h.policy, free_delta=h.overlay,
+                    node_mask=h.node_mask, ports_delta=h.inflight_ports,
+                    seed=self._cycle_seq, chunk=self.solver.chunk,
+                    device_state=h.mesh_state, aot_pending=pending)
+        else:
+            def pack_fn(pending):
+                return pack_mod.pack_solve_batch(
+                    h.batch, self.encoder.nodes, policy=h.policy,
+                    free_delta=h.overlay, node_mask=h.node_mask,
+                    ports_delta=h.inflight_ports, seed=self._cycle_seq,
+                    chunk=self.solver.chunk, device_state=h.device_state,
+                    aot_pending=pending, partitioner=mode)
         try:
             from yunikorn_tpu.aot import pending_enabled
 
             h.pack = self.supervisor.run(
-                "pack",
-                lambda: pack_mod.pack_solve_batch(
-                    h.batch, self.encoder.nodes, policy=h.policy,
-                    free_delta=h.overlay, node_mask=h.node_mask,
-                    ports_delta=h.inflight_ports, seed=self._cycle_seq,
-                    chunk=self.solver.chunk,
-                    device_state=h.device_state,
-                    aot_pending=pending_enabled()),
+                "pack", lambda: pack_fn(pending_enabled()),
                 commit_success=False)
+            # counted only on a dispatch that actually produced a plan, so
+            # the mode ratio stays comparable to pack_plans_total outcomes
+            self._m_pack_partitioner.inc(mode=mode)
         except AbandonedDispatch:
             raise  # zombie thread: stop, don't continue a stale cycle
         except pack_mod.PackUnsupported as e:
@@ -1501,6 +1601,7 @@ class CoreScheduler(SchedulerAPI):
             "pack_plan_ms": round(plan_ms, 2),
             "pack_placed": stats["pack"]["placed"],
             "greedy_placed": stats["greedy"]["placed"],
+            "partitioner": getattr(h.pack, "partitioner", "random"),
         }
         return pack_assigned if use_pack else greedy_assigned
 
@@ -1683,13 +1784,28 @@ class CoreScheduler(SchedulerAPI):
     def _preempt_candidate_nodes(self) -> List[str]:
         """Candidate nodes in cache order, restricted to rows the encoder
         holds as schedulable — passed to BOTH planners so the device's
-        node_order ranking and the host loop walk identical lists."""
+        node_order ranking and the host loop walk identical lists.
+
+        With topology active the list is re-ranked toward freeing
+        CONTIGUOUS ICI domains (topology/score.preempt_node_order): nodes
+        in the domains holding the most free capacity come first, so victim
+        selection completes nearly-open domains instead of nibbling busy
+        ones. Because the single ordered list feeds both planners, the
+        device/host exact-parity contract is untouched."""
         na = self.encoder.nodes
         out = []
         for name in self.cache.node_names():
             idx = na.index_of(name)
             if idx is not None and na.valid[idx] and na.schedulable[idx]:
                 out.append(name)
+        if self._topology_on():
+            from yunikorn_tpu.topology.score import preempt_node_order
+
+            try:
+                out = preempt_node_order(out, na)
+            except Exception:
+                logger.exception("topology preempt ordering failed; cache "
+                                 "order stands")
         return out
 
     def _preempt_device_enabled(self) -> bool:
@@ -1961,6 +2077,7 @@ class CoreScheduler(SchedulerAPI):
                                                     extra_placed=inflight_placed)
             self._resolve_solver_runtime()
             self._attach_device_req(admitted, batch)
+            self._attach_topology(admitted, batch, overlay=overlay)
             t_encode = time.time()
             policy = self._policy_for_partition()
             handle = self._solve_dispatch(admitted, batch, policy, overlay,
@@ -1977,6 +2094,7 @@ class CoreScheduler(SchedulerAPI):
             (new_allocs, skipped_keys, unplaced_asks, fallback_keys,
              fb_rounds) = self._commit_solve(admitted, batch, assigned,
                                              policy, node_mask, cycle_id=cid)
+            self._note_topology_commit(new_allocs)
         if new_allocs or replaced.new:
             self._m_allocated.inc(len(new_allocs) + len(replaced.new))
         if skipped_keys:
@@ -2020,6 +2138,7 @@ class CoreScheduler(SchedulerAPI):
                 entry["encode_device_bytes"] = self._last_encode_device["bytes"]
             entry.update(_gate_extras(self._last_gate_stats))
             entry.update(_pack_extras(self._last_pack_stats))
+            entry.update(_topo_extras(self._last_topo_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
@@ -2213,6 +2332,11 @@ class CoreScheduler(SchedulerAPI):
             overlay = self._inflight_overlay()
             inflight_ports = self._inflight_ports()
             self.encoder.sync_nodes()
+            # topology fold at DISPATCH time with the same in-flight
+            # overlay the solve subtracts: the domain busy/free state and
+            # the gang-domain plan see exactly the capacity the fit checks
+            # will see
+            self._attach_topology(cyc.admitted, batch, overlay=overlay)
             cyc.policy = self._policy_for_partition()
             self._resolve_solver_runtime_locked()
             self.supervisor.cycle_id = cyc.cycle_id
@@ -2281,6 +2405,7 @@ class CoreScheduler(SchedulerAPI):
                                              cyc.policy, None,
                                              node_names=cyc.node_names,
                                              cycle_id=cyc.cycle_id)
+            self._note_topology_commit(new_allocs)
             if new_allocs:
                 self._m_allocated.inc(len(new_allocs))
             if skipped_keys:
@@ -2317,6 +2442,7 @@ class CoreScheduler(SchedulerAPI):
                 entry["encode_device_bytes"] = cyc.encode_device["bytes"]
             entry.update(_gate_extras(cyc.gate_stats))
             entry.update(_pack_extras(self._last_pack_stats))
+            entry.update(_topo_extras(self._last_topo_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
                 entry["fallback_placed"] = len(fallback_keys)
@@ -2782,6 +2908,117 @@ class CoreScheduler(SchedulerAPI):
                 "bytes": store.last_upload_bytes,
             }
 
+    # -------------------------------------------- topology-aware placement
+    # solver.topology (round 15): the ICI-domain model (topology/) steers
+    # the batched score — BandPilot-style contention penalty + per-gang
+    # preferred-domain plan through refined constraint groups — orders
+    # preemption candidates toward freeing contiguous domains, and switches
+    # the pack solver to the mesh-aligned domain-boundary partitioner. All
+    # of it is score/ordering-level: with the tri-state off (or a fleet
+    # with no topology labels) batch.topo stays None and every solver path
+    # runs the exact pre-topology program.
+
+    def _topology_on(self) -> bool:
+        t = getattr(self.solver, "topology", None)
+        if t is False:
+            return False
+        if t is True:
+            return True
+        return self.encoder.nodes.has_topology
+
+    def _attach_topology(self, admitted, batch, overlay=None) -> None:
+        """Fold the topology steering args onto the batch for this cycle's
+        dispatch (core lock held, nodes synced). `overlay` is the in-flight
+        allocation overlay the solve itself will subtract — the gang
+        planner must see the same overlay-reduced free capacity or a
+        domain filled by still-in-flight commits looks open. Scope gates
+        mirror the pack solver's: locality and host-port batches keep
+        their base group ids (their side tables are keyed by them)."""
+        import numpy as np
+
+        batch.topo = None
+        self._last_topo_stats = {}
+        self._topology_active = self._topology_on()
+        if not self._topology_active:
+            return
+        na = self.encoder.nodes
+        try:
+            from yunikorn_tpu.topology import score as topo_score
+            from yunikorn_tpu.topology.model import fleet_fragmentation
+
+            if (batch.locality is None
+                    and not batch.g_ports.view(np.uint32).any()):
+                # domain stickiness: node rows of each batch app's EXISTING
+                # allocations (O(batch apps' allocations), not O(cluster));
+                # built only for batches inside the steering scope — the
+                # gated ones would discard it
+                app_rows: Dict[str, List[int]] = {}
+                for ask in admitted[: batch.num_pods]:
+                    app = self.partition.applications.get(ask.application_id)
+                    if app is None or ask.application_id in app_rows:
+                        continue
+                    rows = []
+                    for alloc in app.allocations.values():
+                        idx = na.index_of(alloc.node_id)
+                        if idx is not None:
+                            rows.append(idx)
+                    app_rows[ask.application_id] = rows
+                batch.topo = topo_score.build_topo_args(
+                    admitted, batch, na, app_rows, free_delta=overlay)
+            if batch.topo is not None:
+                s = batch.topo.stats
+                frag = s["fragmentation"]
+                self._last_topo_stats = {
+                    "fragmentation": frag,
+                    "gangs": s["gangs"], "domains": s["domains"]}
+            else:
+                # scope-gated or unlabeled batch: keep the gauge live from
+                # a direct aggregate (build_topo_args did not run) — with
+                # the SAME in-flight overlay the steered branch subtracts,
+                # or the gauge jumps between batch types with no fleet
+                # change
+                frag = fleet_fragmentation(na, free_delta=overlay)
+                self._last_topo_stats = {"fragmentation": frag}
+            self._g_topo_frag.set(frag)
+        except Exception:
+            # steering is best-effort: a fold failure must never cost the
+            # cycle — the solve runs un-steered (the topology-off program)
+            batch.topo = None
+            logger.exception("topology fold failed; cycle runs un-steered")
+
+    def _note_topology_commit(self, new_allocs) -> None:
+        """Commit-side gang/domain accounting: count gangs (apps placing
+        >= 2 pods this cycle) and those whose placements crossed an ICI
+        domain. Runs only while topology accounting is active."""
+        if not self._topology_active or not new_allocs:
+            return
+        na = self.encoder.nodes
+        doms_of_app: Dict[str, set] = {}
+        for a in new_allocs:
+            idx = na.index_of(a.node_id)
+            dom = int(na.topo[idx, 2]) if idx is not None else -1
+            doms_of_app.setdefault(a.application_id, set()).add(dom)
+        counts_of_app: Dict[str, int] = {}
+        for a in new_allocs:
+            counts_of_app[a.application_id] = (
+                counts_of_app.get(a.application_id, 0) + 1)
+        gangs = cross = 0
+        for app, n in counts_of_app.items():
+            if n < 2:
+                continue
+            gangs += 1
+            doms = doms_of_app[app]
+            # "in one domain" = every member on the SAME labeled domain;
+            # any unlabeled node or spread across domains counts as cross
+            if len(doms) != 1 or -1 in doms:
+                cross += 1
+        if gangs:
+            self._m_topo_gangs.inc(gangs)
+            self._last_topo_stats["cycle_gangs"] = gangs
+            self._last_topo_stats["cycle_cross_domain"] = cross
+        if cross:
+            self._m_topo_cross.inc(cross)
+
     def _gate_queue_meta(self, by_queue, cluster_cap: Resource) -> Dict[str, tuple]:
         """qname -> (leaf, dominant_share, priority_adjustment), cached.
 
@@ -3240,9 +3477,23 @@ def _pack_extras(stats: dict) -> dict:
     the committed policy plus the A/B numbers when a comparison ran."""
     out = {"solver_policy": stats.get("policy", "greedy")}
     for k in ("pack_util", "pack_plan_ms", "pack_placed", "greedy_placed",
-              "skip"):
+              "partitioner", "skip"):
         if k in stats:
             out["pack_skip" if k == "skip" else k] = stats[k]
+    return out
+
+
+def _topo_extras(stats: dict) -> dict:
+    """Topology-fold stats (solver.topology) for the cycle entry: domain
+    fragmentation plus gang-plan/commit counts when steering engaged."""
+    out = {}
+    for src, dst in (("fragmentation", "topo_fragmentation"),
+                     ("gangs", "topo_gangs"),
+                     ("domains", "topo_domains"),
+                     ("cycle_gangs", "topo_cycle_gangs"),
+                     ("cycle_cross_domain", "topo_cycle_cross_domain")):
+        if src in stats:
+            out[dst] = stats[src]
     return out
 
 

@@ -51,6 +51,18 @@ from yunikorn_tpu.ops.predicates import group_feasibility, group_preferred_bonus
 # binary must not dial the TPU before it means to
 NEG_INF = -3.0e38
 
+# ---- topology steering weights (solver.topology, topology/score.py) ----
+# Scores are base_scores ∈ [0,1] plus soft adjustments of comparable scale.
+# The gang term must DOMINATE base-score differences (a gang member must
+# prefer its planned ICI domain over a marginally better-packed node in a
+# foreign domain) without being able to override feasibility — it is a
+# score, argmax/water-fill ordering only. The contention/empty terms are
+# deliberately mild: tie-breakers between otherwise comparable nodes, the
+# BandPilot-style "avoid co-tenant-loaded interconnects" pressure.
+TOPO_GANG_W = 8.0        # node is in the gang's planned ICI domain
+TOPO_CONTENTION_W = 0.25  # × co-tenant busy fraction of the node's domain
+TOPO_EMPTY_W = 0.5       # the node's domain is co-tenant-free
+
 
 @dataclasses.dataclass
 class SolveResult:
@@ -178,11 +190,14 @@ def _loc_soft_scores(gid_rows, dom_cols, loc, cnt, minc, contrib_rows):
 
 def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
                         base_scores, chunk: int, policy: str,
-                        score_cols: int = 0):
+                        score_cols: int = 0, node_dom=None, pref_pod=None):
     """For every pod: (best node, any feasible?) without materializing [N, M].
 
     Locality rules/scores arrive pre-folded into group_feas/group_soft (the
     per-round [G, M] hoist in `solve`), so this stage is pure gather + fit.
+    node_dom/pref_pod (topology steering): per-pod preferred-ICI-domain
+    bonus — a gang pod whose contiguous proposal failed still prefers its
+    planned domain in the argmax fallback.
     """
     N, R = req.shape
     M = free.shape[0]
@@ -203,6 +218,11 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
             s = score_cols if score_cols > 0 else R
             scores = scores + alignment_scores(
                 creq[:, :s], free[:, :s], capacity[:, :s])
+        if node_dom is not None and pref_pod is not None:
+            cpref = lax.dynamic_slice(pref_pod, (start,), (chunk,))    # [C]
+            in_pref = ((cpref[:, None] >= 0) & (node_dom[None, :] >= 0)
+                       & (node_dom[None, :] == cpref[:, None]))
+            scores = scores + jnp.where(in_pref, TOPO_GANG_W, 0.0)
         scores = jnp.where(ok, scores, NEG_INF)
         best = jnp.argmax(scores, axis=1).astype(jnp.int32)            # [C]
         feasible = jnp.any(ok, axis=1)                                 # [C]
@@ -529,12 +549,17 @@ def _loc_update_counts(cnt, loc, accepted, best, M):
     return cnt
 
 
-def _segment_prefix_accept(snode, sreq, free_ext, M):
+def _segment_prefix_accept(snode, sreq, free, M):
     """Accept the per-node-segment prefix of sorted requests that fits.
 
     snode: [N] int32 sorted node ids (M = dummy/no-candidate, sorts last)
     sreq:  [N, R] int32 requests in sorted order
-    free_ext: [M+1, R] int32
+    free:  [M, R] int32 — dummy rows (snode == M) are masked explicitly
+           rather than read from an extended [M+1] array: the odd row
+           count shards UNEVENLY under GSPMD, and XLA:CPU's partitioner
+           was observed to zero local row (M // n_shards) of every shard
+           when scattering into the padded dimension (the root cause of
+           the two seed-era test_parallel free_after mismatches)
     returns accept_sorted [N] bool
     """
     N = snode.shape[0]
@@ -545,9 +570,11 @@ def _segment_prefix_accept(snode, sreq, free_ext, M):
     cums = jnp.cumsum(sreq, axis=0, dtype=jnp.int32)                   # wraps ok
     base = jnp.where((head > 0)[:, None], cums[jnp.maximum(head - 1, 0)], 0)
     prefix = cums - base                                               # [N, R]
-    node_free = free_ext[snode]                                        # [N, R]
+    real = snode < M
+    node_free = jnp.where(real[:, None],
+                          free[jnp.clip(snode, 0, M - 1)], 0)          # [N, R]
     fits = jnp.all(prefix <= node_free, axis=1)
-    return fits & (snode < M)
+    return fits & real
 
 
 def _hoist_group_state(g_term_req, g_term_forb, g_term_valid, g_anyof,
@@ -654,15 +681,154 @@ def _hoist_loc_state(loc, group_id_full, G):
             (g_ref_spread, g_ref_anti, g_ref_seed, g_ref_soft, g_skew_l))
 
 
-def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
-                  free_ext0, cnt0, capacity, loc, loc_hoist, *,
-                  max_rounds, chunk, policy, use_pallas, pallas_interpret,
-                  has_loc_soft, pallas_soft, score_cols):
-    """The assignment round loop for one pod slice against hoisted group
-    state. free_ext0 [M+1, R] and cnt0 [L, D] carry across chained chunks;
-    the return keeps their shapes so a lax.scan can thread them."""
+def _seg_sat_scan(vals, seg_start):
+    """Segmented SATURATING inclusive scan along axis 0.
+
+    vals [L, R] int32 (non-negative, pre-clipped to CAP); seg_start [L]
+    bool marks segment heads. The operator ((f1,s1),(f2,s2)) -> (f1|f2,
+    where(f2, s2, min(s1+s2, CAP))) is associative for non-negative values
+    (the saturating-add argument from _water_fill_proposals, lifted to
+    segments), so the scan is exact below the cap and conservatively large
+    at it — positions degraded by saturation overflow to the fallback
+    proposal, never to a wrong accept."""
+    CAP = jnp.int32(2**30 - 1)
+
+    def op(a, b):
+        fa, sa = a
+        fb, sb = b
+        return fa | fb, jnp.where(fb, sb, jnp.minimum(sa + sb, CAP))
+
+    flags = seg_start[:, None]
+    _, out = lax.associative_scan(op, (jnp.broadcast_to(flags, vals.shape),
+                                       vals), axis=0)
+    return out
+
+
+def _topo_gang_proposals(pref_pod, rank, active, req, free, node_dom,
+                         base_scores):
+    """ICI-contiguous gang proposals: the segmented per-domain water-fill.
+
+    Every steered pod (pref_pod >= 0, its gang's planned target domain from
+    topology/score.plan_gang_domains) is proposed into its domain by the
+    same capacity-coverage rule the per-group water-fill uses — nodes of
+    the domain ordered best-score-first, cumulative free capacity vs the
+    rank-ordered cumulative demand of the domain's steered pods — but
+    computed for ALL domains at once with ONE merged sort per resource
+    column: O((M+N) log(M+N) · R) per round, independent of how many gangs
+    the batch carries — topology steering adds a near-constant round cost
+    instead of multiplying the per-group water-fill's vmap.
+
+    Returns proposals [N] int32 (node row, or M when the pod is unsteered,
+    its domain's capacity is exhausted at its position, or saturation made
+    the position conservative — all of which fall back to the base
+    proposal / argmax and from there to ordinary spill behavior).
+    """
     N, R = req.shape
-    M = free_ext0.shape[0] - 1
+    M = free.shape[0]
+    CAP = jnp.int32(2**30 - 1)
+    BIG = jnp.int32(2**30)
+    idx_m = jnp.arange(M, dtype=jnp.int32)
+
+    # domain-major node order, best score first inside a domain; unlabeled
+    # nodes form a trailing segment no pod key can reach (BIG vs BIG+1)
+    dkey_n = jnp.where(node_dom >= 0, node_dom, BIG)
+    order_n = jnp.lexsort((idx_m, -base_scores, dkey_n))
+    nd_s = dkey_n[order_n]                                         # [M]
+    nfree = jnp.minimum(jnp.maximum(free[order_n], 0), CAP)
+    seg_n = jnp.concatenate([jnp.array([True]), nd_s[1:] != nd_s[:-1]])
+    cumF = _seg_sat_scan(nfree, seg_n)                             # [M, R]
+
+    mine = active & (pref_pod >= 0)
+    dkey_p = jnp.where(mine, pref_pod, BIG + 1)
+    order_p = jnp.lexsort((rank, dkey_p))
+    pd_s = dkey_p[order_p]                                         # [N]
+    dem = jnp.minimum(jnp.where(mine[order_p, None], req[order_p], 0), CAP)
+    seg_p = jnp.concatenate([jnp.array([True]), pd_s[1:] != pd_s[:-1]])
+    cumD = _seg_sat_scan(dem, seg_p)                               # [N, R]
+
+    # per-domain searchsorted via one merged sort per column: pods sort
+    # BEFORE nodes on equal values (side="left" semantics), and a pod's
+    # in-segment count of preceding nodes is exactly the first node
+    # position whose cumulative capacity covers its cumulative demand
+    L = M + N
+    keys_dom = jnp.concatenate([nd_s, pd_s])
+    keys_tag = jnp.concatenate([jnp.ones((M,), jnp.int32),
+                                jnp.zeros((N,), jnp.int32)])
+    idx_l = jnp.arange(L, dtype=jnp.int32)
+    pos = jnp.zeros((N,), jnp.int32)
+    for r in range(R):
+        keys_val = jnp.concatenate([cumF[:, r], cumD[:, r]])
+        o = jnp.lexsort((keys_tag, keys_val, keys_dom))
+        isnode = keys_tag[o]
+        c = jnp.cumsum(isnode)
+        seg = jnp.concatenate([jnp.array([True]),
+                               keys_dom[o][1:] != keys_dom[o][:-1]])
+        head = lax.cummax(jnp.where(seg, idx_l, 0))
+        base = jnp.where(head > 0, c[jnp.maximum(head - 1, 0)], 0)
+        pos_elem = (c - base) - isnode          # nodes strictly before
+        pos_all = jnp.zeros((L,), jnp.int32).at[o].set(pos_elem)
+        pos = jnp.maximum(pos, pos_all[M:])                        # [N]
+
+    dom_lo = jnp.searchsorted(nd_s, pd_s, side="left",
+                              method="sort").astype(jnp.int32)
+    dom_hi = jnp.searchsorted(nd_s, pd_s, side="right",
+                              method="sort").astype(jnp.int32)
+    ok = mine[order_p] & (pos < dom_hi - dom_lo)
+    node_s = jnp.where(ok, order_n[jnp.clip(dom_lo + pos, 0, M - 1)], M)
+    return jnp.full((N,), M, jnp.int32).at[order_p].set(
+        node_s.astype(jnp.int32))
+
+
+def _topo_node_adj(topo):
+    """The node-level topology score term (the BandPilot contention
+    penalty): co-tenant busy fraction of the node's ICI domain, plus a
+    domain-empty bonus. Group-independent, so callers fold the returned
+    [M] adjustment into every group_soft row — the whole steered-solve
+    cost stays independent of how many gangs the batch carries (the
+    per-gang preferred-domain term is per-POD: _topo_gang_proposals for
+    the proposal stage, the pref gather in _best_nodes_chunked for the
+    argmax fallback).
+
+    topo = (node_dom [M] i32 node → ICI-domain id (-1 unlabeled),
+            pref_pod [N] i32 planned target domain per ask (-1 none),
+            dom_busy [D] i32 co-tenant busy units per domain,
+            dom_cap [D] i32 capacity units per domain)
+    """
+    node_dom, _pref_pod, dom_busy, dom_cap = topo
+    D = dom_busy.shape[0]
+    dcl = jnp.clip(node_dom, 0, D - 1)
+    has_dom = node_dom >= 0
+    busy = dom_busy[dcl].astype(jnp.float32)
+    frac = busy / jnp.maximum(dom_cap[dcl].astype(jnp.float32), 1.0)
+    return jnp.where(
+        has_dom,
+        TOPO_EMPTY_W * (busy == 0).astype(jnp.float32)
+        - TOPO_CONTENTION_W * frac,
+        0.0)                                                       # [M]
+
+
+def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
+                  free0, cnt0, capacity, loc, loc_hoist, *,
+                  max_rounds, chunk, policy, use_pallas, pallas_interpret,
+                  has_loc_soft, pallas_soft, score_cols, topo_rt=None):
+    """The assignment round loop for one pod slice against hoisted group
+    state. free0 [M, R] and cnt0 [L, D] carry across chained chunks; the
+    return keeps their shapes so a lax.scan can thread them. The free
+    carry is exactly [M, R] — no extended dummy row: an [M+1] row count
+    shards unevenly under GSPMD and XLA:CPU's partitioner miscompiled the
+    dummy-row scatter (see _segment_prefix_accept).
+
+    topo_rt (topology steering, solver.topology): (node_dom [M], pref_pod
+    [N]) — the node-level contention term is already folded into
+    group_soft by the caller; this adds the per-pod gang-domain steering:
+    the ICI-contiguous proposals from the segmented per-domain fill
+    (_topo_gang_proposals) override the group water-fill proposal wherever
+    they name a feasible node, and the argmax fallback carries the same
+    preferred-domain bonus per pod. Nothing here scales with gang count —
+    the bit-identical-off contract holds because topo_rt=None recovers the
+    exact pre-topology round body."""
+    N, R = req.shape
+    M = free0.shape[0]
     has_loc = loc is not None
     if has_loc:
         (loc_spread_l, loc_aff_l, loc_softspread_l, loc_anti_l,
@@ -673,7 +839,7 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
         g_capped = None
         g_rr_dom = None
     init = (
-        free_ext0,
+        free0,
         ~valid,                                     # "done" = assigned or invalid
         jnp.full((N,), -1, jnp.int32),              # assignment
         jnp.full((N,), -1, jnp.int32),              # accept round per pod
@@ -690,8 +856,7 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
     sc = score_cols if score_cols > 0 else R
 
     def body(state):
-        free_ext, done, assigned, around, rnd, stalls, cnt = state
-        cur_free = free_ext[:M]
+        cur_free, done, assigned, around, rnd, stalls, cnt = state
         base_scores = node_base_scores(cur_free[:, :sc], capacity[:, :sc],
                                        policy)
         active = ~done
@@ -710,17 +875,34 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
             loc_mask_g = None
             feas_round, soft_round = group_feas, group_soft
 
-        proposals = _water_fill_proposals(req, group_id, rank, active, feas_round,
-                                          cur_free, base_scores, soft_round,
-                                          g_rr_dom, g_capped)
-        prop_fits = jnp.all(free_ext[proposals] >= req, axis=1) & (proposals < M)
+        proposals = _water_fill_proposals(req, group_id, rank, active,
+                                          feas_round, cur_free, base_scores,
+                                          soft_round, g_rr_dom, g_capped)
+        if topo_rt is not None:
+            # the segmented per-domain gang fill: its proposal wins
+            # wherever it names a feasible node — fit is re-checked by
+            # prop_fits below exactly like every other proposal
+            node_dom_t, pref_pod = topo_rt
+            tprop = _topo_gang_proposals(pref_pod, rank, active, req,
+                                         cur_free, node_dom_t, base_scores)
+            tp_ok = ((tprop < M)
+                     & feas_round[group_id, jnp.clip(tprop, 0, M - 1)])
+            proposals = jnp.where(tp_ok, tprop, proposals)
+        prop_real = proposals < M
+        prop_fits = prop_real & jnp.all(
+            jnp.where(prop_real[:, None],
+                      cur_free[jnp.clip(proposals, 0, M - 1)] - req, -1) >= 0,
+            axis=1)
         if has_loc:
             # proposals must also satisfy the dynamic locality rules
             prop_fits &= loc_mask_g[group_id, jnp.clip(proposals, 0, M - 1)]
 
         def with_argmax(_):
             # exact per-pod argmax; guarantees ≥1 accept per contended node
-            if use_pallas and policy != "align":
+            if use_pallas and policy != "align" and topo_rt is None:
+                # the fused kernel has no per-pod domain-bonus input; the
+                # steered argmax takes the XLA path (proposals — where the
+                # steering mostly lands — are kernel-independent anyway)
                 from yunikorn_tpu.ops.pallas_kernels import pallas_best_nodes
 
                 best, feasible = pallas_best_nodes(
@@ -730,7 +912,9 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
             else:
                 best, feasible = _best_nodes_chunked(
                     req, group_id, feas_round, soft_round, cur_free, capacity,
-                    base_scores, chunk, policy, score_cols)
+                    base_scores, chunk, policy, score_cols,
+                    node_dom=topo_rt[0] if topo_rt is not None else None,
+                    pref_pod=topo_rt[1] if topo_rt is not None else None)
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
 
@@ -745,7 +929,7 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
         order = jnp.lexsort((rank, node_key))       # primary: node, secondary: rank
         snode = node_key[order]
         sreq = req[order]
-        accept_sorted = _segment_prefix_accept(snode, sreq, free_ext, M)
+        accept_sorted = _segment_prefix_accept(snode, sreq, cur_free, M)
         if has_loc:
             # soft-spread groups get a per-domain allowance of ceil(remaining
             # pods / domains): the batch balances across domains within a
@@ -760,10 +944,11 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
                                             loc_spread_l, loc_aff_l, loc_anti_l,
                                             loc_min_skew_l, allowance_l,
                                             g_ref_masks, loc[9], g_capped)
-        # commit accepted capacity
+        # commit accepted capacity (accepted rows always have snode < M;
+        # rejected rows carry a zero delta, so the clipped scatter target
+        # for dummy rows receives nothing)
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
-        free_ext = free_ext.at[snode].add(-delta)
-        free_ext = free_ext.at[M].set(0)
+        cur_free = cur_free.at[jnp.clip(snode, 0, M - 1)].add(-delta)
         accepted = jnp.zeros((N,), bool).at[order].set(accept_sorted)
         assigned = jnp.where(accepted, best, assigned)
         around = jnp.where(accepted, rnd, around)
@@ -772,11 +957,11 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
         done = done | accepted
         progress = jnp.any(accept_sorted)
         stalls = jnp.where(progress, 0, stalls + 1)
-        return free_ext, done, assigned, around, rnd + 1, stalls, cnt
+        return cur_free, done, assigned, around, rnd + 1, stalls, cnt
 
-    (free_ext, done, assigned, around, rounds, _,
+    (free_out, done, assigned, around, rounds, _,
      cnt_final) = lax.while_loop(cond, body, init)
-    return assigned, around, free_ext, rounds, cnt_final
+    return assigned, around, free_out, rounds, cnt_final
 
 
 @functools.partial(
@@ -802,6 +987,9 @@ def solve(
                     #  contrib [N,L], g_refs [G,S], g_kind, g_skew, g_seed,
                     #  g_weight [G,S] f32 — soft-slot score weights,
                     #  pair [L] int32 — holder→primary group pairing)
+    topo=None,      # topology steering tuple (see _topo_node_adj /
+                    # _topo_gang_proposals); None = the exact pre-topology
+                    # program (the solver.topology=off contract)
     *,
     max_rounds: int = 16,
     chunk: int = 512,
@@ -845,24 +1033,30 @@ def solve(
         g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
         node_labels, node_taints, node_taints_soft, node_ports, node_ok,
         host_group_mask, host_group_soft)
+    topo_rt = None
+    if topo is not None:
+        # node-level contention/empty-domain term folded into every group
+        # row (group-independent), per-pod gang steering threaded into the
+        # round loop; the core never sets topo on locality batches
+        group_soft = group_soft + _topo_node_adj(topo)[None, :]
+        topo_rt = (topo[0], topo[1])
 
     has_loc = loc is not None
-    free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
     cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
     # the pallas kernel needs its soft input whenever the per-round hoist
     # folds soft-locality scores into it (both flags are static)
     pallas_soft = pallas_has_soft or has_loc_soft
     loc_hoist = (_hoist_loc_state(loc, group_id, group_feas.shape[0])
                  if has_loc else None)
-    assigned, around, free_ext, rounds, cnt_final = _solve_rounds(
-        req, group_id, rank, valid, group_feas, group_soft, free_ext0, cnt0,
+    assigned, around, free_after, rounds, cnt_final = _solve_rounds(
+        req, group_id, rank, valid, group_feas, group_soft, free, cnt0,
         capacity, loc, loc_hoist, max_rounds=max_rounds, chunk=chunk,
         policy=policy, use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         has_loc_soft=has_loc_soft, pallas_soft=pallas_soft,
-        score_cols=score_cols)
+        score_cols=score_cols, topo_rt=topo_rt)
     # cnt_final rides out so the chunked scan path can reuse _solve_rounds
     # with carried locality domain counts
-    return assigned, around, free_ext[:M], rounds, cnt_final
+    return assigned, around, free_after, rounds, cnt_final
 
 
 @functools.partial(
@@ -877,6 +1071,7 @@ def solve_chunked(
     g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
     node_labels, node_taints, node_taints_soft, node_ports, node_ok,
     free, capacity, host_group_mask=None, host_group_soft=None, loc=None,
+    topo=None,
     *,
     chunk_pods: int,
     max_rounds: int = 16,
@@ -920,21 +1115,30 @@ def solve_chunked(
         g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
         node_labels, node_taints, node_taints_soft, node_ports, node_ok,
         host_group_mask, host_group_soft)
+    if topo is not None:
+        # hoisted OUT of the chain like the group state: one score fold
+        # shared by every chunk (see solve)
+        group_soft = group_soft + _topo_node_adj(topo)[None, :]
 
     has_loc = loc is not None
     pallas_soft = pallas_has_soft or has_loc_soft
     loc_hoist = (_hoist_loc_state(loc, group_id, group_feas.shape[0])
                  if has_loc else None)
-    free_ext0 = jnp.concatenate([free, jnp.zeros((1, R), jnp.int32)], axis=0)
     cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
 
     xs = (req.reshape(K, mb, R), group_id.reshape(K, mb),
           rank.reshape(K, mb), valid.reshape(K, mb))
     if has_loc:
         xs = xs + (loc[3].reshape(K, mb, loc[3].shape[1]),)
+    if topo is not None:
+        xs = xs + (topo[1].reshape(K, mb),)            # pref_pod
 
     def scan_body(carry, x):
-        free_ext, cnt, round_base = carry
+        free_k, cnt, round_base = carry
+        topo_rt_k = None
+        if topo is not None:
+            x, cpref = x[:-1], x[-1]
+            topo_rt_k = (topo[0], cpref)
         if has_loc:
             creq, cgid, crank, cvalid, ccontrib = x
             l = list(loc)
@@ -943,20 +1147,21 @@ def solve_chunked(
         else:
             creq, cgid, crank, cvalid = x
             loc_k = None
-        a_k, ar_k, free_ext, r_k, cnt = _solve_rounds(
-            creq, cgid, crank, cvalid, group_feas, group_soft, free_ext, cnt,
+        a_k, ar_k, free_k, r_k, cnt = _solve_rounds(
+            creq, cgid, crank, cvalid, group_feas, group_soft, free_k, cnt,
             capacity, loc_k, loc_hoist, max_rounds=max_rounds, chunk=chunk,
             policy=policy, use_pallas=use_pallas,
             pallas_interpret=pallas_interpret, has_loc_soft=has_loc_soft,
-            pallas_soft=pallas_soft, score_cols=score_cols)
+            pallas_soft=pallas_soft, score_cols=score_cols,
+            topo_rt=topo_rt_k)
         # offset accept rounds so the chain's order is globally monotone (a
         # later chunk's round 0 happens after every earlier chunk's rounds)
         ar_k = jnp.where(ar_k >= 0, ar_k + round_base, -1)
-        return (free_ext, cnt, round_base + r_k), (a_k, ar_k, r_k)
+        return (free_k, cnt, round_base + r_k), (a_k, ar_k, r_k)
 
-    (free_ext, cnt, _), (assigned_k, around_k, rounds_k) = lax.scan(
-        scan_body, (free_ext0, cnt0, jnp.int32(0)), xs)
-    return (assigned_k.reshape(N), around_k.reshape(N), free_ext[:M],
+    (free_after, cnt, _), (assigned_k, around_k, rounds_k) = lax.scan(
+        scan_body, (free, cnt0, jnp.int32(0)), xs)
+    return (assigned_k.reshape(N), around_k.reshape(N), free_after,
             jnp.sum(rounds_k), cnt)
 
 
@@ -978,10 +1183,11 @@ SOLVE_ARG_NAMES = (
     "g_term_req", "g_term_forb", "g_term_valid", "g_anyof", "g_anyof_valid",
     "g_tol", "g_ports", "g_pref_req", "g_pref_forb", "g_pref_weight",
     "node_labels", "node_taints", "node_taints_soft", "node_ports", "node_ok",
-    "free", "capacity", "host_mask", "host_soft", "loc",
+    "free", "capacity", "host_mask", "host_soft", "loc", "topo",
 )
 _ARG_RANK = SOLVE_ARG_NAMES.index("rank")
 _ARG_LOC = SOLVE_ARG_NAMES.index("loc")
+_ARG_TOPO = SOLVE_ARG_NAMES.index("topo")
 
 
 def _unsort(order, *arrays):
@@ -1017,6 +1223,11 @@ def _sort_pods_by_rank(np_args):
         l = list(loc)
         l[3] = np.asarray(loc[3])[order]          # contrib [N, L]
         out[_ARG_LOC] = tuple(l)
+    topo = np_args[_ARG_TOPO]
+    if topo is not None:
+        t = list(topo)
+        t[1] = np.asarray(topo[1])[order]         # pref_pod [N]
+        out[_ARG_TOPO] = tuple(t)
     return tuple(out), order
 
 
@@ -1117,7 +1328,8 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
             node_ok = node_ok & jnp.asarray(node_mask[: node_ok.shape[0]])
         return _finish_solve_args(batch, req_i, score_cols, dev["labels"],
                                   dev["taints_hard"], dev["taints_soft"],
-                                  node_ports_u32, node_ok, free_i, cap_i, na)
+                                  node_ports_u32, node_ok, free_i, cap_i, na,
+                                  topo_mirror=dev.get("topo"))
     free_i = np.floor(na.free).astype(np.int32)
     if free_delta is not None:
         # overlay may be narrower/shorter than the (possibly grown) node arrays
@@ -1173,10 +1385,14 @@ def prepare_solve_args(batch, node_arrays, *, free_delta=None, node_mask=None,
 
 
 def _finish_solve_args(batch, req_i, score_cols, labels, taints_hard,
-                       taints_soft, node_ports, node_ok, free_i, cap_i, na):
+                       taints_soft, node_ports, node_ok, free_i, cap_i, na,
+                       topo_mirror=None):
     """Common tail of prepare_solve_args: pod/group args + static kwargs.
     Node-side inputs may be host numpy or persistent device arrays — the two
-    variants produce identical avals, so they share one compiled program."""
+    variants produce identical avals, so they share one compiled program.
+    topo_mirror: the persistent device mirror's [M, 3] topo tensor (the
+    use_device path) — the node→domain column then rides the mirror
+    (O(node-object-change) transfer) instead of re-uploading per cycle."""
     import numpy as np
 
     host_mask = batch.g_host_mask
@@ -1191,6 +1407,31 @@ def _finish_solve_args(batch, req_i, score_cols, labels, taints_hard,
         loc = (lb.dom, lb.cnt0, lb.dom_valid, lb.contrib,
                lb.g_refs, lb.g_kind, lb.g_skew, lb.g_seed, lb.g_weight,
                lb.pair)
+    # topology steering (solver.topology, topology/score.TopoArgs) rides
+    # its own slot. batch.topo is attached per cycle by the core — None
+    # keeps the exact pre-topology arg tuple (the bit-identical-off
+    # contract).
+    topo = None
+    topo_args = getattr(batch, "topo", None)
+    if topo_args is not None and loc is None:
+        M_ = free_i.shape[0]
+        if topo_mirror is not None and topo_mirror.shape[0] == M_:
+            # device path: the node→domain column comes from the persistent
+            # mirror (already resident; a tiny device-side slice)
+            node_dom = topo_mirror[:, 2]
+        else:
+            node_dom = topo_args.node_dom
+            if node_dom.shape[0] != M_:
+                # node capacity grew since the fold: unlabeled-pad the tail
+                nd = np.full((M_,), -1, np.int32)
+                nd[: min(M_, node_dom.shape[0])] = node_dom[:M_]
+                node_dom = nd
+        pref = topo_args.pref_pod
+        if pref.shape[0] != req_i.shape[0]:
+            pp = np.full((req_i.shape[0],), -1, np.int32)
+            pp[: min(pp.shape[0], pref.shape[0])] = pref[: pp.shape[0]]
+            pref = pp
+        topo = (node_dom, pref, topo_args.dom_busy, topo_args.dom_cap)
     np_args = (
         req_i,
         batch.group_id,
@@ -1216,14 +1457,17 @@ def _finish_solve_args(batch, req_i, score_cols, labels, taints_hard,
         host_mask,
         host_soft,
         loc,
+        topo,
     )
     assert len(np_args) == len(SOLVE_ARG_NAMES)
     static_kwargs = dict(
         has_loc_soft=(batch.locality is not None
                       and bool(np.any(batch.locality.g_weight))),
         # no-soft batches take the kernel variant without the soft DMA/matmul
+        # (topology steering is itself a soft-score channel)
         pallas_has_soft=(bool(batch.g_pref_weight.any())
                          or host_soft is not None
+                         or topo is not None
                          or bool(np.any(na.taints_soft))),
         # scoring ignores the synthetic port columns appended above
         score_cols=score_cols,

@@ -49,9 +49,10 @@ The solve is three fixed-shape stages inside ONE jitted program:
               `pack_plans_total{outcome=infeasible}`).
 
 Scope (explicitly gated by the core, not silently mis-handled): batches with
-locality constraints, host-port requests, or a sharded mesh
-(parallel.mesh.PACK_SHARDED_SUPPORTED) fall back to greedy for the cycle —
-PackUnsupported names the reason. The differential contract with greedy is
+locality constraints or host-port requests fall back to greedy for the
+cycle — PackUnsupported names the reason. Mesh-sharded cycles pack too
+since round 15 (`parallel.mesh.pack_solve_sharded` + the mesh-aligned
+`partitioner="topo"` mode below). The differential contract with greedy is
 pinned by tests/test_pack_solve.py and enforced at runtime by the core's
 choose_plan comparison: the pack plan commits only when its packed objective
 beats the greedy plan's, otherwise the cycle falls back (the
@@ -74,6 +75,7 @@ from yunikorn_tpu.ops.assign import (
     _hoist_group_state,
     _segment_prefix_accept,
     _solve_rounds,
+    _topo_node_adj,
     prepare_solve_args,
 )
 
@@ -104,11 +106,15 @@ class PackUnsupported(Exception):
     caller must keep the greedy plan for the cycle."""
 
 
-def pick_parts(n_pods: int, n_nodes: int) -> int:
+def pick_parts(n_pods: int, n_nodes: int, n_shards: int = 1) -> int:
     """Standard partition-count bucket for a (pods, nodes) shape.
 
     Deterministic in the shape alone, so every compiled program variant is
-    keyed by the same standard buckets the encoder already pads to."""
+    keyed by the same standard buckets the encoder already pads to.
+    n_shards (the mesh-aligned topology mode): the part count is floored at
+    the GSPMD shard count — each device shard then holds a whole number of
+    parts, so part boundaries land on shard boundaries and every part's
+    relaxation state is chip-local under the static node sharding."""
     k = 1
     while (k < MAX_PARTS
            and n_pods % (2 * k) == 0 and n_nodes % (2 * k) == 0
@@ -116,17 +122,24 @@ def pick_parts(n_pods: int, n_nodes: int) -> int:
            and n_nodes // (2 * k) >= _MIN_PART_NODES
            and (n_pods // k) * (n_nodes // k) > _CELL_BUDGET):
         k *= 2
+    while (k < n_shards
+           and n_pods % (2 * k) == 0 and n_nodes % (2 * k) == 0):
+        k *= 2
     return k
 
 
-def shape_supported(n_pods: int, n_nodes: int) -> bool:
+def shape_supported(n_pods: int, n_nodes: int, n_shards: int = 1) -> bool:
     """Whether a (padded pods, node capacity) shape is packable: non-empty
-    and partitionable within the cell budget. The core pre-gates on this
-    BEFORE the supervised dispatch — a deterministic scope gate must skip
-    cheaply, not ride the supervisor's transient-retry/breaker machinery."""
+    and partitionable within the cell budget (and, for the mesh-aligned
+    mode, into at least one whole part per shard). The core pre-gates on
+    this BEFORE the supervised dispatch — a deterministic scope gate must
+    skip cheaply, not ride the supervisor's transient-retry/breaker
+    machinery."""
     if n_pods < 1 or n_nodes < 1:
         return False
-    k = pick_parts(n_pods, n_nodes)
+    k = pick_parts(n_pods, n_nodes, n_shards)
+    if k < n_shards or k % max(n_shards, 1) != 0:
+        return False
     return (n_pods // k) * (n_nodes // k) <= 4 * _CELL_BUDGET
 
 
@@ -139,6 +152,7 @@ class PackResult:
     feasible: jnp.ndarray
     n_parts: int
     seed: int
+    partitioner: str = "random"
 
     def block_until_ready(self):
         self.assigned.block_until_ready()
@@ -200,12 +214,10 @@ def _round_part(preq, prank, pvalid, feas, scores, nfree, ncap, size_key,
     the CURRENT free capacity (the LP prices are what stay fixed)."""
     n, R = preq.shape
     m = nfree.shape[0]
-    free_ext0 = jnp.concatenate([nfree, jnp.zeros((1, R), jnp.int32)], axis=0)
-    init = (free_ext0, ~pvalid, jnp.full((n,), -1, jnp.int32))
+    init = (nfree, ~pvalid, jnp.full((n,), -1, jnp.int32))
 
     def body(i, state):
-        free_ext, done, assigned = state
-        cur = free_ext[:m]
+        cur, done, assigned = state
         margin = jnp.full((n, m), jnp.int32(2**30))
         for r in range(R):                       # static unroll, like greedy
             margin = jnp.minimum(margin,
@@ -222,22 +234,22 @@ def _round_part(preq, prank, pvalid, feas, scores, nfree, ncap, size_key,
         order = jnp.lexsort((prank, -size_key, node_key))
         snode = node_key[order]
         sreq = preq[order]
-        accept_sorted = _segment_prefix_accept(snode, sreq, free_ext, m)
+        accept_sorted = _segment_prefix_accept(snode, sreq, cur, m)
         delta = jnp.where(accept_sorted[:, None], sreq, 0)
-        free_ext = free_ext.at[snode].add(-delta)
-        free_ext = free_ext.at[m].set(0)
+        cur = cur.at[jnp.clip(snode, 0, m - 1)].add(-delta)
         accepted = jnp.zeros((n,), bool).at[order].set(accept_sorted)
         assigned = jnp.where(accepted, best, assigned)
-        return free_ext, done | accepted, assigned
+        return cur, done | accepted, assigned
 
-    free_ext, _, assigned = lax.fori_loop(0, rounds, body, init)
-    return assigned, free_ext[:m]
+    free_left, _, assigned = lax.fori_loop(0, rounds, body, init)
+    return assigned, free_left
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("n_parts", "lp_iters", "round_rounds", "repair_rounds",
-                     "chunk", "policy", "score_cols"),
+    static_argnames=("n_parts", "partitioner", "n_shards", "lp_iters",
+                     "round_rounds", "repair_rounds", "chunk", "policy",
+                     "score_cols"),
 )
 def pack_solve(
     req, group_id, rank, valid,
@@ -245,9 +257,12 @@ def pack_solve(
     g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
     node_labels, node_taints, node_taints_soft, node_ports, node_ok,
     free, capacity, host_group_mask=None, host_group_soft=None, loc=None,
+    topo=None,
     seed=0,
     *,
     n_parts: int,
+    partitioner: str = "random",
+    n_shards: int = 1,
     lp_iters: int = LP_ITERS,
     round_rounds: int = ROUND_ROUNDS,
     repair_rounds: int = REPAIR_ROUNDS,
@@ -256,9 +271,23 @@ def pack_solve(
     score_cols: int = 0,
 ):
     """One global pack solve. Positional args mirror `ops.assign.solve` (the
-    prepare_solve_args tuple) so the two paths cannot drift on arg prep;
-    `seed` is a traced int32 so reseeding never recompiles. Returns
-    (assigned [N] i32, free_after [M, R] i32)."""
+    prepare_solve_args tuple, including the topology steering tuple) so the
+    two paths cannot drift on arg prep; `seed` is a traced int32 so
+    reseeding never recompiles. Returns (assigned [N] i32, free_after
+    [M, R] i32, feasible bool).
+
+    partitioner="topo" is the mesh-aligned ICI-domain partitioner: instead
+    of POP's random node permutation, nodes are ordered by (GSPMD shard,
+    ICI domain, row) and cut into K equal parts — part boundaries land on
+    domain boundaries wherever the domain layout allows, and (with
+    n_shards > 1) always on shard boundaries, so a sharded mesh solves
+    whole parts chip-locally instead of fighting the static node sharding
+    (`parallel.mesh.PACK_SHARDED_SUPPORTED`). The node order is a traced
+    function of node_dom — deterministic per input, part count still keyed
+    only on the bucketed shape, parts still disjoint by construction.
+    Unlabeled fleets degrade to (shard, row) order, which is exactly the
+    shard-aligned identity cut. Pod partitioning stays the seeded random
+    permutation in both modes (POP's ask-side variance reduction)."""
     if loc is not None:
         raise PackUnsupported("locality batches take the greedy path")
     N, R = req.shape
@@ -272,6 +301,13 @@ def pack_solve(
         g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
         node_labels, node_taints, node_taints_soft, node_ports, node_ok,
         host_group_mask, host_group_soft)
+    if topo is not None:
+        # same node-level contention/empty-domain term as the greedy solve
+        # — the pack LP then optimizes the same contention-aware objective.
+        # Per-gang domain steering stays a greedy-proposal concern: pack's
+        # seeded Gumbel rounding has no proposal stage to override, and
+        # choose_plan keeps the steered greedy plan as the floor.
+        group_soft = group_soft + _topo_node_adj(topo)[None, :]
 
     # column normalization for the relaxation: prices and loads compare
     # per-resource magnitudes, which span orders of magnitude across vocab
@@ -281,7 +317,17 @@ def pack_solve(
 
     kp, kn, kr = jax.random.split(jax.random.PRNGKey(seed), 3)
     pods_part = jax.random.permutation(kp, N).reshape(K, n)
-    nodes_part = jax.random.permutation(kn, M).reshape(K, m)
+    if partitioner == "topo":
+        node_dom = (topo[0] if topo is not None
+                    else jnp.full((M,), -1, jnp.int32))
+        idx_m = jnp.arange(M, dtype=jnp.int32)
+        shard_id = idx_m // jnp.int32(M // max(n_shards, 1))
+        # unlabeled nodes sort after every labeled domain within their shard
+        dom_key = jnp.where(node_dom >= 0, node_dom, jnp.int32(2**30))
+        order = jnp.lexsort((idx_m, dom_key, shard_id))
+        nodes_part = order.astype(jnp.int32).reshape(K, m)
+    else:
+        nodes_part = jax.random.permutation(kn, M).reshape(K, m)
     part_keys = jax.random.split(kr, K)
 
     def solve_part(x):
@@ -318,10 +364,13 @@ def pack_solve(
     assigned_parts, free_parts = lax.map(solve_part,
                                          (pods_part, nodes_part, part_keys))
 
-    assigned = jnp.full((N,), -1, jnp.int32).at[pods_part.reshape(N)].set(
-        assigned_parts.reshape(N))
-    free_after = jnp.zeros((M, R), jnp.int32).at[nodes_part.reshape(M)].set(
-        free_parts.reshape(M, R))
+    # un-permute via inverse-permutation GATHERS, not scatters: both index
+    # vectors are permutations, so vals[argsort(perm)] is exactly the
+    # scatter out[perm[i]] = vals[i] — and gathers partition cleanly under
+    # GSPMD where the equivalent scatter was observed to drop rows on the
+    # sharded CPU mesh (pinned by the round-15 sharded-pack parity test)
+    assigned = assigned_parts.reshape(N)[jnp.argsort(pods_part.reshape(N))]
+    free_after = free_parts.reshape(M, R)[jnp.argsort(nodes_part.reshape(M))]
 
     # repair: asks the partition stranded run the unmodified greedy round
     # loop over the FULL node set with the parts' residual capacity — the
@@ -329,16 +378,13 @@ def pack_solve(
     # placements (and the proof-by-construction that pack feasibility is
     # exactly greedy feasibility)
     leftover = valid & (assigned < 0)
-    free_ext0 = jnp.concatenate(
-        [free_after, jnp.zeros((1, R), jnp.int32)], axis=0)
-    rep_assigned, _, free_ext, _, _ = _solve_rounds(
-        req, group_id, rank, leftover, group_feas, group_soft, free_ext0,
+    rep_assigned, _, free_after, _, _ = _solve_rounds(
+        req, group_id, rank, leftover, group_feas, group_soft, free_after,
         jnp.zeros((1, 1), jnp.int32), capacity, None, None,
         max_rounds=repair_rounds, chunk=min(chunk, N), policy=policy,
         use_pallas=False, pallas_interpret=False, has_loc_soft=False,
         pallas_soft=False, score_cols=score_cols)
     assigned = jnp.where(assigned >= 0, assigned, rep_assigned)
-    free_after = free_ext[:M]
     # structural feasibility: placements only subtract what fits, so every
     # cell must sit at or above min(initial free, 0) — a pre-existing
     # negative column stays untouched, a non-negative one stays
@@ -353,7 +399,8 @@ def pack_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
                      round_rounds: int = ROUND_ROUNDS,
                      repair_rounds: int = REPAIR_ROUNDS,
                      chunk: int = 512, device_state=None,
-                     aot_pending: bool = False) -> PackResult:
+                     aot_pending: bool = False,
+                     partitioner: Optional[str] = None) -> PackResult:
     """Host wrapper: PodBatch + NodeArrays in → async PackResult out.
 
     Shares `prepare_solve_args` with the greedy paths (same dtype views,
@@ -363,7 +410,12 @@ def pack_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
     greedy dispatch used this cycle (read-only reuse — node tensors and the
     row-store req gather then transfer O(changed), not O(M)+O(N·R), per
     optimal cycle). Raises PackUnsupported for batches outside the model
-    (locality, host ports, non-bucketed shapes)."""
+    (locality, host ports, non-bucketed shapes).
+
+    partitioner: None resolves to "topo" (the mesh-aligned ICI-domain
+    partitioner) when the batch carries topology steering args, else
+    "random" (POP's seeded permutation). Sharded-mesh dispatch lives in
+    `parallel.mesh.pack_solve_sharded`, which forces "topo"."""
     if batch.locality is not None:
         raise PackUnsupported("locality batches take the greedy path")
     if batch.g_ports.view(np.uint32).any():
@@ -383,19 +435,27 @@ def pack_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
             f"shape ({N} pods, {M} nodes) is not packable within the "
             "partitionable cell budget")
     n_parts = pick_parts(N, M)
+    if partitioner is None:
+        partitioner = ("topo"
+                       if np_args[SOLVE_ARG_NAMES.index("topo")] is not None
+                       else "random")
     solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
     from yunikorn_tpu.aot import runtime as aot_rt
 
     # seed rides positionally (it is a traced int32, reseeding never
-    # recompiles — the AOT fingerprint keys scalar leaves on dtype only)
+    # recompiles — the AOT fingerprint keys scalar leaves on dtype only);
+    # the partitioner mode is static, so it joins the AOT fingerprint with
+    # the topology tuple's treedef/shapes
     assigned, free_after, feasible = aot_rt.aot_call(
         "pack.solve", pack_solve, (*solve_args, jnp.int32(seed)),
-        dict(n_parts=n_parts, lp_iters=lp_iters, round_rounds=round_rounds,
+        dict(n_parts=n_parts, partitioner=partitioner,
+             lp_iters=lp_iters, round_rounds=round_rounds,
              repair_rounds=repair_rounds, chunk=chunk, policy=policy,
              score_cols=static_kwargs["score_cols"]),
         pending_ok=aot_pending)
     return PackResult(assigned=assigned, free_after=free_after,
-                      feasible=feasible, n_parts=n_parts, seed=seed)
+                      feasible=feasible, n_parts=n_parts, seed=seed,
+                      partitioner=partitioner)
 
 
 def packed_utilization(assigned, req_i, valid, free0_i=None,

@@ -40,6 +40,15 @@ cross-ask claimed-victim mask — the device equivalent of the host planner's
   5. choose the node minimizing (victim count, victim priority sum, cache
      order) lexicographically — the host planner's strict-< tie-breaking
 
+Topology-aware victim selection (solver.topology, round 15) changes none of
+this kernel: the `node_order` ranks BOTH planners consume are produced by
+the core, and with topology active they arrive pre-ordered toward freeing
+CONTIGUOUS ICI domains (topology/score.preempt_node_order — nodes in the
+domains holding the most free capacity rank first, so the budgeted search
+and the final tie-break both prefer completing a nearly-open domain over
+nibbling a busy one). One shared ordered list in, exact device/host parity
+preserved by construction.
+
 Resource arithmetic is int32 in device units: ask requests ceil, freed victim
 capacity floor — both conservative, and exact whenever quantities are integral
 in device units (the vocab scales are chosen for that). Priority sums clamp

@@ -34,15 +34,15 @@ from yunikorn_tpu.ops import assign as assign_mod
 
 NODE_AXIS = "nodes"
 
-# Explicit single-partition gating for the pack solver (solver.policy=
-# optimal, ops/pack_solve.py): its POP partitioning already re-permutes the
-# node dimension per seed, which fights GSPMD's static node sharding — a
-# sharded variant needs mesh-aligned partitions (part boundaries on shard
-# boundaries so each chip solves whole parts locally). Until that lands the
-# core skips the pack dispatch when a mesh is active (pack_plans_total
-# {outcome=skipped}); flipping this flag without the mesh-aligned
-# partitioner would resharded-gather every pack solve arg per cycle.
-PACK_SHARDED_SUPPORTED = False
+# Pack under a mesh (solver.policy=optimal + shardSolve): supported since
+# the mesh-aligned partitioner landed (round 15) — `pack_solve_sharded`
+# below dispatches ops/pack_solve with partitioner="topo", which orders
+# nodes by (GSPMD shard, ICI domain, row) and cuts parts on shard
+# boundaries, so every part's dense relaxation state is chip-local under
+# the static node sharding instead of fighting it the way POP's random
+# node permutation did. Differential parity vs the single-shard solve on
+# the same trace is pinned by tests/test_topology.py.
+PACK_SHARDED_SUPPORTED = True
 
 # Host bytes of the pod-side (replicated) solve args assembled by the LAST
 # solve_sharded call. Node-side tensors ride the persistent device mirror
@@ -127,7 +127,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
          g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
          g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
-         free_i, cap_i, host_mask, host_soft, loc) = cargs
+         free_i, cap_i, host_mask, host_soft, loc, topo) = cargs
         args = (
             put(req, repl), put(group_id, repl), put(rank, repl), put(valid, repl),
             put(g_term_req, repl), put(g_term_forb, repl), put(g_term_valid, repl),
@@ -146,7 +146,13 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         # and the per-round count updates are global reductions anyway
         loc_arg = (tuple(put(a, repl) for a in loc)
                    if loc is not None else None)
-        return args, mask_arg, soft_arg, loc_arg
+        # topology tuple: node_dom shards with the node dim, the [G']/[D]
+        # tables replicate (tiny; the refined-group gather is group-dim)
+        topo_arg = None
+        if topo is not None:
+            topo_arg = (put(topo[0], node_s),) + tuple(
+                put(a, repl) for a in topo[1:])
+        return args, mask_arg, soft_arg, loc_arg, topo_arg
 
     solve_kwargs = dict(
         max_rounds=max_rounds, chunk=min(chunk, min(N, mb)),
@@ -163,18 +169,18 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         # one compiled lax.scan program over [mb]-pod rank-ordered slices
         # (assign.solve_chunked) — same sharding layout, group state hoisted
         np_args_s, order = assign_mod._sort_pods_by_rank(np_args)
-        args, mask_arg, soft_arg, loc_arg = build_args(np_args_s)
+        args, mask_arg, soft_arg, loc_arg, topo_arg = build_args(np_args_s)
         ck = dict(solve_kwargs, chunk_pods=mb)
         with mesh:
             if compile_only:
                 aot_rt.aot_compile(
                     "mesh.solve_chunked", assign_mod.solve_chunked,
-                    (*args, mask_arg, soft_arg, loc_arg), ck,
+                    (*args, mask_arg, soft_arg, loc_arg, topo_arg), ck,
                     extra=aot_extra, lower_cm=mesh)
                 return None
             assigned, around, free_after, rounds, _ = aot_rt.aot_call(
                 "mesh.solve_chunked", assign_mod.solve_chunked,
-                (*args, mask_arg, soft_arg, loc_arg), ck,
+                (*args, mask_arg, soft_arg, loc_arg, topo_arg), ck,
                 pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
         if order is not None:
             assigned, around = assign_mod._unsort(order, assigned, around)
@@ -182,20 +188,100 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
             assigned=assigned, free_after=free_after, rounds=rounds,
             accept_round=around)
 
-    args, mask_arg, soft_arg, loc_arg = build_args(np_args)
+    args, mask_arg, soft_arg, loc_arg, topo_arg = build_args(np_args)
     with mesh:
         if compile_only:
             aot_rt.aot_compile(
                 "mesh.solve", assign_mod.solve,
-                (*args, mask_arg, soft_arg, loc_arg), solve_kwargs,
+                (*args, mask_arg, soft_arg, loc_arg, topo_arg), solve_kwargs,
                 extra=aot_extra, lower_cm=mesh)
             return None
         assigned, around, free_after, rounds, _ = aot_rt.aot_call(
             "mesh.solve", assign_mod.solve,
-            (*args, mask_arg, soft_arg, loc_arg), solve_kwargs,
+            (*args, mask_arg, soft_arg, loc_arg, topo_arg), solve_kwargs,
             pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after,
                                   rounds=rounds, accept_round=around)
+
+
+def pack_solve_sharded(batch, node_arrays, mesh: Mesh, *,
+                       policy: str = "binpacking", free_delta=None,
+                       node_mask=None, ports_delta=None, seed: int = 0,
+                       chunk: int = 512, device_state=None,
+                       aot_pending: bool = False):
+    """Node-dimension sharded dispatch of ops.pack_solve.pack_solve.
+
+    Same layout contract as solve_sharded — pod/group args replicate,
+    node-side tensors shard along M — with the partitioner forced to the
+    mesh-aligned "topo" mode: `pick_parts(..., n_shards=mesh size)` floors
+    the part count at the shard count and the (shard, ICI-domain, row)
+    node ordering cuts every part inside one shard, so the partition
+    layout composes with the static GSPMD node sharding instead of
+    fighting it the way POP's random permutation did. Placement parity vs
+    the single-shard program is pinned by tests/test_topology.py (it only
+    holds because the solve's free carry is exactly [M, R] — the round-15
+    root-cause fix for the uneven-shard dummy-row miscompile, see
+    ops/assign._segment_prefix_accept). Raises PackUnsupported when the
+    shape cannot split into whole parts per shard."""
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import SOLVE_ARG_NAMES
+
+    if batch.locality is not None:
+        raise pack_mod.PackUnsupported(
+            "locality batches take the greedy path")
+    if batch.g_ports.view(np.uint32).any():
+        raise pack_mod.PackUnsupported(
+            "host-port batches take the greedy path")
+    n_dev = mesh.devices.size
+    np_args, static_kwargs = assign_mod.prepare_solve_args(
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
+        ports_delta=ports_delta, device_state=device_state,
+        allow_req_device=False)
+    N = np_args[SOLVE_ARG_NAMES.index("req")].shape[0]
+    M = np_args[SOLVE_ARG_NAMES.index("free")].shape[0]
+    if not pack_mod.shape_supported(N, M, n_shards=n_dev):
+        raise pack_mod.PackUnsupported(
+            f"shape ({N} pods, {M} nodes) does not split into whole parts "
+            f"per shard over {n_dev} devices")
+    n_parts = pack_mod.pick_parts(N, M, n_shards=n_dev)
+
+    node_s, node_s2, repl = _shardings(mesh)
+    group_node_s = NamedSharding(mesh, P(None, NODE_AXIS))
+    put = jax.device_put
+    (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
+     g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
+     g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
+     free_i, cap_i, host_mask, host_soft, loc, topo) = np_args
+    args = (
+        put(req, repl), put(group_id, repl), put(rank, repl),
+        put(valid, repl),
+        put(g_term_req, repl), put(g_term_forb, repl),
+        put(g_term_valid, repl), put(g_anyof, repl),
+        put(g_anyof_valid, repl), put(g_tol, repl), put(g_ports, repl),
+        put(g_pref_req, repl), put(g_pref_forb, repl),
+        put(g_pref_weight, repl),
+        put(labels, node_s2), put(taints_hard, node_s2),
+        put(taints_soft, node_s2), put(ports, node_s2),
+        put(node_ok, node_s), put(free_i, node_s2), put(cap_i, node_s2),
+        put(host_mask, group_node_s) if host_mask is not None else None,
+        put(host_soft, group_node_s) if host_soft is not None else None,
+        None,  # loc: gated above
+        ((put(topo[0], node_s),) + tuple(put(a, repl) for a in topo[1:])
+         if topo is not None else None),
+    )
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    with mesh:
+        assigned, free_after, feasible = aot_rt.aot_call(
+            "mesh.pack_solve", pack_mod.pack_solve,
+            (*args, jnp.int32(seed)),
+            dict(n_parts=n_parts, partitioner="topo", n_shards=n_dev,
+                 chunk=chunk, policy=policy,
+                 score_cols=static_kwargs["score_cols"]),
+            pending_ok=aot_pending, extra=("mesh", n_dev), lower_cm=mesh)
+    return pack_mod.PackResult(assigned=assigned, free_after=free_after,
+                               feasible=feasible, n_parts=n_parts,
+                               seed=seed, partitioner="topo")
 
 
 def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int,

@@ -62,9 +62,13 @@ from yunikorn_tpu.snapshot.vocab import _next_pow2 as _bucket
 
 
 # device-mirror array names (single source: NodeArrays dirty marking and
-# DeviceNodeState uploads must agree, or a stale array is served as "clean")
+# DeviceNodeState uploads must agree, or a stale array is served as "clean").
+# "topo" is the [M, 3] interned (slice, rack, ici-domain) coordinate tensor
+# (topology/model.py): tiny, and it changes only when node OBJECTS change,
+# so mirroring it as its own field costs a 12-byte-per-node upload on label
+# churn and nothing on pod churn.
 DEVICE_FIELDS = ("free_i", "cap_i", "labels", "taints_hard", "taints_soft",
-                 "ports", "node_ok")
+                 "ports", "node_ok", "topo")
 
 # victim-table mirror (the batched preemption planner's node-side state).
 # Maintained lazily — sync_victims runs only on preemption-pressure cycles —
@@ -233,6 +237,12 @@ class PodBatch:
     # takes the persistent-device-state path. Values are pinned identical
     # to req.astype(int32). None = host req only.
     req_device: Optional[object] = None
+    # topology steering (topology/score.TopoArgs), attached per cycle by
+    # the core when solver.topology resolves on — prepare_solve_args folds
+    # it into the solve args (refined group ids + the topo tuple). None =
+    # the exact pre-topology program (the bit-identical-off contract).
+    # Scope-gated by the core: never set on locality or host-port batches.
+    topo: Optional[object] = None
 
     @property
     def placement_dependent(self) -> bool:
@@ -272,6 +282,18 @@ class NodeArrays:
         self.ports = np.zeros((m, self._Wp), np.uint32)
         self.schedulable = np.zeros((m,), bool)
         self.valid = np.zeros((m,), bool)
+        # fleet topology coordinates (topology/model.py): interned
+        # (slice, rack, ici-domain) ids per node, -1 = unlabeled. The ICI
+        # domain (col 2) is the contention/contiguity unit the solver
+        # steers on; interning maps survive re-allocation like the other
+        # symbol registries.
+        self.topo = np.full((m, 3), -1, np.int32)
+        self._topo_slice_ids: Dict[str, int] = getattr(
+            self, "_topo_slice_ids", {})
+        self._topo_rack_ids: Dict[str, int] = getattr(
+            self, "_topo_rack_ids", {})
+        self._topo_ici_ids: Dict[tuple, int] = getattr(
+            self, "_topo_ici_ids", {})
         # per-node victim tables for the batched preemption planner:
         # MAX_VICTIMS_PER_NODE rows per node in eviction order (priority asc,
         # newest first — ops.preempt.victim_table is the single source of the
@@ -314,7 +336,7 @@ class NodeArrays:
                 new[:old] = arr
                 setattr(self, arr_name, new)
             for arr_name, fill in (("victim_prio", VICTIM_PRIO_PAD),
-                                   ("victim_app", -1)):
+                                   ("victim_app", -1), ("topo", -1)):
                 arr = getattr(self, arr_name)
                 new = np.full((self.capacity,) + arr.shape[1:], fill, arr.dtype)
                 new[:old] = arr
@@ -395,6 +417,16 @@ class NodeArrays:
                     hp = p.get("hostPort")
                     if hp:
                         port_bits.append(self.vocabs.ports.bit(port_bit(p.get("protocol", "TCP"), hp)))
+        # topology coordinates (topology/model.py): intern the slice/rack/
+        # ici-domain label values; nodes without topology labels keep -1
+        from yunikorn_tpu.topology.model import parse_topology_labels
+
+        sl, rack, ici = parse_topology_labels(node.metadata.labels)
+        topo_row = (
+            self._intern(self._topo_slice_ids, sl),
+            self._intern(self._topo_rack_ids, rack),
+            self._intern(self._topo_ici_ids, ici),
+        )
 
         self._maybe_grow()
         idx = self._name_to_idx.get(node.name)
@@ -427,9 +459,32 @@ class NodeArrays:
             _set_bit(self.ports[idx], b)
         self.schedulable[idx] = schedulable and not node.spec.unschedulable
         self.valid[idx] = True
+        self.topo[idx] = topo_row
         self.version += 1
         self._dirty_fields |= set(DEVICE_FIELDS)
         return idx
+
+    @staticmethod
+    def _intern(registry: Dict, key) -> int:
+        if key is None:
+            return -1
+        v = registry.get(key)
+        if v is None:
+            v = registry[key] = len(registry)
+        return v
+
+    @property
+    def num_ici_domains(self) -> int:
+        """Distinct interned ICI domains ever seen (ids are dense, so this
+        is also the [D] aggregate-array length the topology scorer sizes)."""
+        return len(self._topo_ici_ids)
+
+    @property
+    def has_topology(self) -> bool:
+        """Any live node carries an ICI-domain coordinate (the
+        solver.topology=auto resolution input)."""
+        return (self.num_ici_domains > 0
+                and bool((self.topo[self.valid, 2] >= 0).any()))
 
     def update_free_row(self, name: str, info: NodeInfo) -> None:
         """Cheap path: refresh only the free-capacity row (pod churn)."""
@@ -471,6 +526,7 @@ class NodeArrays:
         self.taints_hard[idx] = 0
         self.taints_soft[idx] = 0
         self.ports[idx] = 0
+        self.topo[idx] = -1
         self._soft_taint_rows.discard(idx)
         self._clear_victim_row(idx)
         self._free_rows.append(idx)
@@ -605,6 +661,8 @@ class DeviceNodeState:
             return np.floor(na.capacity_arr).astype(np.int32)
         if field == "node_ok":
             return na.valid & na.schedulable
+        if field == "topo":
+            return na.topo
         return getattr(na, {"taints_hard": "taints_hard",
                             "taints_soft": "taints_soft",
                             "labels": "labels",

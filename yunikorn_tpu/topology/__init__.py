@@ -1,0 +1,36 @@
+"""Fleet topology model: slice / rack / ICI-domain coordinates and the
+contention- and topology-aware placement built on them (round 15).
+
+Real TPU fleets are not flat node lists: chips within one ICI domain talk
+over the inter-chip interconnect at orders of magnitude higher bandwidth
+than across domains, pod slices define which nodes can form a mesh at all,
+and co-tenant traffic inside a domain degrades everyone sharing it
+(BandPilot, PAPERS.md: performance-plus-contention-aware dispatch beats
+capacity-only scoring in AI clusters). This package turns node topology
+labels into dense integer coordinates on `NodeArrays` (mirrored to the
+device like every other node field) and derives the three consumers:
+
+  score.py      the solver-side steering — a contention-penalty /
+                domain-empty term in the batched score plus a per-gang
+                preferred-ICI-domain plan folded through refined constraint
+                groups (ops/assign.py consumes it behind `solver.topology`)
+  model.py      label parsing + interning, per-domain aggregates, the
+                fragmentation measure the obs gauge reports
+  (pack)        ops/pack_solve.py partitions along ICI-domain boundaries in
+                `partitioner="topo"` mode — the mesh-aligned partitioner
+                that lets `parallel.mesh.PACK_SHARDED_SUPPORTED` hold
+
+Everything is strictly additive: with `solver.topology=off` (or no topology
+labels anywhere) no topology argument is ever built and every solver path
+runs the exact program it ran before this package existed.
+"""
+from yunikorn_tpu.topology.model import (  # noqa: F401
+    LABEL_ICI_DOMAIN,
+    LABEL_RACK,
+    LABEL_SLICE,
+    TOPOLOGY_LABELS,
+    domain_free_units,
+    fragmentation,
+    normalize_topology_labels,
+    parse_topology_labels,
+)
