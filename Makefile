@@ -2,8 +2,8 @@
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke \
-	slo-smoke topology-smoke smoke lint run-scheduler run-admission dryrun \
-	clean image sched_image adm_image webtest_image
+	slo-smoke topology-smoke shard-smoke smoke lint run-scheduler \
+	run-admission dryrun clean image sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -108,7 +108,17 @@ topology-smoke:  ## topology-aware placement: model/steering/pack-partitioner su
 		python scripts/topology_bench.py --shapes 384x512x16 \
 		--assert-quality
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke  ## all tier-1 smoke targets
+shard-smoke:  ## control-plane sharding (solver.shards): ledger/partitioner/repair/parity suite (incl. the shard_parity differential oracle and the epoch re-seed storm) + a 4-shard gang-storm replay under --assert-slo with the shards fingerprint block + the shard A/B (N-shard placed/packed >= 0.97x single-shard, >= 1.5x cycle throughput, zero ledger violations)
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_shard.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --shards 4 --assert-slo
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/shard_bench.py --shape 2000x1000x64 --shards 1,4 \
+		--assert-quality
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke shard-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -99,8 +99,39 @@ def _downshift_for_cpu_fallback() -> None:
         N_PODS = int(os.environ.get("YK_BENCH_CPU_PODS", 10000))
 
 
+# injectable for the wedge regression tests (a real wedged dial can only be
+# abandoned by killing the process; tests substitute a raiser)
+_hard_exit = os._exit
+
+
+def _backend_unavailable_json(error: str, init_secs: float) -> str:
+    """The backend-unavailable JSON shape: every bench exit path emits a
+    PARSEABLE line with the full key set (the BENCH_r05 regression was
+    rc=124 with parsed:null — the driver window died before any JSON)."""
+    return json.dumps({
+        "metric": "backend-unavailable",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "error": error[:400],
+        "init_secs": round(init_secs, 1),
+        "degradations": {"transitions": [], "final": {}},
+        "gate_ms": 0.0,
+        "pod_encode_ms": 0.0,
+        "solver_policy": "greedy",
+        "pack_util": 0.0,
+        "pack_plan_ms": 0.0,
+        "cold_first_cycle_ms": 0.0,
+        "aot_hits": 0,
+        "aot_compiles": 0,
+        "slo": {},
+        "topology": {"mode": "off", "gangs_total": 0,
+                     "cross_domain_gangs": 0, "fragmentation": 0.0},
+    })
+
+
 def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
-                         cpu_fallback=None) -> str:
+                         cpu_fallback=None, parent_dial=None) -> str:
     """Initialize the JAX backend up front, retrying the TPU relay.
 
     Failure history: r1 died on a raw UNAVAILABLE; r2/r3 fell back to CPU on
@@ -124,13 +155,28 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
     early leaves the CPU fallback its whole reserve, so every bench round
     emits a parseable JSON result.
 
-    probe_fn/clock/sleep/cpu_fallback are injectable for the wedged-relay
-    regression test (a fake dialer must drive this loop without a relay).
+    The attempt cap also bounds TOTAL dial wall time (BENCH_r05 follow-up:
+    the cap alone did not stop a post-probe parent dial from wedging past
+    every budget — 9 x 150 s on the relay, rc=124): the whole dial phase is
+    bounded by min(window, attempts x per-dial timeout + slack), the
+    post-probe parent dial inherits the REMAINDER of that wall budget on a
+    joined thread instead of waiting forever, and a parent dial that blows
+    it emits the backend-unavailable JSON shape and exits — well inside
+    the dial budget, parseable, labeled.
+
+    probe_fn/clock/sleep/cpu_fallback/parent_dial are injectable for the
+    wedged-relay regression tests (a fake dialer must drive this loop
+    without a relay).
     """
     if probe_fn is None:
         probe_fn = _probe_backend
     if cpu_fallback is None:
         cpu_fallback = _cpu_fallback_platform
+    if parent_dial is None:
+        def parent_dial():
+            import jax
+
+            return jax.devices()
     if os.environ.get("YK_BENCH_FORCE_CPU"):
         # explicit CPU run (local testing): beat the axon plugin before any
         # backend init — the env var alone cannot (plugin overrides it).
@@ -147,6 +193,10 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
         budget = min(budget, float(os.environ["YK_BENCH_TPU_WAIT"]))
     dial_timeout = float(os.environ.get("YK_BENCH_TPU_DIAL_TIMEOUT", 150))
     max_attempts = max(1, int(os.environ.get("YK_BENCH_TPU_DIAL_ATTEMPTS", 2)))
+    # the attempt cap bounds WALL TIME too: N capped probes plus one
+    # parent dial plus backoff slack — 2 attempts documents as ~5 min of
+    # dialing, never the whole driver window
+    wall_cap = min(budget, max_attempts * dial_timeout + 60.0)
     attempt = 0
     backoff = 5.0
     probed = None
@@ -158,7 +208,7 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                   file=sys.stderr, flush=True)
             break
         attempt += 1
-        remaining = budget - (clock() - t0)
+        remaining = min(budget, wall_cap) - (clock() - t0)
         if remaining <= 0:
             break
         # the last attempt may not stretch past the budget: a wedged probe
@@ -172,11 +222,15 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                   f"{clock() - t_a:.1f}s: {n}x {platform}",
                   file=sys.stderr, flush=True)
             # The probe just held and released a relay claim, so the parent's
-            # own dial is expected to be fast — but it can still wedge (another
-            # client stole the claim) or raise. A raise resumes the probe
-            # loop; a wedge can't be killed in-process, so it is HEARTBEAT-ed
-            # (the relay was demonstrably alive seconds ago; waiting on a live
-            # claim queue is the known-good behavior, r2/r3 postmortem).
+            # own dial is expected to be fast — but it can still wedge
+            # (another client stole the claim) or raise. A raise resumes the
+            # probe loop. A wedge can't be killed in-process, so the dial
+            # runs on a joined thread bounded by the REMAINING dial wall
+            # budget (heartbeat-logged while waiting): r05's parent dial
+            # waited on the claim queue until the driver window died rc=124
+            # with parsed:null — now a blown wall budget emits the
+            # backend-unavailable JSON shape and exits while the budget
+            # still has headroom.
             t_d = time.time()
             hb_stop = threading.Event()
 
@@ -187,10 +241,39 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                           f"another client?)", file=sys.stderr, flush=True)
 
             threading.Thread(target=_hb, daemon=True).start()
-            try:
-                import jax
-                devs = jax.devices()
-            except Exception as e:
+            dial_box: dict = {}
+
+            def _dial():
+                try:
+                    dial_box["devs"] = parent_dial()
+                except Exception as e:  # delivered to the waiter below
+                    dial_box["error"] = e
+
+            dial_thread = threading.Thread(target=_dial, daemon=True)
+            dial_thread.start()
+            dial_wall = max(wall_cap - (clock() - t0),
+                            float(os.environ.get(
+                                "YK_BENCH_PARENT_DIAL_MIN", 30)))
+            dial_thread.join(dial_wall)
+            hb_stop.set()
+            if dial_thread.is_alive():
+                # wedged past the whole dial wall budget: the zombie thread
+                # cannot be reclaimed and the backend is half-initialized,
+                # so a CPU fallback in this process is not safe — emit the
+                # parseable backend-unavailable shape and exit NOW, inside
+                # the driver budget (os._exit: interpreter teardown under a
+                # wedged XLA dial can segfault after the verdict printed)
+                print(f"# bench: parent dial wedged past the dial wall "
+                      f"budget ({dial_wall:.0f}s); emitting "
+                      f"backend-unavailable and exiting",
+                      file=sys.stderr, flush=True)
+                print(_backend_unavailable_json(
+                    "parent dial wedged past the dial wall budget",
+                    clock() - t0), flush=True)
+                sys.stderr.flush()
+                _hard_exit(1)
+            if "error" in dial_box:
+                e = dial_box["error"]
                 print(f"# bench: parent dial failed after "
                       f"{time.time() - t_d:.1f}s: {type(e).__name__}: "
                       f"{str(e)[:300]}; resuming probe loop",
@@ -203,8 +286,8 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
                     jeb.clear_backends()
                 except Exception:
                     pass
-            finally:
-                hb_stop.set()
+            else:
+                devs = dial_box.get("devs")
             if devs is not None:
                 break
         else:
@@ -216,7 +299,7 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
         sleep(min(backoff, max(budget - (clock() - t0), 1.0)))
         backoff = min(backoff * 2, 60.0)
     if probed is None or devs is None:
-        print(f"# bench: TPU dial window ({budget:.0f}s of the "
+        print(f"# bench: TPU dial window ({wall_cap:.0f}s of the "
               f"{TOTAL_BUDGET:.0f}s total budget) exhausted after {attempt} "
               f"dial attempts; falling back to CPU (labeled)",
               file=sys.stderr, flush=True)
@@ -226,26 +309,8 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
             # CPU before first init rather than unwinding a failed TPU claim
             return cpu_fallback()
         except Exception as e2:  # no backend at all: one diagnostic JSON line
-            print(json.dumps({
-                "metric": "backend-unavailable",
-                "value": 0.0,
-                "unit": "pods/s",
-                "vs_baseline": 0.0,
-                "error": f"{type(e2).__name__}: {e2}"[:400],
-                "init_secs": round(clock() - t0, 1),
-                "degradations": {"transitions": [], "final": {}},
-                "gate_ms": 0.0,
-                "pod_encode_ms": 0.0,
-                "solver_policy": "greedy",
-                "pack_util": 0.0,
-                "pack_plan_ms": 0.0,
-                "cold_first_cycle_ms": 0.0,
-                "aot_hits": 0,
-                "aot_compiles": 0,
-                "slo": {},
-                "topology": {"mode": "off", "gangs_total": 0,
-                             "cross_domain_gangs": 0, "fragmentation": 0.0},
-            }))
+            print(_backend_unavailable_json(f"{type(e2).__name__}: {e2}",
+                                            clock() - t0))
             sys.exit(1)
     platform = devs[0].platform
     print(f"# bench: backend up in {clock() - t0:.1f}s "

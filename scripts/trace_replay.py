@@ -273,7 +273,8 @@ class ReplayStack:
         from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
         from yunikorn_tpu.client.kube import KubeConfig, RealAPIProvider
         from yunikorn_tpu.conf.schedulerconf import get_holder, reset_for_tests
-        from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+        from yunikorn_tpu.core.scheduler import SolverOptions
+        from yunikorn_tpu.core.shard import make_core_scheduler
         from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
         from yunikorn_tpu.obs.slo import SloOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
@@ -288,8 +289,8 @@ class ReplayStack:
         self.provider = RealAPIProvider(cfg)
         cache = SchedulerCache()
         conf = holder.get()
-        self.core = CoreScheduler(
-            cache, interval=conf.interval,
+        self.core = make_core_scheduler(
+            cache, shards=conf.solver_shards, interval=conf.interval,
             solver_options=SolverOptions.from_conf(conf),
             supervisor_options=SupervisorOptions.from_conf(conf),
             slo_options=SloOptions.from_conf(conf))
@@ -426,6 +427,9 @@ def run_replay(args, policy: str) -> dict:
         "robustness.breakerThreshold": "2",
         "robustness.probeIntervalSeconds": "1",
         "solver.topology": args.topology,
+        # control-plane sharding (core/shard.py): N pipelined shards over
+        # disjoint topology-aligned node partitions behind one front end
+        "solver.shards": str(args.shards),
     }
     if args.aot_store:
         from yunikorn_tpu import aot
@@ -457,10 +461,19 @@ def run_replay(args, policy: str) -> dict:
             while b <= cap:
                 buckets.append(b)
                 b *= 2
-            spec = ",".join(f"{args.nodes}x{n}" for n in buckets)
+            warm_nodes = [args.nodes]
+            if args.shards > 1:
+                # each shard solves over its own partition: warm the
+                # per-shard node scale too, or every shard's first wave
+                # pays a fresh compile at a bucket the fleet-size warm
+                # never touched
+                warm_nodes.append(max(1, args.nodes // args.shards))
+            spec = ",".join(f"{m}x{n}" for m in warm_nodes for n in buckets)
             print(f"[replay] prewarming buckets {spec}", file=sys.stderr,
                   flush=True)
-            t = prewarm_buckets(spec, core=stack.core)
+            t = prewarm_buckets(spec,
+                                core=getattr(stack.core, "primary",
+                                             stack.core))
             t.join(timeout=args.warmup_timeout)
             if t.is_alive():
                 print("[replay] WARNING: bucket prewarm still running; "
@@ -611,7 +624,11 @@ def run_replay(args, policy: str) -> dict:
         # fragmentation or the comparison reads inverted
         from yunikorn_tpu.topology.model import fleet_fragmentation
 
-        frag = fleet_fragmentation(core.encoder.nodes)
+        # the sharded front end composes per-shard aggregates (its .encoder
+        # is only the primary shard's fleet slice)
+        frag = (core.fleet_fragmentation()
+                if hasattr(core, "fleet_fragmentation")
+                else fleet_fragmentation(core.encoder.nodes))
         topo_block = {
             "mode": ("off" if args.topology == "false"
                      else ("on" if with_topology else "unlabeled")),
@@ -621,6 +638,23 @@ def run_replay(args, policy: str) -> dict:
                                  if gangs else 1.0),
             "fragmentation": frag,
         }
+        # shards block (round 16): deterministic routing/commit facts in
+        # the fingerprint (node partition and app->home-shard maps are
+        # seed/hash-deterministic); the ledger's contention counters are
+        # timing-dependent, so they ride `timings` instead
+        if hasattr(core, "shard_report"):
+            srep = core.shard_report()
+            shard_block = {
+                "count": srep["count"],
+                "nodes_per_shard": [s["nodes"] for s in srep["shards"]],
+                "bound_per_shard": [s["bound"] for s in srep["shards"]],
+                "repair_placed": srep["repair"]["placed"],
+                "repair_migrated": srep["repair"]["migrated"],
+                "quota_violations": len(core.ledger.audit()),
+            }
+            timings["shard_ledger"] = srep["ledger"]
+        else:
+            shard_block = {"count": 1}
         preempt_total = int(core.obs.get("preempted_total").value())
         mis_evict = int(
             core.obs.get("preemption_mis_evictions_total").value())
@@ -665,6 +699,7 @@ def run_replay(args, policy: str) -> dict:
                 "mis_evictions": mis_evict,
                 "restarts": stack.restarts,
                 "topology": topo_block,
+                "shards": shard_block,
             },
             "slo": slo_report,
             "violations": violations,
@@ -699,6 +734,16 @@ def main() -> int:
     ap.add_argument("--ab", action="store_true",
                     help="replay twice (greedy, then optimal) and record "
                          "preemption volume for both policies")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="control-plane shards (core/shard.py): N >= 2 "
+                         "replays the trace through N pipelined "
+                         "CoreScheduler shards over disjoint node "
+                         "partitions — the shard_parity dial for "
+                         "gang-storm / slice-fragmentation under "
+                         "--assert-slo; the report fingerprint gains a "
+                         "`shards` block (per-shard bound counts, "
+                         "repair-pass placements; ledger contention "
+                         "retries ride `timings`)")
     ap.add_argument("--topology", choices=("auto", "true", "false"),
                     default="auto",
                     help="solver.topology for the replay (the round-15 A/B "

@@ -164,3 +164,79 @@ def test_bench_exits_zero_with_parsed_result_on_cpu():
     # the pressure-cycle plan latency rides every bench result (round 8)
     assert "preempt_plan_ms" in parsed
     assert parsed["preempt_plan_ms"] > 0
+
+
+def test_dial_wall_cap_bounds_total_dial_time(monkeypatch):
+    """The BENCH_r05 follow-up: the attempt cap must bound total dial WALL
+    time too — raising the cap cannot let the dial phase stretch past
+    attempts x per-dial timeout (+ slack), even inside a huge window."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 100_000.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE", 600.0)
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_ATTEMPTS", "3")
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_TIMEOUT", "150")
+    monkeypatch.delenv("YK_BENCH_TPU_WAIT", raising=False)
+    monkeypatch.delenv("YK_BENCH_FORCE_CPU", raising=False)
+    clock = FakeClock()
+    attempts = []
+
+    def wedged_probe(timeout):
+        attempts.append(timeout)
+        clock.sleep(timeout)
+        return None, 0, "dial timed out (fake wedge)"
+
+    t0 = clock()
+    platform = bench._init_backend_or_die(
+        probe_fn=wedged_probe, clock=clock, sleep=clock.sleep,
+        cpu_fallback=lambda: "cpu")
+    assert platform == "cpu"
+    # 3 x 150 s probes + backoffs, bounded by the wall cap (3*150 + 60),
+    # nowhere near the ~99 400 s window
+    assert clock() - t0 <= 3 * 150.0 + 60.0, (clock() - t0, attempts)
+    # no probe was handed a deadline past the remaining wall budget
+    assert all(t <= 150.0 for t in attempts)
+
+
+def test_parent_dial_wedge_emits_backend_unavailable(monkeypatch, capsys):
+    """A parent dial that wedges AFTER a successful probe (the r05 rc=124
+    shape: claim queue never drains) must emit the parseable
+    backend-unavailable JSON and hard-exit inside the dial wall budget
+    instead of waiting on the claim forever."""
+    import threading
+
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 1500.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE", 600.0)
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_TIMEOUT", "0.05")
+    monkeypatch.setenv("YK_BENCH_PARENT_DIAL_MIN", "0.2")
+    # shrink the whole dial wall budget so the wedge trips in test time
+    monkeypatch.setenv("YK_BENCH_TPU_WAIT", "0.5")
+    monkeypatch.delenv("YK_BENCH_FORCE_CPU", raising=False)
+
+    exited = []
+
+    def fake_exit(code):
+        exited.append(code)
+        raise SystemExit(code)
+
+    monkeypatch.setattr(bench, "_hard_exit", fake_exit)
+    release = threading.Event()
+
+    def wedged_parent_dial():
+        release.wait(30)  # well past the 0.2 s dial wall minimum
+        return []
+
+    clock = FakeClock()
+    with pytest.raises(SystemExit):
+        bench._init_backend_or_die(
+            probe_fn=lambda t: ("tpu", 1, "ok"), clock=clock,
+            sleep=clock.sleep, cpu_fallback=lambda: "cpu",
+            parent_dial=wedged_parent_dial)
+    release.set()
+    assert exited == [1]
+    out = capsys.readouterr().out
+    last = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
+    parsed = json.loads(last)
+    assert parsed["metric"] == "backend-unavailable"
+    assert "wedged" in parsed["error"]
+    # the full key set rides the shape (drivers parse these unconditionally)
+    for key in ("degradations", "slo", "topology", "aot_hits"):
+        assert key in parsed

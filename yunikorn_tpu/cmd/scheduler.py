@@ -22,7 +22,6 @@ from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
 from yunikorn_tpu.client.fake import FakeCluster
 from yunikorn_tpu.client.synthetic import make_kwok_nodes
 from yunikorn_tpu.conf.schedulerconf import get_holder
-from yunikorn_tpu.core.scheduler import CoreScheduler
 from yunikorn_tpu.log.logger import log
 from yunikorn_tpu.shim.scheduler import KubernetesShim
 from yunikorn_tpu.utils.jaxtools import ensure_compilation_cache
@@ -64,6 +63,18 @@ def main(argv=None) -> int:
                         help="dump the cycle tracer as Chrome trace-event "
                              "JSON to this path at shutdown (the live ring "
                              "is always available at /debug/traces)")
+    parser.add_argument("--shards", type=str, default="",
+                        help="control-plane shards (core/shard.py): 'auto' "
+                             "or a count in [1, 64]. N >= 2 runs N pipelined "
+                             "CoreScheduler shards over disjoint topology-"
+                             "aligned node partitions, coupled through the "
+                             "exact global quota ledger + stranded-ask "
+                             "repair. Default: conf solver.shards (auto=1)")
+    parser.add_argument("--shard-epoch-seconds", type=float, default=0.0,
+                        help="re-seed the shard partition every N seconds "
+                             "(0 = never): moved ICI domains migrate "
+                             "between shards so fragmentation cannot "
+                             "ossify")
     args = parser.parse_args(argv)
 
     ensure_compilation_cache()
@@ -125,12 +136,19 @@ def main(argv=None) -> int:
     from yunikorn_tpu.obs.slo import SloOptions
 
     cache = SchedulerCache()
-    core = CoreScheduler(cache,
-                         solver_options=SolverOptions.from_conf(holder.get()),
-                         trace_spans=holder.get().obs_trace_spans,
-                         supervisor_options=SupervisorOptions.from_conf(
-                             holder.get()),
-                         slo_options=SloOptions.from_conf(holder.get()))
+    from yunikorn_tpu.core.shard import make_core_scheduler, resolve_shards
+
+    n_shards = resolve_shards(args.shards or holder.get().solver_shards)
+    core = make_core_scheduler(
+        cache, shards=n_shards,
+        solver_options=SolverOptions.from_conf(holder.get()),
+        trace_spans=holder.get().obs_trace_spans,
+        supervisor_options=SupervisorOptions.from_conf(holder.get()),
+        slo_options=SloOptions.from_conf(holder.get()),
+        epoch_seconds=args.shard_epoch_seconds)
+    if n_shards > 1:
+        logger.info("control-plane sharding: %d shards (epoch %ss)",
+                    n_shards, args.shard_epoch_seconds or "off")
     if aot_rt is not None:
         # hit/miss/compile metrics land in this core's /metrics; compile
         # spans land on its cycle timeline
@@ -148,7 +166,10 @@ def main(argv=None) -> int:
     if args.prewarm:
         from yunikorn_tpu.utils.jaxtools import prewarm_buckets
 
-        prewarm_buckets(args.prewarm, core=core)
+        # sharded front end: warm against the primary shard's resolved
+        # variant (every shard runs the same program family; per-shard
+        # AOT namespaces mean a shard's first dispatch may still compile)
+        prewarm_buckets(args.prewarm, core=getattr(core, "primary", core))
 
     stop = threading.Event()
 

@@ -66,6 +66,7 @@ CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optima
 CM_SOLVER_AOT_STORE = PREFIX_SOLVER + "aotStore"        # dir path; "" = off
 CM_SOLVER_AOT_BACKGROUND = PREFIX_SOLVER + "aotBackground"  # auto | true | false
 CM_SOLVER_TOPOLOGY = PREFIX_SOLVER + "topology"         # auto | true | false
+CM_SOLVER_SHARDS = PREFIX_SOLVER + "shards"             # auto | 1..64
 
 # the tri-state device-path gates share one value domain; solver.policy and
 # solver.gateVerify have their own. All parse through _parse_choice: an
@@ -186,6 +187,14 @@ class SchedulerConf:
     # when the fleet carries topology labels (a no-op otherwise); "false"
     # keeps every solver path bit-identical to the pre-topology programs.
     solver_topology: str = "auto"
+    # control-plane sharding (core/shard.py): N pipelined CoreScheduler
+    # shards over disjoint topology-aligned node partitions, coupled only
+    # through the exact global quota ledger + the stranded-ask repair
+    # pass. "auto" and "1" build the plain single scheduler (bit-identical
+    # to the pre-shard core); sharding is opt-in until the parity bench
+    # has hardware numbers. NOT hot-reloadable (shards are process
+    # structure, like the scheduling interval).
+    solver_shards: str = "auto"
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -233,6 +242,7 @@ _NON_RELOADABLE = [
     CM_SVC_PLACEHOLDER_RUN_AS_USER,
     CM_SVC_PLACEHOLDER_RUN_AS_GROUP,
     CM_SVC_PLACEHOLDER_FS_GROUP,
+    CM_SOLVER_SHARDS,
 ]
 
 
@@ -388,7 +398,29 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             (CM_SOLVER_POLICY, "solver_policy", SOLVER_POLICIES)):
         if key in data:
             setattr(conf, attr, _parse_choice(key, data[key], allowed))
+    if CM_SOLVER_SHARDS in data:
+        conf.solver_shards = _parse_shards(data[CM_SOLVER_SHARDS])
     return conf
+
+
+def _parse_shards(v: str) -> str:
+    """solver.shards: "auto" or an integer shard count in [1, 64]. Unknown
+    values REJECT the configmap update like the other enumerated keys
+    (core/shard.resolve_shards maps the validated string to a count)."""
+    s = v.strip().lower()
+    if s == "auto":
+        return s
+    try:
+        n = int(s)
+    except ValueError:
+        raise ValueError(
+            f"invalid value {v!r} for {CM_SOLVER_SHARDS}: expected "
+            "'auto' or an integer in [1, 64]")
+    if not 1 <= n <= 64:
+        raise ValueError(
+            f"invalid value {v!r} for {CM_SOLVER_SHARDS}: shard count "
+            "must be in [1, 64]")
+    return str(n)
 
 
 def decompress(key: str, value: bytes) -> Tuple[str, str]:
@@ -443,6 +475,7 @@ def check_non_reloadable(old: SchedulerConf, new: SchedulerConf) -> List[str]:
         CM_SVC_PLACEHOLDER_RUN_AS_USER: (old.placeholder.run_as_user, new.placeholder.run_as_user),
         CM_SVC_PLACEHOLDER_RUN_AS_GROUP: (old.placeholder.run_as_group, new.placeholder.run_as_group),
         CM_SVC_PLACEHOLDER_FS_GROUP: (old.placeholder.fs_group, new.placeholder.fs_group),
+        CM_SOLVER_SHARDS: (old.solver_shards, new.solver_shards),
     }
     for key, (a, b) in pairs.items():
         if a != b:
@@ -501,6 +534,7 @@ class ConfHolder:
                 new_conf.kube_burst = keep.kube_burst
                 new_conf.disable_gang_scheduling = keep.disable_gang_scheduling
                 new_conf.instance_type_node_label_key = keep.instance_type_node_label_key
+                new_conf.solver_shards = keep.solver_shards
                 new_conf.placeholder = dataclasses.replace(keep.placeholder)
             self._conf = new_conf
             # queues.yaml payload keyed by "<policyGroup>.yaml" or the bare policy group

@@ -102,6 +102,43 @@ def fits_quota_with(quota_chain, cycle_extra: Dict[str, Resource],
     return True
 
 
+def ledger_charges(leaf, user: str, groups, resource: Resource) -> list:
+    """Tracker charges one allocation applies to the shared cross-shard
+    quota ledger (core/shard.GlobalQuotaLedger): one entry per LIMITED
+    tracker on the leaf's ancestor chain — queue max_resource nodes plus
+    every applicable user/group limit — as (tracker_id, limit_items,
+    amount_items) with plain-int item tuples (the ledger's arithmetic is
+    exact python-int, the same integers the gate's int64 trackers carry).
+
+    Unlimited trackers charge nothing: a fleet with no quotas configured
+    produces an empty list and the ledger's reserve is a no-op — the
+    sharded gate then costs nothing over the single-shard one. Mirrors
+    fits_quota/fits_user_limit's applicability rules exactly (wildcard and
+    named user/group lists; group limits charge the GROUP aggregate)."""
+    if leaf is None:
+        return []
+    amount = tuple(resource.resources.items())
+    if not amount:
+        return []
+    out = []
+    for q in leaf.ancestors_and_self():
+        if q.config.max_resource is not None:
+            out.append((f"q|{q.full_name}",
+                        tuple(q.config.max_resource.resources.items()),
+                        amount))
+        for i, lim in enumerate(q.config.limits):
+            if lim.max_resources is None:
+                continue
+            lim_items = tuple(lim.max_resources.resources.items())
+            if "*" in lim.users or user in lim.users:
+                out.append((f"u|{q.full_name}|{i}|{user}", lim_items, amount))
+            for g in groups:
+                if g in lim.groups or "*" in lim.groups:
+                    out.append((f"g|{q.full_name}|{i}|{g}", lim_items,
+                                amount))
+    return out
+
+
 def legacy_admit(by_queue: Dict[str, list], meta: Dict[str, tuple],
                  queue_tree, seed_admissions=None) -> Tuple[list, int]:
     """The reference-shaped per-ask admission loop: per-queue sorts, per-ask

@@ -97,6 +97,12 @@ logger = log("core.scheduler")
 DEFAULT_PLACEHOLDER_TIMEOUT = 15 * 60.0  # core default when the app sets none
 COMPLETING_TIMEOUT = 30.0  # Running app with nothing left → Completed after this
 
+# Guest (repair-target) app registrations from the sharded front end carry
+# this tag (core/shard.GUEST_APP_TAG): a guest shard sees only the stranded
+# asks migrated into it, so it must never auto-complete the application —
+# only the home shard (and the front end's fleet view) can decide that.
+SHARD_GUEST_APP_TAG = "yunikorn.io/shard-guest"
+
 # Whether solver.usePallas=auto turns the fused kernel on for TPU backends.
 # Flipped by the hardware A/B (docs/PERF.md): stays False until the kernel
 # measurably beats the XLA path on a real chip.
@@ -278,9 +284,26 @@ class CoreScheduler(SchedulerAPI):
                  solver_options: Optional[SolverOptions] = None,
                  trace_spans: int = 4096,
                  supervisor_options: Optional[SupervisorOptions] = None,
-                 slo_options: Optional[SloOptions] = None):
+                 slo_options: Optional[SloOptions] = None,
+                 registry=None, shard_label: Optional[str] = None,
+                 quota_ledger=None, aot_namespace: Optional[str] = None):
         self._lock = locking.RMutex()
         self.cache = cache
+        # ---- control-plane sharding hooks (core/shard.py) ----
+        # All four default off and the defaults are bit-identical to the
+        # pre-shard scheduler: no ledger probes, per-core registry, no
+        # shard label on cycle_stage_ms, no AOT fingerprint namespace.
+        # quota_ledger: shared GlobalQuotaLedger — the ONLY cross-shard
+        # admission coupling (reserve at gate, confirm at commit, release
+        # on release/eviction/app removal). shard_label: stamps per-shard
+        # series in a SHARED registry. aot_namespace: isolates this
+        # shard's AOT executables under its own fingerprint namespace.
+        self.quota_ledger = quota_ledger
+        self.shard_label = shard_label
+        self.shard_index = 0
+        self.aot_namespace = aot_namespace
+        self._stage_kw = ({"shard": shard_label}
+                          if shard_label is not None else {})
         self.encoder = SnapshotEncoder(cache)
         self.solver = solver_options or SolverOptions()
         self._solver_resolved = False
@@ -344,7 +367,7 @@ class CoreScheduler(SchedulerAPI):
         # _pipeline_trace deque. The registry is per-core (tests build many
         # cores per process; shared counters would cross-talk); the shim and
         # dispatcher attach to it through `self.obs`.
-        self.obs = MetricsRegistry()
+        self.obs = registry if registry is not None else MetricsRegistry()
         self.tracer = CycleTracer(capacity=max(int(trace_spans), 64))
         m = self.obs
         # ---- robustness (robustness/): supervised device dispatches ----
@@ -357,6 +380,18 @@ class CoreScheduler(SchedulerAPI):
         # backlog into /ws/v1/health.
         self.supervisor = SupervisedExecutor(
             supervisor_options, registry=m, tracer=self.tracer)
+        if shard_label is not None:
+            # per-shard breakers stay per-supervisor; the prefix keeps this
+            # shard's path/outcome SERIES separate in the shared registry
+            self.supervisor.path_label_prefix = f"s{shard_label}/"
+        if aot_namespace:
+            # enter the shard's AOT fingerprint namespace on the watchdog
+            # thread that actually runs each supervised dispatch (the
+            # namespace is thread-local, like aot.bypass)
+            from yunikorn_tpu.aot import runtime as _aot_rt
+
+            self.supervisor.dispatch_cm = (
+                lambda: _aot_rt.namespace(aot_namespace))
         # a deadline-abandoned dispatch leaves a daemon thread that may still
         # mutate the device mirror whenever it unwedges — orphan the mirror
         # so those late writes can't tear the next cycle's refresh
@@ -545,8 +580,10 @@ class CoreScheduler(SchedulerAPI):
             buckets=LATENCY_BUCKETS_S)
         self._m_cycle_stage = m.histogram(
             "cycle_stage_ms",
-            "per-cycle stage latency distribution",
-            labelnames=("stage",), buckets=MS_BUCKETS)
+            "per-cycle stage latency distribution"
+            + (" (per shard)" if shard_label is not None else ""),
+            labelnames=(("stage", "shard") if shard_label is not None
+                        else ("stage",)), buckets=MS_BUCKETS)
         self._m_batch_pods = m.histogram(
             "solve_batch_pods", "pods per dispatched solve batch",
             buckets=COUNT_BUCKETS)
@@ -817,6 +854,8 @@ class CoreScheduler(SchedulerAPI):
             return
         for key in list(app.pending_asks) + list(app.allocations):
             self._span_discard(key)
+            if self.quota_ledger is not None:
+                self.quota_ledger.release(key)
         leaf = self.queues.resolve(app.queue_name, create=False)
         if leaf is not None:
             leaf.app_ids.discard(app_id)
@@ -888,6 +927,11 @@ class CoreScheduler(SchedulerAPI):
             if leaf.has_limits_in_chain():
                 leaf.add_user_allocated(app.user.user, alloc.resource,
                                         list(app.user.groups))
+        if self.quota_ledger is not None:
+            # recovery commits outside the gate: force-charge the ledger
+            self.quota_ledger.commit(
+                alloc.allocation_key,
+                self._ledger_charges_of(app, alloc.resource))
 
     def _track_foreign(self, alloc: Allocation) -> None:
         # The shim re-sends a foreign allocation whenever (node, resource)
@@ -922,6 +966,10 @@ class CoreScheduler(SchedulerAPI):
         release pays one ancestor walk per leaf instead of one per pod
         (_apply_release_accounting applies the sums)."""
         self._span_discard(release.allocation_key)
+        if self.quota_ledger is not None:
+            # drops whatever the key holds on the shared ledger: a pending
+            # ask's reservation, a committed allocation's usage, or nothing
+            self.quota_ledger.release(release.allocation_key)
         # foreign release (carries no app id; search the partitions)
         for part in self.partitions.values():
             foreign = part.foreign_allocations.pop(release.allocation_key, None)
@@ -1738,6 +1786,19 @@ class CoreScheduler(SchedulerAPI):
         self._record_committed_spans([a.allocation_key for a in new_allocs],
                                      cycle_id=cycle_id)
         self._account_unschedulable(unplaced_asks)
+        if self.quota_ledger is not None:
+            # an admitted ask that did not commit this cycle must not keep
+            # holding budget against the other shards — it re-reserves at
+            # its next gate (confirmed commits already popped their
+            # reservation, so this is a no-op for placed asks). Keys the
+            # NEXT in-flight pipelined batch has since re-admitted keep
+            # their hold: releasing here would let that batch's commit
+            # fall through to the unchecked force-charge path.
+            placed = {a.allocation_key for a in new_allocs}
+            for ask in admitted:
+                key = ask.allocation_key
+                if key not in placed and key not in self._inflight_ask_keys:
+                    self.quota_ledger.release_reservation(key)
         if self._evicted_for:
             # asks that placed paid their evictions off — they are no
             # longer mis-eviction candidates
@@ -2635,6 +2696,10 @@ class CoreScheduler(SchedulerAPI):
                 if leaf.has_limits_in_chain():
                     leaf.add_user_allocated(app.user.user, alloc.resource,
                                             list(app.user.groups))
+        if self.quota_ledger is not None:
+            self.quota_ledger.commit(
+                alloc.allocation_key,
+                self._ledger_charges_of(app, alloc.resource))
         return app
 
     def _cluster_capacity(self) -> Resource:
@@ -2852,6 +2917,17 @@ class CoreScheduler(SchedulerAPI):
                     len(admitted), held, len(ref_admitted), ref_held)
                 admitted, held = ref_admitted, ref_held
                 stats = dict(stats, path="legacy", mismatch=1)
+        if self.quota_ledger is not None and admitted:
+            # cross-shard coupling (core/shard.GlobalQuotaLedger): the local
+            # queue tree admitted against THIS shard's optimistic view; the
+            # shared ledger applies the exact global check atomically. A
+            # refused ask is held exactly like a quota hold — it re-enters
+            # the next gate, by which time the contending shard's commit or
+            # release has settled the budget.
+            admitted, ledger_held = self._ledger_reserve(meta, admitted)
+            if ledger_held:
+                held += ledger_held
+                stats["ledger_held"] = ledger_held
         if problem is not None:
             # O(changed) extraction evidence for the cycle entry/bench
             stats["extract_derived"] = self._gate_extract_cache.derived
@@ -2865,6 +2941,45 @@ class CoreScheduler(SchedulerAPI):
         self._last_gate_stats = stats
         ranks = list(range(len(admitted)))
         return admitted, ranks, held
+
+    # ----------------------------------------- cross-shard quota coupling
+    # Active only when core/shard.ShardedCoreScheduler injected a shared
+    # GlobalQuotaLedger (solver.shards >= 2). Contract: every admitted ask
+    # RESERVES its limited-tracker charges before the solve; a commit
+    # CONFIRMS the reservation (or force-charges for paths that commit
+    # outside the gate: pinned asks, gang replacement, recovery restores);
+    # an ask that finishes its cycle unplaced releases the reservation; a
+    # released/evicted allocation releases its confirmed usage. With the
+    # ledger unset (single shard) none of these paths execute.
+
+    def _ledger_reserve(self, meta, admitted):
+        """Reserve each admitted ask's charges on the shared ledger; asks
+        the global check refuses are held (returns (kept, held_count)).
+        Looks apps up per ADMITTED ask only — an O(pending) flatten of
+        by_queue would put per-entity Python cost back on the gate's
+        critical path."""
+        ledger = self.quota_ledger
+        applications = self.partition.applications
+        kept = []
+        held = 0
+        for ask in admitted:
+            app = applications.get(ask.application_id)
+            charges = []
+            if app is not None:
+                entry = meta.get(app.queue_name)
+                charges = gate_mod.ledger_charges(
+                    entry[0] if entry else None, app.user.user,
+                    app.user.groups, ask.resource)
+            if ledger.reserve(ask.allocation_key, charges):
+                kept.append(ask)
+            else:
+                held += 1
+        return kept, held
+
+    def _ledger_charges_of(self, app, resource) -> list:
+        leaf = self.queues.resolve(app.queue_name, create=False)
+        return gate_mod.ledger_charges(leaf, app.user.user,
+                                       app.user.groups, resource)
 
     def _gate_device_on(self) -> bool:
         """Tri-state solver.gateDevice resolved: auto = on (the supervisor
@@ -3097,6 +3212,8 @@ class CoreScheduler(SchedulerAPI):
                     continue
                 # release placeholder
                 app.allocations.pop(ph.allocation_key, None)
+                if self.quota_ledger is not None:
+                    self.quota_ledger.release(ph.allocation_key)
                 leaf = self.queues.resolve(app.queue_name, create=False)
                 if leaf is not None:
                     leaf.remove_allocated(ph.resource)
@@ -3129,6 +3246,8 @@ class CoreScheduler(SchedulerAPI):
         for app in self.partition.applications.values():
             if app.state not in (APP_RUNNING, APP_COMPLETING, APP_RESUMING):
                 continue
+            if app.tags.get(SHARD_GUEST_APP_TAG):
+                continue  # repair guest: the home shard owns completion
             real = any(not a.placeholder for a in app.allocations.values())
             if real or app.pending_asks:
                 self._completing_since.pop(app.application_id, None)
@@ -3195,11 +3314,15 @@ class CoreScheduler(SchedulerAPI):
                 released = [a for a in app.allocations.values() if a.placeholder]
                 for ph in released:
                     app.allocations.pop(ph.allocation_key, None)
+                    if self.quota_ledger is not None:
+                        self.quota_ledger.release(ph.allocation_key)
                     leaf = self.queues.resolve(app.queue_name, create=False)
                     if leaf is not None:
                         leaf.remove_allocated(ph.resource)
                 for key in [k for k, a in app.pending_asks.items() if a.placeholder]:
                     app.pending_asks.pop(key, None)
+                    if self.quota_ledger is not None:
+                        self.quota_ledger.release(key)
                 new_state = (
                     APP_FAILING if app.gang_style == constants.GANG_STYLE_HARD else APP_RESUMING
                 )
@@ -3348,7 +3471,8 @@ class CoreScheduler(SchedulerAPI):
                   "total_ms"):
             v = entry.get(k)
             if v is not None:
-                self._m_cycle_stage.observe(v, stage=k[:-3])
+                self._m_cycle_stage.observe(v, stage=k[:-3],
+                                            **self._stage_kw)
 
     # per-cycle cap on exact unplaced-ask diagnosis (a vectorized all-nodes
     # fit check per ask; the remainder is counted but not classified)

@@ -1,0 +1,1383 @@
+"""Control-plane sharding: N pipelined CoreScheduler partitions behind one
+SchedulerAPI front end, coupled only through an exact global quota ledger
+and a stranded-ask repair pass.
+
+The single CoreScheduler cycle is the fleet's throughput ceiling: gate,
+encode, solve and commit are each device-fast, but every pod still flows
+through ONE pipelined cycle loop. `ops/pack_solve.py` already proved the
+POP result (arxiv 2110.11927; CvxCluster's 100-1000x claim) for one solve —
+random partitioning preserves solution quality at a fraction of the cost.
+This module lifts that result one level, to the control plane itself:
+
+  ShardedCoreScheduler
+      N full CoreScheduler shards, each owning a DISJOINT node partition
+      (assigned by the topology partitioner below so ICI domains never
+      straddle shards, re-seeded per epoch so fragmentation cannot ossify),
+      each with its own pipelined cycle loop, supervised device->cpu->host
+      ladder, snapshot encoder and AOT fingerprint namespace. Shards run
+      their cycles concurrently on their own scheduler threads (started
+      phase-staggered), so shard k's device solve overlaps shard k±1's
+      host-side gate/commit.
+
+  GlobalQuotaLedger
+      The ONLY admission coupling between shards. Each shard's gate still
+      admits against its local queue tree (which sees only the shard's own
+      allocations — an optimistic, shard-local view); the ledger then
+      applies the exact global check: reserve at admission, confirm at
+      commit, release on unplaced/eviction/release. All arithmetic is
+      plain-python-int exact — the same integers the gate's int64 device
+      trackers carry — and atomic under one lock, so no queue max or
+      user/group RESOURCE limit can be double-spent across shards. A fleet
+      with no quotas configured produces zero trackers and the ledger
+      costs one dict probe per ask. Known scope limit: APP-COUNT limits
+      (maxApplications / per-user app counts) are still enforced per-shard
+      only — cross-shard app-count coupling needs app-slot reservations on
+      the registration path, a ROADMAP follow-up.
+
+  Repair pass (stranded asks)
+      Mirrors pack_solve's partition-repair contract: an ask its home
+      shard reports SKIPPED re-enters scheduling on the next untried shard
+      (the app is registered there as a guest) until every shard — i.e.
+      the full node fleet — has seen it; only then is SKIPPED surfaced to
+      the shim. A repaired ask that places clears its repair state.
+
+  ShardCacheFanout / ShardCacheView
+      All shards share ONE SchedulerCache (pods/volumes/DRA are global
+      state); each shard's CoreScheduler receives a node-scoped VIEW that
+      filters every node read to the shard's owned set. The fanout also
+      multiplexes the cache's DESTRUCTIVE take_dirty_nodes() — N encoders
+      draining it directly would steal each other's dirty marks.
+
+`solver.shards=1` (and auto) builds a plain CoreScheduler via
+make_core_scheduler — bit-identical to the pre-shard scheduler by
+construction: none of the ledger/fanout/namespace hooks activate.
+
+Differential oracle: tests/test_shard.py's shard_parity replays one trace
+through 1-shard and N-shard configurations and gates on placement-quality
+parity (placed count, packed units, zero ledger violations);
+scripts/shard_bench.py scales the same comparison to the 10k-node bench.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
+
+from yunikorn_tpu.common.si import (
+    AllocationRequest,
+    ApplicationRequest,
+    NodeAction,
+    NodeRequest,
+    SchedulerAPI,
+    UpdateContainerSchedulingStateRequest,
+)
+from yunikorn_tpu.common.si import NodeInfo as SiNodeInfo
+from yunikorn_tpu.core.scheduler import SHARD_GUEST_APP_TAG, CoreScheduler
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+logger = log("core.shard")
+
+# tag the front end stamps on guest (repair-target) app registrations; the
+# core skips auto-completion for guests so a drained repair target can never
+# race the home shard's app lifecycle (core/scheduler._check_app_completion)
+GUEST_APP_TAG = SHARD_GUEST_APP_TAG
+
+# a reservation never confirmed within this window is presumed leaked by an
+# abandoned cycle (every ordinary path releases explicitly; this is the
+# failsafe so a crashed cycle cannot hold quota budget forever)
+RESERVE_TTL_S = 300.0
+
+# after a full repair round fails on every shard, the ask cools down before
+# the next round so saturation does not ping-pong asks between shards every
+# cycle
+REPAIR_COOLDOWN_S = 10.0
+
+
+# ---------------------------------------------------------------------------
+# Global quota ledger
+# ---------------------------------------------------------------------------
+class GlobalQuotaLedger:
+    """Shared exact quota/budget tracker: atomic reserve/confirm/release.
+
+    Trackers are created lazily per charge id (see gate.ledger_charges);
+    each holds plain-int per-resource `used` (confirmed allocations) and
+    `reserved` (gate admissions whose commit is pending) sums. All checks
+    and mutations happen under one lock — the atomicity that makes
+    double-spending across concurrently-gating shards impossible."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._mu = threading.Lock()
+        self._used: Dict[str, Dict[str, int]] = {}
+        self._reserved: Dict[str, Dict[str, int]] = {}
+        self._limits: Dict[str, Dict[str, int]] = {}   # last-seen, for audit
+        # allocation_key -> list of (tracker_id, amount_items)
+        self._res_by_key: Dict[str, Tuple[float, list]] = {}
+        self._use_by_key: Dict[str, list] = {}
+        self.reserve_held = 0          # reserves refused (ask held)
+        self.contention_retries = 0    # refusals where another shard's live
+        #                                reservation was part of the overage
+        self.forced_charges = 0        # commits with no prior reservation
+        self.expired = 0               # TTL-reaped leaked reservations
+        self._m_violations = self._m_contention = None
+        if registry is not None:
+            self.attach_metrics(registry)
+
+    def attach_metrics(self, registry: MetricsRegistry) -> None:
+        self._m_violations = registry.counter(
+            "shard_quota_violations_total",
+            "forced ledger charges that pushed a tracker past its limit — "
+            "cross-shard quota exactness is gated on this staying zero")
+        self._m_contention = registry.counter(
+            "shard_quota_contention_retries_total",
+            "ledger reserves refused while another live reservation held "
+            "part of the budget (the ask re-enters the next gate)")
+
+    # -- internals (lock held) ---------------------------------------------
+    @staticmethod
+    def _add(acc: Dict[str, int], items, sign: int = 1) -> None:
+        for k, v in items:
+            acc[k] = acc.get(k, 0) + sign * v
+
+    def _expire_locked(self, now: float) -> None:
+        dead = [k for k, (ts, _) in self._res_by_key.items()
+                if now - ts > RESERVE_TTL_S]
+        for key in dead:
+            _, charges = self._res_by_key.pop(key)
+            for tid, amount in charges:
+                self._add(self._reserved.setdefault(tid, {}), amount, -1)
+            self.expired += 1
+            logger.warning("quota ledger: reservation for %s expired "
+                           "unconfirmed (abandoned cycle?)", key)
+
+    # -- API ----------------------------------------------------------------
+    def reserve(self, key: str, charges: list) -> bool:
+        """Atomically reserve every charge, or none. charges comes from
+        gate.ledger_charges: [(tracker_id, limit_items, amount_items)].
+        Empty charges (no limits configured anywhere on the chain) always
+        succeed without touching tracker state."""
+        if not charges:
+            return True
+        now = time.time()
+        with self._mu:
+            held = self._res_by_key.get(key)
+            if held is not None:
+                # already held (pipelined re-gate overlap): refresh the
+                # stamp so a long-lived legitimate hold never TTL-expires
+                self._res_by_key[key] = (now, held[1])
+                return True
+            if key in self._use_by_key:
+                return True
+            self._expire_locked(now)
+            contended = False
+            for tid, limit, amount in charges:
+                used = self._used.get(tid, {})
+                reserved = self._reserved.get(tid, {})
+                self._limits[tid] = dict(limit)
+                for rk, lim_v in limit:
+                    if (used.get(rk, 0) + reserved.get(rk, 0)
+                            + dict(amount).get(rk, 0)) > lim_v:
+                        if reserved.get(rk, 0) > 0:
+                            contended = True
+                        self.reserve_held += 1
+                        if contended:
+                            self.contention_retries += 1
+                            if self._m_contention is not None:
+                                self._m_contention.inc()
+                        return False
+            rec = []
+            for tid, _limit, amount in charges:
+                self._add(self._reserved.setdefault(tid, {}), amount)
+                rec.append((tid, amount))
+            self._res_by_key[key] = (now, rec)
+            return True
+
+    def commit(self, key: str, charges: list) -> None:
+        """Commit one allocation: confirm its reservation (the normal solve
+        path), or force-charge when none exists (pinned asks, gang
+        placeholder replacement, recovery restores — paths that commit
+        outside the gate). Idempotent per key."""
+        with self._mu:
+            if key in self._use_by_key:
+                return
+            rec = self._res_by_key.pop(key, None)
+            if rec is not None:
+                _, reserved = rec
+                for tid, amount in reserved:
+                    self._add(self._reserved.setdefault(tid, {}), amount, -1)
+                    self._add(self._used.setdefault(tid, {}), amount)
+                self._use_by_key[key] = reserved
+                return
+            if not charges:
+                return
+            self.forced_charges += 1
+            rec2 = []
+            violated = False
+            for tid, limit, amount in charges:
+                used = self._used.setdefault(tid, {})
+                self._limits[tid] = dict(limit)
+                self._add(used, amount)
+                rec2.append((tid, amount))
+                for rk, lim_v in limit:
+                    if used.get(rk, 0) > lim_v:
+                        violated = True
+            self._use_by_key[key] = rec2
+            if violated and self._m_violations is not None:
+                self._m_violations.inc()
+
+    def release_reservation(self, key: str) -> None:
+        with self._mu:
+            rec = self._res_by_key.pop(key, None)
+            if rec is None:
+                return
+            for tid, amount in rec[1]:
+                self._add(self._reserved.setdefault(tid, {}), amount, -1)
+
+    def release(self, key: str) -> None:
+        """Drop whatever the key holds — reservation and/or confirmed usage
+        (allocation released / evicted / app removed)."""
+        with self._mu:
+            rec = self._res_by_key.pop(key, None)
+            if rec is not None:
+                for tid, amount in rec[1]:
+                    self._add(self._reserved.setdefault(tid, {}),
+                              amount, -1)
+            used = self._use_by_key.pop(key, None)
+            if used is not None:
+                for tid, amount in used:
+                    self._add(self._used.setdefault(tid, {}), amount, -1)
+
+    def audit(self) -> List[str]:
+        """Tracker ids whose CONFIRMED usage exceeds the last-seen limit —
+        the zero-global-quota-violations oracle the parity tests gate on."""
+        out = []
+        with self._mu:
+            for tid, limit in self._limits.items():
+                used = self._used.get(tid, {})
+                for rk, lim_v in limit.items():
+                    if used.get(rk, 0) > lim_v:
+                        out.append(tid)
+                        break
+        return out
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "trackers": len(self._limits),
+                "reservations": len(self._res_by_key),
+                "charged_keys": len(self._use_by_key),
+                "reserve_held": self.reserve_held,
+                "contention_retries": self.contention_retries,
+                "forced_charges": self.forced_charges,
+                "expired": self.expired,
+            }
+
+
+# ---------------------------------------------------------------------------
+# Shared-cache fan-out: one SchedulerCache, N node-scoped views
+# ---------------------------------------------------------------------------
+class ShardCacheFanout:
+    """Owns the node->shard map and multiplexes the base cache's destructive
+    take_dirty_nodes() into per-shard pending sets. Marks for nodes with no
+    owner yet (informer events racing core registration) are parked and
+    flushed to the owner the moment one is assigned."""
+
+    def __init__(self, cache, n_shards: int):
+        self.cache = cache
+        self.n = n_shards
+        self._mu = threading.Lock()
+        self._owner: Dict[str, int] = {}
+        # per-shard owned-name sets: names_for/count_for are O(owned), not
+        # an O(fleet) owner-map scan (the repair pass sizes every untried
+        # shard per stranded ask — under the front _mu)
+        self._owned: List[Set[str]] = [set() for _ in range(n_shards)]
+        self._pending: List[Tuple[Set[str], Set[str]]] = [
+            (set(), set()) for _ in range(n_shards)]
+        self._unowned: Tuple[Set[str], Set[str]] = (set(), set())
+        self._membership = [0] * n_shards
+
+    def owner_of(self, name: str) -> Optional[int]:
+        with self._mu:
+            return self._owner.get(name)
+
+    def set_owner(self, name: str, idx: Optional[int]) -> None:
+        """Assign/move/drop a node's owning shard. Both the old and new
+        owner get an object-dirty mark so the next syncs remove/create the
+        row; parked unowned marks flush to a new owner."""
+        with self._mu:
+            old = self._owner.get(name)
+            if old == idx:
+                return
+            if old is not None:
+                self._pending[old][0].add(name)
+                self._pending[old][1].add(name)
+                self._membership[old] += 1
+                self._owned[old].discard(name)
+            if idx is None:
+                self._owner.pop(name, None)
+            else:
+                self._owner[name] = idx
+                self._owned[idx].add(name)
+                self._pending[idx][0].add(name)
+                self._pending[idx][1].add(name)
+                self._membership[idx] += 1
+                self._unowned[0].discard(name)
+                self._unowned[1].discard(name)
+
+    def membership_version(self, idx: int) -> int:
+        with self._mu:
+            return self._membership[idx]
+
+    def take_dirty(self, idx: int) -> Tuple[Set[str], Set[str]]:
+        """Drain the base cache's dirty sets, distribute by ownership, then
+        return-and-clear this shard's accumulated marks."""
+        with self._mu:
+            dirty, objects = self.cache.take_dirty_nodes()
+            for name in dirty:
+                o = self._owner.get(name)
+                tgt = self._pending[o] if o is not None else self._unowned
+                tgt[0].add(name)
+                if name in objects:
+                    tgt[1].add(name)
+            d, ob = self._pending[idx]
+            self._pending[idx] = (set(), set())
+            return d, ob
+
+    def names_for(self, idx: int) -> List[str]:
+        with self._mu:
+            return list(self._owned[idx])
+
+    def count_for(self, idx: int) -> int:
+        with self._mu:
+            return len(self._owned[idx])
+
+
+class ShardCacheView:
+    """Node-scoped view of the shared SchedulerCache for one shard's
+    CoreScheduler + SnapshotEncoder: node reads filter to the shard's owned
+    set, everything else (pods, volumes, DRA, priority classes, generations)
+    delegates to the base cache."""
+
+    def __init__(self, fanout: ShardCacheFanout, idx: int):
+        self._fanout = fanout
+        self._idx = idx
+        self.base = fanout.cache
+
+    def __getattr__(self, name):
+        return getattr(self.base, name)
+
+    # -- node-scoped overrides ---------------------------------------------
+    def _owned(self, name: str) -> bool:
+        return self._fanout.owner_of(name) == self._idx
+
+    def get_node(self, name: str):
+        return self.base.get_node(name) if self._owned(name) else None
+
+    def snapshot_node(self, name: str):
+        return self.base.snapshot_node(name) if self._owned(name) else None
+
+    def node_names(self) -> List[str]:
+        return self._fanout.names_for(self._idx)
+
+    def node_count(self) -> int:
+        return self._fanout.count_for(self._idx)
+
+    def snapshot_nodes(self) -> list:
+        own = set(self._fanout.names_for(self._idx))
+        return [info for info in self.base.snapshot_nodes()
+                if info.node.name in own]
+
+    def take_dirty_nodes(self) -> Tuple[Set[str], Set[str]]:
+        return self._fanout.take_dirty(self._idx)
+
+    def capacity_version(self):
+        # the shard's capacity changes when EITHER a node object changes or
+        # shard membership moves a node; equality-keyed memo consumers
+        # (CoreScheduler._cluster_capacity) accept any hashable
+        return (self.base.capacity_version(),
+                self._fanout.membership_version(self._idx))
+
+
+# ---------------------------------------------------------------------------
+# Topology-aware node partitioner (ICI domains never straddle shards)
+# ---------------------------------------------------------------------------
+class ShardTopologyPartitioner:
+    """Deterministic domain->shard assignment: every node of one ICI domain
+    lands in one shard, domains balance across shards by count, and the
+    epoch seed rotates the placement so one epoch's fragmentation cannot
+    ossify into the next. Unlabeled nodes form singleton domains keyed by
+    node name."""
+
+    def __init__(self, n_shards: int, seed: int = 0):
+        self.n = n_shards
+        self.seed = seed
+        self.domain_shard: Dict[tuple, int] = {}
+        self.domain_nodes: Dict[tuple, Set[str]] = {}
+        self.node_domain: Dict[str, tuple] = {}
+        self._counts = [0] * n_shards
+
+    @staticmethod
+    def domain_of(name: str, labels: Optional[Dict[str, str]]) -> tuple:
+        from yunikorn_tpu.topology.model import (normalize_topology_labels,
+                                                 parse_topology_labels)
+
+        if labels:
+            _sl, _rack, ici = parse_topology_labels(
+                normalize_topology_labels(labels))
+            if ici is not None:
+                return ("ici",) + tuple(ici)
+        return ("node", name)
+
+    def _pick(self, dom: tuple, seed: int) -> int:
+        base = zlib.crc32(f"{seed}:{dom}".encode()) % self.n
+        return min(range(self.n),
+                   key=lambda k: (self._counts[k], (k - base) % self.n))
+
+    def assign(self, name: str, labels: Optional[Dict[str, str]]) -> int:
+        dom = self.domain_of(name, labels)
+        prev = self.node_domain.get(name)
+        if prev is not None and prev != dom:
+            # re-registration with CHANGED topology labels: drop the stale
+            # domain membership first, or reseed() would keep acting on it
+            # (migrating the node with its OLD domain — splitting it from
+            # its actual siblings) and _counts would drift
+            self.remove(name)
+        self.node_domain[name] = dom
+        self.domain_nodes.setdefault(dom, set()).add(name)
+        shard = self.domain_shard.get(dom)
+        if shard is None:
+            shard = self._pick(dom, self.seed)
+            self.domain_shard[dom] = shard
+            self._counts[shard] += 1
+        return shard
+
+    def remove(self, name: str) -> None:
+        dom = self.node_domain.pop(name, None)
+        if dom is None:
+            return
+        nodes = self.domain_nodes.get(dom)
+        if nodes is not None:
+            nodes.discard(name)
+            if not nodes:
+                del self.domain_nodes[dom]
+                shard = self.domain_shard.pop(dom, None)
+                if shard is not None:
+                    self._counts[shard] -= 1
+
+    def reseed(self, seed: int) -> Dict[str, Tuple[int, int]]:
+        """Recompute the whole assignment under a new seed; returns
+        {node: (old_shard, new_shard)} for every node that moves.
+        Deterministic: domains are revisited in sorted order."""
+        self.seed = seed
+        old = dict(self.domain_shard)
+        self.domain_shard = {}
+        self._counts = [0] * self.n
+        moves: Dict[str, Tuple[int, int]] = {}
+        for dom in sorted(self.domain_nodes):
+            shard = self._pick(dom, seed)
+            self.domain_shard[dom] = shard
+            self._counts[shard] += 1
+            prev = old.get(dom)
+            if prev is not None and prev != shard:
+                for name in self.domain_nodes[dom]:
+                    moves[name] = (prev, shard)
+        return moves
+
+
+# ---------------------------------------------------------------------------
+# Per-shard callback: fan-in + repair interception
+# ---------------------------------------------------------------------------
+class _ShardCallback:
+    """Wraps the real RM callback for one shard: passes responses through,
+    tees per-shard accounting into the front end, intercepts SKIPPED for
+    the stranded-ask repair pass, and suppresses app-Completed updates the
+    home shard cannot decide alone (repaired allocations live elsewhere)."""
+
+    def __init__(self, front: "ShardedCoreScheduler", idx: int, real):
+        self._front = front
+        self._idx = idx
+        self._real = real
+
+    def update_allocation(self, response) -> None:
+        if response.new or response.released:
+            self._front._note_allocations(self._idx, response)
+        if response.rejected:
+            # a rejected ask gets no release event: forget its routing
+            # entries here or _asks/_ask_home leak for the process lifetime
+            self._front._forget_asks(
+                [(r.application_id, r.allocation_key)
+                 for r in response.rejected])
+        self._real.update_allocation(response)
+
+    def update_application(self, response) -> None:
+        response = self._front._filter_app_updates(self._idx, response)
+        if response is not None:
+            self._real.update_application(response)
+
+    def update_node(self, response) -> None:
+        self._real.update_node(response)
+
+    def update_container_scheduling_state(self, request) -> None:
+        if request.state and str(request.state).endswith("SKIPPED"):
+            if self._front._on_skipped(self._idx, request):
+                return  # repair in flight: not yet unschedulable
+        self._real.update_container_scheduling_state(request)
+
+    def predicates(self, args):
+        return self._real.predicates(args)
+
+    def preemption_predicates(self, args):
+        return self._real.preemption_predicates(args)
+
+    def send_event(self, events) -> None:
+        self._real.send_event(events)
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+# ---------------------------------------------------------------------------
+# Facades (REST/replay compatibility surfaces)
+# ---------------------------------------------------------------------------
+class _MergedTracer:
+    """Read-only merge of the shards' cycle tracers."""
+
+    def __init__(self, shards: List[CoreScheduler]):
+        self._shards = shards
+
+    def spans(self) -> list:
+        out = []
+        for core in self._shards:
+            out.extend(core.tracer.spans())
+        out.sort(key=lambda s: s.t0)
+        return out
+
+    def chrome_trace(self) -> dict:
+        merged = None
+        for k, core in enumerate(self._shards):
+            t = core.tracer.chrome_trace()
+            if merged is None:
+                merged = dict(t)
+                merged["traceEvents"] = list(t.get("traceEvents", []))
+                continue
+            for ev in t.get("traceEvents", []):
+                ev = dict(ev)
+                ev["pid"] = ev.get("pid", 0) + k * 1000
+                merged["traceEvents"].append(ev)
+        return merged or {"traceEvents": []}
+
+    def add(self, *a, **kw) -> None:   # front-level spans land on shard 0
+        self._shards[0].tracer.add(*a, **kw)
+
+
+class _ShardSlo:
+    """SLO facade: ticks/resets fan out to every shard's engine; the report
+    comes from the primary (all engines consume the same shared e2e stream);
+    violations merge as the per-objective MAX across shards (one stalled
+    shard must surface, N engines seeing the same e2e episode must not
+    count it N times)."""
+
+    def __init__(self, shards: List[CoreScheduler]):
+        self._shards = shards
+
+    def maybe_tick(self) -> None:
+        for core in self._shards:
+            core.slo.maybe_tick()
+
+    def tick(self, now=None):
+        out = None
+        for core in self._shards:
+            out = core.slo.tick(now)
+        return out
+
+    def reset(self) -> None:
+        for core in self._shards:
+            core.slo.reset()
+
+    def violations(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for core in self._shards:
+            for k, v in core.slo.violations().items():
+                out[k] = max(out.get(k, 0), v)
+        return out
+
+    def report(self) -> dict:
+        return self._shards[0].slo.report()
+
+
+class _FanoutFaults:
+    """Fault-plane facade: scripted faults apply to every shard's
+    supervisor (trace_replay's chaos coupling drives this)."""
+
+    def __init__(self, shards: List[CoreScheduler]):
+        self._shards = shards
+
+    def __getattr__(self, name):
+        def fan(*a, **kw):
+            out = None
+            for core in self._shards:
+                out = getattr(core.supervisor.faults, name)(*a, **kw)
+            return out
+        return fan
+
+
+class _ShardSupervisor:
+    """Supervisor facade for fleet-level readers (degraded_paths union,
+    shared fault plane); per-shard supervisors stay authoritative."""
+
+    def __init__(self, shards: List[CoreScheduler]):
+        self._shards = shards
+        self.faults = _FanoutFaults(shards)
+
+    @property
+    def cycle_id(self) -> int:
+        # fleet-level attach points (the AOT runtime's compile spans) read
+        # one committing cycle id; the primary's is representative
+        return self._shards[0].supervisor.cycle_id
+
+    def degraded_paths(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for k, core in enumerate(self._shards):
+            for path, tier in core.supervisor.degraded_paths().items():
+                out[f"s{k}/{path}"] = tier
+        return out
+
+    def snapshot(self) -> dict:
+        return {f"s{k}/{p}": s
+                for k, core in enumerate(self._shards)
+                for p, s in core.supervisor.snapshot().items()}
+
+
+# ---------------------------------------------------------------------------
+# The front end
+# ---------------------------------------------------------------------------
+class ShardedCoreScheduler(SchedulerAPI):
+    """SchedulerAPI front end over N pipelined CoreScheduler shards.
+
+    Routing: nodes to shards by ICI domain (ShardTopologyPartitioner),
+    apps/asks to a stable home shard (crc32 of the application id — the
+    whole gang solves in one shard, preserving gang contiguity), pinned
+    asks to the shard owning their preferred node, releases broadcast
+    (only the holder acts). All shards share one SchedulerCache (node reads
+    scoped per shard by ShardCacheView), one MetricsRegistry (fleet-total
+    counters; per-shard series carry a shard label), and one
+    GlobalQuotaLedger."""
+
+    def __init__(self, cache, n_shards: int, interval: float = 0.1,
+                 solver_policy: Optional[str] = None,
+                 solver_options=None, trace_spans: int = 4096,
+                 supervisor_options=None, slo_options=None,
+                 epoch_seconds: float = 0.0, aot_namespace: bool = False):
+        # aot_namespace=True gives each shard its own executable namespace
+        # in the AOT store (corruption/variant isolation for multi-process
+        # deployments) at the cost of N compiles per program AND of the
+        # bucket prewarm: warm_bucket runs outside any namespace, so
+        # namespaced shards would miss every prewarmed entry. Default off:
+        # in-process shards share executables — same program, same avals.
+        if n_shards < 2:
+            raise ValueError("ShardedCoreScheduler needs >= 2 shards; "
+                             "use make_core_scheduler for the 1-shard case")
+        self.cache = cache
+        self.n = n_shards
+        self._interval = interval
+        self.obs = MetricsRegistry()
+        self.ledger = GlobalQuotaLedger(registry=self.obs)
+        self.fanout = ShardCacheFanout(cache, n_shards)
+        self.partitioner = ShardTopologyPartitioner(n_shards, seed=0)
+        self.epoch_seconds = float(epoch_seconds)
+        self.epoch = 0
+        self.callback = None
+        self.rm_id = ""
+        self._rm_request = None
+        # routing state (under _mu; _mu is ALWAYS taken before shard locks,
+        # and never while holding one)
+        self._mu = threading.RLock()
+        self._app_home: Dict[str, int] = {}
+        self._app_shards: Dict[str, Set[int]] = {}
+        self._app_reqs: Dict[str, object] = {}
+        self._ask_home: Dict[str, int] = {}
+        self._asks: Dict[str, object] = {}
+        self._node_reg: Dict[str, SiNodeInfo] = {}
+        self._node_sched: Dict[str, bool] = {}
+        # repair + stats state (under _stats_mu; leaf-level only — safe to
+        # take while a shard lock is held, never held across shard calls)
+        self._stats_mu = threading.Lock()
+        self._repair: Dict[str, dict] = {}
+        self._repair_allocs: Dict[str, Set[str]] = {}   # app -> repaired keys
+        # allocation key -> (committing shard, app id); the app id makes
+        # app-removal purge possible (removal emits no per-key releases)
+        self._alloc_shard: Dict[str, Tuple[int, str]] = {}
+        # apps whose Completed update was suppressed while repaired
+        # allocations lived in other shards: re-emitted by
+        # _note_allocations when the last such allocation releases
+        self._suppressed_apps: Set[str] = set()
+        self._bound_per_shard = [0] * n_shards
+        self._repair_placed = 0
+        self._suppressed_completions = 0
+        self._epoch_thread: Optional[threading.Thread] = None
+        self._epoch_stop = threading.Event()
+        m = self.obs
+        m.gauge("shard_count",
+                "control-plane shards in this scheduler process"
+                ).set(n_shards)
+        self._m_asks = m.counter(
+            "shard_asks_total", "asks routed to each shard",
+            labelnames=("shard",))
+        self._m_bound = m.counter(
+            "shard_bound_total", "allocations committed by each shard",
+            labelnames=("shard",))
+        self._m_repair = m.counter(
+            "shard_repair_total",
+            "stranded-ask repair outcomes (migrated = ask moved to an "
+            "untried shard, placed = a repaired ask committed, exhausted = "
+            "every shard tried and the ask is genuinely unschedulable)",
+            labelnames=("outcome",))
+        self._m_node_migrations = m.counter(
+            "shard_node_migrations_total",
+            "nodes moved between shards by epoch re-seeding")
+        self._m_epochs = m.counter(
+            "shard_epoch_total", "shard-partition re-seed epochs completed")
+        # -- the shards -------------------------------------------------------
+        self.shards: List[CoreScheduler] = []
+        for k in range(n_shards):
+            view = ShardCacheView(self.fanout, k)
+            so = (dataclasses.replace(solver_options)
+                  if solver_options is not None else None)
+            sup = (dataclasses.replace(supervisor_options)
+                   if supervisor_options is not None else None)
+            slo = (dataclasses.replace(slo_options)
+                   if slo_options is not None else None)
+            core = CoreScheduler(
+                view, interval=interval, solver_policy=solver_policy,
+                solver_options=so, trace_spans=trace_spans,
+                supervisor_options=sup, slo_options=slo, registry=self.obs,
+                shard_label=str(k), quota_ledger=self.ledger,
+                aot_namespace=(f"shard{k}" if aot_namespace else None))
+            core.shard_index = k
+            self.shards.append(core)
+        self.primary = self.shards[0]
+        self.tracer = _MergedTracer(self.shards)
+        self.slo = _ShardSlo(self.shards)
+        self.supervisor = _ShardSupervisor(self.shards)
+        from yunikorn_tpu.robustness.health import HealthMonitor
+
+        self.health = HealthMonitor()
+        self.health.register("shards", self._shards_health)
+
+    # ------------------------------------------------------- compat surface
+    @property
+    def partition(self):
+        return self.primary.partition
+
+    @property
+    def partitions(self):
+        return self.primary.partitions
+
+    @property
+    def queues(self):
+        return self.primary.queues
+
+    @property
+    def queue_trees(self):
+        return self.primary.queue_trees
+
+    @property
+    def encoder(self):
+        return self.primary.encoder
+
+    @property
+    def _lock(self):
+        return self.primary._lock
+
+    @property
+    def _first_cycle_ms(self) -> Optional[float]:
+        vals = [c._first_cycle_ms for c in self.shards
+                if c._first_cycle_ms is not None]
+        return min(vals) if vals else None
+
+    @property
+    def metrics(self) -> dict:
+        return self.metrics_snapshot()
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.obs.snapshot()
+        last = {}
+        for k, core in enumerate(self.shards):
+            with core._lock:
+                for pname, entry in core._last_cycle.items():
+                    last[f"shard{k}/{pname}"] = dict(entry)
+        if last:
+            snap["last_cycle"] = last
+        return snap
+
+    def health_report(self) -> dict:
+        return self.health.report()
+
+    def _shards_health(self) -> dict:
+        per = {}
+        healthy = True
+        live = True
+        for k, core in enumerate(self.shards):
+            rep = core.health.report()
+            per[f"s{k}"] = {"ready": rep["ready"], "live": rep["live"]}
+            healthy = healthy and rep["ready"]
+            live = live and rep["live"]
+        out = {"healthy": healthy, "shards": per,
+               "ledger": self.ledger.stats()}
+        if not live:
+            out["live"] = False
+        return out
+
+    def recent_preemptions(self) -> List[dict]:
+        out = []
+        for core in self.shards:
+            out.extend(core.recent_preemptions())
+        out.sort(key=lambda p: p.get("at", 0))
+        return out
+
+    def validate_configuration(self, config_text: str):
+        return self.primary.validate_configuration(config_text)
+
+    def get_partition_dao(self) -> dict:
+        dao = self.primary.get_partition_dao()
+        dao["shards"] = self.shard_report()
+        return dao
+
+    def state_dump(self) -> str:
+        import json
+
+        return json.dumps(self.get_partition_dao(), default=str)
+
+    def observe_pod_bound(self, allocation_key: str) -> None:
+        for core in self.shards:
+            core.observe_pod_bound(allocation_key)
+
+    def fleet_fragmentation(self) -> float:
+        """ICI-domain fragmentation across every shard's free capacity.
+        Domains never straddle shards, so the global measure composes from
+        per-shard (max, total) free-unit aggregates exactly."""
+        import numpy as np
+
+        from yunikorn_tpu.topology.model import domain_free_units
+
+        best = 0
+        total = 0
+        for core in self.shards:
+            na = core.encoder.nodes
+            n_dom = na.num_ici_domains
+            if n_dom <= 0:
+                continue
+            free_i = np.floor(na.free).astype(np.int64)
+            cap_i = np.floor(na.capacity_arr).astype(np.int64)
+            free_d, _ = domain_free_units(na.topo[:, 2], free_i, cap_i,
+                                          n_dom)
+            if free_d.size:
+                best = max(best, int(free_d.max()))
+                total += int(free_d.sum())
+        if total <= 0:
+            return 0.0
+        return round(1.0 - best / total, 6)
+
+    def shard_report(self) -> dict:
+        """Operator surface (/ws/v1/shards + the replay fingerprint):
+        per-shard routing/commit counts, repair + ledger + epoch state."""
+        with self._stats_mu:
+            bound = list(self._bound_per_shard)
+            repair_live = len(self._repair)
+            repair_placed = self._repair_placed
+            suppressed = self._suppressed_completions
+        shards = []
+        for k, core in enumerate(self.shards):
+            shards.append({
+                "shard": k,
+                "nodes": len(self.fanout.names_for(k)),
+                "bound": bound[k],
+                # _cycle_seq is per-core (the registry's solve_count counter
+                # is shared across shards, i.e. fleet-total)
+                "cycles": int(core._cycle_seq),
+                "degraded": core.supervisor.degraded_paths(),
+            })
+        return {
+            "count": self.n,
+            "epoch": self.epoch,
+            "epoch_seconds": self.epoch_seconds,
+            "node_migrations": int(self._m_node_migrations.value()),
+            "shards": shards,
+            "repair": {
+                "in_flight": repair_live,
+                "placed": repair_placed,
+                "migrated": int(self._m_repair.value(outcome="migrated")),
+                "exhausted": int(self._m_repair.value(outcome="exhausted")),
+            },
+            "ledger": self.ledger.stats(),
+            "suppressed_completions": suppressed,
+        }
+
+    # ---------------------------------------------------------- SchedulerAPI
+    def register_resource_manager(self, request, callback) -> None:
+        self.callback = callback
+        self.rm_id = request.rm_id
+        self._rm_request = request
+        for k, core in enumerate(self.shards):
+            core.register_resource_manager(
+                request, _ShardCallback(self, k, callback))
+
+    def update_configuration(self, config: str, extra_config) -> None:
+        for core in self.shards:
+            core.update_configuration(config, extra_config)
+
+    def update_node(self, request: NodeRequest) -> None:
+        # routed per shard under ONE _mu pass, delivered as one batched
+        # NodeRequest per shard (a 10k-node fleet registration is N shard
+        # calls, not 10k lock/callback/trigger round-trips)
+        routed: Dict[int, List[SiNodeInfo]] = {}
+        with self._mu:
+            for info in request.nodes:
+                if info.action in (NodeAction.CREATE,
+                                   NodeAction.CREATE_DRAIN):
+                    labels = self._node_labels(info)
+                    old = self.fanout.owner_of(info.node_id)
+                    shard = self.partitioner.assign(info.node_id, labels)
+                    self.fanout.set_owner(info.node_id, shard)
+                    self._node_reg[info.node_id] = dataclasses.replace(
+                        info, existing_allocations=[])
+                    self._node_sched[info.node_id] = (
+                        info.action == NodeAction.CREATE)
+                    if old is not None and old != shard:
+                        # re-registration moved ownership (changed
+                        # topology labels): decommission the old shard or
+                        # it keeps the node registered forever (the same
+                        # DECOMISSION+CREATE contract reseed_epoch uses)
+                        routed.setdefault(old, []).append(SiNodeInfo(
+                            node_id=info.node_id,
+                            action=NodeAction.DECOMISSION))
+                    routed.setdefault(shard, []).append(info)
+                    continue
+                shard = self.fanout.owner_of(info.node_id)
+                if info.action == NodeAction.DECOMISSION:
+                    self.partitioner.remove(info.node_id)
+                    self.fanout.set_owner(info.node_id, None)
+                    self._node_reg.pop(info.node_id, None)
+                    self._node_sched.pop(info.node_id, None)
+                elif info.action == NodeAction.DRAIN_NODE:
+                    self._node_sched[info.node_id] = False
+                elif info.action == NodeAction.DRAIN_TO_SCHEDULABLE:
+                    self._node_sched[info.node_id] = True
+                if shard is not None:
+                    routed.setdefault(shard, []).append(info)
+        for shard, infos in routed.items():
+            self.shards[shard].update_node(NodeRequest(nodes=infos))
+
+    def _node_labels(self, info: SiNodeInfo) -> Optional[Dict[str, str]]:
+        node = getattr(info, "node", None)
+        labels = getattr(getattr(node, "metadata", None), "labels", None)
+        if labels:
+            return labels
+        cached = self.cache.get_node(info.node_id)
+        if cached is not None:
+            return getattr(cached.node.metadata, "labels", None)
+        return None
+
+    def _home_shard(self, app_id: str) -> int:
+        shard = self._app_home.get(app_id)
+        if shard is None:
+            shard = zlib.crc32(app_id.encode()) % self.n
+            self._app_home[app_id] = shard
+        return shard
+
+    def update_application(self, request: ApplicationRequest) -> None:
+        routed: Dict[int, ApplicationRequest] = {}
+        with self._mu:
+            for add in request.new:
+                shard = self._home_shard(add.application_id)
+                self._app_reqs[add.application_id] = add
+                self._app_shards.setdefault(add.application_id,
+                                            set()).add(shard)
+                routed.setdefault(
+                    shard, ApplicationRequest()).new.append(add)
+            for rem in request.remove:
+                shards = self._app_shards.pop(rem.application_id,
+                                              None) or set(range(self.n))
+                self._app_home.pop(rem.application_id, None)
+                self._app_reqs.pop(rem.application_id, None)
+                # purge the removed app's routing entries: the core emits
+                # no per-key releases on app removal, so these would
+                # otherwise leak (and misroute a reused key's release)
+                dead = [k for k, a in self._asks.items()
+                        if a.application_id == rem.application_id]
+                for k in dead:
+                    self._asks.pop(k, None)
+                    self._ask_home.pop(k, None)
+                with self._stats_mu:
+                    self._repair_allocs.pop(rem.application_id, None)
+                    self._suppressed_apps.discard(rem.application_id)
+                    for k in dead:
+                        self._repair.pop(k, None)
+                    for k in [k for k, v in self._alloc_shard.items()
+                              if v[1] == rem.application_id]:
+                        self._alloc_shard.pop(k, None)
+                for shard in shards:
+                    routed.setdefault(
+                        shard, ApplicationRequest()).remove.append(rem)
+        for shard, req in routed.items():
+            self.shards[shard].update_application(req)
+
+    def update_allocation(self, request: AllocationRequest) -> None:
+        routed: Dict[int, AllocationRequest] = {}
+        guest_apps: Dict[int, ApplicationRequest] = {}
+        with self._mu:
+            for ask in request.asks:
+                shard = None
+                if ask.preferred_node:
+                    shard = self.fanout.owner_of(ask.preferred_node)
+                    if (shard is not None
+                            and shard != self._home_shard(
+                                ask.application_id)):
+                        self._ensure_guest_app_locked(ask.application_id,
+                                                      shard, guest_apps)
+                if shard is None:
+                    shard = self._home_shard(ask.application_id)
+                self._ask_home[ask.allocation_key] = shard
+                self._asks[ask.allocation_key] = ask
+                routed.setdefault(
+                    shard, AllocationRequest()).asks.append(ask)
+                self._m_asks.inc(shard=str(shard))
+                with self._stats_mu:
+                    # fresh work revokes a pending fleet-level Completed
+                    # re-emit (the app is visibly not done anymore)
+                    self._suppressed_apps.discard(ask.application_id)
+            for alloc in request.allocations:
+                if alloc.foreign:
+                    shard = self.fanout.owner_of(alloc.node_id) or 0
+                else:
+                    shard = self._home_shard(alloc.application_id)
+                routed.setdefault(
+                    shard, AllocationRequest()).allocations.append(alloc)
+            for rel in request.releases:
+                # route each release to the shard(s) known to hold the key
+                # (pending ask home + committing shard); unknown keys —
+                # foreign allocations, recovery residue — broadcast. A 50k
+                # mass release then costs 50k walks, not 50k x N.
+                self._asks.pop(rel.allocation_key, None)
+                home = self._ask_home.pop(rel.allocation_key, None)
+                with self._stats_mu:
+                    self._repair.pop(rel.allocation_key, None)
+                    keys = self._repair_allocs.get(rel.application_id)
+                    if keys is not None:
+                        keys.discard(rel.allocation_key)
+                    held = self._alloc_shard.get(rel.allocation_key)
+                    held = held[0] if held is not None else None
+                targets = {s for s in (home, held) if s is not None}
+                if not targets:
+                    targets = set(range(self.n))
+                for shard in targets:
+                    routed.setdefault(
+                        shard, AllocationRequest()).releases.append(rel)
+        # guest registrations must land BEFORE the asks that need them
+        for shard, req in guest_apps.items():
+            self.shards[shard].update_application(req)
+        for shard, req in routed.items():
+            self.shards[shard].update_allocation(req)
+
+    def _ensure_guest_app_locked(self, app_id: str, shard: int,
+                                 routed: Optional[
+                                     Dict[int, ApplicationRequest]]
+                                 ) -> bool:
+        """Register the app in `shard` as a repair guest if absent (front
+        _mu held). `routed` must be an ApplicationRequest-keyed map (the
+        caller delivers it BEFORE any asks that depend on the guest);
+        None sends the registration inline — _mu before shard locks is
+        the sanctioned order."""
+        shards = self._app_shards.setdefault(app_id, set())
+        if shard in shards:
+            return False
+        add = self._app_reqs.get(app_id)
+        if add is None:
+            return False
+        guest = dataclasses.replace(add, tags=dict(add.tags))
+        guest.tags[GUEST_APP_TAG] = "true"
+        shards.add(shard)
+        if routed is not None:
+            routed.setdefault(shard, ApplicationRequest()).new.append(guest)
+        else:
+            self.shards[shard].update_application(
+                ApplicationRequest(new=[guest]))
+        return True
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        for k, core in enumerate(self.shards):
+            core.start()
+            # phase-stagger the cycle loops: shard k's device solve then
+            # overlaps its neighbors' host-side gate/commit windows
+            if k + 1 < self.n:
+                time.sleep(self._interval * (k + 1) / self.n / 4)
+        if self.epoch_seconds > 0 and self._epoch_thread is None:
+            self._epoch_stop.clear()
+            self._epoch_thread = threading.Thread(
+                target=self._epoch_loop, name="shard-epoch", daemon=True)
+            self._epoch_thread.start()
+
+    def stop(self) -> None:
+        self._epoch_stop.set()
+        if self._epoch_thread is not None:
+            self._epoch_thread.join(timeout=5)
+            self._epoch_thread = None
+        for core in self.shards:
+            core.stop()
+
+    def trigger(self) -> None:
+        for core in self.shards:
+            core.trigger()
+
+    def schedule_once(self) -> int:
+        """Drive one cycle on every shard (test/bench surface; production
+        runs the shards' own staggered loops)."""
+        total = 0
+        for core in self.shards:
+            total += core.schedule_once()
+        return total
+
+    # ------------------------------------------------------ epoch re-seeding
+    def _epoch_loop(self) -> None:
+        while not self._epoch_stop.wait(self.epoch_seconds):
+            try:
+                self.reseed_epoch()
+            except Exception:
+                logger.exception("shard epoch re-seed failed; assignment "
+                                 "unchanged this epoch")
+
+    def reseed_epoch(self) -> int:
+        """Advance the partition epoch: re-assign domains under a fresh
+        seed and migrate every moved node (DECOMISSION from the old shard,
+        CREATE into the new one, drain state preserved). Returns the
+        number of nodes migrated."""
+        with self._mu:
+            self.epoch += 1
+            moves = self.partitioner.reseed(self.epoch)
+            plan = []
+            for name, (old, new) in sorted(moves.items()):
+                reg = self._node_reg.get(name)
+                if reg is None:
+                    continue
+                self.fanout.set_owner(name, new)
+                plan.append((name, old, new, reg,
+                             self._node_sched.get(name, True)))
+        for name, old, new, reg, schedulable in plan:
+            self.shards[old].update_node(NodeRequest(nodes=[SiNodeInfo(
+                node_id=name, action=NodeAction.DECOMISSION)]))
+            create = dataclasses.replace(
+                reg,
+                action=(NodeAction.CREATE if schedulable
+                        else NodeAction.CREATE_DRAIN),
+                existing_allocations=[])
+            self.shards[new].update_node(NodeRequest(nodes=[create]))
+        if plan:
+            self._m_node_migrations.inc(len(plan))
+            logger.info("shard epoch %d: migrated %d nodes", self.epoch,
+                        len(plan))
+        self._m_epochs.inc()
+        return len(plan)
+
+    # ----------------------------------------------------------- repair pass
+    def _on_skipped(self, shard_idx: int,
+                    request: UpdateContainerSchedulingStateRequest) -> bool:
+        """A shard declared an ask unplaceable on ITS nodes. Returns True
+        when the SKIPPED is absorbed (repair migrated the ask to an
+        untried shard — the full-fleet pass); False surfaces it.
+
+        The whole migration runs under _mu — the lock every routing
+        writer (ask submit, release, node moves) takes — so a concurrent
+        pod release cannot interleave: either the release won _mu first
+        (then _asks no longer holds the key and we surface), or we
+        migrate first and the release's broadcast/pop reaches the target
+        shard afterwards, cleaning up the re-submitted ask normally."""
+        key = request.allocation_key
+        now = time.time()
+        with self._mu:
+            ask = self._asks.get(key)
+            if ask is None:
+                return False
+            with self._stats_mu:
+                st = self._repair.setdefault(
+                    key, {"tried": set(), "cool_until": 0.0})
+                st["tried"].add(shard_idx)
+                exhausted = len(st["tried"]) >= self.n
+                cooling = now < st["cool_until"]
+                if exhausted:
+                    # full-fleet pass complete: genuinely unschedulable
+                    # right now; cool down before the next round so
+                    # saturation does not ping-pong the ask between
+                    # shards every cycle
+                    st["tried"] = {shard_idx}
+                    st["cool_until"] = now + REPAIR_COOLDOWN_S
+                    tried = None
+                else:
+                    tried = set(st["tried"])
+            if tried is None:
+                self._m_repair.inc(outcome="exhausted")
+                return False
+            if cooling:
+                return False
+            untried = [k for k in range(self.n) if k not in tried]
+            if not untried:
+                return False
+            # prefer the untried shard with the most nodes (fleet
+            # coverage per hop); ties by index for determinism
+            target = max(untried,
+                         key=lambda k: (self.fanout.count_for(k), -k))
+            app_id = request.application_id
+            self._ensure_guest_app_locked(app_id, target, None)
+            self._ask_home[key] = target
+            # pull the pending ask out of the reporting shard, then
+            # re-submit to the target: _release_allocation pops a pending
+            # ask without emitting a release (the allocation never
+            # existed). Still under _mu: sanctioned _mu -> shard order.
+            from yunikorn_tpu.common.si import (AllocationRelease,
+                                                TerminationType)
+
+            self.shards[shard_idx].update_allocation(AllocationRequest(
+                releases=[AllocationRelease(
+                    application_id=app_id, allocation_key=key,
+                    termination_type=TerminationType.STOPPED_BY_RM,
+                    message="shard repair: migrating stranded ask")]))
+            self.shards[target].update_allocation(
+                AllocationRequest(asks=[ask]))
+            with self._stats_mu:
+                st = self._repair.get(key)
+                if st is not None:
+                    st["tried"].add(target)
+        self._m_repair.inc(outcome="migrated")
+        self._m_asks.inc(shard=str(target))
+        logger.info("shard repair: ask %s migrated s%d -> s%d", key,
+                    shard_idx, target)
+        return True
+
+    # ------------------------------------------------------------- callbacks
+    def _forget_asks(self, pairs: List[Tuple[str, str]]) -> None:
+        """Drop routing/repair entries for asks a shard REJECTED (no
+        release event will ever arrive for them). _mu is an RLock, so the
+        repair path's inline re-submit rejecting on the same thread is
+        safe."""
+        with self._mu:
+            for _app_id, key in pairs:
+                self._asks.pop(key, None)
+                self._ask_home.pop(key, None)
+            with self._stats_mu:
+                for _app_id, key in pairs:
+                    self._repair.pop(key, None)
+
+    def _note_allocations(self, shard_idx: int, response) -> None:
+        """Per-shard commit accounting + repair settlement (may run under
+        the shard's core lock: touches _stats_mu only; the deferred
+        Completed re-emit goes straight to the REAL callback — async on
+        the shim side, so safe from any lock context)."""
+        done_apps: List[str] = []
+        with self._stats_mu:
+            for alloc in response.new:
+                self._bound_per_shard[shard_idx] += 1
+                self._alloc_shard[alloc.allocation_key] = (
+                    shard_idx, alloc.application_id)
+                self._m_bound.inc(shard=str(shard_idx))
+                if self._repair.pop(alloc.allocation_key, None) is not None:
+                    self._repair_placed += 1
+                    self._m_repair.inc(outcome="placed")
+                home = self._app_home.get(alloc.application_id)
+                if home is not None and home != shard_idx:
+                    self._repair_allocs.setdefault(
+                        alloc.application_id, set()).add(
+                            alloc.allocation_key)
+            for rel in response.released:
+                self._alloc_shard.pop(rel.allocation_key, None)
+                keys = self._repair_allocs.get(rel.application_id)
+                if keys is not None:
+                    keys.discard(rel.allocation_key)
+                    if not keys:
+                        self._repair_allocs.pop(rel.application_id, None)
+                        # the home shard already decided Completed (we
+                        # suppressed it while this allocation was live);
+                        # the fleet view is done now — re-emit, or the
+                        # shim waits forever
+                        if rel.application_id in self._suppressed_apps:
+                            self._suppressed_apps.discard(
+                                rel.application_id)
+                            done_apps.append(rel.application_id)
+        if done_apps and self.callback is not None:
+            from yunikorn_tpu.common.si import (ApplicationResponse,
+                                                UpdatedApplication)
+
+            logger.info("re-emitting Completed for %s: last repaired "
+                        "allocation released", done_apps)
+            self.callback.update_application(ApplicationResponse(updated=[
+                UpdatedApplication(application_id=a, state="Completed",
+                                   message="application completed")
+                for a in done_apps]))
+
+    def _filter_app_updates(self, shard_idx: int, response):
+        """Suppress app-Completed updates the reporting shard cannot decide
+        alone: while repaired allocations of the app live in OTHER shards,
+        the app is not done — only the fleet view knows."""
+        if not response.updated:
+            return response
+        kept = []
+        for upd in response.updated:
+            if upd.state == "Completed":
+                with self._stats_mu:
+                    live = self._repair_allocs.get(upd.application_id)
+                    if live:
+                        self._suppressed_completions += 1
+                        # remember: core emits Completed only once (the
+                        # state transition); _note_allocations re-emits
+                        # when the last repaired allocation releases
+                        self._suppressed_apps.add(upd.application_id)
+                        logger.info(
+                            "suppressing Completed for %s from s%d: %d "
+                            "repaired allocation(s) live elsewhere",
+                            upd.application_id, shard_idx, len(live))
+                        continue
+            kept.append(upd)
+        if not (kept or response.accepted or response.rejected):
+            return None
+        return dataclasses.replace(response, updated=kept)
+
+
+# ---------------------------------------------------------------------------
+# Factory: the conf-driven entry point
+# ---------------------------------------------------------------------------
+def resolve_shards(value) -> int:
+    """solver.shards -> shard count. "auto" resolves to 1 (sharding is
+    opt-in: the single-shard scheduler stays bit-identical to the pre-shard
+    one, and auto-scaling by fleet size is a follow-up once the parity
+    bench has hardware numbers); integers clamp to [1, 64]."""
+    s = str(value).strip().lower()
+    if s in ("", "auto"):
+        return 1
+    try:
+        return max(1, min(int(s), 64))
+    except ValueError:
+        logger.warning("invalid solver.shards %r; using 1", value)
+        return 1
+
+
+def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
+                        solver_policy=None, solver_options=None,
+                        trace_spans: int = 4096, supervisor_options=None,
+                        slo_options=None, epoch_seconds: float = 0.0):
+    """Build the scheduler for a shard count: a plain CoreScheduler for 1
+    (bit-identical to the pre-shard scheduler — no ledger, no views, no
+    namespaces), the sharded front end for N >= 2."""
+    n = shards if isinstance(shards, int) else resolve_shards(shards)
+    if n <= 1:
+        return CoreScheduler(cache, interval=interval,
+                             solver_policy=solver_policy,
+                             solver_options=solver_options,
+                             trace_spans=trace_spans,
+                             supervisor_options=supervisor_options,
+                             slo_options=slo_options)
+    return ShardedCoreScheduler(
+        cache, n, interval=interval, solver_policy=solver_policy,
+        solver_options=solver_options, trace_spans=trace_spans,
+        supervisor_options=supervisor_options, slo_options=slo_options,
+        epoch_seconds=epoch_seconds)

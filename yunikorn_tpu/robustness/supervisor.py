@@ -232,6 +232,16 @@ class SupervisedExecutor:
         # it as a label so dashboards separate the two solve paths without
         # new series names
         self.policy_label = "greedy"
+        # control-plane sharding (core/shard.py): shards share one metrics
+        # registry, so each shard's supervisor prefixes its path LABEL
+        # (e.g. "s2/assign") to keep per-shard series distinct — breakers,
+        # ladders and degraded_paths() stay keyed by the bare path name.
+        self.path_label_prefix = ""
+        # optional context-manager factory entered around every tier fn ON
+        # the watchdog thread that runs it (thread-local state like the
+        # shard's AOT fingerprint namespace must be set there, not on the
+        # scheduler thread that called execute())
+        self.dispatch_cm: Optional[Callable] = None
         self._mu = threading.Lock()
         self._breakers: Dict[Tuple[str, str], CircuitBreaker] = {}
         self._ladders: Dict[str, Tuple[str, ...]] = {}
@@ -281,7 +291,8 @@ class SupervisedExecutor:
         if path not in self._tier_state:
             self._tier_state[path] = ladder[0]
             if self._g_state is not None:
-                self._g_state.set(TIER_GAUGE.get(ladder[0], 0), path=path)
+                self._g_state.set(TIER_GAUGE.get(ladder[0], 0),
+                                  path=self.path_label_prefix + path)
 
     def _effective_tier(self, path: str) -> str:
         """First tier whose circuit is not open (half-open counts: it is
@@ -299,7 +310,8 @@ class SupervisedExecutor:
         """Breaker state changed (mutex held): re-derive the path's tier and
         publish degrade/recover when it moved."""
         if self._m_transitions is not None:
-            self._m_transitions.inc(path=path, tier=tier, state=state)
+            self._m_transitions.inc(path=self.path_label_prefix + path,
+                                    tier=tier, state=state)
         ladder = self._ladders.get(path, ("device",))
         old = self._tier_state.get(path, ladder[0])
         new = self._effective_tier(path)
@@ -315,7 +327,8 @@ class SupervisedExecutor:
         self._transitions.append({"at": round(now, 3), "path": path,
                                   "from": old, "to": new, "event": event})
         if self._g_state is not None:
-            self._g_state.set(TIER_GAUGE.get(new, 3), path=path)
+            self._g_state.set(TIER_GAUGE.get(new, 3),
+                              path=self.path_label_prefix + path)
         if self.tracer is not None:
             self.tracer.add(event, self.cycle_id, now, now, path=path,
                             from_tier=old, to_tier=new)
@@ -497,7 +510,11 @@ class SupervisedExecutor:
                  deadline_s: Optional[float]):
         def wrapped():
             self.faults.on_attempt(path, tier)
-            return fn()
+            cm = self.dispatch_cm
+            if cm is None:
+                return fn()
+            with cm():
+                return fn()
 
         try:
             return self._run_deadline(wrapped, deadline_s)
@@ -516,7 +533,8 @@ class SupervisedExecutor:
         if _call_abandoned():
             return  # a zombie's outcome must not move live circuits/metrics
         if self._m_dispatch is not None:
-            self._m_dispatch.inc(path=path, outcome=outcome,
+            self._m_dispatch.inc(path=self.path_label_prefix + path,
+                                 outcome=outcome,
                                  policy=self.policy_label)
         with self._mu:
             br = self._breaker(path, tier)

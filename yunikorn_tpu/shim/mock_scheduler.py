@@ -34,7 +34,10 @@ class MockScheduler:
     def _boot(self, queues_yaml: str, interval: float, core_interval: float,
               solver_policy: Optional[str], conf_extra: Optional[dict]) -> None:
         """Shared conf/dispatcher/core/shim construction for init + restart
-        (self.cluster must already exist)."""
+        (self.cluster must already exist). conf_extra's solver.shards (or
+        the configmap's) selects the control-plane shard count: "auto"/1
+        builds the plain CoreScheduler, N >= 2 the sharded front end
+        (core/shard.make_core_scheduler)."""
         reset_for_tests()
         holder = get_holder()
         cm = {"service.schedulingInterval": str(interval),
@@ -44,13 +47,15 @@ class MockScheduler:
         dispatch_mod.reset_dispatcher()
         cache = SchedulerCache()
         from yunikorn_tpu.core.scheduler import SolverOptions
+        from yunikorn_tpu.core.shard import make_core_scheduler
 
         self._solver_policy = solver_policy
         from yunikorn_tpu.obs.slo import SloOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
 
-        self.core = CoreScheduler(
-            cache, interval=core_interval, solver_policy=solver_policy,
+        self.core = make_core_scheduler(
+            cache, shards=holder.get().solver_shards,
+            interval=core_interval, solver_policy=solver_policy,
             solver_options=SolverOptions.from_conf(holder.get()),
             supervisor_options=SupervisorOptions.from_conf(holder.get()),
             slo_options=SloOptions.from_conf(holder.get()))

@@ -127,6 +127,15 @@ class RestServer:
                         return self._reply(200, core.slo.report())
                     return self._reply(404, {"error": "slo engine "
                                                       "unavailable"})
+                if path == "/ws/v1/shards":
+                    # control-plane sharding (core/shard.py): per-shard
+                    # node/commit/cycle counts, repair-pass + quota-ledger
+                    # + partition-epoch state. 404 on the single-shard
+                    # scheduler — the surface exists only when sharded
+                    if hasattr(core, "shard_report"):
+                        return self._reply(200, core.shard_report())
+                    return self._reply(404, {"error": "scheduler is not "
+                                                      "sharded"})
                 if path == "/ws/v1/preemptions":
                     # recent preemption plans (ring-buffered): which ask
                     # evicted which victims on which node, by which planner
