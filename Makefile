@@ -2,8 +2,9 @@
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke \
-	slo-smoke topology-smoke shard-smoke smoke lint run-scheduler \
-	run-admission dryrun clean image sched_image adm_image webtest_image
+	slo-smoke topology-smoke shard-smoke policy-smoke smoke lint \
+	run-scheduler run-admission dryrun clean image sched_image adm_image \
+	webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -118,7 +119,29 @@ shard-smoke:  ## control-plane sharding (solver.shards): ledger/partitioner/repa
 		python scripts/shard_bench.py --shape 2000x1000x64 --shards 1,4 \
 		--assert-quality
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke shard-smoke  ## all tier-1 smoke targets
+policy-smoke:  ## learned dispatch policy (solver.policy=learned): unit suite (untrained-is-inert, checkpoint REJECT-on-mismatch, N-way priority-guarded duel, ladder chaos), the 4k-node fragmented train-then-solve gate (trained checkpoint wins >= 5% packed units vs greedy with ZERO placement loss; garbage checkpoint commits bit-identical-to-greedy), and the replay round trip (record duels --dataset-out -> train -> three-arm --ab where the learned arm never loses placements)
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_policy.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/policy_bench.py --train --pods 512 --nodes 4096 \
+		--assert-quality
+	rm -rf /tmp/yk_policy_smoke_ds
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace slice-fragmentation \
+		--nodes 64 --pods 48 --tenants 2 --duration 8 --no-prewarm \
+		--policy optimal --dataset-out /tmp/yk_policy_smoke_ds \
+		--assert-slo
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/policy_train.py --dataset /tmp/yk_policy_smoke_ds \
+		--out /tmp/yk_policy_smoke_ck --imitation-epochs 30 \
+		--finetune-epochs 20
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace slice-fragmentation \
+		--nodes 64 --pods 48 --tenants 2 --duration 8 --no-prewarm \
+		--ab --policy-checkpoint /tmp/yk_policy_smoke_ck \
+		--assert-quality
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

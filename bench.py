@@ -127,6 +127,9 @@ def _backend_unavailable_json(error: str, init_secs: float) -> str:
         "slo": {},
         "topology": {"mode": "off", "gangs_total": 0,
                      "cross_domain_gangs": 0, "fragmentation": 0.0},
+        "policy": {"active": "greedy", "checkpoint_hash": "",
+                   "checkpoint_epoch": 0, "duels": {},
+                   "last_inference_ms": 0.0},
     })
 
 
@@ -417,6 +420,40 @@ def _topology_block(core) -> dict:
         return {"mode": "error", "error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _policy_block(core) -> dict:
+    """Learned-dispatch-policy evidence for the bench JSON (round 17): the
+    active solver.policy mode, the validated checkpoint (hash + epoch) if
+    one is loaded, committed-duel counts per policy, and the most recent
+    learned-plan inference latency. The microbench's homogeneous pods give
+    the learned arm nothing to win — scripts/policy_bench.py is where the
+    packed-units win is measured and gated — but the block rides every
+    JSON shape (incl. backend-unavailable) so a run with a checkpoint
+    attached is always attributable to its exact params."""
+    try:
+        ck = getattr(core, "_policy_ckpt", None)
+        duels = {}
+        c = core.obs.get("policy_duels_total")
+        if c is not None:
+            for pol in ("greedy", "optimal", "learned"):
+                won = int(c.sum_over(policy=pol, outcome="won"))
+                if won:
+                    duels[pol] = won
+        g = core.obs.get("policy_last_inference_ms")
+        solver = getattr(core, "solver", None)
+        return {
+            "active": str(getattr(solver, "policy", "greedy")),
+            "checkpoint_hash": ck.hash if ck is not None else "",
+            "checkpoint_epoch": int(ck.epoch) if ck is not None else 0,
+            "duels": duels,
+            "last_inference_ms": (round(float(g.value()), 2)
+                                  if g is not None else 0.0),
+        }
+    except Exception as e:
+        # same contract as _slo_block/_topology_block: present in every
+        # shape, carrying the error instead of fabricated zeros
+        return {"active": "error", "error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _preempt_stat(core) -> float:
     """Latest preemption-planning latency (ms) recorded by the core
     registry this run. 0.0 when no pressure cycle planned."""
@@ -590,7 +627,7 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
         return (stats.throughput(), wall, stats.success_count, len(pods),
                 _preempt_stat(ms.core), _degradations(ms.core),
                 _cycle_stats(ms.core), _slo_block(ms.core),
-                _topology_block(ms.core))
+                _topology_block(ms.core), _policy_block(ms.core))
     finally:
         ms.stop()
 
@@ -744,6 +781,7 @@ def main() -> int:
         **core_cycle_stats,
         "slo": _slo_block(core),
         "topology": _topology_block(core),
+        "policy": _policy_block(core),
     }
 
     if MODE == "both":
@@ -768,7 +806,8 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
     core-cycle number, that stays the headline (north-star metric) and the
     shim e2e rides along; standalone shim mode publishes the shim number."""
     (shim_tp, shim_wall, bound, total, shim_preempt_ms, shim_degr,
-     shim_cycle_stats, shim_slo, shim_topo) = run_shim_mode(N_PODS, N_NODES)
+     shim_cycle_stats, shim_slo, shim_topo,
+     shim_policy) = run_shim_mode(N_PODS, N_NODES)
     print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
           f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
     if core_pods_per_s is None:
@@ -786,6 +825,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             **shim_cycle_stats,
             "slo": shim_slo,
             "topology": shim_topo,
+            "policy": shim_policy,
         }
     return {
         "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
@@ -812,6 +852,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         # the run's delivered-latency verdicts
         "slo": shim_slo,
         "topology": shim_topo,
+        "policy": shim_policy,
     }
 
 

@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""Learned-policy A/B: train-then-solve round trip on a seeded fragmented
+trace, gating the round-17 acceptance criteria.
+
+The scenario is the pack-bench fragmentation shape — two node flavors with
+opposite headroom (cpu-rich/mem-poor vs cpu-poor/mem-rich) under a mixed
+cpu-heavy/mem-heavy ask wave with priority skew — exactly where the greedy
+scalar score strands capacity and the round-12 LP pack solver wins. The
+bench:
+
+  1. records a duel DATASET at the training shape: each seeded cycle runs
+     the greedy solve and the pack solve, the production `choose_plan`
+     decides the winner, and the cycle is written in the exact
+     CoreScheduler.policy_recorder format trace_replay --dataset-out uses
+     (policy/train.py is the single source for both);
+  2. trains a checkpoint from it (imitation of the duel winners +
+     packed-units fine-tune) — or loads one via --checkpoint;
+  3. evaluates at the EVAL shape (default 512 pods x 4096 nodes, the
+     acceptance scale): the learned-arm solve vs the greedy solve on a
+     fresh seeded cycle, compared with the solver's capacity-normalized
+     packed units;
+  4. proves the safety floor: an UNTRAINED (zero output layer) checkpoint
+     must produce a plan bit-identical to greedy's.
+
+--assert-quality (the policy-smoke CI gate) exits nonzero unless, at the
+eval shape: learned packed units >= --min-win x greedy's (default 1.05 —
+the ">= 5%% win" acceptance bar), learned placements >= greedy placements
+(zero placement loss), AND the untrained arm is bit-identical to greedy.
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build(n_pods: int, n_nodes: int, seed: int = 0):
+    """Seeded fragmented-fleet cycle (the pack_bench shape + priorities)."""
+    import numpy as np
+
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    rng = random.Random(seed)
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        if i % 2 == 0:
+            cache.update_node(make_node(f"n{i:05d}", cpu_milli=8000,
+                                        memory=4 * 2**30))
+        else:
+            cache.update_node(make_node(f"n{i:05d}", cpu_milli=2000,
+                                        memory=16 * 2**30))
+    pods = []
+    for k in range(n_pods):
+        if rng.random() < 0.5:
+            pods.append(make_pod(f"p{k}", cpu_milli=1900, memory=2**28,
+                                 priority=rng.choice([0, 5])))
+        else:
+            pods.append(make_pod(f"p{k}", cpu_milli=300, memory=3 * 2**30,
+                                 priority=rng.choice([0, 5])))
+    asks = [AllocationAsk(p.uid, "policy-app", get_pod_resource(p),
+                          priority=p.spec.priority or 0, pod=p)
+            for p in pods]
+    priorities = np.asarray([p.spec.priority or 0 for p in pods])
+    order = np.lexsort((np.arange(len(pods)), -priorities))
+    ranks = np.empty(len(pods), np.int64)
+    ranks[order] = np.arange(len(pods))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return enc, enc.build_batch(asks, ranks=ranks.tolist()), priorities
+
+
+def record_cycle(enc, batch, priorities, writer) -> str:
+    """One greedy-vs-pack duel, recorded in the policy_recorder format."""
+    import numpy as np
+
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    ga = np.asarray(solve_batch(batch, enc.nodes).assigned)[:batch.num_pods]
+    pa = np.asarray(pack_mod.pack_solve_batch(
+        batch, enc.nodes, seed=7).assigned)[:batch.num_pods]
+    cap = np.floor(enc.nodes.capacity_arr).astype(np.int64)
+    winner, _ = pack_mod.choose_plan_n(
+        [("greedy", ga), ("optimal", pa)], batch.req.astype(np.int32),
+        batch.valid, cap_i=cap, priorities=priorities)
+    na = enc.nodes
+    writer({
+        "req": batch.req.astype(np.int32),
+        "rank": np.asarray(batch.rank),
+        "valid": np.asarray(batch.valid),
+        "free0": np.floor(na.free).astype(np.int32),
+        "cap": cap.astype(np.int32),
+        "node_ok": np.asarray(na.valid & na.schedulable),
+        "priorities": priorities,
+        "score_cols": int(batch.req.shape[1]),
+        "winner": winner,
+        "plan_greedy": ga,
+        "plan_optimal": pa,
+    })
+    return winner
+
+
+def evaluate(params, untrained, n_pods: int, n_nodes: int, seed: int) -> dict:
+    import numpy as np
+
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    enc, batch, priorities = build(n_pods, n_nodes, seed=seed)
+    n = batch.num_pods
+
+    def timed(fn):
+        fn()                       # cold (trace+compile or store load)
+        t0 = time.time()
+        out = fn()
+        return out, (time.time() - t0) * 1000
+
+    ga, greedy_ms = timed(lambda: np.asarray(
+        solve_batch(batch, enc.nodes).assigned)[:n])
+    la, learned_ms = timed(lambda: np.asarray(
+        solve_batch(batch, enc.nodes, learned=(params, 1)).assigned)[:n])
+    ua = np.asarray(solve_batch(
+        batch, enc.nodes, learned=(untrained, 1)).assigned)[:n]
+    cap = np.floor(enc.nodes.capacity_arr).astype(np.int64)
+    req_i = batch.req.astype(np.int32)
+    winner, utils = pack_mod.choose_plan_n(
+        [("greedy", ga), ("learned", la)], req_i, batch.valid,
+        cap_i=cap, priorities=priorities)
+    g, l = utils["greedy"], utils["learned"]
+    return {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "greedy_placed": g["placed"],
+        "learned_placed": l["placed"],
+        "greedy_units_norm": round(g["units_norm"], 3),
+        "learned_units_norm": round(l["units_norm"], 3),
+        # the production decision rule's own verdict + the A/B headline
+        "duel_winner": winner,
+        "util_ratio": round(l["units_norm"] / max(g["units_norm"], 1e-9), 4),
+        "greedy_warm_ms": round(greedy_ms, 1),
+        "learned_warm_ms": round(learned_ms, 1),
+        "latency_ratio": round(learned_ms / max(greedy_ms, 1e-6), 2),
+        "untrained_bit_identical": bool(np.array_equal(ua, ga)),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--train", action="store_true",
+                    help="record a dataset + train a checkpoint first "
+                         "(otherwise --checkpoint must point at one)")
+    ap.add_argument("--checkpoint", default="",
+                    help="existing checkpoint prefix to evaluate")
+    ap.add_argument("--out", default="",
+                    help="checkpoint prefix to write when --train "
+                         "(default: a temp dir)")
+    ap.add_argument("--dataset-out", default="",
+                    help="keep the recorded dataset here when --train")
+    ap.add_argument("--train-shape", default="256x128",
+                    help="podsxnodes of each recorded training cycle")
+    ap.add_argument("--train-cycles", type=int, default=4)
+    ap.add_argument("--pods", type=int, default=512)
+    ap.add_argument("--nodes", type=int, default=4096,
+                    help="eval node count (the acceptance gate runs 4k+)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--eval-seed", type=int, default=99)
+    ap.add_argument("--imitation-epochs", type=int, default=60)
+    ap.add_argument("--finetune-epochs", type=int, default=40)
+    ap.add_argument("--min-win", type=float, default=1.05,
+                    help="required learned/greedy packed-units ratio "
+                         "(1.05 = the >=5%% acceptance bar)")
+    ap.add_argument("--assert-quality", action="store_true",
+                    help="exit 1 unless the win/no-loss/bit-identity gates "
+                         "all hold (the policy-smoke CI gate)")
+    args = ap.parse_args()
+
+    from yunikorn_tpu.policy import net as pnet
+    from yunikorn_tpu.policy import train as ptrain
+
+    if args.train:
+        ds_dir = args.dataset_out or os.path.join(
+            tempfile.mkdtemp(prefix="yk_policy_"), "ds")
+        writer = ptrain.DatasetWriter(ds_dir)
+        tp, tn = (int(x) for x in args.train_shape.split("x"))
+        t0 = time.time()
+        winners = {}
+        for c in range(args.train_cycles):
+            enc, batch, pr = build(tp, tn, seed=args.seed + c)
+            w = record_cycle(enc, batch, pr, writer)
+            winners[w] = winners.get(w, 0) + 1
+        print(f"[policy-bench] recorded {writer.written} cycles at "
+              f"{args.train_shape} in {time.time() - t0:.1f}s "
+              f"(winners: {winners})", file=sys.stderr, flush=True)
+        t0 = time.time()
+        params, report = ptrain.fit(
+            ptrain.load_dataset(ds_dir), seed=args.seed,
+            imitation_epochs=args.imitation_epochs,
+            finetune_epochs=args.finetune_epochs)
+        print(f"[policy-bench] trained in {time.time() - t0:.1f}s: "
+              f"{report}", file=sys.stderr, flush=True)
+        out = args.out or os.path.join(os.path.dirname(ds_dir), "ck")
+        ck = pnet.save_checkpoint(
+            out, params, epoch=args.imitation_epochs + args.finetune_epochs,
+            meta={"bench": True, "train_shape": args.train_shape,
+                  "cycles": writer.written})
+        print(f"[policy-bench] checkpoint {out} (hash {ck.hash})",
+              file=sys.stderr, flush=True)
+    elif args.checkpoint:
+        ck = pnet.load_checkpoint(args.checkpoint)
+        params = ck.params
+    else:
+        print("FAIL: pass --train or --checkpoint", file=sys.stderr)
+        return 2
+
+    result = evaluate(params, pnet.init_params(args.seed + 1),
+                      args.pods, args.nodes, args.eval_seed)
+    result["checkpoint_hash"] = ck.hash
+    print(json.dumps(result), flush=True)
+
+    if args.assert_quality:
+        ok = True
+        if result["util_ratio"] < args.min_win:
+            print(f"FAIL: learned/greedy packed-units ratio "
+                  f"{result['util_ratio']} < required {args.min_win}",
+                  file=sys.stderr)
+            ok = False
+        if result["learned_placed"] < result["greedy_placed"]:
+            print(f"FAIL: learned arm lost placements "
+                  f"({result['learned_placed']} < "
+                  f"{result['greedy_placed']})", file=sys.stderr)
+            ok = False
+        if not result["untrained_bit_identical"]:
+            print("FAIL: untrained checkpoint's plan is not bit-identical "
+                  "to greedy", file=sys.stderr)
+            ok = False
+        if not ok:
+            return 1
+        print(f"OK: learned beats greedy {result['util_ratio']}x packed "
+              f"units at {result['nodes']} nodes with zero placement loss "
+              f"({result['learned_placed']} vs {result['greedy_placed']} "
+              f"placed); untrained arm bit-identical to greedy",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Offline trainer for the learned dispatch policy (solver.policy=learned).
+
+Consumes a duel dataset recorded by `scripts/trace_replay.py --dataset-out`
+or `scripts/policy_bench.py` (the CoreScheduler.policy_recorder format: raw
+per-cycle solve tensors + every candidate plan + the choose_plan winner),
+runs the DOPPLER-style two-phase fit (imitation of recorded duel winners,
+then fine-tuning on the packed-units + contention relaxation — see
+yunikorn_tpu/policy/train.py), and emits a versioned checkpoint
+(`<out>.npz` + `<out>.json`) loadable via conf `solver.policyCheckpoint`.
+
+Deterministic: same dataset + seed + hyperparameters => byte-identical
+params (and therefore the same checkpoint content hash).
+
+Usage:
+    python scripts/policy_train.py --dataset /tmp/yk_policy_ds \
+        --out /tmp/yk_policy_ck
+    python -m yunikorn_tpu.cmd.scheduler --policy learned \
+        --policy-checkpoint /tmp/yk_policy_ck
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dataset", required=True,
+                    help="dataset dir (trace_replay --dataset-out)")
+    ap.add_argument("--out", required=True,
+                    help="checkpoint prefix (writes <out>.npz + <out>.json)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--imitation-epochs", type=int, default=80)
+    ap.add_argument("--finetune-epochs", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--epoch-tag", type=int, default=None,
+                    help="epoch number stamped into the manifest (defaults "
+                         "to imitation+finetune epochs)")
+    args = ap.parse_args()
+
+    from yunikorn_tpu.policy import net as pnet
+    from yunikorn_tpu.policy import train as ptrain
+
+    examples = ptrain.load_dataset(args.dataset)
+    if not examples:
+        print(f"FAIL: no cycle examples under {args.dataset}",
+              file=sys.stderr)
+        return 1
+    winners = {}
+    for ex in examples:
+        winners[ex["winner"]] = winners.get(ex["winner"], 0) + 1
+    print(f"[policy-train] {len(examples)} cycles "
+          f"(duel winners: {winners})", file=sys.stderr, flush=True)
+    params, report = ptrain.fit(
+        examples, seed=args.seed,
+        imitation_epochs=args.imitation_epochs,
+        finetune_epochs=args.finetune_epochs, lr=args.lr)
+    epoch = (args.epoch_tag if args.epoch_tag is not None
+             else args.imitation_epochs + args.finetune_epochs)
+    ck = pnet.save_checkpoint(
+        args.out, params, epoch=epoch,
+        meta={"dataset": os.path.abspath(args.dataset),
+              "cycles": len(examples), "winners": winners,
+              "seed": args.seed, "report": report})
+    print(json.dumps({"checkpoint": args.out, "hash": ck.hash,
+                      "epoch": ck.epoch, "cycles": len(examples),
+                      "winners": winners, "losses": report}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,9 +43,15 @@ poisons the supervised assign path mid-trace — the staleness objective must
 detect it (`--expect-violation` asserts that it does).
 
 A/B (--ab): replays the identical trace under solver.policy=greedy and
-=optimal and records preemption volume + placement counts for both — the
-round-12 follow-up (a denser cycle should preempt less under contention;
-raise --overcommit above 1.0 to create it).
+=optimal — and, when --policy-checkpoint names a trained learned-policy
+checkpoint, a THIRD arm under solver.policy=learned — recording preemption
+volume + placement counts for every arm, with the policy (and the active
+checkpoint hash) named in each arm's fingerprint block so A/B reports stay
+seed-reproducible across checkpoints. --assert-quality gates the learned
+arm against the greedy arm (never fewer pods bound). --dataset-out records
+every choose_plan duel the replay's core runs (raw solve tensors + plans +
+winner) as a training dataset for scripts/policy_train.py — the scheduler
+feeding its own training loop.
 
 Usage (acceptance shape):
     python scripts/trace_replay.py --trace gang-storm --nodes 10000 --assert-slo
@@ -257,11 +263,14 @@ class ReplayStack:
     trace's recovery-under-pressure seam."""
 
     def __init__(self, server, port: int, conf_map: Dict[str, str],
-                 policy: str):
+                 policy: str, recorder=None):
         self.server = server
         self.port = port
         self.conf_map = dict(conf_map)
         self.policy = policy
+        # policy duel recorder (policy/train.DatasetWriter): re-attached on
+        # every (re)boot so a restart-storm rebuild keeps recording
+        self.recorder = recorder
         self.violations_history: List[Dict[str, int]] = []
         self.restarts = 0
         self.restart_first_cycle_ms: Optional[float] = None
@@ -294,6 +303,10 @@ class ReplayStack:
             solver_options=SolverOptions.from_conf(conf),
             supervisor_options=SupervisorOptions.from_conf(conf),
             slo_options=SloOptions.from_conf(conf))
+        if self.recorder is not None:
+            target = getattr(self.core, "primary", self.core)
+            if hasattr(target, "policy_recorder"):
+                target.policy_recorder = self.recorder
         ctx = Context(self.provider, self.core, cache=cache)
         self.shim = KubernetesShim(self.provider, self.core, context=ctx)
         self.core.start()
@@ -431,12 +444,41 @@ def run_replay(args, policy: str) -> dict:
         # disjoint topology-aligned node partitions behind one front end
         "solver.shards": str(args.shards),
     }
+    if args.policy_checkpoint:
+        # learned-policy checkpoint (round 17): only the learned arm
+        # dispatches it, but the conf rides every arm so the A/B replays
+        # one identical configuration modulo solver.policy
+        conf_map["solver.policyCheckpoint"] = args.policy_checkpoint
     if args.aot_store:
         from yunikorn_tpu import aot
 
         aot.install(args.aot_store, background=False)
 
-    stack = ReplayStack(server, port, conf_map, policy)
+    recorder = None
+    if args.dataset_out:
+        from yunikorn_tpu.policy.train import DatasetWriter
+
+        if args.shards > 1:
+            print("[replay] WARNING: --dataset-out records the primary "
+                  "shard only", file=sys.stderr, flush=True)
+        runs_duels = (policy in ("optimal", "all")
+                      or (policy == "learned" and args.policy_checkpoint))
+        if not runs_duels:
+            # greedy never duels; learned without a checkpoint skips every
+            # cycle ("no-checkpoint") — either way the dataset stays empty
+            print(f"[replay] WARNING: --dataset-out records choose_plan "
+                  f"duels, and solver.policy={policy} runs none here "
+                  "(use optimal/all, or learned WITH --policy-checkpoint)",
+                  file=sys.stderr, flush=True)
+        # each --ab arm records into its own subdirectory: DatasetWriter
+        # owns (and wipes) its dir, so arms sharing one path would erase
+        # each other's cycles
+        ds_path = (os.path.join(args.dataset_out, policy) if args.ab
+                   else args.dataset_out)
+        recorder = DatasetWriter(ds_path,
+                                 max_cycles=args.dataset_max_cycles)
+
+    stack = ReplayStack(server, port, conf_map, policy, recorder=recorder)
     ledger = {"completed": set()}
     timings: Dict[str, object] = {}
     try:
@@ -659,6 +701,7 @@ def run_replay(args, policy: str) -> dict:
         mis_evict = int(
             core.obs.get("preemption_mis_evictions_total").value())
         e2e = core.obs.get("pod_e2e_latency_seconds")
+        timings["policy_duels"] = _duel_counts(core)
         timings["wall_s"] = round(time.time() - t_run0, 2)
         timings["restart_first_cycle_ms"] = stack.restart_first_cycle_ms
         timings["bound_e2e_observations"] = (
@@ -700,6 +743,12 @@ def run_replay(args, policy: str) -> dict:
                 "restarts": stack.restarts,
                 "topology": topo_block,
                 "shards": shard_block,
+                # the learned-policy hash makes A/B reports seed-
+                # reproducible ACROSS checkpoints (two runs only
+                # fingerprint-match when the same params served); duel
+                # COUNTS are cycle-batching- (timing-) dependent and ride
+                # `timings` below
+                "policy_checkpoint": _ckpt_hash(core),
             },
             "slo": slo_report,
             "violations": violations,
@@ -710,6 +759,27 @@ def run_replay(args, policy: str) -> dict:
     finally:
         stack.stop()
         server.stop()
+
+
+def _ckpt_hash(core) -> Optional[str]:
+    """Active learned-policy checkpoint hash (primary shard) or None."""
+    target = getattr(core, "primary", core)
+    ck = getattr(target, "_policy_ckpt", None)
+    return ck.hash if ck is not None else None
+
+
+def _duel_counts(core) -> Dict[str, int]:
+    """Committed-winner counts per policy from the duel counter (seed-
+    deterministic: the duel inputs and decision rule are)."""
+    c = core.obs.get("policy_duels_total")
+    if c is None:
+        return {}
+    out = {}
+    for pol in ("greedy", "optimal", "learned"):
+        won = int(c.sum_over(policy=pol, outcome="won"))
+        if won:
+            out[pol] = won
+    return out
 
 
 def main() -> int:
@@ -729,11 +799,32 @@ def main() -> int:
                     default="none",
                     help="inject a robustness/faults.py fault on the "
                          "supervised assign path mid-trace")
-    ap.add_argument("--policy", choices=("auto", "greedy", "optimal"),
+    ap.add_argument("--policy",
+                    choices=("auto", "greedy", "optimal", "learned", "all"),
                     default="auto")
+    ap.add_argument("--policy-checkpoint", default="",
+                    help="learned-policy checkpoint prefix (solver."
+                         "policyCheckpoint) — required for the learned "
+                         "policy to actually dispatch, and enables the "
+                         "third --ab arm")
+    ap.add_argument("--dataset-out", default="",
+                    help="record every choose_plan duel the core runs as a "
+                         "training dataset (policy/train.DatasetWriter "
+                         "format; consumed by scripts/policy_train.py). "
+                         "Needs a duel-running policy: optimal/all, or "
+                         "learned with --policy-checkpoint. The writer "
+                         "OWNS the dir (wipes stale cycles); --ab arms "
+                         "record into per-policy subdirectories")
+    ap.add_argument("--dataset-max-cycles", type=int, default=512)
     ap.add_argument("--ab", action="store_true",
-                    help="replay twice (greedy, then optimal) and record "
-                         "preemption volume for both policies")
+                    help="replay the identical trace per policy arm — "
+                         "greedy, optimal, plus learned when "
+                         "--policy-checkpoint is set — and record "
+                         "preemption volume + placements for each")
+    ap.add_argument("--assert-quality", action="store_true",
+                    help="with --ab + --policy-checkpoint: exit 1 if the "
+                         "learned arm bound fewer pods than the greedy arm "
+                         "(the zero-placement-loss gate)")
     ap.add_argument("--shards", type=int, default=1,
                     help="control-plane shards (core/shard.py): N >= 2 "
                          "replays the trace through N pipelined "
@@ -782,7 +873,10 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.ab:
-        reports = {p: run_replay(args, p) for p in ("greedy", "optimal")}
+        arms = ["greedy", "optimal"]
+        if args.policy_checkpoint:
+            arms.append("learned")
+        reports = {p: run_replay(args, p) for p in arms}
         report = {
             "ab": {p: r["fingerprint"] for p, r in reports.items()},
             "preemption_volume": {
@@ -805,6 +899,23 @@ def main() -> int:
               flush=True)
     print(out)
 
+    if args.assert_quality:
+        if not (args.ab and args.policy_checkpoint):
+            print("[replay] FAIL: --assert-quality needs --ab plus "
+                  "--policy-checkpoint (the learned arm)", file=sys.stderr,
+                  flush=True)
+            return 2
+        g_bound = reports["greedy"]["fingerprint"]["bound"]
+        l_bound = reports["learned"]["fingerprint"]["bound"]
+        if l_bound < g_bound:
+            print(f"[replay] FAIL: learned arm bound {l_bound} < greedy "
+                  f"arm {g_bound} — the learned policy lost placements",
+                  file=sys.stderr, flush=True)
+            return 1
+        print(f"[replay] QUALITY OK: learned arm bound {l_bound} >= "
+              f"greedy arm {g_bound} (duels: "
+              f"{reports['learned']['timings'].get('policy_duels')})",
+              file=sys.stderr, flush=True)
     if args.expect_violation:
         if violated:
             print(f"[replay] EXPECTED violation detected: {violated}",

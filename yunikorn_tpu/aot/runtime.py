@@ -95,7 +95,10 @@ def _code_version() -> str:
     pkg = os.path.dirname(os.path.abspath(yunikorn_tpu.__file__))
     h = hashlib.sha256()
     targets = []
-    for sub in ("ops", "models", "parallel"):
+    # policy/ is included because the learned solve variant traces through
+    # the feature extractor + towers (a scorer code change must invalidate
+    # stored learned executables exactly like a solver code change)
+    for sub in ("ops", "models", "parallel", "policy"):
         d = os.path.join(pkg, sub)
         try:
             targets.extend(os.path.join(d, n) for n in os.listdir(d)

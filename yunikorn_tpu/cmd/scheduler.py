@@ -70,6 +70,18 @@ def main(argv=None) -> int:
                              "aligned node partitions, coupled through the "
                              "exact global quota ledger + stranded-ask "
                              "repair. Default: conf solver.shards (auto=1)")
+    parser.add_argument("--policy", type=str, default="",
+                        choices=("", "greedy", "optimal", "learned", "all"),
+                        help="solver.policy override: learned/all dispatch "
+                             "the two-tower scorer (policy/) behind the "
+                             "differential oracle. Unknown values reject "
+                             "here, matching the configmap validation")
+    parser.add_argument("--policy-checkpoint", type=str, default="",
+                        help="learned-policy checkpoint prefix "
+                             "(scripts/policy_train.py output). Default: "
+                             "conf solver.policyCheckpoint. A checkpoint "
+                             "failing validation is REJECTED at load and "
+                             "the learned arm skips")
     parser.add_argument("--shard-epoch-seconds", type=float, default=0.0,
                         help="re-seed the shard partition every N seconds "
                              "(0 = never): moved ICI domains migrate "
@@ -139,9 +151,14 @@ def main(argv=None) -> int:
     from yunikorn_tpu.core.shard import make_core_scheduler, resolve_shards
 
     n_shards = resolve_shards(args.shards or holder.get().solver_shards)
+    solver_opts = SolverOptions.from_conf(holder.get())
+    if args.policy:
+        solver_opts.policy = args.policy
+    if args.policy_checkpoint:
+        solver_opts.policy_checkpoint = args.policy_checkpoint
     core = make_core_scheduler(
         cache, shards=n_shards,
-        solver_options=SolverOptions.from_conf(holder.get()),
+        solver_options=solver_opts,
         trace_spans=holder.get().obs_trace_spans,
         supervisor_options=SupervisorOptions.from_conf(holder.get()),
         slo_options=SloOptions.from_conf(holder.get()),

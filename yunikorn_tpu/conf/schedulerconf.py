@@ -62,7 +62,12 @@ CM_SOLVER_PREEMPT_DEVICE = PREFIX_SOLVER + "preemptDevice"  # auto | true | fals
 CM_SOLVER_GATE = PREFIX_SOLVER + "gateVectorized"       # auto | true | false
 CM_SOLVER_GATE_DEVICE = PREFIX_SOLVER + "gateDevice"    # auto | true | false
 CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
-CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optimal
+CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optimal | learned | all
+# learned-policy checkpoint prefix (policy/net.save_checkpoint's
+# <prefix>.npz + <prefix>.json pair); "" = no checkpoint, the learned arm
+# skips. A checkpoint failing validation REJECTS at load with the previous
+# policy retained (core.set_policy_checkpoint).
+CM_SOLVER_POLICY_CHECKPOINT = PREFIX_SOLVER + "policyCheckpoint"
 CM_SOLVER_AOT_STORE = PREFIX_SOLVER + "aotStore"        # dir path; "" = off
 CM_SOLVER_AOT_BACKGROUND = PREFIX_SOLVER + "aotBackground"  # auto | true | false
 CM_SOLVER_TOPOLOGY = PREFIX_SOLVER + "topology"         # auto | true | false
@@ -73,7 +78,7 @@ CM_SOLVER_SHARDS = PREFIX_SOLVER + "shards"             # auto | 1..64
 # unknown value REJECTS the configmap update (ValueError) instead of
 # silently keeping a default the operator did not ask for.
 TRI_STATE = ("auto", "true", "false")
-SOLVER_POLICIES = ("auto", "greedy", "optimal")
+SOLVER_POLICIES = ("auto", "greedy", "optimal", "learned", "all")
 
 # observability.* keys (the obs/ registry + tracer + SLO engine)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
@@ -169,9 +174,14 @@ class SchedulerConf:
     # assignment policy: "optimal" runs the jitted LP/ADMM pack solver
     # (ops/pack_solve.py) next to the greedy solve and commits whichever
     # plan packs better (greedy is the floor — the cycle falls back when the
-    # pack plan does not beat it); "auto" = greedy for now (flips when the
+    # pack plan does not beat it); "learned" runs the two-tower learned
+    # scorer (policy/) behind the same differential oracle; "all" runs both
+    # (the three-way duel); "auto" = greedy for now (flips when the
     # hardware A/B lands, like PALLAS_TPU_DEFAULT)
     solver_policy: str = "auto"
+    # learned-policy checkpoint prefix (solver.policyCheckpoint): the
+    # .npz+manifest pair a policy_train run emits; "" = none
+    solver_policy_checkpoint: str = ""
     # AOT executable store (aot/): directory holding serialized compiled
     # solver executables per fingerprint; "" = disabled. A fresh process
     # with a prebuilt store serves its first cycle without XLA compiles.
@@ -321,6 +331,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     conf.solver_scoring_policy = s(CM_SOLVER_SCORING_POLICY, conf.solver_scoring_policy)
     conf.solver_platform = s(CM_SOLVER_DEVICE_PLATFORM, conf.solver_platform)
     conf.solver_aot_store = s(CM_SOLVER_AOT_STORE, conf.solver_aot_store)
+    conf.solver_policy_checkpoint = s(CM_SOLVER_POLICY_CHECKPOINT,
+                                      conf.solver_policy_checkpoint)
     if CM_SVC_SCHEDULING_INTERVAL in data:
         conf.interval = _parse_duration(data[CM_SVC_SCHEDULING_INTERVAL], conf.interval)
     if CM_SVC_VOLUME_BIND_TIMEOUT in data:

@@ -168,9 +168,18 @@ class SolverOptions:
     # a supervised "pack" path and commits whichever plan packs better —
     # the greedy plan is the floor (differential oracle in the gateVerify /
     # preempt-parity mold: a pack plan that does not beat greedy, fails, or
-    # proves infeasible falls back for the cycle). "greedy" = the
+    # proves infeasible falls back for the cycle). "learned" dispatches the
+    # two-tower learned scorer variant (policy/) as its own supervised
+    # "policy" path behind the same oracle; "all" dispatches both (the
+    # three-way duel greedy vs optimal vs learned). "greedy" = the
     # rank-ordered argmin only.
     policy: str = "greedy"
+    # learned-policy checkpoint prefix (solver.policyCheckpoint): the
+    # versioned .npz+manifest pair policy/net.save_checkpoint writes. A
+    # checkpoint that fails validation REJECTS at load and the previous
+    # policy (or none) is retained — the learned arm then skips with
+    # reason "no-checkpoint" instead of scoring garbage.
+    policy_checkpoint: str = ""
     # topology-aware placement (solver.topology): ICI-domain contention
     # penalty + per-gang preferred-domain steering in the batched score,
     # topology-ordered preemption candidates, and the mesh-aligned pack
@@ -205,9 +214,11 @@ class SolverOptions:
             gate_verify=str(getattr(conf, "solver_gate_verify",
                                     "false")).lower() == "true",
             # auto = greedy until the hardware A/B flips the default
-            policy=("optimal"
-                    if str(getattr(conf, "solver_policy", "auto")).lower()
-                    == "optimal" else "greedy"),
+            policy=(lambda v: v if v in ("optimal", "learned", "all")
+                    else "greedy")(
+                str(getattr(conf, "solver_policy", "auto")).lower()),
+            policy_checkpoint=str(
+                getattr(conf, "solver_policy_checkpoint", "") or ""),
             topology=tri.get(
                 getattr(conf, "solver_topology", "auto"), None),
         )
@@ -264,6 +275,12 @@ class _SolveHandle:
     # the greedy solve (None = pack skipped/failed; greedy is the floor)
     pack: Optional[object] = None
     pack_t0: float = 0.0              # pack dispatch start (plan-latency ms)
+    # solver.policy=learned: the async learned-scorer solve dispatched as
+    # its own supervised "policy" path (None = skipped/failed — the
+    # effective ladder is learned-device → greedy-device → cpu → host,
+    # because a missing learned plan simply leaves greedy authoritative)
+    learned: Optional[object] = None
+    learned_t0: float = 0.0           # learned dispatch start (inference ms)
     # the persistent device mirror the greedy device dispatch used (single-
     # device only): the pack dispatch reuses it read-only so an optimal
     # cycle ships O(changed) node state + the row-store req gather, not a
@@ -505,6 +522,46 @@ class CoreScheduler(SchedulerAPI):
         self._g_pack_ms = m.gauge(
             "pack_last_plan_ms",
             "dispatch-to-decision latency of the most recent pack plan (ms)")
+        # ---- learned dispatch policy (round 17, solver.policy=learned) ----
+        self._m_policy = m.counter(
+            "policy_plans_total",
+            "learned-policy (two-tower scorer, solver.policy=learned) "
+            "cycles by outcome (won = learned plan committed, fell_back = "
+            "the incumbent packed at least as well, skipped = no valid "
+            "checkpoint / batch outside the model / circuit open, failed = "
+            "dispatch or materialize error)",
+            labelnames=("outcome",))
+        self._m_policy_duels = m.counter(
+            "policy_duels_total",
+            "choose_plan duel outcomes by participating policy (won = that "
+            "policy's plan committed the cycle, lost = another plan beat "
+            "it) — the measured no-op guarantee: a bad checkpoint shows up "
+            "here as learned/lost, never as an incident",
+            labelnames=("policy", "outcome"))
+        self._h_policy_ms = m.histogram(
+            "policy_inference_ms",
+            "dispatch-to-decision latency of the learned plan (ms): the "
+            "feature extraction + two-tower inference + steered solve, "
+            "overlapped with the greedy solve like the pack path",
+            buckets=MS_BUCKETS)
+        self._g_policy_ms = m.gauge(
+            "policy_last_inference_ms",
+            "most recent cycle's learned-plan latency (ms)")
+        self._g_policy_util = m.gauge(
+            "policy_last_util",
+            "most recent cycle's packed-units ratio learned/greedy "
+            "(> 1 = the learned plan packed more of the cluster)")
+        self._g_policy_epoch = m.gauge(
+            "policy_checkpoint_epoch",
+            "training epoch of the ACTIVE learned-policy checkpoint, "
+            "labelled by its content hash (a swap moves the epoch to the "
+            "new hash series and zeroes the old one)",
+            labelnames=("hash",))
+        self._m_policy_rejected = m.counter(
+            "policy_checkpoint_rejected_total",
+            "learned-policy checkpoints REJECTED at load (corrupt payload, "
+            "format/feature-schema/shape mismatch) — the previous policy "
+            "was retained each time")
         # ---- topology-aware placement (round 15, solver.topology) ----
         self._m_topo_cross = m.counter(
             "topology_cross_domain_gangs_total",
@@ -535,6 +592,23 @@ class CoreScheduler(SchedulerAPI):
         # stats of the most recent pack comparison (chosen policy, util
         # ratio, plan ms); ride the cycle entry and the solve tracer span
         self._last_pack_stats: dict = {}
+        # stats of the most recent learned-arm dispatch/duel (skip reason,
+        # util ratio, inference ms); ride the cycle entry next to the pack
+        # stats
+        self._last_policy_stats: dict = {}
+        # ---- learned dispatch policy state (round 17) ----
+        # the ACTIVE validated checkpoint (policy/net.PolicyCheckpoint) or
+        # None; swapped atomically by set_policy_checkpoint — a rejected
+        # load never touches it
+        self._policy_ckpt = None
+        # optional per-cycle duel recorder (policy/train.DatasetWriter or
+        # any callable taking the raw-example dict): the trace-replay
+        # --dataset-out hook that turns the scheduler into its own
+        # training-data source. Failures are swallowed — recording must
+        # never touch the scheduling path.
+        self.policy_recorder = None
+        if getattr(self.solver, "policy_checkpoint", ""):
+            self.set_policy_checkpoint(self.solver.policy_checkpoint)
         # single-device mirror used by the most recent greedy device
         # dispatch (stashed by _dispatch_solve for the pack dispatch),
         # plus its mesh-sharded counterpart and whether the mesh ran
@@ -1439,15 +1513,15 @@ class CoreScheduler(SchedulerAPI):
                          allow_mesh=allow_mesh,
                          mirror_epoch=self.encoder.mirror_epoch)
         # solver.policy label rides every supervised dispatch + solve span
-        # this cycle, so dashboards separate the greedy and optimal paths
-        # without new series names
-        self.supervisor.policy_label = ("optimal" if self._pack_on()
-                                        else "greedy")
+        # this cycle, so dashboards separate the greedy/optimal/learned
+        # paths without new series names
+        self.supervisor.policy_label = self._policy_mode()
         if allow_mesh:
             # drain solves (allow_mesh=False: the locality-fallback rounds)
             # ride the cycle's MAIN pack stats — resetting here would let a
             # drain round clobber a pack-won comparison already recorded
             self._last_pack_stats = {}
+            self._last_policy_stats = {}
 
         def mk(tier):
             return lambda: self._solve_tier_dispatch(h, tier)
@@ -1465,6 +1539,7 @@ class CoreScheduler(SchedulerAPI):
             h.used_mesh = self._last_solve_used_mesh
         if allow_mesh:
             self._pack_dispatch(h)
+            self._learned_dispatch(h)
         return h
 
     # --------------------------------------------- optimal packing (pack)
@@ -1481,7 +1556,114 @@ class CoreScheduler(SchedulerAPI):
     # free_after >= 0 re-check below refuses the plan outright otherwise.
 
     def _pack_on(self) -> bool:
-        return getattr(self.solver, "policy", "greedy") == "optimal"
+        return getattr(self.solver, "policy", "greedy") in ("optimal", "all")
+
+    # ------------------------------------------- learned policy (round 17)
+    # solver.policy=learned: the two-tower scorer (policy/) runs INSIDE a
+    # second greedy-machinery solve — score-matrix augmentation + gated
+    # proposal overrides — dispatched as its own supervised "policy" path
+    # next to the greedy solve. The effective ladder is learned-device →
+    # greedy-device → cpu → host: a learned dispatch that fails, blows its
+    # deadline or trips its breaker leaves greedy authoritative, and the
+    # materialized learned plan only commits when the N-way choose_plan
+    # duel proves it strictly better. A bad checkpoint is therefore a
+    # measured no-op (policy_duels_total{policy="learned",outcome="lost"}),
+    # never an incident.
+
+    def _learned_on(self) -> bool:
+        return getattr(self.solver, "policy", "greedy") in ("learned", "all")
+
+    def _policy_mode(self) -> str:
+        """The configured policy label for supervised-dispatch series."""
+        p = getattr(self.solver, "policy", "greedy")
+        return p if p in ("optimal", "learned", "all") else "greedy"
+
+    def set_policy_checkpoint(self, prefix: str) -> bool:
+        """Load + validate a learned-policy checkpoint; REJECT on any
+        mismatch and retain the previous policy. Returns True when the
+        checkpoint is now active."""
+        from yunikorn_tpu.policy import net as policy_net
+
+        try:
+            ck = policy_net.load_checkpoint(prefix)
+        except Exception as e:
+            self._m_policy_rejected.inc()
+            prev = self._policy_ckpt
+            logger.error(
+                "policy checkpoint %s REJECTED (%s: %s); keeping previous "
+                "policy (%s)", prefix, type(e).__name__, e,
+                prev.hash if prev is not None else "none")
+            return False
+        prev, self._policy_ckpt = self._policy_ckpt, ck
+        if prev is not None and prev.hash != ck.hash:
+            self._g_policy_epoch.set(0.0, hash=prev.hash)
+        self._g_policy_epoch.set(float(ck.epoch), hash=ck.hash)
+        logger.info("policy checkpoint %s active (hash %s, epoch %d)",
+                    prefix, ck.hash, ck.epoch)
+        return True
+
+    def _learned_eligible(self, h: "_SolveHandle") -> Optional[str]:
+        """None when the learned arm can run this cycle; else the skip
+        reason. Deterministic gates live here, before the supervised
+        dispatch (the _pack_eligible rationale)."""
+        if self._policy_ckpt is None:
+            return "no-checkpoint"
+        if h.batch.locality is not None:
+            # locality rules re-rank per round on the host-visible domain
+            # counts; the learned override would fight the accept caps for
+            # no measured win — these cycles keep the greedy plan
+            return "locality"
+        if self._mesh is not None:
+            # the learned variant has no sharded dispatch yet; a
+            # single-device learned solve under a live mesh would re-upload
+            # the full node tensors per cycle (the round-12 rationale that
+            # gates single-device pack under a mesh)
+            return "mesh"
+        return None
+
+    def _learned_dispatch(self, h: "_SolveHandle") -> None:
+        """Async-dispatch the learned-scorer solve for an eligible cycle;
+        failures leave h.learned None (greedy stays authoritative)."""
+        if not self._learned_on():
+            return
+        reason = self._learned_eligible(h)
+        if reason is not None:
+            self._m_policy.inc(outcome="skipped")
+            self._last_policy_stats = {"skip": reason}
+            return
+        if not self.supervisor.allow("policy"):
+            self._m_policy.inc(outcome="skipped")
+            self._last_policy_stats = {"skip": "circuit"}
+            return
+        ck = self._policy_ckpt
+        so = self.solver
+        h.learned_t0 = time.perf_counter()
+
+        def learned_fn(pending):
+            # the checkpoint hash rides the AOT fingerprint extra: a
+            # checkpoint swap can never serve a stale stored executable
+            return solve_batch(
+                h.batch, self.encoder.nodes, policy=h.policy,
+                max_rounds=so.max_rounds, chunk=so.chunk,
+                free_delta=h.overlay, node_mask=h.node_mask,
+                ports_delta=h.inflight_ports, max_batch=so.max_batch,
+                device_state=h.device_state, aot_pending=pending,
+                learned=(ck.params, self._cycle_seq),
+                aot_extra=("policy", ck.hash))
+
+        try:
+            from yunikorn_tpu.aot import pending_enabled
+
+            h.learned = self.supervisor.run(
+                "policy", lambda: learned_fn(pending_enabled()),
+                commit_success=False)
+        except AbandonedDispatch:
+            raise  # zombie thread: stop, don't continue a stale cycle
+        except Exception:
+            self._m_policy.inc(outcome="failed")
+            self._last_policy_stats = {"skip": "error"}
+            logger.exception("learned-policy dispatch failed; greedy plan "
+                             "stands this cycle")
 
     def _pack_eligible(self, batch) -> Optional[str]:
         """None when the pack solver models this batch; else the skip
@@ -1593,65 +1775,150 @@ class CoreScheduler(SchedulerAPI):
             logger.exception("pack solve dispatch failed; greedy plan "
                              "stands this cycle")
 
-    def _pack_choose(self, h: "_SolveHandle", greedy_assigned):
-        """Materialize the pack plan and run the differential comparison;
-        returns the committed assignment (pack only when strictly better)."""
+    def _plan_duel(self, h: "_SolveHandle", greedy_assigned):
+        """Materialize every challenger plan (pack, learned) and run the
+        N-way differential comparison; returns the committed assignment —
+        a challenger commits only when strictly better than the incumbent
+        fold (ops/pack_solve.choose_plan_n), so greedy stays the floor."""
         import numpy as np
 
         from yunikorn_tpu.ops import pack_solve as pack_mod
 
         n = h.batch.num_pods
-        try:
-            pack_assigned, feasible = self.supervisor.run(
-                "pack",
-                lambda: (np.asarray(h.pack.assigned)[:n],
-                         bool(np.asarray(h.pack.feasible))))
-        except AbandonedDispatch:
-            raise  # zombie thread: stop, don't commit a stale cycle
-        except Exception:
-            self._m_pack.inc(outcome="failed")
-            self._last_pack_stats = {"policy": "greedy", "skip": "error"}
-            logger.exception("pack plan materialization failed; greedy "
-                             "plan stands this cycle")
-            return greedy_assigned
-        plan_ms = (time.perf_counter() - h.pack_t0) * 1000
-        if not feasible:
-            # structurally impossible (the rounding/repair shares greedy's
-            # fit arithmetic, and pre-existing overlay negativity is
-            # excluded from the device-side check) — belt and braces:
-            # never commit such a plan
-            self._m_pack.inc(outcome="infeasible")
-            self._last_pack_stats = {"policy": "greedy", "skip": "infeasible"}
-            logger.error("pack plan over-committed capacity; greedy plan "
-                         "stands this cycle")
+        cands = [("greedy", np.asarray(greedy_assigned)[:n])]
+        pack_ms = learned_ms = None
+        if h.pack is not None:
+            try:
+                pack_assigned, feasible = self.supervisor.run(
+                    "pack",
+                    lambda: (np.asarray(h.pack.assigned)[:n],
+                             bool(np.asarray(h.pack.feasible))))
+            except AbandonedDispatch:
+                raise  # zombie thread: stop, don't commit a stale cycle
+            except Exception:
+                self._m_pack.inc(outcome="failed")
+                self._last_pack_stats = {"policy": "greedy", "skip": "error"}
+                logger.exception("pack plan materialization failed; the "
+                                 "pack arm sits out this cycle")
+            else:
+                pack_ms = (time.perf_counter() - h.pack_t0) * 1000
+                if not feasible:
+                    # structurally impossible (the rounding/repair shares
+                    # greedy's fit arithmetic, and pre-existing overlay
+                    # negativity is excluded from the device-side check) —
+                    # belt and braces: never commit such a plan
+                    self._m_pack.inc(outcome="infeasible")
+                    self._last_pack_stats = {"policy": "greedy",
+                                             "skip": "infeasible"}
+                    logger.error("pack plan over-committed capacity; the "
+                                 "pack arm sits out this cycle")
+                else:
+                    cands.append(("optimal", pack_assigned))
+        if h.learned is not None:
+            try:
+                learned_assigned = self.supervisor.run(
+                    "policy", lambda: np.asarray(h.learned.assigned)[:n])
+            except AbandonedDispatch:
+                raise  # zombie thread: stop, don't commit a stale cycle
+            except Exception:
+                self._m_policy.inc(outcome="failed")
+                self._last_policy_stats = {"skip": "error"}
+                logger.exception("learned plan materialization failed; the "
+                                 "learned arm sits out this cycle")
+            else:
+                learned_ms = (time.perf_counter() - h.learned_t0) * 1000
+                self._h_policy_ms.observe(learned_ms)
+                self._g_policy_ms.set(learned_ms)
+                # learned placements come from the unmodified greedy accept
+                # machinery (same fit masks, same prefix arithmetic), so
+                # free_after >= 0 holds by construction — no extra
+                # feasibility re-check is needed beyond the duel itself
+                cands.append(("learned", learned_assigned))
+        if len(cands) == 1:
             return greedy_assigned
         # the committed objective matches the solver's (capacity-normalized
-        # units) and is priority-guarded: the pack plan must match greedy
-        # class by class from the highest priority down before packing
-        # quality decides, so optimal can never starve a high-priority ask
-        use_pack, stats = pack_mod.choose_plan(
-            np.asarray(greedy_assigned)[:n], pack_assigned,
-            h.batch.req.astype(np.int32), h.batch.valid,
+        # units) and is priority-guarded PAIRWISE: every challenger must
+        # match the incumbent class by class from the highest priority down
+        # before packing quality decides, so no policy can starve a
+        # high-priority ask the greedy rank order would have placed
+        winner, utils = pack_mod.choose_plan_n(
+            cands, h.batch.req.astype(np.int32), h.batch.valid,
             cap_i=np.floor(self.encoder.nodes.capacity_arr).astype(np.int64),
             priorities=np.asarray(
                 [(a.priority or 0) for a in h.admitted], np.int64))
-        # pack_util: the A/B headline — capacity-normalized packed units of
-        # the pack plan relative to the greedy plan on the same cycle
-        # (> 1 = pack packed more of the cluster)
-        util_ratio = (stats["pack"]["units_norm"]
-                      / max(stats["greedy"]["units_norm"], 1e-9))
-        self._m_pack.inc(outcome="won" if use_pack else "fell_back")
-        self._g_pack_util.set(util_ratio)
-        self._g_pack_ms.set(plan_ms)
-        self._last_pack_stats = {
-            "policy": "optimal" if use_pack else "greedy",
-            "pack_util": round(util_ratio, 4),
-            "pack_plan_ms": round(plan_ms, 2),
-            "pack_placed": stats["pack"]["placed"],
-            "greedy_placed": stats["greedy"]["placed"],
-            "partitioner": getattr(h.pack, "partitioner", "random"),
-        }
-        return pack_assigned if use_pack else greedy_assigned
+        by_name = dict(cands)
+        g_units = max(utils["greedy"]["units_norm"], 1e-9)
+        for name, _ in cands:
+            self._m_policy_duels.inc(
+                policy=name, outcome="won" if name == winner else "lost")
+        if "optimal" in by_name:
+            use_pack = winner == "optimal"
+            util_ratio = utils["optimal"]["units_norm"] / g_units
+            self._m_pack.inc(outcome="won" if use_pack else "fell_back")
+            self._g_pack_util.set(util_ratio)
+            self._g_pack_ms.set(pack_ms)
+            self._last_pack_stats = {
+                "policy": winner,
+                "pack_util": round(util_ratio, 4),
+                "pack_plan_ms": round(pack_ms, 2),
+                "pack_placed": utils["optimal"]["placed"],
+                "greedy_placed": utils["greedy"]["placed"],
+                "partitioner": getattr(h.pack, "partitioner", "random"),
+            }
+        else:
+            self._last_pack_stats = {**self._last_pack_stats,
+                                     "policy": winner}
+        if "learned" in by_name:
+            use_learned = winner == "learned"
+            l_ratio = utils["learned"]["units_norm"] / g_units
+            self._m_policy.inc(
+                outcome="won" if use_learned else "fell_back")
+            self._g_policy_util.set(l_ratio)
+            self._last_policy_stats = {
+                "learned_util": round(l_ratio, 4),
+                "learned_ms": round(learned_ms, 2),
+                "learned_placed": utils["learned"]["placed"],
+                "checkpoint": (self._policy_ckpt.hash
+                               if self._policy_ckpt else ""),
+            }
+        self._record_duel(h, cands, winner)
+        return by_name[winner]
+
+    def _record_duel(self, h: "_SolveHandle", cands, winner: str) -> None:
+        """Feed the optional policy_recorder one raw-tensor duel example
+        (the policy/train.py training-data contract). Never throws into
+        the scheduling path."""
+        rec = self.policy_recorder
+        if rec is None:
+            return
+        try:
+            import numpy as np
+
+            na = self.encoder.nodes
+            free0 = np.floor(na.free).astype(np.int32)
+            if h.overlay is not None:
+                free0 = assign_mod.apply_free_delta(free0, h.overlay)
+            node_ok = np.asarray(na.valid & na.schedulable)
+            if h.node_mask is not None:
+                node_ok = node_ok & np.asarray(
+                    h.node_mask[: node_ok.shape[0]])
+            ex = {
+                "req": h.batch.req.astype(np.int32),
+                "rank": np.asarray(h.batch.rank),
+                "valid": np.asarray(h.batch.valid),
+                "free0": free0,
+                "cap": np.floor(na.capacity_arr).astype(np.int32),
+                "node_ok": node_ok,
+                "priorities": np.asarray(
+                    [(a.priority or 0) for a in h.admitted], np.int64),
+                "score_cols": int(h.batch.req.shape[1]),
+                "winner": winner,
+            }
+            for name, assigned in cands:
+                ex[f"plan_{name}"] = assigned
+            rec(ex)
+        except Exception:
+            logger.exception("policy duel recording failed (ignored)")
 
     def _solve_materialize(self, h: "_SolveHandle"):
         """Finish one supervised solve: materialize the async result under
@@ -1680,10 +1947,10 @@ class CoreScheduler(SchedulerAPI):
             "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
             start_tier=h.tier)
         h.tier = tier
-        if h.pack is not None:
-            # optimal policy: the differential comparison against the
-            # greedy plan decides which assignment commits
-            assigned = self._pack_choose(h, assigned)
+        if h.pack is not None or h.learned is not None:
+            # optimal/learned policy: the N-way differential comparison
+            # against the greedy plan decides which assignment commits
+            assigned = self._plan_duel(h, assigned)
         return assigned
 
     def _ask_pending(self, ask) -> bool:
@@ -2095,8 +2362,7 @@ class CoreScheduler(SchedulerAPI):
         self._cycle_seq += 1
         cid = self._cycle_seq
         self.supervisor.cycle_id = cid
-        self.supervisor.policy_label = ("optimal" if self._pack_on()
-                                        else "greedy")
+        self.supervisor.policy_label = self._policy_mode()
         # unconditional cooldown purge: a wasted eviction must settle its
         # mis-eviction ledger on schedule even if this cluster never feels
         # preemption pressure again (the pressure paths also purge)
@@ -2199,6 +2465,7 @@ class CoreScheduler(SchedulerAPI):
                 entry["encode_device_bytes"] = self._last_encode_device["bytes"]
             entry.update(_gate_extras(self._last_gate_stats))
             entry.update(_pack_extras(self._last_pack_stats))
+            entry.update(_policy_extras(self._last_policy_stats))
             entry.update(_topo_extras(self._last_topo_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -2301,8 +2568,7 @@ class CoreScheduler(SchedulerAPI):
             self._use_partition("default")
             if getattr(self.partition, "draining", False):
                 return None
-            self.supervisor.policy_label = ("optimal" if self._pack_on()
-                                            else "greedy")
+            self.supervisor.policy_label = self._policy_mode()
             admitted, ranks, held = self._collect_and_gate(
                 exclude_keys=self._inflight_ask_keys or None,
                 seed_admissions=self._inflight_gate_seed or None)
@@ -2503,6 +2769,7 @@ class CoreScheduler(SchedulerAPI):
                 entry["encode_device_bytes"] = cyc.encode_device["bytes"]
             entry.update(_gate_extras(cyc.gate_stats))
             entry.update(_pack_extras(self._last_pack_stats))
+            entry.update(_policy_extras(self._last_policy_stats))
             entry.update(_topo_extras(self._last_topo_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -3604,6 +3871,19 @@ def _pack_extras(stats: dict) -> dict:
               "partitioner", "skip"):
         if k in stats:
             out["pack_skip" if k == "skip" else k] = stats[k]
+    return out
+
+
+def _policy_extras(stats: dict) -> dict:
+    """Learned-arm stats (solver.policy=learned) for the cycle entry: util
+    ratio / inference ms / checkpoint hash when the duel ran, or the skip
+    reason when the arm sat out."""
+    out = {}
+    for k in ("learned_util", "learned_ms", "learned_placed", "checkpoint"):
+        if k in stats:
+            out[k] = stats[k]
+    if "skip" in stats:
+        out["policy_skip"] = stats["skip"]
     return out
 
 

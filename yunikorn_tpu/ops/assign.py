@@ -190,7 +190,8 @@ def _loc_soft_scores(gid_rows, dom_cols, loc, cnt, minc, contrib_rows):
 
 def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
                         base_scores, chunk: int, policy: str,
-                        score_cols: int = 0, node_dom=None, pref_pod=None):
+                        score_cols: int = 0, node_dom=None, pref_pod=None,
+                        learned_emb=None):
     """For every pod: (best node, any feasible?) without materializing [N, M].
 
     Locality rules/scores arrive pre-folded into group_feas/group_soft (the
@@ -198,6 +199,11 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
     node_dom/pref_pod (topology steering): per-pod preferred-ICI-domain
     bonus — a gang pod whose contiguous proposal failed still prefers its
     planned domain in the argmax fallback.
+    learned_emb (solver.policy=learned): (pod_emb [N, E], node_emb [M, E])
+    two-tower embeddings — the learned score augments the score matrix as a
+    per-chunk [C, E] x [E, M] matmul. An untrained checkpoint embeds every
+    pod to the zero vector (policy/net.init_params), so the augmentation is
+    exactly 0 and the argmax is bit-identical to the greedy program.
     """
     N, R = req.shape
     M = free.shape[0]
@@ -223,6 +229,11 @@ def _best_nodes_chunked(req, group_id, group_feas, group_soft, free, capacity,
             in_pref = ((cpref[:, None] >= 0) & (node_dom[None, :] >= 0)
                        & (node_dom[None, :] == cpref[:, None]))
             scores = scores + jnp.where(in_pref, TOPO_GANG_W, 0.0)
+        if learned_emb is not None:
+            pod_emb, node_emb = learned_emb
+            cemb = lax.dynamic_slice(
+                pod_emb, (start, 0), (chunk, pod_emb.shape[1]))        # [C, E]
+            scores = scores + cemb @ node_emb.T
         scores = jnp.where(ok, scores, NEG_INF)
         best = jnp.argmax(scores, axis=1).astype(jnp.int32)            # [C]
         feasible = jnp.any(ok, axis=1)                                 # [C]
@@ -807,10 +818,91 @@ def _topo_node_adj(topo):
         0.0)                                                       # [M]
 
 
+def _learned_proposals(pod_emb, node_emb, group_id, group_feas, free, req,
+                       active, tau, key, chunk: int):
+    """Gated learned proposal override (solver.policy=learned).
+
+    For each active pod, the two-tower score picks a candidate node among
+    the pod's feasible-and-fitting nodes, with seeded Gumbel exploration
+    (tau-scaled — identical-featured nodes score identically, and a plain
+    argmax would herd every pod onto the lowest row index, the same failure
+    _water_fill_proposals documents). The override only fires when the
+    CHOSEN node's raw learned score beats the pod's feasible-mean by
+    GATE_MARGIN — a shift-invariant confidence gate, so an untrained or
+    garbage-zero checkpoint (score identically 0) can NEVER override a
+    proposal and the learned program stays bit-identical to greedy.
+
+    Returns [N] int32 proposals (M = no override; fit is re-checked by the
+    round loop's prop_fits exactly like every other proposal source).
+
+    Known cost: this stage re-derives the per-chunk fit-margin mask that
+    _best_nodes_chunked also computes on argmax rounds (two lax.map bodies,
+    so XLA CSE across them is not guaranteed). Fusing the two passes is a
+    ROADMAP follow-up; as shipped, the learned variant's measured warm
+    latency still lands BELOW greedy's on the fragmented win shapes (its
+    placements converge in fewer rounds).
+    """
+    from yunikorn_tpu.policy.net import GATE_MARGIN
+
+    N, R = req.shape
+    M = free.shape[0]
+    E = pod_emb.shape[1]
+    n_chunks = N // chunk
+
+    def one_chunk(c):
+        start = c * chunk
+        cemb = lax.dynamic_slice(pod_emb, (start, 0), (chunk, E))
+        creq = lax.dynamic_slice(req, (start, 0), (chunk, R))
+        cgid = lax.dynamic_slice(group_id, (start,), (chunk,))
+        cfeas = group_feas[cgid]                                   # [C, M]
+        margin = jnp.full((chunk, M), jnp.int32(2**30))
+        for r in range(R):
+            margin = jnp.minimum(margin,
+                                 free[:, r][None, :] - creq[:, r][:, None])
+        ok = cfeas & (margin >= 0)
+        ls = cemb @ node_emb.T                                     # [C, M]
+        nf = jnp.sum(ok.astype(jnp.int32), axis=1)
+        lmean = (jnp.sum(jnp.where(ok, ls, 0.0), axis=1)
+                 / jnp.maximum(nf.astype(jnp.float32), 1.0))
+        g = jax.random.gumbel(jax.random.fold_in(key, c), (chunk, M))
+        u = jnp.where(ok, ls + tau * g, NEG_INF)
+        best = jnp.argmax(u, axis=1).astype(jnp.int32)
+        ls_best = jnp.take_along_axis(ls, best[:, None], axis=1)[:, 0]
+        good = (nf > 0) & (ls_best - lmean > GATE_MARGIN)
+        return jnp.where(good, best, M)
+
+    props = lax.map(one_chunk, jnp.arange(n_chunks)).reshape(N)
+    return jnp.where(active, props, M)
+
+
+def _learned_prep(learned, req, rank, capacity, score_cols: int, salt=None):
+    """Hoisted pod-side state of the learned scorer for one pod slice:
+    (params, pod embeddings, PRNG key, capacity inv_scale). rank is unused
+    by the v1 feature schema but rides the signature so a future version
+    can fold ordering in without touching call sites. salt: extra fold for
+    the exploration key — the chunked scan passes its slice index so two
+    pod slices never share Gumbel noise (same-row pods across slices would
+    otherwise herd onto identical nodes)."""
+    from yunikorn_tpu.policy import features as _pf
+    from yunikorn_tpu.policy import net as _pnet
+
+    params, seed = learned
+    R = req.shape[1]
+    sc = score_cols if score_cols > 0 else R
+    inv_sc = _pf.inv_capacity_scale(capacity[:, :sc])
+    pod_f = _pf.pod_features(req[:, :sc], inv_sc)
+    pod_emb = _pnet.pod_tower(params, pod_f)
+    key = jax.random.PRNGKey(seed)
+    if salt is not None:
+        key = jax.random.fold_in(key, salt)
+    return (params, pod_emb, key, inv_sc)
+
+
 def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
                   free0, cnt0, capacity, loc, loc_hoist, *,
                   max_rounds, chunk, policy, use_pallas, pallas_interpret,
-                  has_loc_soft, pallas_soft, score_cols, topo_rt=None):
+                  has_loc_soft, pallas_soft, score_cols, topo_rt=None,
+                  learned_rt=None):
     """The assignment round loop for one pod slice against hoisted group
     state. free0 [M, R] and cnt0 [L, D] carry across chained chunks; the
     return keeps their shapes so a lax.scan can thread them. The free
@@ -826,7 +918,17 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
     they name a feasible node, and the argmax fallback carries the same
     preferred-domain bonus per pod. Nothing here scales with gang count —
     the bit-identical-off contract holds because topo_rt=None recovers the
-    exact pre-topology round body."""
+    exact pre-topology round body.
+
+    learned_rt (solver.policy=learned, from _learned_prep): (params,
+    pod_emb [N, E], PRNG key, inv_scale) — the node tower re-embeds the
+    CURRENT free capacity each round (tiny [M, F] x [F, H] matmuls, the
+    same per-round refresh the base score gets), the gated learned
+    proposals override the water-fill where the scorer is confident
+    (strictly positive advantage — see _learned_proposals), and the argmax
+    stage's score matrix is augmented with the same bilinear term.
+    learned_rt=None (and equally a zero/untrained checkpoint) recovers the
+    exact greedy round body — the untrained-is-inert contract."""
     N, R = req.shape
     M = free0.shape[0]
     has_loc = loc is not None
@@ -878,6 +980,24 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
         proposals = _water_fill_proposals(req, group_id, rank, active,
                                           feas_round, cur_free, base_scores,
                                           soft_round, g_rr_dom, g_capped)
+        learned_emb = None
+        if learned_rt is not None:
+            from yunikorn_tpu.policy import features as _pf
+            from yunikorn_tpu.policy import net as _pnet
+
+            l_params, pod_emb, l_key, inv_sc = learned_rt
+            node_emb = _pnet.node_tower(
+                l_params, _pf.node_features(cur_free[:, :sc],
+                                            capacity[:, :sc], inv_sc))
+            learned_emb = (pod_emb, node_emb)
+            lprop = _learned_proposals(
+                pod_emb, node_emb, group_id, feas_round, cur_free, req,
+                active, l_params["tau"], jax.random.fold_in(l_key, rnd),
+                chunk)
+            # confident learned proposals override the water-fill; the topo
+            # gang proposals below still win over both (gang contiguity is
+            # a structural constraint, the learned term a packing score)
+            proposals = jnp.where(lprop < M, lprop, proposals)
         if topo_rt is not None:
             # the segmented per-domain gang fill: its proposal wins
             # wherever it names a feasible node — fit is re-checked by
@@ -899,10 +1019,12 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
 
         def with_argmax(_):
             # exact per-pod argmax; guarantees ≥1 accept per contended node
-            if use_pallas and policy != "align" and topo_rt is None:
-                # the fused kernel has no per-pod domain-bonus input; the
-                # steered argmax takes the XLA path (proposals — where the
-                # steering mostly lands — are kernel-independent anyway)
+            if (use_pallas and policy != "align" and topo_rt is None
+                    and learned_rt is None):
+                # the fused kernel has no per-pod domain-bonus or learned
+                # embedding input; the steered argmax takes the XLA path
+                # (proposals — where the steering mostly lands — are
+                # kernel-independent anyway)
                 from yunikorn_tpu.ops.pallas_kernels import pallas_best_nodes
 
                 best, feasible = pallas_best_nodes(
@@ -914,7 +1036,8 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
                     req, group_id, feas_round, soft_round, cur_free, capacity,
                     base_scores, chunk, policy, score_cols,
                     node_dom=topo_rt[0] if topo_rt is not None else None,
-                    pref_pod=topo_rt[1] if topo_rt is not None else None)
+                    pref_pod=topo_rt[1] if topo_rt is not None else None,
+                    learned_emb=learned_emb)
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
 
@@ -990,6 +1113,10 @@ def solve(
     topo=None,      # topology steering tuple (see _topo_node_adj /
                     # _topo_gang_proposals); None = the exact pre-topology
                     # program (the solver.topology=off contract)
+    learned=None,   # learned-policy tuple (params pytree, seed i32) — the
+                    # two-tower scorer (policy/net.py) augments the score
+                    # matrix and gates proposal overrides; None = the exact
+                    # pre-policy program (solver.policy=learned off contract)
     *,
     max_rounds: int = 16,
     chunk: int = 512,
@@ -1041,6 +1168,11 @@ def solve(
         group_soft = group_soft + _topo_node_adj(topo)[None, :]
         topo_rt = (topo[0], topo[1])
 
+    # learned scorer (solver.policy=learned): pod embeddings hoisted once,
+    # node embeddings re-derived per round from current free capacity
+    learned_rt = (_learned_prep(learned, req, rank, capacity, score_cols)
+                  if learned is not None else None)
+
     has_loc = loc is not None
     cnt0 = loc[1] if has_loc else jnp.zeros((1, 1), jnp.int32)
     # the pallas kernel needs its soft input whenever the per-round hoist
@@ -1053,7 +1185,7 @@ def solve(
         capacity, loc, loc_hoist, max_rounds=max_rounds, chunk=chunk,
         policy=policy, use_pallas=use_pallas, pallas_interpret=pallas_interpret,
         has_loc_soft=has_loc_soft, pallas_soft=pallas_soft,
-        score_cols=score_cols, topo_rt=topo_rt)
+        score_cols=score_cols, topo_rt=topo_rt, learned_rt=learned_rt)
     # cnt_final rides out so the chunked scan path can reuse _solve_rounds
     # with carried locality domain counts
     return assigned, around, free_after, rounds, cnt_final
@@ -1071,7 +1203,7 @@ def solve_chunked(
     g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
     node_labels, node_taints, node_taints_soft, node_ports, node_ok,
     free, capacity, host_group_mask=None, host_group_soft=None, loc=None,
-    topo=None,
+    topo=None, learned=None,
     *,
     chunk_pods: int,
     max_rounds: int = 16,
@@ -1134,7 +1266,7 @@ def solve_chunked(
         xs = xs + (topo[1].reshape(K, mb),)            # pref_pod
 
     def scan_body(carry, x):
-        free_k, cnt, round_base = carry
+        free_k, cnt, round_base, slice_idx = carry
         topo_rt_k = None
         if topo is not None:
             x, cpref = x[:-1], x[-1]
@@ -1147,20 +1279,28 @@ def solve_chunked(
         else:
             creq, cgid, crank, cvalid = x
             loc_k = None
+        # learned pod embeddings are per-chunk (features are a pure
+        # function of the chunk's request rows; params/seed chunk-
+        # invariant); the slice index salts the exploration key so slices
+        # never share Gumbel noise
+        learned_rt_k = (_learned_prep(learned, creq, crank, capacity,
+                                      score_cols, salt=slice_idx)
+                        if learned is not None else None)
         a_k, ar_k, free_k, r_k, cnt = _solve_rounds(
             creq, cgid, crank, cvalid, group_feas, group_soft, free_k, cnt,
             capacity, loc_k, loc_hoist, max_rounds=max_rounds, chunk=chunk,
             policy=policy, use_pallas=use_pallas,
             pallas_interpret=pallas_interpret, has_loc_soft=has_loc_soft,
             pallas_soft=pallas_soft, score_cols=score_cols,
-            topo_rt=topo_rt_k)
+            topo_rt=topo_rt_k, learned_rt=learned_rt_k)
         # offset accept rounds so the chain's order is globally monotone (a
         # later chunk's round 0 happens after every earlier chunk's rounds)
         ar_k = jnp.where(ar_k >= 0, ar_k + round_base, -1)
-        return (free_k, cnt, round_base + r_k), (a_k, ar_k, r_k)
+        return ((free_k, cnt, round_base + r_k, slice_idx + 1),
+                (a_k, ar_k, r_k))
 
-    (free_after, cnt, _), (assigned_k, around_k, rounds_k) = lax.scan(
-        scan_body, (free, cnt0, jnp.int32(0)), xs)
+    (free_after, cnt, _, _), (assigned_k, around_k, rounds_k) = lax.scan(
+        scan_body, (free, cnt0, jnp.int32(0), jnp.int32(0)), xs)
     return (assigned_k.reshape(N), around_k.reshape(N), free_after,
             jnp.sum(rounds_k), cnt)
 
@@ -1500,7 +1640,8 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
                 free_delta=None, use_pallas=False, pallas_interpret=False,
                 device=None, node_mask=None, ports_delta=None,
                 compile_only=False, max_batch=MAX_SOLVE_PODS,
-                device_state=None, aot_pending=False) -> Optional[SolveResult]:
+                device_state=None, aot_pending=False,
+                learned=None, aot_extra=()) -> Optional[SolveResult]:
     """Convenience host wrapper: numpy in → SolveResult out.
 
     See prepare_solve_args for free_delta / node_mask / device_state
@@ -1519,6 +1660,14 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     in background-compile mode raises aot.CompilePending instead of paying
     the XLA compile inline, and the caller's ladder serves the cycle from a
     lower tier while the compile thread populates the store.
+    learned: (params pytree, seed) — run the solve as the LEARNED-policy
+    variant (two-tower score augmentation + gated proposal overrides; see
+    policy/). The params ride as traced leaves, so a same-shape checkpoint
+    swap re-uses the compiled program; callers MUST also pass the
+    checkpoint hash via aot_extra so the AOT store can never serve an
+    executable fingerprinted for a different checkpoint (belt and braces —
+    the core passes ("policy", <hash>)).
+    aot_extra: extra components folded into the AOT fingerprint manifest.
     """
     from yunikorn_tpu.aot import runtime as aot_rt
 
@@ -1529,6 +1678,10 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         # the chunked path rank-sorts pod args on the host — a device req
         # there would bounce device→host→device; use the host rows
         allow_req_device=batch.req.shape[0] <= mb)
+    learned_tail = ()
+    if learned is not None:
+        learned_tail = ((jax.tree_util.tree_map(jnp.asarray, learned[0]),
+                         jnp.asarray(learned[1], jnp.int32)),)
     solve_kwargs = dict(
         max_rounds=max_rounds,
         chunk=chunk,
@@ -1549,14 +1702,16 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
         ck = dict(solve_kwargs, chunk_pods=mb)
         if compile_only:
             specs = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args_s)
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (*np_args_s, *learned_tail))
             aot_rt.aot_compile("assign.solve_chunked", solve_chunked,
-                               specs, ck)
+                               specs, ck, extra=aot_extra)
             return None
         solve_args = jax.tree_util.tree_map(jnp.asarray, np_args_s)
         assigned, around, free_after, rounds, _ = aot_rt.aot_call(
-            "assign.solve_chunked", solve_chunked, solve_args, ck,
-            pending_ok=aot_pending)
+            "assign.solve_chunked", solve_chunked,
+            (*solve_args, *learned_tail), ck,
+            pending_ok=aot_pending, extra=aot_extra)
         if order is not None:
             assigned, around = _unsort(order, assigned, around)
         return SolveResult(assigned=assigned, free_after=free_after,
@@ -1564,12 +1719,14 @@ def solve_batch(batch, node_arrays, *, max_rounds=16, chunk=512, policy="binpack
     if compile_only:
         # specs instead of arrays: no host->device transfer at all
         specs = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), np_args)
-        aot_rt.aot_compile("assign.solve", solve, specs, solve_kwargs)
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            (*np_args, *learned_tail))
+        aot_rt.aot_compile("assign.solve", solve, specs, solve_kwargs,
+                           extra=aot_extra)
         return None
     solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
     assigned, around, free_after, rounds, _ = aot_rt.aot_call(
-        "assign.solve", solve, solve_args, solve_kwargs,
-        pending_ok=aot_pending)
+        "assign.solve", solve, (*solve_args, *learned_tail), solve_kwargs,
+        pending_ok=aot_pending, extra=aot_extra)
     return SolveResult(assigned=assigned, free_after=free_after, rounds=rounds,
                        accept_round=around)

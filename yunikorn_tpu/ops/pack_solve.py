@@ -500,39 +500,47 @@ def packed_utilization(assigned, req_i, valid, free0_i=None,
     return out
 
 
-def choose_plan(greedy_assigned, pack_assigned, req_i, valid,
-                score_cols: int = 0, free0_i=None, cap_i=None,
-                priorities=None):
-    """The differential oracle's decision rule: the pack plan commits only
-    when its packed objective strictly beats greedy's, lexicographically on
+def choose_plan_n(plans, req_i, valid, score_cols: int = 0, free0_i=None,
+                  cap_i=None, priorities=None):
+    """The differential oracle's decision rule as an N-WAY incumbent fold
+    (round 17: the duel grew a third, learned arm).
+
+    plans: ordered [(name, assigned)] — plans[0] is the INCUMBENT (the
+    greedy floor). Each challenger in order replaces the incumbent only
+    when its key compares strictly greater, lexicographically on
     (per-priority-class placed counts highest class first, placed asks,
     capacity-normalized packed units, fewer nodes touched). Ties keep the
-    greedy plan so `solver.policy=optimal` can never regress default
-    behavior.
+    incumbent, so no alternate policy can ever regress default behavior.
 
-    priorities: optional [n] per-ask priorities — with it, the pack plan
-    must match greedy class by class from the highest priority down before
-    packing quality decides ("Priority Matters"): a plan that packs more
-    units by displacing a higher-priority ask for bulkier low-priority ones
-    LOSES, so the optimal policy can never starve a high-priority ask the
-    greedy rank order would have placed. cap_i: [M, R] node capacities —
-    aligns the committed objective with the solver's capacity-normalized
-    one (see packed_utilization.units_norm).
+    The priority guard is applied PAIRWISE: the class axis of the key is
+    built over the one global set of priority classes, so every pairwise
+    comparison demands the challenger match the incumbent class by class
+    from the highest priority down before packing quality decides — a plan
+    that packs more units by displacing a higher-priority ask for bulkier
+    low-priority ones LOSES every duel it enters (pinned by the three-plan
+    starvation regression in tests/test_policy.py). With one shared class
+    axis the pairwise fold is exactly a lexicographic max, so the winner
+    is order-independent beyond tie-breaking toward the earlier plan.
 
-    Returns (use_pack: bool, stats: dict)."""
-    g = packed_utilization(greedy_assigned, req_i, valid, free0_i,
-                           score_cols, cap_i)
-    p = packed_utilization(pack_assigned, req_i, valid, free0_i,
-                           score_cols, cap_i)
+    cap_i: [M, R] node capacities — aligns the committed objective with
+    the solver's capacity-normalized one (packed_utilization.units_norm).
+
+    Returns (winner_name, stats) with stats[name] = packed_utilization of
+    each plan."""
+    if not plans:
+        raise ValueError("choose_plan_n needs at least the incumbent plan")
+    utils = {name: packed_utilization(assigned, req_i, valid, free0_i,
+                                      score_cols, cap_i)
+             for name, assigned in plans}
     # scale-free integer quantization of the float objective: two plans
     # placing the SAME multiset of requests sum in different row orders,
     # and float addition-order noise (~1e-16 relative) must never break
-    # the "ties keep greedy" contract
-    norm_scale = max(g["units_norm"], p["units_norm"], 1e-12)
-    g_units_q = round(g["units_norm"] / norm_scale * 1e9)
-    p_units_q = round(p["units_norm"] / norm_scale * 1e9)
+    # the "ties keep the incumbent" contract
+    norm_scale = max(max(u["units_norm"] for u in utils.values()), 1e-12)
 
-    def key(assigned, placed_u, units_q, nodes_used):
+    def key(name, assigned):
+        u = utils[name]
+        units_q = round(u["units_norm"] / norm_scale * 1e9)
         assigned = np.asarray(assigned)
         n = assigned.shape[0]
         pk = ()
@@ -541,12 +549,29 @@ def choose_plan(greedy_assigned, pack_assigned, req_i, valid,
             placed = np.asarray(valid, bool)[:n] & (assigned >= 0)
             classes = np.unique(pr)[::-1]
             pk = tuple(int((placed & (pr == c)).sum()) for c in classes)
-        return pk + (placed_u, units_q, -nodes_used)
+        return pk + (u["placed"], units_q, -u["nodes_used"])
 
-    use_pack = (key(pack_assigned, p["placed"], p_units_q, p["nodes_used"])
-                > key(greedy_assigned, g["placed"], g_units_q,
-                      g["nodes_used"]))
-    return use_pack, {
+    win_name, win_assigned = plans[0]
+    win_key = key(win_name, win_assigned)
+    for name, assigned in plans[1:]:
+        k = key(name, assigned)
+        if k > win_key:
+            win_name, win_key = name, k
+    return win_name, utils
+
+
+def choose_plan(greedy_assigned, pack_assigned, req_i, valid,
+                score_cols: int = 0, free0_i=None, cap_i=None,
+                priorities=None):
+    """Two-plan compatibility wrapper over choose_plan_n (the round-12
+    surface: greedy incumbent vs the pack challenger).
+
+    Returns (use_pack: bool, stats: dict)."""
+    winner, utils = choose_plan_n(
+        [("greedy", greedy_assigned), ("pack", pack_assigned)],
+        req_i, valid, score_cols, free0_i, cap_i, priorities)
+    g, p = utils["greedy"], utils["pack"]
+    return winner == "pack", {
         "greedy": g, "pack": p,
         "pack_util": p["util"], "greedy_util": g["util"],
     }

@@ -1,0 +1,20 @@
+"""Learned dispatch policy (round 17, solver.policy=learned).
+
+A small pure-JAX two-tower scorer trained OFFLINE from replay traces
+(DOPPLER-style dual-policy learning, arXiv 2505.23131) and served INSIDE the
+jitted assignment solve as a score-matrix augmentation + gated proposal
+override — behind the round-12 differential oracle, so a bad checkpoint is a
+measured no-op rather than an incident.
+
+Modules:
+  features   jitted fixed-shape feature extractor over the existing solve
+             args ([N, F_POD] pod rows, [M, F_NODE] node rows) — every
+             compiled learned variant stays a standard bucket
+  net        the two-tower MLP (plain pytree params, flax-free), plus the
+             versioned checkpoint format (.npz + JSON manifest) with
+             REJECT-on-mismatch validation
+  train      dataset IO (the trace-replay --dataset-out format) and the
+             offline trainer: imitation of recorded choose_plan duel
+             winners, then fine-tuning on a packed-units + contention
+             objective
+"""

@@ -1,0 +1,114 @@
+"""Jitted feature extraction over the solver's existing arguments.
+
+The learned scorer must compose with the solver's standard-bucket compile
+discipline (docs/PERF.md: unbounded shapes mean unbounded compiles), so the
+feature tensors are FIXED-WIDTH regardless of the fleet's resource-vocab
+width R: per-pod rows are [N, F_POD] and per-node rows are [M, F_NODE], with
+the first FEAT_COLS resource columns carried verbatim (zero-padded when the
+vocab is narrower) and the rest summarized as scale-free aggregates. Every
+value is normalized — per-column by the fleet's mean node capacity (the same
+inv_scale the pack LP prices with) or per-node by the node's own capacity —
+so a checkpoint trained at one fleet scale transfers to another.
+
+FEATURE_VERSION is part of the checkpoint manifest: a checkpoint trained
+against a different feature schema REJECTS at load (net.load_checkpoint)
+instead of silently scoring garbage.
+
+All functions here are pure jnp and trace inside the solver's jitted
+programs; the trainer calls the same functions on host arrays, so the
+features seen at train time and at inference time cannot drift.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# bump when the shape OR semantics of any feature column changes — the
+# checkpoint loader rejects manifests built against a different version
+FEATURE_VERSION = 1
+
+# resource columns carried verbatim (zero-padded); the common fleets carry
+# 2-4 real columns (cpu, memory, extended resources)
+FEAT_COLS = 4
+
+F_POD = 8
+F_NODE = 8
+
+
+def inv_capacity_scale(cap_i) -> jnp.ndarray:
+    """[R] per-column normalization: 1 / mean node capacity — the scale the
+    pack LP and packed_utilization already normalize with, so the learned
+    objective and the duel's objective agree on what a "unit" is."""
+    return 1.0 / jnp.maximum(
+        jnp.mean(cap_i.astype(jnp.float32), axis=0), 1.0)
+
+
+def _first_cols(x, width: int):
+    """[*, R] -> [*, width]: verbatim leading columns, zero-padded (static
+    Python on the trace-time column count, so no dynamic shapes)."""
+    r = x.shape[1]
+    if r >= width:
+        return x[:, :width]
+    pad = jnp.zeros((x.shape[0], width - r), x.dtype)
+    return jnp.concatenate([x, pad], axis=1)
+
+
+def pod_features(req_i, inv_scale) -> jnp.ndarray:
+    """[N, F_POD] per-ask features from the quantized request rows.
+
+    req_i: [N, R] int32 requests over the SCORING columns only (the caller
+    slices off synthetic port columns); inv_scale: [R] from
+    inv_capacity_scale. Columns:
+      0..3  normalized request, first FEAT_COLS columns verbatim
+      4     total normalized request (the ask's "size" in solver units)
+      5     max normalized column (the bottleneck resource)
+      6     dominant share: max / total (1.0 = single-resource ask — the
+            shape signal the alignment policy keys on)
+      7     breadth: fraction of scoring columns the ask requests
+    """
+    q = req_i.astype(jnp.float32) * inv_scale[None, :]          # [N, R]
+    total = jnp.sum(q, axis=1)
+    mx = jnp.max(q, axis=1) if q.shape[1] else jnp.zeros_like(total)
+    dom = mx / jnp.maximum(total, 1e-9)
+    breadth = (jnp.sum((q > 0).astype(jnp.float32), axis=1)
+               / float(max(q.shape[1], 1)))
+    return jnp.concatenate(
+        [_first_cols(q, FEAT_COLS),
+         total[:, None], mx[:, None], dom[:, None], breadth[:, None]],
+        axis=1)
+
+
+def node_features(free_i, cap_i, inv_scale) -> jnp.ndarray:
+    """[M, F_NODE] per-node features from CURRENT free capacity — the round
+    loop recomputes these as placements land, exactly like the base score.
+
+    free_i/cap_i: [M, R] int32 over the scoring columns. The verbatim
+    columns are FLEET-normalized absolute free (free * inv_scale), not
+    own-capacity fractions: two heterogeneous flavors that are both empty
+    have identical fractions everywhere, and a scorer fed only fractions
+    provably cannot tell a cpu-rich node from a mem-rich one on the
+    fragmented shapes where shape-aware placement pays (the round-17
+    training-signal finding). Columns:
+      0..3  fleet-normalized free, first FEAT_COLS columns verbatim (the
+            per-resource headroom SHAPE — the alignment signal)
+      4     mean free fraction of own capacity (1 - binpacking base score)
+      5     min free fraction (the node's bottleneck)
+      6     max free fraction (the node's slack shape)
+      7     MEAN fleet-normalized free across the scoring columns (the
+            absolute-headroom scale signal — a big empty node scores
+            higher than a small empty one; mean not sum, so the value
+            stays comparable across vocab widths). This column's code IS
+            the versioned contract — changing its arithmetic requires a
+            FEATURE_VERSION bump.
+    """
+    cap = jnp.maximum(cap_i.astype(jnp.float32), 1.0)
+    pos = jnp.clip(free_i.astype(jnp.float32), 0.0, None)
+    q = pos * inv_scale[None, :]                                # [M, R]
+    f = pos / cap                                               # [M, R]
+    mean_f = jnp.mean(f, axis=1)
+    min_f = jnp.min(f, axis=1) if f.shape[1] else jnp.zeros_like(mean_f)
+    max_f = jnp.max(f, axis=1) if f.shape[1] else jnp.zeros_like(mean_f)
+    total = jnp.sum(q, axis=1) / float(max(free_i.shape[1], 1))
+    return jnp.concatenate(
+        [_first_cols(q, FEAT_COLS),
+         mean_f[:, None], min_f[:, None], max_f[:, None], total[:, None]],
+        axis=1)

@@ -1,0 +1,246 @@
+"""The two-tower policy scorer and its versioned checkpoint format.
+
+DOPPLER-style dual policies (arXiv 2505.23131) factor an assignment score
+into per-side embeddings; here a pod tower and a node tower (tiny tanh MLPs,
+plain pytree params — flax-free, so the params ride straight into the jitted
+solve as traced leaves) meet in a dot product:
+
+    score[i, m] = pod_tower(pod_feats[i]) . node_tower(node_feats[m])
+
+The bilinear family covers the structural wins the greedy scalar score
+cannot express — request/free shape alignment and per-resource pricing — at
+a per-chunk cost of one [C, H] x [H, M] matmul, MXU-shaped like the rest of
+the solve.
+
+UNTRAINED-IS-INERT CONTRACT: `init_params` zero-initializes the pod tower's
+output layer, so an untrained net scores exactly 0.0 for every (pod, node)
+pair, and the solver's learned branch is arithmetically bit-identical to the
+greedy program (the gate in ops/assign._learned_proposals needs a strictly
+positive advantage, and the additive term is zero). A freshly-initialized or
+garbage-zero checkpoint therefore commits plans bit-identical to greedy —
+pinned by tests/test_policy.py.
+
+Checkpoints are a `.npz` of named leaves plus a JSON manifest carrying the
+format version, the feature-schema version, tower dims, a sha256 of the npz
+bytes and a content hash of the params. `load_checkpoint` REJECTS (raises
+CheckpointError) on any mismatch — the caller keeps its previous policy, a
+bad artifact can never be half-loaded.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from yunikorn_tpu.policy.features import F_NODE, F_POD, FEATURE_VERSION
+
+CKPT_FORMAT = 1
+HIDDEN = 32
+EMB = 16
+
+# learned proposal-override gate: the chosen node's raw learned score must
+# beat the mean over the pod's feasible nodes by this margin (shift-invariant
+# — CE training is invariant to per-pod logit shifts) before the policy may
+# override the water-fill proposal. Untrained nets score identically 0
+# everywhere, so the gate can never fire.
+GATE_MARGIN = 0.05
+
+
+class CheckpointError(RuntimeError):
+    """The checkpoint failed validation (corrupt payload, format/feature
+    schema mismatch, shape drift). The previous policy must be retained."""
+
+
+# ---------------------------------------------------------------------- net
+def init_params(seed: int = 0, hidden: int = HIDDEN, emb: int = EMB) -> Dict:
+    """Plain-pytree params. Hidden layers get small random init (seeded,
+    reproducible); the POD tower's output layer is exactly zero so the
+    untrained score matrix is exactly zero (see module docstring)."""
+    rng = np.random.RandomState(seed)
+
+    def lin(fin, fout, scale):
+        return (np.asarray(rng.standard_normal((fin, fout)) * scale,
+                           np.float32),
+                np.zeros((fout,), np.float32))
+
+    zero_out = (np.zeros((hidden, emb), np.float32),
+                np.zeros((emb,), np.float32))
+    return {
+        "pod": (lin(F_POD, hidden, 1.0 / np.sqrt(F_POD)), zero_out),
+        "node": (lin(F_NODE, hidden, 1.0 / np.sqrt(F_NODE)),
+                 lin(hidden, emb, 1.0 / np.sqrt(hidden))),
+        # gumbel exploration temperature of the proposal override (spreads
+        # proposals across equally-scored nodes instead of herding onto the
+        # lowest row index; ops/assign._learned_proposals)
+        "tau": np.float32(0.25),
+    }
+
+
+def _tower(layers, x):
+    import jax.numpy as jnp
+
+    (w1, b1), (w2, b2) = layers
+    h = jnp.tanh(x @ w1 + b1)
+    return h @ w2 + b2
+
+
+def pod_tower(params, pod_feats):
+    """[N, F_POD] -> [N, E]."""
+    return _tower(params["pod"], pod_feats)
+
+
+def node_tower(params, node_feats):
+    """[M, F_NODE] -> [M, E]."""
+    return _tower(params["node"], node_feats)
+
+
+def score_matrix(params, pod_feats, node_feats):
+    """[N, M] learned score (higher = prefer). Inference composes the same
+    two calls inside the solve's chunked stages; this form is the trainer's
+    and the tests'."""
+    return pod_tower(params, pod_feats) @ node_tower(params, node_feats).T
+
+
+# ------------------------------------------------------------- checkpoint IO
+_LEAF_ORDER = ("pod_0_w", "pod_0_b", "pod_1_w", "pod_1_b",
+               "node_0_w", "node_0_b", "node_1_w", "node_1_b", "tau")
+
+
+def _flatten(params: Dict) -> Dict[str, np.ndarray]:
+    (pw1, pb1), (pw2, pb2) = params["pod"]
+    (nw1, nb1), (nw2, nb2) = params["node"]
+    vals = (pw1, pb1, pw2, pb2, nw1, nb1, nw2, nb2, params["tau"])
+    return {k: np.asarray(v, np.float32) for k, v in zip(_LEAF_ORDER, vals)}
+
+
+def _unflatten(leaves: Dict[str, np.ndarray]) -> Dict:
+    return {
+        "pod": ((leaves["pod_0_w"], leaves["pod_0_b"]),
+                (leaves["pod_1_w"], leaves["pod_1_b"])),
+        "node": ((leaves["node_0_w"], leaves["node_0_b"]),
+                 (leaves["node_1_w"], leaves["node_1_b"])),
+        "tau": np.float32(leaves["tau"]),
+    }
+
+
+def params_hash(params: Dict) -> str:
+    """Content hash of the params (16 hex chars): folds into the AOT
+    fingerprint `extra` so a checkpoint swap can never serve a stale
+    compiled executable, and into the policy_checkpoint_epoch gauge."""
+    h = hashlib.sha256()
+    for k, v in sorted(_flatten(params).items()):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(v).tobytes())
+    return h.hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class PolicyCheckpoint:
+    params: Dict
+    hash: str
+    epoch: int
+    manifest: dict
+    prefix: str = ""
+
+
+def save_checkpoint(prefix: str, params: Dict, *, epoch: int = 0,
+                    meta: Optional[dict] = None) -> PolicyCheckpoint:
+    """Write `<prefix>.npz` + `<prefix>.json` atomically (tmp + replace).
+    Returns the checkpoint as the loader would see it."""
+    leaves = _flatten(params)
+    npz_path, man_path = prefix + ".npz", prefix + ".json"
+    d = os.path.dirname(os.path.abspath(npz_path))
+    os.makedirs(d, exist_ok=True)
+    tmp = npz_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **leaves)
+    with open(tmp, "rb") as f:
+        npz_sha = hashlib.sha256(f.read()).hexdigest()
+    os.replace(tmp, npz_path)
+    phash = params_hash(params)
+    manifest = {
+        "format": CKPT_FORMAT,
+        "feature_version": FEATURE_VERSION,
+        "f_pod": F_POD,
+        "f_node": F_NODE,
+        "hidden": int(leaves["pod_0_w"].shape[1]),
+        "emb": int(leaves["pod_1_w"].shape[1]),
+        "epoch": int(epoch),
+        "param_hash": phash,
+        "npz_sha256": npz_sha,
+        "leaves": {k: [list(v.shape), str(v.dtype)]
+                   for k, v in leaves.items()},
+        "meta": meta or {},
+    }
+    tmp = man_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, man_path)
+    return PolicyCheckpoint(params=params, hash=phash, epoch=int(epoch),
+                            manifest=manifest, prefix=prefix)
+
+
+def load_checkpoint(prefix: str) -> PolicyCheckpoint:
+    """Load + VALIDATE `<prefix>.npz` / `<prefix>.json`. Any failure raises
+    CheckpointError with the specific reason; nothing is partially applied."""
+    npz_path, man_path = prefix + ".npz", prefix + ".json"
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except Exception as e:
+        raise CheckpointError(f"manifest unreadable at {man_path}: "
+                              f"{type(e).__name__}: {e}")
+    if manifest.get("format") != CKPT_FORMAT:
+        raise CheckpointError(
+            f"checkpoint format {manifest.get('format')!r} != supported "
+            f"{CKPT_FORMAT}")
+    if manifest.get("feature_version") != FEATURE_VERSION:
+        raise CheckpointError(
+            f"feature schema v{manifest.get('feature_version')} != the "
+            f"extractor's v{FEATURE_VERSION} — retrain against the current "
+            "features")
+    if (manifest.get("f_pod"), manifest.get("f_node")) != (F_POD, F_NODE):
+        raise CheckpointError("feature width mismatch "
+                              f"({manifest.get('f_pod')}x"
+                              f"{manifest.get('f_node')} != {F_POD}x{F_NODE})")
+    try:
+        with open(npz_path, "rb") as f:
+            raw = f.read()
+    except Exception as e:
+        raise CheckpointError(f"params unreadable at {npz_path}: "
+                              f"{type(e).__name__}: {e}")
+    npz_sha = hashlib.sha256(raw).hexdigest()
+    if npz_sha != manifest.get("npz_sha256"):
+        raise CheckpointError("params payload sha256 mismatch (corrupt or "
+                              "tampered npz)")
+    import io
+
+    try:
+        with np.load(io.BytesIO(raw)) as z:
+            leaves = {k: np.asarray(z[k], np.float32) for k in _LEAF_ORDER}
+    except Exception as e:
+        raise CheckpointError(f"params npz undecodable: "
+                              f"{type(e).__name__}: {e}")
+    want = manifest.get("leaves") or {}
+    for k, v in leaves.items():
+        spec = want.get(k)
+        if spec is None or list(v.shape) != list(spec[0]):
+            raise CheckpointError(
+                f"leaf {k} shape {list(v.shape)} != manifest {spec}")
+    if leaves["pod_0_w"].shape != (F_POD, manifest["hidden"]) \
+            or leaves["node_0_w"].shape != (F_NODE, manifest["hidden"]) \
+            or leaves["pod_1_w"].shape[1] != leaves["node_1_w"].shape[1]:
+        raise CheckpointError("tower dims inconsistent with the feature "
+                              "schema / embedding width")
+    params = _unflatten(leaves)
+    phash = params_hash(params)
+    if phash != manifest.get("param_hash"):
+        raise CheckpointError("param content hash mismatch "
+                              f"({phash} != {manifest.get('param_hash')})")
+    return PolicyCheckpoint(params=params, hash=phash,
+                            epoch=int(manifest.get("epoch", 0)),
+                            manifest=manifest, prefix=prefix)

@@ -1,0 +1,277 @@
+"""Offline DOPPLER-style training from replay datasets.
+
+Training data is the per-cycle duel record the scheduler itself produces
+(`CoreScheduler.policy_recorder`, wired by `scripts/trace_replay.py
+--dataset-out` and `scripts/policy_bench.py`): the RAW solve tensors of one
+cycle — quantized request rows, round-0 free capacity, node capacities and
+validity, priorities — plus every candidate plan that entered the
+`choose_plan` duel and the duel's winner. Recording raw tensors (not
+features) keeps datasets valid across feature-schema bumps: the trainer
+derives features with the SAME `policy/features.py` functions inference
+uses, so train/serve skew is structurally impossible.
+
+Two phases (train.fit):
+
+  imitation   cross-entropy of the scorer's per-pod node distribution
+              against the recorded duel WINNER's assignment, masked to
+              fit-feasible nodes — the policy first learns to reproduce
+              whichever plan the differential oracle actually committed
+              (greedy on homogeneous cycles, the LP pack plan exactly on
+              the fragmented cycles where a global view pays).
+  fine-tune   a differentiable relaxation of the packing objective itself:
+              soft-assign each ask across its feasible nodes (softmax with
+              an always-available null column, the pack LP's drop-out
+              semantics), maximize expected capacity-normalized placed
+              units minus per-node-per-resource overload and a mild
+              contention penalty on busy nodes. This is the dual-policy
+              refinement step: the scorer stops imitating and starts
+              optimizing the committed objective directly.
+
+Feasibility in the dataset is FIT feasibility (free >= request, node
+schedulable): the proving-ground traces carry no selector constraints, and
+the solver re-checks full group feasibility at inference anyway — an
+over-permissive training mask can only cost score quality, never
+correctness (the differential oracle is the floor).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.policy import features as pf
+from yunikorn_tpu.policy import net as pnet
+
+logger = log("policy.train")
+
+_MASK = -1.0e9
+
+
+# ---------------------------------------------------------------- dataset IO
+_ARRAY_KEYS = ("req", "rank", "valid", "free0", "cap", "node_ok",
+               "priorities")
+
+
+class DatasetWriter:
+    """Append per-cycle duel examples as one .npz each + a manifest.jsonl.
+    Bounded (max_cycles) so a long replay cannot fill the disk; callable so
+    it plugs straight into CoreScheduler.policy_recorder."""
+
+    def __init__(self, path: str, max_cycles: int = 512,
+                 fresh: bool = True):
+        self.path = path
+        self.max_cycles = int(max_cycles)
+        self.written = 0
+        self.dropped = 0
+        os.makedirs(path, exist_ok=True)
+        if fresh:
+            # a writer owns its dataset dir: stale cycles from a previous
+            # run (or a previous --ab arm on the same path) would silently
+            # mix into training via load_dataset's glob
+            for name in os.listdir(path):
+                if ((name.startswith("cycle_") and name.endswith(".npz"))
+                        or name == "manifest.jsonl"):
+                    os.unlink(os.path.join(path, name))
+
+    def write(self, example: Dict) -> bool:
+        if self.written >= self.max_cycles:
+            self.dropped += 1
+            return False
+        out = {k: np.asarray(example[k]) for k in _ARRAY_KEYS
+               if k in example}
+        for k, v in example.items():
+            if k.startswith("plan_") and v is not None:
+                out[k] = np.asarray(v, np.int32)
+        out["score_cols"] = np.asarray(int(example["score_cols"]))
+        out["winner"] = np.asarray(str(example.get("winner", "greedy")))
+        fname = f"cycle_{self.written:05d}.npz"
+        fp = os.path.join(self.path, fname)
+        with open(fp + ".tmp", "wb") as f:
+            np.savez_compressed(f, **out)
+        os.replace(fp + ".tmp", fp)
+        with open(os.path.join(self.path, "manifest.jsonl"), "a") as f:
+            f.write(json.dumps({
+                "file": fname, "winner": str(out["winner"]),
+                "pods": int(out["req"].shape[0]),
+                "nodes": int(out["free0"].shape[0]),
+                "plans": sorted(k for k in out if k.startswith("plan_")),
+            }) + "\n")
+        self.written += 1
+        return True
+
+    __call__ = write
+
+
+def load_dataset(path: str) -> List[Dict]:
+    """Read every cycle npz under `path` (sorted, deterministic)."""
+    out = []
+    for name in sorted(os.listdir(path)):
+        if not (name.startswith("cycle_") and name.endswith(".npz")):
+            continue
+        with np.load(os.path.join(path, name)) as z:
+            ex = {k: np.asarray(z[k]) for k in z.files}
+        ex["score_cols"] = int(ex["score_cols"])
+        ex["winner"] = str(ex["winner"])
+        out.append(ex)
+    return out
+
+
+# ------------------------------------------------------------------ trainer
+def _prepare(ex: Dict) -> Optional[Dict]:
+    """Derive the fixed-shape training tensors for one recorded cycle."""
+    sc = int(ex["score_cols"])
+    req = np.asarray(ex["req"], np.int32)
+    free0 = np.asarray(ex["free0"], np.int32)
+    cap = np.asarray(ex["cap"], np.int32)
+    valid = np.asarray(ex["valid"], bool)
+    node_ok = np.asarray(ex["node_ok"], bool)
+    n, r = req.shape
+    m = free0.shape[0]
+    sc = min(max(sc, 1), r)
+    winner = ex.get("winner", "greedy")
+    target = ex.get(f"plan_{winner}", ex.get("plan_greedy"))
+    if target is None or n == 0 or m == 0:
+        return None
+    # plans are recorded over the LIVE asks ([:num_pods]) while the solve
+    # tensors keep their bucket padding — pad with -1 (padded rows are
+    # valid=False and masked out of every loss)
+    target = np.asarray(target, np.int32)
+    if target.shape[0] < n:
+        target = np.concatenate(
+            [target, np.full(n - target.shape[0], -1, np.int32)])
+    target = target[:n]
+    # fit feasibility over ALL recorded columns (ports ride synthetic
+    # columns in req/free0 when present) — loop keeps memory at [N, M]
+    ok = np.broadcast_to(valid[:, None] & node_ok[None, :], (n, m)).copy()
+    for col in range(r):
+        ok &= (free0[None, :, col] - req[:, None, col]) >= 0
+    inv = np.asarray(pf.inv_capacity_scale(cap[:, :sc]))
+    pod_f = np.asarray(pf.pod_features(req[:, :sc], inv))
+    node_f = np.asarray(pf.node_features(free0[:, :sc], cap[:, :sc], inv))
+    q = req[:, :sc].astype(np.float64) * inv[None, :]
+    return {
+        "pod_f": pod_f.astype(np.float32),
+        "node_f": node_f.astype(np.float32),
+        "ok": ok,
+        "target": target,
+        "tmask": valid & (target >= 0),
+        "valid_rows": valid.astype(np.float32),
+        "vunits": q.sum(axis=1).astype(np.float32),
+        "req_n": q.astype(np.float32),
+        "free_n": (np.clip(free0[:, :sc], 0, None).astype(np.float64)
+                   * inv[None, :]).astype(np.float32),
+        # contention proxy: how busy the node already is (BandPilot's
+        # co-tenant pressure signal, absent per-domain labels)
+        "cont": (1.0 - node_f[:, pf.FEAT_COLS]).astype(np.float32),
+    }
+
+
+def _adam_init(params):
+    import jax
+
+    z = jax.tree_util.tree_map(lambda a: np.zeros_like(np.asarray(a)), params)
+    return z, jax.tree_util.tree_map(np.copy, z)
+
+
+def _adam_step(params, grads, m, v, t, lr, b1=0.9, b2=0.999, eps=1e-8):
+    import jax
+
+    def upd(p, g, mi, vi):
+        mi = b1 * mi + (1 - b1) * g
+        vi = b2 * vi + (1 - b2) * g * g
+        mhat = mi / (1 - b1 ** t)
+        vhat = vi / (1 - b2 ** t)
+        return p - lr * mhat / (np.sqrt(vhat) + eps), mi, vi
+
+    flat_p, tree = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_flatten(grads)[0]
+    flat_m = jax.tree_util.tree_flatten(m)[0]
+    flat_v = jax.tree_util.tree_flatten(v)[0]
+    out_p, out_m, out_v = [], [], []
+    for p, g, mi, vi in zip(flat_p, flat_g, flat_m, flat_v):
+        p2, m2, v2 = upd(np.asarray(p, np.float32), np.asarray(g, np.float32),
+                         mi, vi)
+        out_p.append(p2)
+        out_m.append(m2)
+        out_v.append(v2)
+    unf = jax.tree_util.tree_unflatten
+    return unf(tree, out_p), unf(tree, out_m), unf(tree, out_v)
+
+
+def fit(examples: List[Dict], *, seed: int = 0, imitation_epochs: int = 80,
+        finetune_epochs: int = 60, lr: float = 5e-3, beta: float = 4.0,
+        overload_w: float = 2.0, contention_w: float = 0.05,
+        ) -> Tuple[Dict, Dict]:
+    """Train a scorer from recorded duel cycles. Returns (params, report).
+    Deterministic in (examples, seed, hyperparameters)."""
+    import jax
+    import jax.numpy as jnp
+
+    preps = [p for p in (_prepare(ex) for ex in examples) if p is not None]
+    if not preps:
+        raise ValueError("dataset contains no trainable cycles")
+
+    def im_loss(params, pod_f, node_f, ok, target, tmask):
+        ls = pnet.score_matrix(params, pod_f, node_f)
+        logits = jnp.where(ok, ls, _MASK)
+        lse = jax.scipy.special.logsumexp(logits, axis=1)
+        m = logits.shape[1]
+        tgt = jnp.take_along_axis(
+            logits, jnp.clip(target, 0, m - 1)[:, None], axis=1)[:, 0]
+        ce = jnp.where(tmask, lse - tgt, 0.0)
+        return jnp.sum(ce) / jnp.maximum(jnp.sum(tmask), 1)
+
+    def ft_loss(params, pod_f, node_f, ok, vunits, req_n, free_n, cont,
+                valid_rows):
+        ls = pnet.score_matrix(params, pod_f, node_f)
+        n, m = ls.shape
+        logits = jnp.where(ok, beta * ls, _MASK)
+        aug = jnp.concatenate([logits, jnp.zeros((n, 1), jnp.float32)],
+                              axis=1)
+        p = jax.nn.softmax(aug, axis=1)[:, :m]
+        p = jnp.where(ok, p, 0.0) * valid_rows[:, None]
+        placed = jnp.sum(p, axis=1)
+        units = jnp.sum(vunits * placed)
+        load = p.T @ req_n                                    # [M, sc]
+        over = jnp.sum(jnp.maximum(load - free_n, 0.0))
+        # contention is a per-pod DISCOUNT on the units earned on busy
+        # nodes (weighting by vunits keeps it a fraction of the packing
+        # objective — an absolute penalty would swamp small-pod cycles)
+        contention = jnp.sum((vunits[:, None] * p) * cont[None, :])
+        n_eff = jnp.maximum(jnp.sum(valid_rows), 1.0)
+        return -(units - overload_w * over
+                 - contention_w * contention) / n_eff
+
+    im_grad = jax.jit(jax.value_and_grad(im_loss))
+    ft_grad = jax.jit(jax.value_and_grad(ft_loss))
+
+    params = jax.tree_util.tree_map(lambda a: np.asarray(a, np.float32),
+                                    pnet.init_params(seed))
+    m_s, v_s = _adam_init(params)
+    t = 0
+    report = {"examples": len(preps), "imitation": [], "finetune": []}
+    for epoch in range(imitation_epochs):
+        tot = 0.0
+        for p in preps:
+            t += 1
+            loss, g = im_grad(params, p["pod_f"], p["node_f"], p["ok"],
+                              p["target"], p["tmask"])
+            params, m_s, v_s = _adam_step(params, g, m_s, v_s, t, lr)
+            tot += float(loss)
+        if epoch in (0, imitation_epochs - 1) or epoch % 20 == 0:
+            report["imitation"].append(round(tot / len(preps), 5))
+    for epoch in range(finetune_epochs):
+        tot = 0.0
+        for p in preps:
+            t += 1
+            loss, g = ft_grad(params, p["pod_f"], p["node_f"], p["ok"],
+                              p["vunits"], p["req_n"], p["free_n"],
+                              p["cont"], p["valid_rows"])
+            params, m_s, v_s = _adam_step(params, g, m_s, v_s, t, lr * 0.5)
+            tot += float(loss)
+        if epoch in (0, finetune_epochs - 1) or epoch % 20 == 0:
+            report["finetune"].append(round(tot / len(preps), 5))
+    return params, report
