@@ -2,9 +2,9 @@
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke \
-	slo-smoke topology-smoke shard-smoke policy-smoke smoke lint \
-	run-scheduler run-admission dryrun clean image sched_image adm_image \
-	webtest_image
+	slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke \
+	smoke lint run-scheduler run-admission dryrun clean image \
+	sched_image adm_image webtest_image
 
 # container images (reference Makefile:409-435 image targets)
 REGISTRY ?= yunikorn-tpu
@@ -141,7 +141,22 @@ policy-smoke:  ## learned dispatch policy (solver.policy=learned): unit suite (u
 		--ab --policy-checkpoint /tmp/yk_policy_smoke_ck \
 		--assert-quality
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke  ## all tier-1 smoke targets
+failover-smoke:  ## shard failure domains + true fresh-process restart: the chaos suite (crash/wedge detection, quarantine re-homes 100% of the dead shard's domains under a clean ledger audit, fresh-core rejoin at the next epoch, watchdog-thread hygiene, cross-shard app-COUNT exactness, mis-eviction ledger across restart), a 4-shard kill-one-mid-gang-storm replay (--assert-failover: quarantined + fully re-homed + every pod bound + zero SLO violations), and a restart-storm whose mid-storm restart is a GENUINELY FRESH interpreter serving from a prebuilt AOT store within the aot_cold_start budget with zero lost bound pods and zero mis-evictions
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_failover.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --shards 4 --kill-shard 1 \
+		--failover-stale 30 --failover-probe 0.3 --assert-failover \
+		--assert-slo
+	rm -rf /tmp/yk_failover_store
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace restart-storm --nodes 300 \
+		--pods 240 --tenants 4 --duration 14 --restart-mode process \
+		--takeover-window 25 --aot-store /tmp/yk_failover_store \
+		--slo-cold-budget-ms 120000 --assert-slo
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

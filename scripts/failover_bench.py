@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Shard-failover bench: time-to-rehome and time-to-recover when one shard
+of an N-shard control plane dies at fleet scale.
+
+Direct-API harness (no shim): M nodes registered through the sharded front
+end, a working set of pods bound across every shard, then ONE shard is
+quarantined (the detection path is probe-cadence-bound and configurable;
+this bench measures the part that scales with fleet size — the quarantine
+TRANSACTION: ledger reconcile + app re-homing + allocation re-attribution
++ whole-domain node re-homing + parked-ask re-admission) and the fleet
+drains the re-admitted asks. Reported per shard count:
+
+  quarantine_s   wall of quarantine_shard() — detection to every domain
+                 re-homed and every parked ask re-submitted
+  recover_s      additional wall until every parked ask is bound again
+  rehomed_nodes  nodes moved off the dead shard (must be ALL it owned)
+  audit          GlobalQuotaLedger.audit() after each phase (must be [])
+
+Usage:
+  python scripts/failover_bench.py --nodes 10000 --shards 4,8 --pods 1024
+  python scripts/failover_bench.py --nodes 2000 --shards 4 --assert
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import zlib
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_one(n_shards: int, n_nodes: int, n_pods: int,
+            interval: float = 0.05) -> dict:
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import (
+        AddApplicationRequest,
+        AllocationAsk,
+        AllocationRequest,
+        ApplicationRequest,
+        NodeAction,
+        NodeInfo,
+        NodeRequest,
+        RegisterResourceManagerRequest,
+        ResourceManagerCallback,
+        UserGroupInfo,
+    )
+    from yunikorn_tpu.core.shard import ShardedCoreScheduler
+    from yunikorn_tpu.robustness.failover import FailoverOptions
+
+    class Recorder(ResourceManagerCallback):
+        def __init__(self):
+            self.bound = set()
+
+        def update_allocation(self, response):
+            for a in response.new:
+                self.bound.add(a.allocation_key)
+
+        def update_application(self, response):
+            pass
+
+        def update_node(self, response):
+            pass
+
+        def predicates(self, args):
+            return None
+
+        def preemption_predicates(self, args):
+            return []
+
+        def send_event(self, events):
+            pass
+
+        def update_container_scheduling_state(self, request):
+            pass
+
+        def get_state_dump(self):
+            return "{}"
+
+    cache = SchedulerCache()
+    cb = Recorder()
+    front = ShardedCoreScheduler(
+        cache, n_shards, interval=interval,
+        failover_options=FailoverOptions(stale_budget_s=3600.0,
+                                         probe_interval_s=3600.0,
+                                         rejoin_after_s=3600.0))
+    front.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="bench", policy_group="queues",
+                                      config=""), cb)
+    t0 = time.time()
+    infos = []
+    for i in range(n_nodes):
+        node = make_node(f"bn-{i}", cpu_milli=8000)
+        cache.update_node(node)
+        infos.append(NodeInfo(node_id=node.name, action=NodeAction.CREATE,
+                              node=node))
+    front.update_node(NodeRequest(nodes=infos))
+    t_reg = time.time() - t0
+    front.start()
+    try:
+        # ---- working set: pods bound across every shard (warm phase) ----
+        apps = [f"bapp-{i}" for i in range(max(n_shards * 4, 16))]
+        for app in apps:
+            front.update_application(ApplicationRequest(new=[
+                AddApplicationRequest(
+                    application_id=app, queue_name="root.default",
+                    user=UserGroupInfo(user="bench", groups=[]))]))
+        keys = []
+        for i in range(n_pods):
+            app = apps[i % len(apps)]
+            pod = make_pod(f"bp-{i}", cpu_milli=200, memory=2 ** 27)
+            key = f"bp-{i}"
+            keys.append(key)
+            front.update_allocation(AllocationRequest(asks=[AllocationAsk(
+                allocation_key=key, application_id=app,
+                resource=get_pod_resource(pod), pod=pod)]))
+        deadline = time.time() + 900
+        while time.time() < deadline and len(cb.bound) < n_pods:
+            time.sleep(0.25)
+        warm_bound = len(cb.bound)
+        victim = 1 % n_shards
+        owned_before = front.fanout.count_for(victim)
+
+        # ---- a second wave lands and the shard dies MID-STREAM: some of
+        #      these asks are pending on the victim when it goes ----
+        wave2 = max(n_pods // 4, n_shards * 8)
+        for i in range(wave2):
+            app = apps[i % len(apps)]
+            pod = make_pod(f"bw-{i}", cpu_milli=200, memory=2 ** 27)
+            key = f"bw-{i}"
+            keys.append(key)
+            front.update_allocation(AllocationRequest(asks=[AllocationAsk(
+                allocation_key=key, application_id=app,
+                resource=get_pod_resource(pod), pod=pod)]))
+        parked_before = sum(
+            1 for k, h in front._ask_home.items()
+            if h == victim and k not in front._alloc_shard)
+
+        # ---- the measured transaction ----
+        t_q0 = time.time()
+        ok = front.quarantine_shard(victim, "bench")
+        quarantine_s = time.time() - t_q0
+        audit_after_q = front.ledger.audit()
+
+        # ---- recovery drain: every ask bound again ----
+        t_r0 = time.time()
+        deadline = time.time() + 600
+        while time.time() < deadline and len(cb.bound) < len(keys):
+            time.sleep(0.2)
+        recover_s = time.time() - t_r0
+        return {
+            "shards": n_shards,
+            "nodes": n_nodes,
+            "pods": n_pods,
+            "node_registration_s": round(t_reg, 2),
+            "warm_bound": warm_bound,
+            "owned_before": owned_before,
+            "parked_before": parked_before,
+            "quarantine_ok": bool(ok),
+            "quarantine_s": round(quarantine_s, 3),
+            "rehomed_nodes": front._rehomed_nodes_total,
+            "recover_s": round(recover_s, 2),
+            "bound_total": len(cb.bound),
+            "all_bound": len(cb.bound) >= len(keys),
+            "audit_after_quarantine": audit_after_q,
+            "audit_final": front.ledger.audit(),
+        }
+    finally:
+        front.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nodes", type=int, default=10_000)
+    ap.add_argument("--shards", default="4,8")
+    ap.add_argument("--pods", type=int, default=1024)
+    ap.add_argument("--interval", type=float, default=0.05)
+    ap.add_argument("--assert", dest="assert_", action="store_true",
+                    help="exit 1 unless every run re-homed 100%% of the "
+                         "dead shard's nodes, re-bound every pod and kept "
+                         "the ledger audit clean")
+    ap.add_argument("--report", default="")
+    args = ap.parse_args()
+
+    results = []
+    for n in (int(s) for s in args.shards.split(",")):
+        print(f"[failover-bench] {n} shards x {args.nodes} nodes x "
+              f"{args.pods} pods", file=sys.stderr, flush=True)
+        r = run_one(n, args.nodes, args.pods, interval=args.interval)
+        print(json.dumps(r), file=sys.stderr, flush=True)
+        results.append(r)
+    out = json.dumps({"runs": results}, indent=2)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out + "\n")
+    print(out)
+    if args.assert_:
+        for r in results:
+            ok = (r["quarantine_ok"] and r["all_bound"]
+                  and r["rehomed_nodes"] >= r["owned_before"]
+                  and not r["audit_after_quarantine"]
+                  and not r["audit_final"])
+            if not ok:
+                print(f"[failover-bench] FAIL at {r['shards']} shards: {r}",
+                      file=sys.stderr, flush=True)
+                return 1
+        print("[failover-bench] PASS", file=sys.stderr, flush=True)
+    return 0
+
+
+def _exit(code: int) -> None:
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)
+
+
+if __name__ == "__main__":
+    _exit(main())

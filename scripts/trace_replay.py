@@ -24,10 +24,17 @@ identical report modulo the `timings` section):
                  rolled back in (node-drain + rolling-upgrade)
   restart-storm  gang storm with a scheduler restart mid-storm: core+shim
                  torn down and rebuilt against the live API server (state
-                 recovery under pressure). With --aot-store the rebuilt
-                 scheduler serves its first cycle from the prebuilt
-                 executable store; TRUE fresh-process cold start stays
-                 covered by scripts/aot_smoke.py.
+                 recovery under pressure). --restart-mode inprocess (the
+                 default) rebuilds inside this interpreter; --restart-mode
+                 process spawns a GENUINELY FRESH interpreter that takes
+                 over scheduling against the live server for a takeover
+                 window — with --aot-store its first admitted cycle is the
+                 true process-boundary cold start, measured by the child's
+                 own SLO engine against the aot_cold_start budget, and the
+                 child verifies recovery restored every bound pod with
+                 zero lost bindings and zero mis-evictions (the fresh-
+                 process verdict scripts/aot_smoke.py covers for the bare
+                 solver, now covered for the full shim path).
   slice-fragmentation
                  mixed-size gangs churning across ICI domains: nodes carry
                  synthesized topology labels (fake_apiserver.topology_labels)
@@ -41,6 +48,14 @@ identical report modulo the `timings` section):
 Chaos coupling (--fault hang|fail): a scripted robustness/faults.py fault
 poisons the supervised assign path mid-trace — the staleness objective must
 detect it (`--expect-violation` asserts that it does).
+
+Shard failover (--kill-shard N, needs --shards >= 2): kills ONE shard's
+scheduling loop mid-trace (--kill-mode crash = faults.crash unwinds the
+loop thread; wedge = a slow fault past every deadline). The failure-domain
+supervisor (robustness/failover.py) must detect it, QUARANTINE the shard,
+re-home 100%% of its node domains onto survivors and re-admit its parked
+asks — `--assert-failover` gates on exactly that (plus a clean ledger
+audit and every pod bound).
 
 A/B (--ab): replays the identical trace under solver.policy=greedy and
 =optimal — and, when --policy-checkpoint names a trained learned-policy
@@ -260,7 +275,9 @@ def _pod_doc(name: str, app: str, queue: str, cpu_m: int, mem_mi: int,
 class ReplayStack:
     """Owns the scheduler side (provider/cache/core/shim) over a shared
     FakeAPIServer; restart() rebuilds it in place — the restart-storm
-    trace's recovery-under-pressure seam."""
+    trace's recovery-under-pressure seam. server may be None when the
+    stack is a fresh-process takeover child attaching to a live server it
+    does not own."""
 
     def __init__(self, server, port: int, conf_map: Dict[str, str],
                  policy: str, recorder=None):
@@ -272,6 +289,12 @@ class ReplayStack:
         # every (re)boot so a restart-storm rebuild keeps recording
         self.recorder = recorder
         self.violations_history: List[Dict[str, int]] = []
+        # counters that must SURVIVE a restart: the rebuilt core's metrics
+        # start at zero, and a report reading only the final core would
+        # silently LOSE every pre-restart preemption and mis-eviction —
+        # the mis-eviction ledger across restart would under-count
+        self._counters_history: List[Dict[str, int]] = []
+        self.takeover_reports: List[dict] = []
         self.restarts = 0
         self.restart_first_cycle_ms: Optional[float] = None
         self.core = self.shim = self.provider = None
@@ -286,6 +309,7 @@ class ReplayStack:
         from yunikorn_tpu.core.shard import make_core_scheduler
         from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
         from yunikorn_tpu.obs.slo import SloOptions
+        from yunikorn_tpu.robustness.failover import FailoverOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
         from yunikorn_tpu.shim.scheduler import KubernetesShim
 
@@ -302,7 +326,8 @@ class ReplayStack:
             cache, shards=conf.solver_shards, interval=conf.interval,
             solver_options=SolverOptions.from_conf(conf),
             supervisor_options=SupervisorOptions.from_conf(conf),
-            slo_options=SloOptions.from_conf(conf))
+            slo_options=SloOptions.from_conf(conf),
+            failover_options=FailoverOptions.from_conf(conf))
         if self.recorder is not None:
             target = getattr(self.core, "primary", self.core)
             if hasattr(target, "policy_recorder"):
@@ -320,14 +345,40 @@ class ReplayStack:
         if self.provider is not None:
             self.provider.stop()
 
-    def restart(self) -> None:
-        """Scheduler-pod restart against the live API server: verdicts and
-        violation counts recorded so far are carried into the report's
-        history; the fresh core recovers bound pods + pending asks from the
-        server's state."""
+    def _counter_snapshot(self) -> Dict[str, int]:
+        return {
+            "preempted_total": int(
+                self.core.obs.get("preempted_total").value()),
+            "mis_evictions": int(self.core.obs.get(
+                "preemption_mis_evictions_total").value()),
+        }
+
+    def restart(self, takeover: Optional[dict] = None) -> None:
+        """Scheduler-pod restart against the live API server: verdicts,
+        violation and preemption/mis-eviction counts recorded so far are
+        carried into the report's history (a rebuilt core's counters start
+        at zero — dropping them would make the mis-eviction ledger lose
+        residue across restarts); the fresh core recovers bound pods +
+        pending asks from the server's state.
+
+        takeover != None runs the TRUE fresh-process restart first: a new
+        interpreter (child_takeover) schedules against the live server for
+        the takeover window, measures the process-boundary cold start and
+        verifies recovery, then exits; this stack reboots in-process to
+        finish the trace (a second recovery)."""
         self.violations_history.append(self.core.slo.violations())
+        self._counters_history.append(self._counter_snapshot())
         self.stop()
         self.restarts += 1
+        if takeover is not None:
+            rep = self._run_takeover(takeover)
+            self.takeover_reports.append(rep)
+            self.restarts += 1  # the child's boot is a restart too
+            self.violations_history.append(rep.get("violations") or {})
+            self._counters_history.append({
+                "preempted_total": int(rep.get("preempted_total", 0)),
+                "mis_evictions": int(rep.get("mis_evictions", 0)),
+            })
         self._boot()
         # the rebuilt core's first admitted cycle is the restart's measured
         # cold start (an attached AOT store serves it from artifacts)
@@ -338,12 +389,181 @@ class ReplayStack:
                 break
             time.sleep(0.2)
 
+    def _run_takeover(self, spec: dict) -> dict:
+        """Spawn the fresh-interpreter takeover child against the live
+        server and collect its one-line JSON report."""
+        import subprocess
+        import tempfile
+
+        fd, conf_path = tempfile.mkstemp(suffix=".json",
+                                         prefix="yk-takeover-")
+        with os.fdopen(fd, "w") as f:
+            json.dump(self.conf_map, f)
+        cmd = [sys.executable, os.path.abspath(__file__), "--takeover",
+               "--takeover-port", str(self.port),
+               "--takeover-conf", conf_path,
+               "--takeover-window", str(spec.get("window", 25.0))]
+        if spec.get("aot_store"):
+            cmd += ["--aot-store", spec["aot_store"]]
+        env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        print(f"[replay] spawning fresh-process takeover: {' '.join(cmd)}",
+              file=sys.stderr, flush=True)
+        try:
+            try:
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=float(spec.get("timeout", 600.0)),
+                                   env=env)
+            except subprocess.TimeoutExpired as e:
+                # surface whatever the wedged child printed, and fail the
+                # structured way (the smoke greps the [replay] FAIL shape)
+                sys.stderr.write((e.stdout or b"")[-4000:].decode(
+                    "utf-8", "replace") if isinstance(e.stdout, bytes)
+                    else (e.stdout or "")[-4000:])
+                raise RuntimeError(
+                    f"fresh-process takeover timed out after {e.timeout}s"
+                ) from e
+            line = next((ln for ln in reversed(r.stdout.splitlines())
+                         if ln.startswith("TAKEOVER_REPORT ")), None)
+            if r.returncode != 0 or line is None:
+                sys.stderr.write(r.stdout[-4000:])
+                sys.stderr.write(r.stderr[-4000:])
+                raise RuntimeError(
+                    f"fresh-process takeover failed rc={r.returncode}")
+            rep = json.loads(line[len("TAKEOVER_REPORT "):])
+        finally:
+            try:
+                os.unlink(conf_path)
+            except OSError:
+                pass
+        print(f"[replay] takeover done: cold={rep.get('first_cycle_ms')}ms "
+              f"({rep.get('cold_verdict')}), restored="
+              f"{rep.get('restored_allocations')}/"
+              f"{rep.get('bound_at_boot')}, lost={rep.get('lost_bound')}, "
+              f"mis_evictions={rep.get('mis_evictions')}",
+              file=sys.stderr, flush=True)
+        return rep
+
     def merged_violations(self) -> Dict[str, int]:
         out = self.core.slo.violations()
         for past in self.violations_history:
             for k, v in past.items():
                 out[k] = out.get(k, 0) + v
         return out
+
+    def merged_counter(self, name: str) -> int:
+        cur = self._counter_snapshot()[name]
+        return cur + sum(past.get(name, 0)
+                         for past in self._counters_history)
+
+
+# ---------------------------------------------------------------------------
+# Fresh-process takeover child (--takeover; internal)
+# ---------------------------------------------------------------------------
+def _count_restored_allocations(core, uids=None) -> int:
+    """Non-placeholder allocations registered across every shard's
+    partitions — recovery restores one per bound pod. With `uids`, count
+    ONLY allocations whose key is in that set (allocation keys are pod
+    uids): the takeover child passes the uids of pods bound at BOOT, so
+    its own post-recovery bindings can never inflate the restored count."""
+    total = 0
+    for c in getattr(core, "shards", None) or [core]:
+        with c._lock:
+            for part in c.partitions.values():
+                for app in part.applications.values():
+                    total += sum(1 for k, a in app.allocations.items()
+                                 if not a.placeholder
+                                 and (uids is None or k in uids))
+    return total
+
+
+def child_takeover(args) -> int:
+    """A GENUINELY fresh interpreter booted mid-restart-storm: attach to
+    the live fake API server, recover its state through the real adapter,
+    serve the storm for the takeover window, and report the process-
+    boundary cold start + recovery verdict as one JSON line.
+
+    This is the restart the in-process rebuild cannot represent: jit
+    caches, interned vocabularies, device buffers and the AOT runtime all
+    start empty here — with --aot-store the first admitted cycle is
+    artifact-load + execute, without one it is the full XLA compile stall,
+    and the child's own SLO engine scores it against the aot_cold_start
+    budget carried in the conf map."""
+    import urllib.request
+
+    from yunikorn_tpu.utils.jaxtools import (ensure_compilation_cache,
+                                             force_cpu_platform)
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        force_cpu_platform(int(os.environ.get("YK_REPLAY_CPU_DEVICES", "1")))
+    ensure_compilation_cache()
+    rt = None
+    if args.aot_store:
+        from yunikorn_tpu import aot
+
+        rt = aot.install(args.aot_store, background=False)
+    with open(args.takeover_conf) as f:
+        conf_map = json.load(f)
+    port = args.takeover_port
+
+    def bound_pods() -> Dict[str, dict]:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/v1/pods", timeout=10) as r:
+            docs = json.loads(r.read()).get("items", [])
+        # completed pods keep their nodeName but hold no allocation — the
+        # recovery contract covers LIVE bound pods only
+        return {d["metadata"]["name"]: {"node": d["spec"]["nodeName"],
+                                        "uid": d["metadata"].get("uid", "")}
+                for d in docs
+                if d.get("spec", {}).get("nodeName")
+                and d.get("status", {}).get("phase")
+                not in ("Succeeded", "Failed")}
+
+    pre = bound_pods()
+    pre_uids = {v["uid"] for v in pre.values() if v["uid"]}
+    t0 = time.time()
+    stack = ReplayStack(None, port, conf_map, "takeover")
+    out: dict = {"bound_at_boot": len(pre)}
+    try:
+        deadline = t0 + args.takeover_window
+        while time.time() < deadline:
+            stack.core.slo.maybe_tick()
+            # once the cold start is measured, half a window of serving is
+            # enough evidence — the parent resumes the storm afterwards
+            if (stack.core._first_cycle_ms is not None
+                    and time.time() - t0 >= args.takeover_window / 2):
+                break
+            time.sleep(0.2)
+        post = bound_pods()
+        lost = sorted(
+            n for n, v in pre.items()
+            if (post.get(n) or {}).get("node") != v["node"])
+        stack.core.slo.tick()
+        slo_report = stack.core.slo.report()
+        cold = slo_report["objectives"]["aot_cold_start"]
+        out.update({
+            "first_cycle_ms": stack.core._first_cycle_ms,
+            "cold_verdict": cold["verdict"],
+            "cold_budget_ms": cold["target"],
+            # keyed by the BOOT-time bound pods' uids: the child's own new
+            # bindings cannot inflate the restored count
+            "restored_allocations": _count_restored_allocations(
+                stack.core, uids=pre_uids),
+            "lost_bound": len(lost),
+            "lost_names": lost[:8],
+            "mis_evictions": int(stack.core.obs.get(
+                "preemption_mis_evictions_total").value()),
+            "preempted_total": int(
+                stack.core.obs.get("preempted_total").value()),
+            "violations": stack.core.slo.violations(),
+            "bound_at_exit": len(post),
+            "window_s": round(time.time() - t0, 2),
+            "aot_hits": rt.stats()["hits"] if rt is not None else 0,
+        })
+    finally:
+        stack.stop()
+    print("TAKEOVER_REPORT " + json.dumps(out), flush=True)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -443,6 +663,12 @@ def run_replay(args, policy: str) -> dict:
         # control-plane sharding (core/shard.py): N pipelined shards over
         # disjoint topology-aligned node partitions behind one front end
         "solver.shards": str(args.shards),
+        # shard failover (robustness/failover.py): the kill-shard dial
+        # compresses these to seconds so detection + re-home land inside
+        # the trace window
+        "robustness.failoverStaleSeconds": str(args.failover_stale),
+        "robustness.failoverProbeSeconds": str(args.failover_probe),
+        "robustness.failoverRejoinSeconds": str(args.failover_rejoin),
     }
     if args.policy_checkpoint:
         # learned-policy checkpoint (round 17): only the learned arm
@@ -562,6 +788,30 @@ def run_replay(args, policy: str) -> dict:
             run_events += [(t_set, "fault_set", args.fault),
                            (t_clear, "fault_clear", None)]
             run_events.sort(key=lambda e: (e[0], e[1]))
+        if args.kill_shard >= 0:
+            if args.shards < 2:
+                raise SystemExit("--kill-shard needs --shards >= 2")
+            run_events.append((args.duration * 0.42, "kill_shard",
+                               args.kill_shard))
+            run_events.sort(key=lambda e: (e[0], e[1]))
+        if args.restart_mode == "process":
+            # the parent is blocked while the fresh interpreter serves, so
+            # pod waves that would land during the takeover window arrive
+            # at the restart instant instead — pods arriving while the
+            # scheduler is down IS the outage shape, and they form the
+            # recovery backlog whose first admitted cycle the child's
+            # aot_cold_start verdict measures ("pods" sorts before
+            # "restart" at equal t, so they are Pending when it dies)
+            t_restart = next((t for t, k, _p in run_events
+                              if k == "restart"), None)
+            if t_restart is not None:
+                horizon = t_restart + args.takeover_window
+                run_events = [
+                    ((t_restart, k, p)
+                     if k == "pods" and t_restart < t <= horizon
+                     else (t, k, p))
+                    for t, k, p in run_events]
+                run_events.sort(key=lambda e: (e[0], e[1]))
 
         def wait_until(target: float) -> None:
             """Sleep in slices, ticking the SLO engine each slice: the
@@ -601,9 +851,30 @@ def run_replay(args, policy: str) -> dict:
                                  "namespace": "yunikorn"},
                     "data": dict(payload)})
             elif kind == "restart":
-                print("[replay] scheduler restart mid-storm",
-                      file=sys.stderr, flush=True)
-                stack.restart()
+                if args.restart_mode == "process":
+                    print("[replay] scheduler restart mid-storm "
+                          "(fresh-process takeover)", file=sys.stderr,
+                          flush=True)
+                    stack.restart(takeover={
+                        "window": args.takeover_window,
+                        "aot_store": args.aot_store,
+                        "timeout": max(600.0, 4 * args.takeover_window)})
+                else:
+                    print("[replay] scheduler restart mid-storm",
+                          file=sys.stderr, flush=True)
+                    stack.restart()
+            elif kind == "kill_shard":
+                idx = int(payload)
+                print(f"[replay] killing shard {idx} mid-storm "
+                      f"({args.kill_mode})", file=sys.stderr, flush=True)
+                core_k = stack.core.shards[idx]
+                if args.kill_mode == "crash":
+                    # the next assign dispatch unwinds the loop thread
+                    core_k.supervisor.faults.crash("assign")
+                else:
+                    core_k.supervisor.faults.slow(
+                        "assign", seconds=3.0 * args.dispatch_deadline,
+                        times=100_000)
             elif kind == "fault_set":
                 print(f"[replay] injecting fault {payload!r} on the assign "
                       f"path", file=sys.stderr, flush=True)
@@ -695,20 +966,73 @@ def run_replay(args, policy: str) -> dict:
                 "quota_violations": len(core.ledger.audit()),
             }
             timings["shard_ledger"] = srep["ledger"]
+            if args.kill_shard >= 0:
+                # which asks landed on the dying shard before the kill is
+                # detection-timing-dependent: per-shard splits and repair
+                # counts leave the deterministic fingerprint under a kill
+                for key in ("bound_per_shard", "nodes_per_shard",
+                            "repair_placed", "repair_migrated"):
+                    timings[key] = shard_block.pop(key)
+            fo = srep.get("failover") or {}
+            if args.kill_shard >= 0 or fo.get("quarantines"):
+                # the deterministic failover facts (the killed shard's
+                # domain set is seed/hash-deterministic); rehome wall and
+                # end-state ride `timings`
+                last = fo.get("last_rehome") or {}
+                shard_block["failover"] = {
+                    "quarantines": fo.get("quarantines", 0),
+                    "rehomed_nodes": fo.get("rehomed_nodes_total", 0),
+                    "quarantined_shard": last.get("shard"),
+                    "reason": last.get("reason"),
+                }
+                timings["failover"] = {
+                    "states": fo.get("states"),
+                    "last_event": fo.get("last_event"),
+                    "last_rehome": last,
+                }
         else:
             shard_block = {"count": 1}
-        preempt_total = int(core.obs.get("preempted_total").value())
-        mis_evict = int(
-            core.obs.get("preemption_mis_evictions_total").value())
+        # counters merged across restarts: a rebuilt core starts at zero
+        # and must neither lose nor double-count pre-restart residue
+        preempt_total = stack.merged_counter("preempted_total")
+        mis_evict = stack.merged_counter("mis_evictions")
         e2e = core.obs.get("pod_e2e_latency_seconds")
         timings["policy_duels"] = _duel_counts(core)
         timings["wall_s"] = round(time.time() - t_run0, 2)
         timings["restart_first_cycle_ms"] = stack.restart_first_cycle_ms
+        process_block = None
+        if stack.takeover_reports:
+            tr = stack.takeover_reports[-1]
+            # booleans in the fingerprint (the recovery contract: stable
+            # across same-seed runs); the raw milliseconds ride timings
+            process_block = {
+                "restored_all": bool(
+                    tr.get("restored_allocations", 0)
+                    >= tr.get("bound_at_boot", 0)),
+                "lost_bound": tr.get("lost_bound"),
+                "mis_evictions": tr.get("mis_evictions"),
+                "cold_verdict": tr.get("cold_verdict"),
+                "measured": tr.get("first_cycle_ms") is not None,
+            }
+            timings["takeover"] = {
+                k: tr.get(k) for k in (
+                    "first_cycle_ms", "cold_budget_ms", "window_s",
+                    "bound_at_boot", "bound_at_exit",
+                    "restored_allocations", "aot_hits")}
         timings["bound_e2e_observations"] = (
             e2e.child_state()[0] if e2e is not None else 0)
 
         violated = sorted(n for n, c in violations.items() if c)
         all_bound = want <= bound
+        # the fresh-process restart is part of the run's pass verdict: a
+        # takeover that lost bound pods, mis-evicted, missed its cold
+        # budget, or never measured an admitted cycle fails the replay
+        process_ok = (process_block is None
+                      or (process_block["restored_all"]
+                          and process_block["lost_bound"] == 0
+                          and process_block["mis_evictions"] == 0
+                          and process_block["measured"]
+                          and process_block["cold_verdict"] == "ok"))
         report = {
             "trace": args.trace,
             "seed": args.seed,
@@ -741,6 +1065,8 @@ def run_replay(args, policy: str) -> dict:
                 "preempted_total": preempt_total,
                 "mis_evictions": mis_evict,
                 "restarts": stack.restarts,
+                "restart_mode": args.restart_mode,
+                "process_restart": process_block,
                 "topology": topo_block,
                 "shards": shard_block,
                 # the learned-policy hash makes A/B reports seed-
@@ -752,7 +1078,7 @@ def run_replay(args, policy: str) -> dict:
             },
             "slo": slo_report,
             "violations": violations,
-            "pass": bool(all_bound and not violated),
+            "pass": bool(all_bound and not violated and process_ok),
             "timings": timings,
         }
         return report
@@ -799,6 +1125,42 @@ def main() -> int:
                     default="none",
                     help="inject a robustness/faults.py fault on the "
                          "supervised assign path mid-trace")
+    ap.add_argument("--restart-mode", choices=("inprocess", "process"),
+                    default="inprocess",
+                    help="restart-storm restart shape: inprocess rebuilds "
+                         "core+shim inside this interpreter; process "
+                         "spawns a GENUINELY FRESH interpreter that takes "
+                         "over against the live server (true process-"
+                         "boundary cold start, scored vs the "
+                         "aot_cold_start budget; pair with --aot-store)")
+    ap.add_argument("--takeover-window", type=float, default=25.0,
+                    help="seconds the fresh-process takeover child serves "
+                         "before handing back (it exits early once the "
+                         "cold start is measured and half the window ran)")
+    ap.add_argument("--kill-shard", type=int, default=-1,
+                    help="kill this shard's scheduling loop mid-trace "
+                         "(needs --shards >= 2): the failover supervisor "
+                         "must quarantine it and re-home its domains")
+    ap.add_argument("--kill-mode", choices=("crash", "wedge"),
+                    default="crash",
+                    help="crash = faults.crash unwinds the loop thread; "
+                         "wedge = slow fault past every dispatch deadline")
+    ap.add_argument("--failover-stale", type=float, default=120.0,
+                    help="robustness.failoverStaleSeconds for the replay")
+    ap.add_argument("--failover-probe", type=float, default=0.5,
+                    help="robustness.failoverProbeSeconds for the replay")
+    ap.add_argument("--failover-rejoin", type=float, default=60.0,
+                    help="robustness.failoverRejoinSeconds for the replay")
+    ap.add_argument("--assert-failover", action="store_true",
+                    help="with --kill-shard: exit 1 unless the killed "
+                         "shard was quarantined, 100%% of its nodes "
+                         "re-homed, the ledger audit stayed clean and "
+                         "every pod bound")
+    # --takeover*: internal (the fresh-process child)
+    ap.add_argument("--takeover", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--takeover-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--takeover-conf", default="", help=argparse.SUPPRESS)
     ap.add_argument("--policy",
                     choices=("auto", "greedy", "optimal", "learned", "all"),
                     default="auto")
@@ -872,6 +1234,17 @@ def main() -> int:
                          "least one violation (chaos-detection assertion)")
     args = ap.parse_args()
 
+    if args.takeover:
+        return child_takeover(args)
+
+    if args.kill_shard >= 0 and not (0 <= args.kill_shard < args.shards
+                                     and args.shards >= 2):
+        # fail at parse time, not 42% into a storm that took minutes
+        print(f"[replay] FAIL: --kill-shard {args.kill_shard} needs "
+              f"--shards >= 2 with the index in range (got --shards "
+              f"{args.shards})", file=sys.stderr, flush=True)
+        return 2
+
     if args.ab:
         arms = ["greedy", "optimal"]
         if args.policy_checkpoint:
@@ -916,6 +1289,34 @@ def main() -> int:
               f"greedy arm {g_bound} (duels: "
               f"{reports['learned']['timings'].get('policy_duels')})",
               file=sys.stderr, flush=True)
+    if args.assert_failover:
+        if args.kill_shard < 0 or args.ab:
+            print("[replay] FAIL: --assert-failover needs --kill-shard "
+                  "(and no --ab)", file=sys.stderr, flush=True)
+            return 2
+        fp = report["fingerprint"]
+        fo = (fp.get("shards") or {}).get("failover") or {}
+        problems = []
+        if fo.get("quarantines", 0) < 1:
+            problems.append("shard was never quarantined")
+        if fo.get("quarantined_shard") != args.kill_shard:
+            problems.append(
+                f"quarantined shard {fo.get('quarantined_shard')} != "
+                f"killed shard {args.kill_shard}")
+        if fo.get("rehomed_nodes", 0) < 1:
+            problems.append("no nodes re-homed")
+        if (fp.get("shards") or {}).get("quota_violations"):
+            problems.append("ledger audit reported violations")
+        if not fp.get("all_bound"):
+            problems.append("not every pod bound")
+        if problems:
+            print(f"[replay] FAIL (failover): {'; '.join(problems)}",
+                  file=sys.stderr, flush=True)
+            return 1
+        print(f"[replay] FAILOVER OK: shard {args.kill_shard} "
+              f"({fo.get('reason')}) quarantined, "
+              f"{fo.get('rehomed_nodes')} nodes re-homed, ledger clean, "
+              "all pods bound", file=sys.stderr, flush=True)
     if args.expect_violation:
         if violated:
             print(f"[replay] EXPECTED violation detected: {violated}",
@@ -927,9 +1328,10 @@ def main() -> int:
     if args.assert_slo:
         ok = report["pass"]
         if not ok:
+            fp = report.get("fingerprint", {})
             print(f"[replay] FAIL: violated objectives: {violated or 'none'}"
-                  f" (all_bound="
-                  f"{report.get('fingerprint', {}).get('all_bound')})",
+                  f" (all_bound={fp.get('all_bound')}, "
+                  f"process_restart={fp.get('process_restart')})",
                   file=sys.stderr, flush=True)
             return 1
         print("[replay] PASS: all pods bound, zero SLO violations",

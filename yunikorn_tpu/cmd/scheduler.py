@@ -156,16 +156,21 @@ def main(argv=None) -> int:
         solver_opts.policy = args.policy
     if args.policy_checkpoint:
         solver_opts.policy_checkpoint = args.policy_checkpoint
+    from yunikorn_tpu.robustness.failover import FailoverOptions
+
     core = make_core_scheduler(
         cache, shards=n_shards,
         solver_options=solver_opts,
         trace_spans=holder.get().obs_trace_spans,
         supervisor_options=SupervisorOptions.from_conf(holder.get()),
         slo_options=SloOptions.from_conf(holder.get()),
-        epoch_seconds=args.shard_epoch_seconds)
+        epoch_seconds=args.shard_epoch_seconds,
+        failover_options=FailoverOptions.from_conf(holder.get()))
     if n_shards > 1:
-        logger.info("control-plane sharding: %d shards (epoch %ss)",
-                    n_shards, args.shard_epoch_seconds or "off")
+        logger.info("control-plane sharding: %d shards (epoch %ss, "
+                    "failover stale budget %ss)",
+                    n_shards, args.shard_epoch_seconds or "off",
+                    holder.get().robustness_failover_stale_s)
     if aot_rt is not None:
         # hit/miss/compile metrics land in this core's /metrics; compile
         # spans land on its cycle timeline

@@ -97,6 +97,15 @@ CM_ROBUST_MAX_RETRIES = PREFIX_ROBUSTNESS + "maxRetries"
 CM_ROBUST_BREAKER_THRESHOLD = PREFIX_ROBUSTNESS + "breakerThreshold"
 CM_ROBUST_PROBE_INTERVAL = PREFIX_ROBUSTNESS + "probeIntervalSeconds"
 CM_ROBUST_PROBE_DEADLINE = PREFIX_ROBUSTNESS + "probeDeadlineSeconds"
+# shard failover (robustness/failover.py; active only when solver.shards>=2):
+# a shard whose run loop has not completed a cycle within the stale budget
+# (or whose loop thread died, or whose every supervised circuit is open) is
+# QUARANTINED — its node domains re-home onto surviving shards — and
+# rebuilt + re-admitted at the next partition epoch after the rejoin delay.
+CM_ROBUST_FAILOVER_STALE = PREFIX_ROBUSTNESS + "failoverStaleSeconds"
+CM_ROBUST_FAILOVER_PROBE = PREFIX_ROBUSTNESS + "failoverProbeSeconds"
+CM_ROBUST_FAILOVER_REJOIN = PREFIX_ROBUSTNESS + "failoverRejoinSeconds"
+CM_ROBUST_FAILOVER_ENABLED = PREFIX_ROBUSTNESS + "failoverEnabled"  # true | false
 
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
@@ -229,6 +238,18 @@ class SchedulerConf:
     robustness_breaker_threshold: int = 3
     robustness_probe_interval_s: float = 30.0
     robustness_probe_deadline_s: float = 20.0
+    # --- shard failover (robustness/failover.py, sharded control plane
+    # only) --- stale: a shard with no completed cycle for this long is
+    # quarantined (generous: a first-touch big-bucket compile is tens of
+    # seconds on CPU); probe: detector cadence; rejoin: quarantine dwell
+    # before the shard is rebuilt and re-admitted at the next epoch.
+    robustness_failover_stale_s: float = 120.0
+    robustness_failover_probe_s: float = 2.0
+    robustness_failover_rejoin_s: float = 60.0
+    # false = the failover supervisor never starts (an external
+    # orchestrator owns shard health, or failover is being ruled out
+    # while debugging); the quarantine mechanics stay callable directly
+    robustness_failover_enabled: str = "true"
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -397,6 +418,17 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     if CM_ROBUST_PROBE_DEADLINE in data:
         conf.robustness_probe_deadline_s = _parse_duration(
             data[CM_ROBUST_PROBE_DEADLINE], conf.robustness_probe_deadline_s)
+    for key, attr in ((CM_ROBUST_FAILOVER_STALE, "robustness_failover_stale_s"),
+                      (CM_ROBUST_FAILOVER_PROBE, "robustness_failover_probe_s"),
+                      (CM_ROBUST_FAILOVER_REJOIN,
+                       "robustness_failover_rejoin_s")):
+        if key in data:
+            setattr(conf, attr,
+                    _parse_duration(data[key], getattr(conf, attr)))
+    if CM_ROBUST_FAILOVER_ENABLED in data:
+        conf.robustness_failover_enabled = _parse_choice(
+            CM_ROBUST_FAILOVER_ENABLED, data[CM_ROBUST_FAILOVER_ENABLED],
+            ("true", "false"))
     for key, attr, allowed in (
             (CM_SOLVER_USE_PALLAS, "solver_use_pallas", TRI_STATE),
             (CM_SOLVER_SHARD, "solver_shard", TRI_STATE),

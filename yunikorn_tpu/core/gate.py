@@ -139,6 +139,48 @@ def ledger_charges(leaf, user: str, groups, resource: Resource) -> list:
     return out
 
 
+APP_SLOT_KEY = "__apps__"
+
+
+def app_slot_charges(leaf, user: str, groups) -> list:
+    """App-COUNT tracker charges one application registration applies to
+    the shared cross-shard ledger (core/shard.GlobalQuotaLedger): one entry
+    per ancestor queue with maxApplications plus every applicable user/group
+    maxApplications limit, each charging one synthetic `__apps__` unit.
+
+    The per-shard subtree_app_count / fits_user_app_limit checks see only
+    the shard's OWN registrations — with N shards each admits up to the
+    full max locally and the fleet overshoots by up to Nx. Registering
+    through these charges (reserve+confirm keyed "app|<id>", released on
+    app removal) makes maxApplications exact fleet-wide. Guest
+    registrations from the stranded-ask repair path charge NOTHING — the
+    home shard already holds the app's slot. Mirrors fits_user_app_limit's
+    applicability rules (wildcard + named users; groups charge the GROUP
+    aggregate). No app-count limits configured => empty list => the
+    ledger's reserve is one dict probe."""
+    if leaf is None:
+        return []
+    amount = ((APP_SLOT_KEY, 1),)
+    out = []
+    for q in leaf.ancestors_and_self():
+        if q.config.max_applications:
+            out.append((f"appq|{q.full_name}",
+                        ((APP_SLOT_KEY, int(q.config.max_applications)),),
+                        amount))
+        for i, lim in enumerate(q.config.limits):
+            if lim.max_applications <= 0:
+                continue
+            lim_items = ((APP_SLOT_KEY, int(lim.max_applications)),)
+            if "*" in lim.users or user in lim.users:
+                out.append((f"appu|{q.full_name}|{i}|{user}", lim_items,
+                            amount))
+            for g in groups:
+                if g in lim.groups or "*" in lim.groups:
+                    out.append((f"appg|{q.full_name}|{i}|{g}", lim_items,
+                                amount))
+    return out
+
+
 def legacy_admit(by_queue: Dict[str, list], meta: Dict[str, tuple],
                  queue_tree, seed_admissions=None) -> Tuple[list, int]:
     """The reference-shaped per-ask admission loop: per-queue sorts, per-ask

@@ -103,6 +103,21 @@ COMPLETING_TIMEOUT = 30.0  # Running app with nothing left → Completed after t
 # only the home shard (and the front end's fleet view) can decide that.
 SHARD_GUEST_APP_TAG = "yunikorn.io/shard-guest"
 
+# Diagnostic marker stamped on re-homed app registrations (shard failover:
+# the app's home shard was quarantined and a surviving shard takes over).
+# No behavior keys off it — a re-homed registration works because it does
+# NOT carry the guest tag (so the new home owns completion, exactly like a
+# fresh registration) and because the app already holds its fleet-wide app
+# slot on the ledger (reserve/commit are idempotent per key, so the
+# re-registration charges nothing). The tag exists so an operator reading
+# an app's tags can tell a failover survivor from an original submission.
+SHARD_REHOME_APP_TAG = "yunikorn.io/shard-rehomed"
+
+# key namespace for app-COUNT slots on the shared GlobalQuotaLedger
+# (allocation-resource charges key on the allocation key; app slots key on
+# this prefix + application id, released on app removal)
+SHARD_APP_SLOT_PREFIX = "app|"
+
 # Whether solver.usePallas=auto turns the fused kernel on for TPU backends.
 # Flipped by the hardware A/B (docs/PERF.md): stays False until the kernel
 # measurably beats the XLA path on a real chip.
@@ -396,11 +411,15 @@ class CoreScheduler(SchedulerAPI):
         # failures, informer staleness (wired by the shim) and dispatcher
         # backlog into /ws/v1/health.
         self.supervisor = SupervisedExecutor(
-            supervisor_options, registry=m, tracer=self.tracer)
+            supervisor_options, tracer=self.tracer)
         if shard_label is not None:
             # per-shard breakers stay per-supervisor; the prefix keeps this
-            # shard's path/outcome SERIES separate in the shared registry
+            # shard's path/outcome SERIES separate in the shared registry.
+            # Set BEFORE attach_metrics: the watchdog gauge publishes its
+            # zero series at attach time, and a prefix applied later would
+            # leave a frozen unprefixed ghost pair in the shared registry.
             self.supervisor.path_label_prefix = f"s{shard_label}/"
+        self.supervisor.attach_metrics(m)
         if aot_namespace:
             # enter the shard's AOT fingerprint namespace on the watchdog
             # thread that actually runs each supervised dispatch (the
@@ -856,8 +875,16 @@ class CoreScheduler(SchedulerAPI):
                         add.application_id, f"unknown or removed partition {pname!r}"))
                     continue
                 self._use_partition(pname)
-                if add.application_id in self.partition.applications:
+                existing = self.partition.applications.get(add.application_id)
+                if existing is not None:
                     # idempotent: re-acknowledge so the shim FSM can progress
+                    if (existing.tags.get(SHARD_GUEST_APP_TAG)
+                            and not add.tags.get(SHARD_GUEST_APP_TAG)):
+                        # guest -> real promotion: shard failover re-homed
+                        # the app onto this shard, which now owns its
+                        # completion lifecycle (_check_app_completion)
+                        existing.tags.pop(SHARD_GUEST_APP_TAG, None)
+                        existing.tags.update(add.tags)
                     resp.accepted.append(AcceptedApplication(add.application_id))
                     continue
                 from yunikorn_tpu.core.placement import apply_namespace_quota, place_application
@@ -878,22 +905,46 @@ class CoreScheduler(SchedulerAPI):
                         add.application_id, f"failed to place application: queue {placed_name!r} not usable"))
                     continue
                 apply_namespace_quota(leaf, add)
-                if any(q.config.max_applications and q.subtree_app_count() >= q.config.max_applications
-                       for q in leaf.ancestors_and_self()):
-                    resp.rejected.append(RejectedApplication(
-                        add.application_id, f"queue {leaf.full_name} is at maxApplications"))
-                    continue
                 user_groups = list(add.user.groups)
+                if self.quota_ledger is None:
+                    # single-shard path: the local counts are the whole
+                    # fleet — byte-identical to the pre-failover checks
+                    if any(q.config.max_applications and q.subtree_app_count() >= q.config.max_applications
+                           for q in leaf.ancestors_and_self()):
+                        resp.rejected.append(RejectedApplication(
+                            add.application_id, f"queue {leaf.full_name} is at maxApplications"))
+                        continue
                 if not leaf.submit_allowed(add.user.user, user_groups):
                     resp.rejected.append(RejectedApplication(
                         add.application_id,
                         f"user {add.user.user} is not allowed to submit to {leaf.full_name}"))
                     continue
-                if self.queues.any_limits() and not leaf.fits_user_app_limit(add.user.user, user_groups):
-                    resp.rejected.append(RejectedApplication(
-                        add.application_id,
-                        f"user {add.user.user} exceeds maxApplications in {leaf.full_name}"))
-                    continue
+                if self.quota_ledger is None:
+                    if self.queues.any_limits() and not leaf.fits_user_app_limit(add.user.user, user_groups):
+                        resp.rejected.append(RejectedApplication(
+                            add.application_id,
+                            f"user {add.user.user} exceeds maxApplications in {leaf.full_name}"))
+                        continue
+                elif not add.tags.get(SHARD_GUEST_APP_TAG):
+                    # sharded path: the shared ledger is the app-COUNT
+                    # authority (each shard's local counts see only its own
+                    # registrations — N optimistic checks would overshoot
+                    # maxApplications by up to Nx fleet-wide). The slot is
+                    # reserved+confirmed atomically under "app|<id>" and
+                    # released on app removal; re-registration (failover
+                    # re-homing) hits the held-key fast path and charges
+                    # nothing. Guests charge nothing either: the home shard
+                    # already holds the app's slot.
+                    slot_charges = gate_mod.app_slot_charges(
+                        leaf, add.user.user, user_groups)
+                    slot_key = SHARD_APP_SLOT_PREFIX + add.application_id
+                    if not self.quota_ledger.reserve(slot_key, slot_charges):
+                        resp.rejected.append(RejectedApplication(
+                            add.application_id,
+                            f"queue {leaf.full_name} is at maxApplications "
+                            "(fleet-wide)"))
+                        continue
+                    self.quota_ledger.commit(slot_key, slot_charges)
                 app = CoreApplication(
                     application_id=add.application_id,
                     queue_name=leaf.full_name,
@@ -926,6 +977,10 @@ class CoreScheduler(SchedulerAPI):
         app = self.partition.applications.pop(app_id, None)
         if app is None:
             return
+        if (self.quota_ledger is not None
+                and not app.tags.get(SHARD_GUEST_APP_TAG)):
+            # free the fleet-wide app-COUNT slot (guests never held one)
+            self.quota_ledger.release(SHARD_APP_SLOT_PREFIX + app_id)
         for key in list(app.pending_asks) + list(app.allocations):
             self._span_discard(key)
             if self.quota_ledger is not None:

@@ -74,7 +74,11 @@ from yunikorn_tpu.common.si import (
     UpdateContainerSchedulingStateRequest,
 )
 from yunikorn_tpu.common.si import NodeInfo as SiNodeInfo
-from yunikorn_tpu.core.scheduler import SHARD_GUEST_APP_TAG, CoreScheduler
+from yunikorn_tpu.core.scheduler import (
+    SHARD_GUEST_APP_TAG,
+    SHARD_REHOME_APP_TAG,
+    CoreScheduler,
+)
 from yunikorn_tpu.log.logger import log
 from yunikorn_tpu.obs.metrics import MetricsRegistry
 
@@ -201,6 +205,14 @@ class GlobalQuotaLedger:
         outside the gate). Idempotent per key."""
         with self._mu:
             if key in self._use_by_key:
+                # already confirmed (an idempotent re-commit) — but a LATER
+                # reservation for the same key (failover re-admission racing
+                # a zombie commit) must not stay held until the TTL: drop it
+                rec = self._res_by_key.pop(key, None)
+                if rec is not None:
+                    for tid, amount in rec[1]:
+                        self._add(self._reserved.setdefault(tid, {}),
+                                  amount, -1)
                 return
             rec = self._res_by_key.pop(key, None)
             if rec is not None:
@@ -417,6 +429,9 @@ class ShardTopologyPartitioner:
         self.domain_nodes: Dict[tuple, Set[str]] = {}
         self.node_domain: Dict[str, tuple] = {}
         self._counts = [0] * n_shards
+        # failure domains: a quarantined shard is inactive — _pick (and so
+        # assign/reseed/evacuate) never target it until it rejoins
+        self.active = [True] * n_shards
 
     @staticmethod
     def domain_of(name: str, labels: Optional[Dict[str, str]]) -> tuple:
@@ -432,8 +447,31 @@ class ShardTopologyPartitioner:
 
     def _pick(self, dom: tuple, seed: int) -> int:
         base = zlib.crc32(f"{seed}:{dom}".encode()) % self.n
-        return min(range(self.n),
+        cands = [k for k in range(self.n) if self.active[k]]
+        if not cands:  # nothing active: degenerate, keep determinism
+            cands = list(range(self.n))
+        return min(cands,
                    key=lambda k: (self._counts[k], (k - base) % self.n))
+
+    def set_active(self, idx: int, active: bool) -> None:
+        self.active[idx] = bool(active)
+
+    def evacuate(self, idx: int) -> Dict[str, Tuple[int, int]]:
+        """Move every domain owned by shard `idx` onto the active shards
+        (the quarantine re-home). Whole domains move — the never-straddle
+        invariant survives failover. Deterministic: domains revisited in
+        sorted order under the current seed. Returns {node: (old, new)}.
+        The caller marks `idx` inactive first."""
+        moves: Dict[str, Tuple[int, int]] = {}
+        for dom in sorted(d for d, s in self.domain_shard.items()
+                          if s == idx):
+            self._counts[idx] -= 1
+            new = self._pick(dom, self.seed)
+            self.domain_shard[dom] = new
+            self._counts[new] += 1
+            for name in self.domain_nodes.get(dom, ()):
+                moves[name] = (idx, new)
+        return moves
 
     def assign(self, name: str, labels: Optional[Dict[str, str]]) -> int:
         dom = self.domain_of(name, labels)
@@ -499,8 +537,16 @@ class _ShardCallback:
         self._front = front
         self._idx = idx
         self._real = real
+        # fenced at quarantine: a wedged cycle that finally unwedges AFTER
+        # its shard was quarantined must not leak zombie commits/releases
+        # into the fleet view — the shard's asks were re-admitted and its
+        # nodes re-homed while it was stuck (predicates stay answerable:
+        # the zombie thread blocks on their return value)
+        self.dead = False
 
     def update_allocation(self, response) -> None:
+        if self.dead:
+            return
         if response.new or response.released:
             self._front._note_allocations(self._idx, response)
         if response.rejected:
@@ -512,14 +558,19 @@ class _ShardCallback:
         self._real.update_allocation(response)
 
     def update_application(self, response) -> None:
+        if self.dead:
+            return
         response = self._front._filter_app_updates(self._idx, response)
         if response is not None:
             self._real.update_application(response)
 
     def update_node(self, response) -> None:
-        self._real.update_node(response)
+        if not self.dead:
+            self._real.update_node(response)
 
     def update_container_scheduling_state(self, request) -> None:
+        if self.dead:
+            return
         if request.state and str(request.state).endswith("SKIPPED"):
             if self._front._on_skipped(self._idx, request):
                 return  # repair in flight: not yet unschedulable
@@ -532,7 +583,8 @@ class _ShardCallback:
         return self._real.preemption_predicates(args)
 
     def send_event(self, events) -> None:
-        self._real.send_event(events)
+        if not self.dead:
+            self._real.send_event(events)
 
     def __getattr__(self, name):
         return getattr(self._real, name)
@@ -574,13 +626,15 @@ class _MergedTracer:
 
 class _ShardSlo:
     """SLO facade: ticks/resets fan out to every shard's engine; the report
-    comes from the primary (all engines consume the same shared e2e stream);
+    comes from the first ACTIVE shard (all engines consume the same shared
+    e2e stream, but a quarantined shard's engine is detached and frozen);
     violations merge as the per-objective MAX across shards (one stalled
     shard must surface, N engines seeing the same e2e episode must not
     count it N times)."""
 
-    def __init__(self, shards: List[CoreScheduler]):
+    def __init__(self, shards: List[CoreScheduler], front=None):
         self._shards = shards
+        self._front = front
 
     def maybe_tick(self) -> None:
         for core in self._shards:
@@ -604,6 +658,11 @@ class _ShardSlo:
         return out
 
     def report(self) -> dict:
+        quarantined = (self._front._quarantined
+                       if self._front is not None else set())
+        for k, core in enumerate(self._shards):
+            if k not in quarantined:
+                return core.slo.report()
         return self._shards[0].slo.report()
 
 
@@ -669,7 +728,8 @@ class ShardedCoreScheduler(SchedulerAPI):
                  solver_policy: Optional[str] = None,
                  solver_options=None, trace_spans: int = 4096,
                  supervisor_options=None, slo_options=None,
-                 epoch_seconds: float = 0.0, aot_namespace: bool = False):
+                 epoch_seconds: float = 0.0, aot_namespace: bool = False,
+                 failover_options=None):
         # aot_namespace=True gives each shard its own executable namespace
         # in the AOT store (corruption/variant isolation for multi-process
         # deployments) at the cost of N compiles per program AND of the
@@ -709,6 +769,12 @@ class ShardedCoreScheduler(SchedulerAPI):
         # allocation key -> (committing shard, app id); the app id makes
         # app-removal purge possible (removal emits no per-key releases)
         self._alloc_shard: Dict[str, Tuple[int, str]] = {}
+        # allocation key -> live Allocation object (commits, restores,
+        # recovery registrations). The failover re-home replays these into
+        # the app's new home shard — the quarantined shard's own state is
+        # unreachable (its locks may be held forever by the wedged cycle),
+        # so the front keeps the authoritative copy.
+        self._allocs: Dict[str, object] = {}
         # apps whose Completed update was suppressed while repaired
         # allocations lived in other shards: re-emitted by
         # _note_allocations when the last such allocation releases
@@ -740,33 +806,62 @@ class ShardedCoreScheduler(SchedulerAPI):
         self._m_epochs = m.counter(
             "shard_epoch_total", "shard-partition re-seed epochs completed")
         # -- the shards -------------------------------------------------------
+        # build kwargs retained: shard failover REBUILDS a quarantined
+        # shard's core from scratch at rejoin (the in-process analog of a
+        # crashed scheduler process restarting)
+        self._solver_policy = solver_policy
+        self._solver_options = solver_options
+        self._supervisor_options = supervisor_options
+        self._slo_options = slo_options
+        self._trace_spans = trace_spans
+        self._aot_namespace = aot_namespace
+        self._last_config: Optional[Tuple[str, object]] = None
+        self._quarantined: Set[int] = set()
+        self._rehomed_nodes_total = 0
+        self._failover_last: Optional[dict] = None
         self.shards: List[CoreScheduler] = []
+        self._callbacks: List[Optional[_ShardCallback]] = [None] * n_shards
         for k in range(n_shards):
-            view = ShardCacheView(self.fanout, k)
-            so = (dataclasses.replace(solver_options)
-                  if solver_options is not None else None)
-            sup = (dataclasses.replace(supervisor_options)
-                   if supervisor_options is not None else None)
-            slo = (dataclasses.replace(slo_options)
-                   if slo_options is not None else None)
-            core = CoreScheduler(
-                view, interval=interval, solver_policy=solver_policy,
-                solver_options=so, trace_spans=trace_spans,
-                supervisor_options=sup, slo_options=slo, registry=self.obs,
-                shard_label=str(k), quota_ledger=self.ledger,
-                aot_namespace=(f"shard{k}" if aot_namespace else None))
-            core.shard_index = k
-            self.shards.append(core)
-        self.primary = self.shards[0]
+            self.shards.append(self._build_shard(k))
         self.tracer = _MergedTracer(self.shards)
-        self.slo = _ShardSlo(self.shards)
+        self.slo = _ShardSlo(self.shards, front=self)
         self.supervisor = _ShardSupervisor(self.shards)
+        from yunikorn_tpu.robustness.failover import (FailoverOptions,
+                                                      ShardSupervisor,
+                                                      failover_source)
         from yunikorn_tpu.robustness.health import HealthMonitor
 
+        self.failover = ShardSupervisor(
+            n_shards, failover_options or FailoverOptions(),
+            self.quarantine_shard, self.rejoin_shard, registry=self.obs)
+        self.failover.set_cores(lambda: list(self.shards))
         self.health = HealthMonitor()
         self.health.register("shards", self._shards_health)
+        self.health.register("failover", failover_source(self.failover))
+
+    def _build_shard(self, k: int) -> CoreScheduler:
+        view = ShardCacheView(self.fanout, k)
+        so = (dataclasses.replace(self._solver_options)
+              if self._solver_options is not None else None)
+        sup = (dataclasses.replace(self._supervisor_options)
+               if self._supervisor_options is not None else None)
+        slo = (dataclasses.replace(self._slo_options)
+               if self._slo_options is not None else None)
+        core = CoreScheduler(
+            view, interval=self._interval, solver_policy=self._solver_policy,
+            solver_options=so, trace_spans=self._trace_spans,
+            supervisor_options=sup, slo_options=slo, registry=self.obs,
+            shard_label=str(k), quota_ledger=self.ledger,
+            aot_namespace=(f"shard{k}" if self._aot_namespace else None))
+        core.shard_index = k
+        return core
 
     # ------------------------------------------------------- compat surface
+    @property
+    def primary(self):
+        # shard 0 can be REBUILT by failover rejoin: always read the list
+        return self.shards[0]
+
     @property
     def partition(self):
         return self.primary.partition
@@ -805,6 +900,8 @@ class ShardedCoreScheduler(SchedulerAPI):
         snap = self.obs.snapshot()
         last = {}
         for k, core in enumerate(self.shards):
+            if k in self._quarantined:
+                continue  # a wedged zombie may hold its core lock forever
             with core._lock:
                 for pname, entry in core._last_cycle.items():
                     last[f"shard{k}/{pname}"] = dict(entry)
@@ -820,6 +917,13 @@ class ShardedCoreScheduler(SchedulerAPI):
         healthy = True
         live = True
         for k, core in enumerate(self.shards):
+            if k in self._quarantined:
+                # a quarantined shard is a KNOWN failure domain being
+                # handled: the failover source reports it; it must not
+                # read as fleet liveness loss (the survivors serve)
+                per[f"s{k}"] = {"state": "quarantined"}
+                healthy = False
+                continue
             rep = core.health.report()
             per[f"s{k}"] = {"ready": rep["ready"], "live": rep["live"]}
             healthy = healthy and rep["ready"]
@@ -832,7 +936,9 @@ class ShardedCoreScheduler(SchedulerAPI):
 
     def recent_preemptions(self) -> List[dict]:
         out = []
-        for core in self.shards:
+        for k, core in enumerate(self.shards):
+            if k in self._quarantined:
+                continue
             out.extend(core.recent_preemptions())
         out.sort(key=lambda p: p.get("at", 0))
         return out
@@ -864,7 +970,11 @@ class ShardedCoreScheduler(SchedulerAPI):
 
         best = 0
         total = 0
-        for core in self.shards:
+        for k, core in enumerate(self.shards):
+            if k in self._quarantined:
+                # its nodes already re-homed; the zombie encoder's stale
+                # rows would double-count the migrated capacity
+                continue
             na = core.encoder.nodes
             n_dom = na.num_ici_domains
             if n_dom <= 0:
@@ -888,10 +998,12 @@ class ShardedCoreScheduler(SchedulerAPI):
             repair_live = len(self._repair)
             repair_placed = self._repair_placed
             suppressed = self._suppressed_completions
+        states = self.failover.states()
         shards = []
         for k, core in enumerate(self.shards):
             shards.append({
                 "shard": k,
+                "state": states.get(k, "serving"),
                 "nodes": len(self.fanout.names_for(k)),
                 "bound": bound[k],
                 # _cycle_seq is per-core (the registry's solve_count counter
@@ -899,6 +1011,11 @@ class ShardedCoreScheduler(SchedulerAPI):
                 "cycles": int(core._cycle_seq),
                 "degraded": core.supervisor.degraded_paths(),
             })
+        fo = self.failover.report()
+        with self._mu:
+            fo["rehomed_nodes_total"] = self._rehomed_nodes_total
+            if self._failover_last is not None:
+                fo["last_rehome"] = dict(self._failover_last)
         return {
             "count": self.n,
             "epoch": self.epoch,
@@ -913,6 +1030,7 @@ class ShardedCoreScheduler(SchedulerAPI):
             },
             "ledger": self.ledger.stats(),
             "suppressed_completions": suppressed,
+            "failover": fo,
         }
 
     # ---------------------------------------------------------- SchedulerAPI
@@ -921,12 +1039,18 @@ class ShardedCoreScheduler(SchedulerAPI):
         self.rm_id = request.rm_id
         self._rm_request = request
         for k, core in enumerate(self.shards):
-            core.register_resource_manager(
-                request, _ShardCallback(self, k, callback))
+            cb = _ShardCallback(self, k, callback)
+            self._callbacks[k] = cb
+            core.register_resource_manager(request, cb)
 
     def update_configuration(self, config: str, extra_config) -> None:
-        for core in self.shards:
-            core.update_configuration(config, extra_config)
+        with self._mu:
+            # retained so a failover-rebuilt shard replays the live config
+            self._last_config = (config, extra_config)
+            quarantined = set(self._quarantined)
+        for k, core in enumerate(self.shards):
+            if k not in quarantined:
+                core.update_configuration(config, extra_config)
 
     def update_node(self, request: NodeRequest) -> None:
         # routed per shard under ONE _mu pass, delivered as one batched
@@ -945,11 +1069,14 @@ class ShardedCoreScheduler(SchedulerAPI):
                         info, existing_allocations=[])
                     self._node_sched[info.node_id] = (
                         info.action == NodeAction.CREATE)
-                    if old is not None and old != shard:
+                    if (old is not None and old != shard
+                            and old not in self._quarantined):
                         # re-registration moved ownership (changed
                         # topology labels): decommission the old shard or
                         # it keeps the node registered forever (the same
-                        # DECOMISSION+CREATE contract reseed_epoch uses)
+                        # DECOMISSION+CREATE contract reseed_epoch uses).
+                        # A quarantined old owner is unreachable (and its
+                        # rebuilt replacement starts empty) — skip it.
                         routed.setdefault(old, []).append(SiNodeInfo(
                             node_id=info.node_id,
                             action=NodeAction.DECOMISSION))
@@ -965,7 +1092,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                     self._node_sched[info.node_id] = False
                 elif info.action == NodeAction.DRAIN_TO_SCHEDULABLE:
                     self._node_sched[info.node_id] = True
-                if shard is not None:
+                if shard is not None and shard not in self._quarantined:
                     routed.setdefault(shard, []).append(info)
         for shard, infos in routed.items():
             self.shards[shard].update_node(NodeRequest(nodes=infos))
@@ -980,11 +1107,27 @@ class ShardedCoreScheduler(SchedulerAPI):
             return getattr(cached.node.metadata, "labels", None)
         return None
 
+    def _first_active_from(self, base: int) -> int:
+        """First non-quarantined shard at or after `base` (wrapping) —
+        THE shard-walk rule, shared by home assignment, failover
+        re-homing and unknown-owner fallbacks so the policy cannot drift
+        between them; `base` itself when nothing is active (degenerate,
+        guarded elsewhere by never-quarantine-the-last-shard)."""
+        for off in range(self.n):
+            k = (base + off) % self.n
+            if k not in self._quarantined:
+                return k
+        return base
+
     def _home_shard(self, app_id: str) -> int:
         shard = self._app_home.get(app_id)
-        if shard is None:
-            shard = zlib.crc32(app_id.encode()) % self.n
-            self._app_home[app_id] = shard
+        if shard is not None and shard not in self._quarantined:
+            return shard
+        # crc32 walked forward to the first non-quarantined shard: the
+        # fault-free fleet keeps the exact pre-failover assignment (offset
+        # 0 always wins), a degraded fleet re-homes deterministically
+        shard = self._first_active_from(zlib.crc32(app_id.encode()) % self.n)
+        self._app_home[app_id] = shard
         return shard
 
     def update_application(self, request: ApplicationRequest) -> None:
@@ -1018,9 +1161,11 @@ class ShardedCoreScheduler(SchedulerAPI):
                     for k in [k for k, v in self._alloc_shard.items()
                               if v[1] == rem.application_id]:
                         self._alloc_shard.pop(k, None)
+                        self._allocs.pop(k, None)
                 for shard in shards:
-                    routed.setdefault(
-                        shard, ApplicationRequest()).remove.append(rem)
+                    if shard not in self._quarantined:
+                        routed.setdefault(
+                            shard, ApplicationRequest()).remove.append(rem)
         for shard, req in routed.items():
             self.shards[shard].update_application(req)
 
@@ -1050,9 +1195,21 @@ class ShardedCoreScheduler(SchedulerAPI):
                     self._suppressed_apps.discard(ask.application_id)
             for alloc in request.allocations:
                 if alloc.foreign:
-                    shard = self.fanout.owner_of(alloc.node_id) or 0
+                    shard = self.fanout.owner_of(alloc.node_id)
+                    if shard is None or shard in self._quarantined:
+                        # unknown/unreachable owner: first active shard (a
+                        # quarantined shard must never receive deliveries —
+                        # its wedged lock could block this caller forever)
+                        shard = self._first_active_from(0)
                 else:
                     shard = self._home_shard(alloc.application_id)
+                    with self._stats_mu:
+                        # recovery/restore registration: track the object
+                        # (and its holder) so a later failover can replay
+                        # it into a surviving shard
+                        self._allocs[alloc.allocation_key] = alloc
+                        self._alloc_shard[alloc.allocation_key] = (
+                            shard, alloc.application_id)
                 routed.setdefault(
                     shard, AllocationRequest()).allocations.append(alloc)
             for rel in request.releases:
@@ -1069,9 +1226,13 @@ class ShardedCoreScheduler(SchedulerAPI):
                         keys.discard(rel.allocation_key)
                     held = self._alloc_shard.get(rel.allocation_key)
                     held = held[0] if held is not None else None
-                targets = {s for s in (home, held) if s is not None}
+                # quarantined shards are unreachable — their keys were
+                # re-attributed at quarantine, so the surviving holder (or
+                # the broadcast) performs the release + ledger drop
+                targets = {s for s in (home, held)
+                           if s is not None and s not in self._quarantined}
                 if not targets:
-                    targets = set(range(self.n))
+                    targets = set(range(self.n)) - self._quarantined
                 for shard in targets:
                     routed.setdefault(
                         shard, AllocationRequest()).releases.append(rel)
@@ -1119,25 +1280,37 @@ class ShardedCoreScheduler(SchedulerAPI):
             self._epoch_thread = threading.Thread(
                 target=self._epoch_loop, name="shard-epoch", daemon=True)
             self._epoch_thread.start()
+        self.failover.start()
 
     def stop(self) -> None:
+        self.failover.stop()
         self._epoch_stop.set()
         if self._epoch_thread is not None:
             self._epoch_thread.join(timeout=5)
             self._epoch_thread = None
-        for core in self.shards:
+        for k, core in enumerate(self.shards):
+            if k in self._quarantined:
+                # a quarantined core may be WEDGED with its pipeline mutex
+                # held forever — a full stop() would join/drain into that
+                # lock and hang shutdown; the soft-stop flag was already
+                # cleared at quarantine, so just leave the zombie behind
+                # (daemon threads; the process owns cleanup)
+                core._running.clear()
+                continue
             core.stop()
 
     def trigger(self) -> None:
-        for core in self.shards:
-            core.trigger()
+        for k, core in enumerate(self.shards):
+            if k not in self._quarantined:
+                core.trigger()
 
     def schedule_once(self) -> int:
-        """Drive one cycle on every shard (test/bench surface; production
-        runs the shards' own staggered loops)."""
+        """Drive one cycle on every serving shard (test/bench surface;
+        production runs the shards' own staggered loops)."""
         total = 0
-        for core in self.shards:
-            total += core.schedule_once()
+        for k, core in enumerate(self.shards):
+            if k not in self._quarantined:
+                total += core.schedule_once()
         return total
 
     # ------------------------------------------------------ epoch re-seeding
@@ -1166,8 +1339,9 @@ class ShardedCoreScheduler(SchedulerAPI):
                 plan.append((name, old, new, reg,
                              self._node_sched.get(name, True)))
         for name, old, new, reg, schedulable in plan:
-            self.shards[old].update_node(NodeRequest(nodes=[SiNodeInfo(
-                node_id=name, action=NodeAction.DECOMISSION)]))
+            if old not in self._quarantined:
+                self.shards[old].update_node(NodeRequest(nodes=[SiNodeInfo(
+                    node_id=name, action=NodeAction.DECOMISSION)]))
             create = dataclasses.replace(
                 reg,
                 action=(NodeAction.CREATE if schedulable
@@ -1180,6 +1354,207 @@ class ShardedCoreScheduler(SchedulerAPI):
                         len(plan))
         self._m_epochs.inc()
         return len(plan)
+
+    # --------------------------------------------------- failure domains
+    def quarantine_shard(self, idx: int, reason: str = "manual") -> bool:
+        """Quarantine one dead/wedged shard: stop routing to it, re-home
+        its whole ICI domains onto surviving shards, reconcile the ledger
+        (its pending reservations released, confirmed usage re-attributed
+        to each app's new home — audit() stays zero-violation throughout),
+        re-register its apps on survivors and re-admit its parked asks.
+
+        Runs entirely under the front _mu (the sanctioned _mu -> shard
+        order), and NEVER calls into the quarantined core: a wedged cycle
+        may hold that core's lock and pipeline mutex forever. Bound pods
+        stay bound — node occupancy lives in the shared cache and the
+        ledger keeps their confirmed usage under the same keys."""
+        done_apps: List[str] = []
+        with self._mu:
+            if idx in self._quarantined or idx < 0 or idx >= self.n:
+                return False
+            if self.n - len(self._quarantined) <= 1:
+                return False  # never amputate the last serving shard
+            self._quarantined.add(idx)
+            self.partitioner.set_active(idx, False)
+            old_core = self.shards[idx]
+            cb = self._callbacks[idx]
+            if cb is not None:
+                cb.dead = True  # zombie emissions fenced from the fleet
+            # fence the zombie off the ledger too: a cycle that unwedges
+            # later must not force-charge keys the fleet re-admitted
+            old_core.quota_ledger = None
+            old_core._running.clear()  # soft-stop; never join a wedged loop
+            try:
+                with old_core._wake:
+                    old_core._wake.notify_all()
+            except Exception:
+                pass
+            try:
+                # the dead engine must stop consuming the shared e2e
+                # stream and ticking at scrape time
+                old_core.slo.detach_core(old_core)
+            except Exception:
+                logger.exception("slo detach failed for shard %d", idx)
+
+            # -- 1. park the shard's pending asks; release reservations --
+            with self._stats_mu:
+                committed = set(self._alloc_shard)
+            parked = [(key, ask) for key, ask in self._asks.items()
+                      if self._ask_home.get(key) == idx
+                      and key not in committed]
+            for key, _ask in parked:
+                self.ledger.release_reservation(key)
+
+            # -- 2. re-home apps whose home shard died --
+            app_moves: Dict[str, int] = {}
+            for app_id, home in list(self._app_home.items()):
+                if home != idx:
+                    continue
+                new = self._first_active_from(
+                    zlib.crc32(app_id.encode()) % self.n)
+                app_moves[app_id] = new
+                self._app_home[app_id] = new
+            for shards_of_app in self._app_shards.values():
+                shards_of_app.discard(idx)
+            reg: Dict[int, ApplicationRequest] = {}
+            for app_id in sorted(app_moves):
+                add = self._app_reqs.get(app_id)
+                if add is None:
+                    continue
+                new = app_moves[app_id]
+                member = self._app_shards.setdefault(app_id, set())
+                rehomed = dataclasses.replace(add, tags=dict(add.tags))
+                rehomed.tags.pop(GUEST_APP_TAG, None)
+                rehomed.tags[SHARD_REHOME_APP_TAG] = "true"
+                member.add(new)
+                reg.setdefault(new, ApplicationRequest()).new.append(rehomed)
+
+            # -- 3. re-attribute the shard's committed allocations --
+            restores: Dict[int, List] = {}
+            with self._stats_mu:
+                for key, (holder, app_id) in list(self._alloc_shard.items()):
+                    if holder != idx:
+                        continue
+                    target = self._app_home.get(app_id)
+                    if target is None or target in self._quarantined:
+                        continue  # unknown app: recovery residue, leave it
+                    alloc = self._allocs.get(key)
+                    if alloc is None:
+                        continue
+                    self._alloc_shard[key] = (target, app_id)
+                    restores.setdefault(target, []).append(alloc)
+                    # a repaired allocation landing at its app's home is
+                    # no longer "repaired elsewhere"
+                    keys = self._repair_allocs.get(app_id)
+                    if keys is not None:
+                        keys.discard(key)
+                        if not keys:
+                            self._repair_allocs.pop(app_id, None)
+                            if app_id in self._suppressed_apps:
+                                self._suppressed_apps.discard(app_id)
+                                done_apps.append(app_id)
+
+            # -- 4. re-home the shard's node domains (whole ICI domains,
+            #       the reseed DECOMISSION->CREATE contract minus the
+            #       DECOMISSION: the dead shard is unreachable) --
+            moves = self.partitioner.evacuate(idx)
+            node_creates: Dict[int, List[SiNodeInfo]] = {}
+            for name in sorted(moves):
+                _old, new = moves[name]
+                self.fanout.set_owner(name, new)
+                reg_info = self._node_reg.get(name)
+                if reg_info is None:
+                    continue
+                create = dataclasses.replace(
+                    reg_info,
+                    action=(NodeAction.CREATE
+                            if self._node_sched.get(name, True)
+                            else NodeAction.CREATE_DRAIN),
+                    existing_allocations=[])
+                node_creates.setdefault(new, []).append(create)
+
+            # -- 5. re-admit the parked asks at each app's (new) home --
+            ask_routes: Dict[int, AllocationRequest] = {}
+            for key, ask in parked:
+                target = self._app_home.get(ask.application_id)
+                if target is None or target in self._quarantined:
+                    continue
+                with self._stats_mu:
+                    # the fleet changed shape: restart the repair pass
+                    self._repair.pop(key, None)
+                self._ask_home[key] = target
+                if target not in self._app_shards.get(ask.application_id,
+                                                      set()):
+                    self._ensure_guest_app_locked(ask.application_id,
+                                                  target, reg)
+                ask_routes.setdefault(
+                    target, AllocationRequest()).asks.append(ask)
+                self._m_asks.inc(shard=str(target))
+
+            # -- 6. deliver (still under _mu: _mu -> shard order) --
+            for shard, req in reg.items():
+                self.shards[shard].update_application(req)
+            for shard, allocs in restores.items():
+                self.shards[shard].update_allocation(
+                    AllocationRequest(allocations=list(allocs)))
+            for shard, infos in node_creates.items():
+                self.shards[shard].update_node(NodeRequest(nodes=infos))
+            for shard, req in ask_routes.items():
+                self.shards[shard].update_allocation(req)
+
+            self._rehomed_nodes_total += len(moves)
+            self._failover_last = {
+                "shard": idx,
+                "reason": reason,
+                "nodes": len(moves),
+                "apps": len(app_moves),
+                "allocations": sum(len(v) for v in restores.values()),
+                "asks": sum(len(r.asks) for r in ask_routes.values()),
+                "at": round(time.time(), 3),
+            }
+        if done_apps and self.callback is not None:
+            from yunikorn_tpu.common.si import (ApplicationResponse,
+                                                UpdatedApplication)
+
+            self.callback.update_application(ApplicationResponse(updated=[
+                UpdatedApplication(application_id=a, state="Completed",
+                                   message="application completed")
+                for a in done_apps]))
+        logger.warning(
+            "shard %d QUARANTINED (%s): re-homed %d nodes, %d apps, "
+            "re-admitted %d asks", idx, reason,
+            self._failover_last["nodes"], self._failover_last["apps"],
+            self._failover_last["asks"])
+        return True
+
+    def rejoin_shard(self, idx: int) -> bool:
+        """Re-admit a quarantined shard: REBUILD its core from scratch (a
+        fresh CoreScheduler — the in-process analog of a crashed scheduler
+        process restarting; the zombie object and its threads are dropped)
+        and advance the partition epoch so node domains flow back. The
+        failover supervisor flips it to serving once the rebuilt loop
+        completes a cycle — the healthy probe."""
+        with self._mu:
+            if idx not in self._quarantined:
+                return False
+            core = self._build_shard(idx)
+            self.shards[idx] = core
+            if self._rm_request is not None and self.callback is not None:
+                cb = _ShardCallback(self, idx, self.callback)
+                self._callbacks[idx] = cb
+                core.register_resource_manager(self._rm_request, cb)
+            if self._last_config is not None:
+                core.update_configuration(*self._last_config)
+            self._quarantined.discard(idx)
+            self.partitioner.set_active(idx, True)
+        core.start()
+        # re-admission happens at the next epoch — advance it now so the
+        # rebuilt shard is not an idle passenger until the epoch timer
+        # (which defaults to off) fires
+        self.reseed_epoch()
+        logger.info("shard %d rebuilt and re-admitted (epoch %d)", idx,
+                    self.epoch)
+        return True
 
     # ----------------------------------------------------------- repair pass
     def _on_skipped(self, shard_idx: int,
@@ -1200,11 +1575,16 @@ class ShardedCoreScheduler(SchedulerAPI):
             ask = self._asks.get(key)
             if ask is None:
                 return False
+            # the full-fleet pass covers the ACTIVE shards: quarantined
+            # shards own no nodes (their domains re-homed), so neither
+            # their old "tried" marks nor their index count toward it
+            active = set(range(self.n)) - self._quarantined
             with self._stats_mu:
                 st = self._repair.setdefault(
                     key, {"tried": set(), "cool_until": 0.0})
+                st["tried"] &= active
                 st["tried"].add(shard_idx)
-                exhausted = len(st["tried"]) >= self.n
+                exhausted = active <= st["tried"]
                 cooling = now < st["cool_until"]
                 if exhausted:
                     # full-fleet pass complete: genuinely unschedulable
@@ -1221,7 +1601,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                 return False
             if cooling:
                 return False
-            untried = [k for k in range(self.n) if k not in tried]
+            untried = [k for k in sorted(active) if k not in tried]
             if not untried:
                 return False
             # prefer the untried shard with the most nodes (fleet
@@ -1280,6 +1660,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                 self._bound_per_shard[shard_idx] += 1
                 self._alloc_shard[alloc.allocation_key] = (
                     shard_idx, alloc.application_id)
+                self._allocs[alloc.allocation_key] = alloc
                 self._m_bound.inc(shard=str(shard_idx))
                 if self._repair.pop(alloc.allocation_key, None) is not None:
                     self._repair_placed += 1
@@ -1291,6 +1672,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                             alloc.allocation_key)
             for rel in response.released:
                 self._alloc_shard.pop(rel.allocation_key, None)
+                self._allocs.pop(rel.allocation_key, None)
                 keys = self._repair_allocs.get(rel.application_id)
                 if keys is not None:
                     keys.discard(rel.allocation_key)
@@ -1364,10 +1746,11 @@ def resolve_shards(value) -> int:
 def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
                         solver_policy=None, solver_options=None,
                         trace_spans: int = 4096, supervisor_options=None,
-                        slo_options=None, epoch_seconds: float = 0.0):
+                        slo_options=None, epoch_seconds: float = 0.0,
+                        failover_options=None):
     """Build the scheduler for a shard count: a plain CoreScheduler for 1
     (bit-identical to the pre-shard scheduler — no ledger, no views, no
-    namespaces), the sharded front end for N >= 2."""
+    namespaces, no failover machinery), the sharded front end for N >= 2."""
     n = shards if isinstance(shards, int) else resolve_shards(shards)
     if n <= 1:
         return CoreScheduler(cache, interval=interval,
@@ -1380,4 +1763,4 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
         cache, n, interval=interval, solver_policy=solver_policy,
         solver_options=solver_options, trace_spans=trace_spans,
         supervisor_options=supervisor_options, slo_options=slo_options,
-        epoch_seconds=epoch_seconds)
+        epoch_seconds=epoch_seconds, failover_options=failover_options)

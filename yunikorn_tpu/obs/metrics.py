@@ -201,6 +201,15 @@ class Histogram(_Metric):
         with self._lock:
             self._observers = getattr(self, "_observers", []) + [fn]
 
+    def remove_observer(self, fn) -> None:
+        """Detach a previously-added observer (equality match, so bound
+        methods work). A rebuilt shard's SLO engine detaches its
+        predecessor's tee — without this, every core rebuild would leave
+        one more dead engine consuming each observation batch."""
+        with self._lock:
+            self._observers = [o for o in getattr(self, "_observers", [])
+                               if o != fn]
+
     def observe(self, value: float, **labels) -> None:
         self.observe_batch((value,), **labels)
 
@@ -267,6 +276,12 @@ class MetricsRegistry:
         """Register a zero-arg callback run before each exposition."""
         with self._lock:
             self._collect_hooks.append(fn)
+
+    def remove_collect_hook(self, fn) -> None:
+        """Drop a collect hook (equality match — bound methods compare by
+        (instance, function), so an engine can remove its own maybe_tick)."""
+        with self._lock:
+            self._collect_hooks = [h for h in self._collect_hooks if h != fn]
 
     def _run_collect_hooks(self) -> None:
         with self._lock:

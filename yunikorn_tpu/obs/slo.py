@@ -341,6 +341,24 @@ class SloEngine:
         self._coldstart_fn = lambda: core._first_cycle_ms
         core.health.register("slo", self.health_source)
 
+    def detach_core(self, core) -> None:
+        """Undo attach_core: drop the histogram tee, the scrape hook and
+        the probes. Shard failover rebuilds a quarantined shard's core
+        against the SHARED registry — the dead core's engine must stop
+        consuming the fleet's e2e stream and ticking at scrape time, or
+        every rebuild leaks one more live engine."""
+        hist = core.obs.get("pod_e2e_latency_seconds")
+        if hist is not None and hasattr(hist, "remove_observer"):
+            hist.remove_observer(self.observe_e2e)
+        if hasattr(core.obs, "remove_collect_hook"):
+            core.obs.remove_collect_hook(self.maybe_tick)
+        core.health.unregister("slo")
+        with self._mu:
+            self._staleness_fn = None
+            self._degraded_fn = None
+            self._misevict_fn = None
+            self._coldstart_fn = None
+
     # ------------------------------------------------------------ feeders
     def observe_e2e(self, values: Sequence[float]) -> None:
         now = self._now()

@@ -11,11 +11,19 @@ answering).
     supervisor  — SupervisedExecutor: per-dispatch deadlines (watchdog
                   worker), error classification, bounded jittered retry,
                   per-path circuit breakers with half-open probe recovery
-    faults      — injectable fault plane the chaos suite drives
+    faults      — injectable fault plane the chaos suite drives (incl. the
+                  crash() loop-killer the failover suite uses)
     health      — component health state machine behind /ws/v1/health
     host_solve  — the exact host-path assignment tier (last resort)
+    failover    — shard failure domains: detect a dead/wedged control-plane
+                  shard, quarantine + re-home its domains, rebuild + rejoin
 """
-from yunikorn_tpu.robustness.faults import FaultPlane, InjectedFault
+from yunikorn_tpu.robustness.failover import FailoverOptions, ShardSupervisor
+from yunikorn_tpu.robustness.faults import (
+    FaultPlane,
+    InjectedCrash,
+    InjectedFault,
+)
 from yunikorn_tpu.robustness.health import HealthMonitor
 from yunikorn_tpu.robustness.supervisor import (
     AllTiersFailed,
@@ -28,9 +36,12 @@ from yunikorn_tpu.robustness.supervisor import (
 __all__ = [
     "AllTiersFailed",
     "DeadlineExceeded",
+    "FailoverOptions",
     "FaultPlane",
     "HealthMonitor",
+    "InjectedCrash",
     "InjectedFault",
+    "ShardSupervisor",
     "SupervisedExecutor",
     "SupervisorOptions",
     "classify_error",

@@ -27,6 +27,17 @@ class InjectedPersistentFault(InjectedFault):
     """Scripted fault classified persistent (compile/shape-error analog)."""
 
 
+class InjectedCrash(BaseException):
+    """Scripted crash of the scheduling loop itself.
+
+    Deliberately NOT an Exception subclass: the supervisor's tier ladder,
+    the cycle's failure accounting and the run loop all contain `except
+    Exception` — an InjectedCrash passes through every one of them and
+    unwinds the scheduler thread, which then dies exactly like a thread
+    hitting a segfault-adjacent interpreter bug would. The shard-failover
+    chaos suite uses it to kill ONE shard's loop in-process."""
+
+
 class _Rule:
     __slots__ = ("kind", "tier", "times", "after", "delay_s", "exc")
 
@@ -65,6 +76,15 @@ class FaultPlane:
     def fail_forever(self, path: str, tier: Optional[str] = None,
                      exc: Optional[Exception] = None) -> None:
         self.fail(path, times=float("inf"), tier=tier, exc=exc)
+
+    def crash(self, path: str, tier: Optional[str] = None,
+              after: int = 0) -> None:
+        """Kill the scheduling loop on the next matching attempt: raises
+        InjectedCrash (a BaseException), which no supervised handler
+        contains — the run-loop thread that dispatched the attempt dies.
+        The failover suite's injected shard death."""
+        self.fail(path, times=1, tier=tier, after=after,
+                  exc=InjectedCrash(f"injected crash on {path}"))
 
     def slow(self, path: str, seconds: float, times: int = 1,
              tier: Optional[str] = None, after: int = 0) -> None:

@@ -249,6 +249,7 @@ class SupervisedExecutor:
         self._transitions: collections.deque = collections.deque(maxlen=256)
         self._abandoned = 0       # cumulative deadline abandonments
         self._live_abandoned = 0  # abandoned watchdogs still running
+        self._live_watchdogs = 0  # watchdog threads currently running
         # called as on_abandon(path, tier) after a deadline abandonment,
         # OUTSIDE the mutex. The abandoned daemon thread is still running
         # the dispatch and may yet mutate whatever shared state the call
@@ -257,6 +258,7 @@ class SupervisedExecutor:
         # late writes land on unreferenced objects.
         self.on_abandon: Optional[Callable[[str, str], None]] = None
         self._m_dispatch = self._m_transitions = self._g_state = None
+        self._g_watchdogs = None
         if registry is not None:
             self.attach_metrics(registry)
 
@@ -275,6 +277,15 @@ class SupervisedExecutor:
             "current degradation tier per supervised path "
             "(0=device, 1=cpu re-jit, 2=host, 3=external fallback)",
             labelnames=("path",))
+        self._g_watchdogs = registry.gauge(
+            "watchdog_threads",
+            "supervised-dispatch watchdog threads currently alive: "
+            "running = watchdogs executing a live dispatch, abandoned = "
+            "deadline-abandoned zombies still wedged in their call (bounded "
+            "by robustness max_abandoned: past it half-open probes are "
+            "refused so a permanent wedge cannot grow zombies forever)",
+            labelnames=("state",))
+        self._publish_watchdogs()
 
     # -- breaker plumbing ---------------------------------------------------
     def _breaker(self, path: str, tier: str) -> CircuitBreaker:
@@ -363,11 +374,16 @@ class SupervisedExecutor:
                 # finished-right-at-the-deadline race
                 with self._mu:
                     done.set()
+                    self._live_watchdogs -= 1
                     if getattr(worker, "_yk_abandoned", False):
                         self._live_abandoned -= 1
+                    self._publish_watchdogs()
 
         worker = threading.Thread(target=job, name="supervised-dispatch",
                                   daemon=True)
+        with self._mu:
+            self._live_watchdogs += 1
+            self._publish_watchdogs()
         worker.start()
         if not done.wait(deadline_s):
             with self._mu:
@@ -379,6 +395,7 @@ class SupervisedExecutor:
                     worker._yk_abandoned = True
                     self._abandoned += 1
                     self._live_abandoned += 1
+                    self._publish_watchdogs()
             if abandoned:
                 raise DeadlineExceeded(
                     f"supervised dispatch exceeded its {deadline_s:g}s "
@@ -407,6 +424,24 @@ class SupervisedExecutor:
                 br.opened_at = time.time()
                 return False
             return ok
+
+    def _publish_watchdogs(self) -> None:
+        """(mutex held) Refresh the watchdog_threads gauge. A shard's
+        supervisor prefixes its state values like its path labels, so N
+        shards sharing one registry keep distinct series."""
+        if self._g_watchdogs is None:
+            return
+        p = self.path_label_prefix
+        running = max(self._live_watchdogs - self._live_abandoned, 0)
+        self._g_watchdogs.set(running, state=p + "running")
+        self._g_watchdogs.set(self._live_abandoned, state=p + "abandoned")
+
+    def watchdog_counts(self) -> Tuple[int, int]:
+        """(running, abandoned) live watchdog threads — the chaos suite's
+        no-thread-leak assertion reads this directly."""
+        with self._mu:
+            running = max(self._live_watchdogs - self._live_abandoned, 0)
+            return running, self._live_abandoned
 
     def _probe_budget(self) -> bool:
         """(mutex held) Whether another half-open probe may run: refused

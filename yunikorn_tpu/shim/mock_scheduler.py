@@ -51,6 +51,7 @@ class MockScheduler:
 
         self._solver_policy = solver_policy
         from yunikorn_tpu.obs.slo import SloOptions
+        from yunikorn_tpu.robustness.failover import FailoverOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
 
         self.core = make_core_scheduler(
@@ -58,7 +59,8 @@ class MockScheduler:
             interval=core_interval, solver_policy=solver_policy,
             solver_options=SolverOptions.from_conf(holder.get()),
             supervisor_options=SupervisorOptions.from_conf(holder.get()),
-            slo_options=SloOptions.from_conf(holder.get()))
+            slo_options=SloOptions.from_conf(holder.get()),
+            failover_options=FailoverOptions.from_conf(holder.get()))
         self.context = Context(self.cluster, self.core, cache=cache)
         self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
 
