@@ -1,8 +1,9 @@
 # Developer entry points (reference Makefile analog).
 
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
-	chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke \
-	slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke \
+	chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke \
+	aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke \
+	failover-smoke \
 	smoke lint run-scheduler run-admission dryrun clean image \
 	sched_image adm_image webtest_image
 
@@ -85,6 +86,13 @@ pack-smoke:  ## optimal packing (solver.policy=optimal): feasibility-parity prop
 		python scripts/pack_bench.py --shapes 1024x128,2048x256 \
 		--assert-quality
 
+cvx-smoke:  ## CvxCluster solver arm (solver.pack=cvx): safety suite (rounding feasibility == greedy feasibility on randomized traces, strict-win-only duel commits, garbage learned dual degrades to a loss, sharded-mesh parity, fused learned-pass bit-identity) + microbench asserting the full-fleet convex plan wins the N-way duel on the fragmented shape with warm solve latency within 3x the pack solve
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_cvx_solve.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/cvx_bench.py --shapes 1024x128,2048x256 \
+		--assert-quality
+
 aot-smoke:  ## AOT cold-start elimination: store/fingerprint unit suite, then build a store offline, restart a FRESH process and assert its first cycle hits the store (aot hits > 0, zero solver compiles), is placement-identical to a cold-compiled baseline, and lands within 3x the steady-state warm cycle at the 10k-pod bucket on CPU
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		python -m pytest tests/test_aot_store.py -q -p no:cacheprovider
@@ -156,7 +164,7 @@ failover-smoke:  ## shard failure domains + true fresh-process restart: the chao
 		--takeover-window 25 --aot-store /tmp/yk_failover_store \
 		--slo-cold-budget-ms 120000 --assert-slo
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke  ## all tier-1 smoke targets
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -121,6 +121,8 @@ def _backend_unavailable_json(error: str, init_secs: float) -> str:
         "solver_policy": "greedy",
         "pack_util": 0.0,
         "pack_plan_ms": 0.0,
+        "cvx_solve_ms": 0.0,
+        "cvx_iters": 0,
         "cold_first_cycle_ms": 0.0,
         "aot_hits": 0,
         "aot_compiles": 0,
@@ -128,7 +130,7 @@ def _backend_unavailable_json(error: str, init_secs: float) -> str:
         "topology": {"mode": "off", "gangs_total": 0,
                      "cross_domain_gangs": 0, "fragmentation": 0.0},
         "policy": {"active": "greedy", "checkpoint_hash": "",
-                   "checkpoint_epoch": 0, "duels": {},
+                   "checkpoint_epoch": 0, "duels": {}, "duel_wins": {},
                    "last_inference_ms": 0.0},
     })
 
@@ -364,13 +366,18 @@ def _cycle_stats(core) -> dict:
             "solver_policy": timing.get("solver_policy", "greedy"),
             "pack_util": float(timing.get("pack_util", 0.0)),
             "pack_plan_ms": float(timing.get("pack_plan_ms", 0.0)),
+            # cvx solver arm (round 19): full-fleet convex-relaxation solve
+            # latency + fixed trip count of the committed-or-duelled plan
+            "cvx_solve_ms": float(timing.get("cvx_solve_ms", 0.0)),
+            "cvx_iters": int(timing.get("cvx_iters", 0)),
         }
     except Exception:
         return {"gate_ms": 0.0, "pod_encode_ms": 0.0, "gate_path": "",
                 "encode_reencoded": 0, "gate_device_ms": 0.0,
                 "gate_passes": 0, "encode_device_rows": 0,
                 "encode_device_bytes": 0, "solver_policy": "greedy",
-                "pack_util": 0.0, "pack_plan_ms": 0.0}
+                "pack_util": 0.0, "pack_plan_ms": 0.0,
+                "cvx_solve_ms": 0.0, "cvx_iters": 0}
 
 
 def _slo_block(core) -> dict:
@@ -420,6 +427,19 @@ def _topology_block(core) -> dict:
         return {"mode": "error", "error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _duel_wins(core) -> dict:
+    """Committed-plan mix by winning arm (duel_wins_total{arm}): one count
+    per duel CYCLE, unlike policy_duels_total's per-participant rows."""
+    wins = {}
+    w = core.obs.get("duel_wins_total")
+    if w is not None:
+        for arm in ("greedy", "optimal", "cvx", "learned"):
+            n = int(w.value(arm=arm))
+            if n:
+                wins[arm] = n
+    return wins
+
+
 def _policy_block(core) -> dict:
     """Learned-dispatch-policy evidence for the bench JSON (round 17): the
     active solver.policy mode, the validated checkpoint (hash + epoch) if
@@ -434,7 +454,7 @@ def _policy_block(core) -> dict:
         duels = {}
         c = core.obs.get("policy_duels_total")
         if c is not None:
-            for pol in ("greedy", "optimal", "learned"):
+            for pol in ("greedy", "optimal", "cvx", "learned"):
                 won = int(c.sum_over(policy=pol, outcome="won"))
                 if won:
                     duels[pol] = won
@@ -445,6 +465,7 @@ def _policy_block(core) -> dict:
             "checkpoint_hash": ck.hash if ck is not None else "",
             "checkpoint_epoch": int(ck.epoch) if ck is not None else 0,
             "duels": duels,
+            "duel_wins": _duel_wins(core),
             "last_inference_ms": (round(float(g.value()), 2)
                                   if g is not None else 0.0),
         }

@@ -32,3 +32,44 @@ def _clear_jax_caches_per_module():
     import jax
 
     jax.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# Durations ledger guard: tier-1 sits at ~97-101% of its 870 s wall, so a
+# multi-second test that forgets @pytest.mark.slow silently eats the margin
+# until the whole run times out. When a measured-durations ledger exists
+# (tests/.durations.json: {nodeid: mean seconds}, generated offline from
+# `pytest --durations=0` output or a CI timing export), collection FAILS for
+# any collected non-slow test whose recorded average exceeds the budget.
+# The ledger is not checked in — without it the guard is inert, so tier-1
+# can never break on a stale file.
+# ---------------------------------------------------------------------------
+DURATIONS_LEDGER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "tests", ".durations.json")
+SLOW_BUDGET_S = 2.0
+
+
+def overlong_unmarked(entries, ledger, budget=SLOW_BUDGET_S):
+    """Pure core of the guard (unit-tested): entries is
+    [(nodeid, has_slow_mark)], ledger {nodeid: mean seconds}. Returns the
+    nodeids that exceed the budget without the slow mark, with their
+    recorded averages."""
+    return [(nid, ledger[nid]) for nid, has_slow in entries
+            if not has_slow and ledger.get(nid, 0.0) > budget]
+
+
+def pytest_collection_modifyitems(config, items):
+    if not os.path.exists(DURATIONS_LEDGER):
+        return
+    import json
+
+    with open(DURATIONS_LEDGER) as f:
+        ledger = json.load(f)
+    bad = overlong_unmarked(
+        [(it.nodeid, it.get_closest_marker("slow") is not None)
+         for it in items], ledger)
+    if bad:
+        lines = "\n".join(f"  {nid}: {avg:.1f}s" for nid, avg in bad)
+        raise pytest.UsageError(
+            f"tests averaging > {SLOW_BUDGET_S:.0f}s must carry "
+            f"@pytest.mark.slow (tier-1 runs -m 'not slow'):\n{lines}")

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""CvxCluster-arm microbench: ONE full-fleet convex relaxation vs the
+POP-partitioned pack LP vs the greedy argmin (and the learned arm when a
+checkpoint is supplied).
+
+Builds the contended shapes the global solve exists for — the pack bench's
+fragmented two-flavor fleet under a priority-skewed mixed ask wave, plus
+optional gang groups (--gang G tags every G consecutive asks as one
+all-or-nothing task group) — and A/Bs packed utilization and warm plan
+latency through the production decision rule (choose_plan_n, priority
+guards, capacity-normalized units).
+
+Per shape prints one JSON line:
+  {"pods": N, "nodes": M, "gang": G, "winner": ...,
+   "greedy_placed"/"pack_placed"/"cvx_placed"/"learned_placed": ...,
+   "greedy_units"/"pack_units"/"cvx_units"/"learned_units": ...,
+   "cvx_util": cvx/greedy normalized units, "cvx_iters": fixed trip count,
+   "greedy_warm_ms"/"pack_warm_ms"/"cvx_solve_ms": ...,
+   "latency_ratio": cvx_warm/pack_warm}
+
+--shapes 2048x1024,4096x4096   podsxnodes (default: the PERF round-19 set;
+                               N*M must clear the cvx cell budget)
+--gang 8                       pods per gang group (0 = no gangs)
+--checkpoint PREFIX            two-tower checkpoint: adds the learned arm
+                               AND warm-starts the cvx dual from it
+--assert-quality               exit 1 unless on the LAST shape the cvx arm
+                               wins the duel with strictly more packed
+                               units than every arm in --beat, within
+                               --max-latency-ratio of the pack solve
+--beat greedy,pack,learned     arms cvx must strictly out-pack (the ISSUE's
+                               gang acceptance is greedy,learned — the pack
+                               arm may tie the relaxation on saturating
+                               shapes)
+--max-latency-ratio 3.0        acceptance bound for cvx_warm/pack_warm
+                               (<= 0 disables: the dense solve's cost grows
+                               with N*M while the partitioned pack solve's
+                               does not — the bound is a smoke-shape check)
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from pack_bench import build as _pack_build  # noqa: E402
+
+
+def build(n_pods: int, n_nodes: int, gang: int = 0, seed: int = 0):
+    """The pack bench's fragmented fleet + priority-skewed wave, rebuilt
+    with gang tags when requested (the batch encoder folds a task group
+    into one all-or-nothing constraint group)."""
+    if gang <= 1:
+        return _pack_build(n_pods, n_nodes, seed=seed)
+    import numpy as np
+
+    from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+    from yunikorn_tpu.common.objects import make_node, make_pod
+    from yunikorn_tpu.common.resource import get_pod_resource
+    from yunikorn_tpu.common.si import AllocationAsk
+    from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+    import random as _random
+
+    rng = _random.Random(seed)
+    cache = SchedulerCache()
+    for i in range(n_nodes):
+        if i % 2 == 0:
+            cache.update_node(make_node(f"n{i:05d}", cpu_milli=8000,
+                                        memory=4 * 2**30))
+        else:
+            cache.update_node(make_node(f"n{i:05d}", cpu_milli=2000,
+                                        memory=16 * 2**30))
+    pods = []
+    for k in range(n_pods):
+        if rng.random() < 0.5:
+            pods.append(make_pod(f"p{k}", cpu_milli=1900, memory=2**28,
+                                 priority=rng.choice([0, 5])))
+        else:
+            pods.append(make_pod(f"p{k}", cpu_milli=300, memory=3 * 2**30,
+                                 priority=rng.choice([0, 5])))
+    asks = []
+    for k, p in enumerate(pods):
+        ask = AllocationAsk(p.uid, "cvx-app", get_pod_resource(p),
+                            priority=p.spec.priority or 0, pod=p)
+        ask.task_group_name = f"tg{k // gang}"
+        asks.append(ask)
+    priorities = np.asarray([p.spec.priority or 0 for p in pods])
+    order = np.lexsort((np.arange(len(pods)), -priorities))
+    ranks = np.empty(len(pods), np.int64)
+    ranks[order] = np.arange(len(pods))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    return enc, enc.build_batch(asks, ranks=ranks.tolist()), priorities
+
+
+def run_shape(n_pods: int, n_nodes: int, gang: int = 0,
+              checkpoint: str = "") -> dict:
+    import numpy as np
+
+    from yunikorn_tpu.ops import cvx_solve as cvx_mod
+    from yunikorn_tpu.ops import pack_solve as pack_mod
+    from yunikorn_tpu.ops.assign import solve_batch
+
+    enc, batch, priorities = build(n_pods, n_nodes, gang=gang)
+    n = batch.num_pods
+
+    learned_params = None
+    ck_hash = ""
+    if checkpoint:
+        from yunikorn_tpu.policy import net as pnet
+
+        ck = pnet.load_checkpoint(checkpoint)
+        learned_params, ck_hash = ck.params, ck.hash
+
+    def greedy():
+        return np.asarray(solve_batch(batch, enc.nodes).assigned)[:n]
+
+    def pack():
+        return np.asarray(pack_mod.pack_solve_batch(
+            batch, enc.nodes, seed=7).assigned)[:n]
+
+    def cvx():
+        r = cvx_mod.cvx_solve_batch(batch, enc.nodes, seed=7,
+                                    learned=learned_params,
+                                    aot_extra=(("policy", ck_hash)
+                                               if ck_hash else ()))
+        return np.asarray(r.assigned)[:n], r
+
+    ga = greedy()                        # cold (trace+compile)
+    t0 = time.time()
+    ga = greedy()
+    greedy_ms = (time.time() - t0) * 1000
+    pa = pack()                          # cold
+    t0 = time.time()
+    pa = pack()
+    pack_ms = (time.time() - t0) * 1000
+    ca, cres = cvx()                     # cold
+    t0 = time.time()
+    ca, cres = cvx()
+    cvx_ms = (time.time() - t0) * 1000
+    assert bool(np.asarray(cres.feasible)), "cvx emitted an infeasible plan"
+
+    cands = [("greedy", ga), ("optimal", pa), ("cvx", ca)]
+    if learned_params is not None:
+        la = np.asarray(solve_batch(
+            batch, enc.nodes,
+            learned=(learned_params, 7)).assigned)[:n]     # cold
+        la = np.asarray(solve_batch(
+            batch, enc.nodes, learned=(learned_params, 7)).assigned)[:n]
+        cands.append(("learned", la))
+
+    winner, st = pack_mod.choose_plan_n(
+        cands, batch.req.astype(np.int32), batch.valid,
+        cap_i=np.floor(enc.nodes.capacity_arr).astype(np.int64),
+        priorities=np.asarray(priorities))
+    out = {
+        "pods": n_pods,
+        "nodes": n_nodes,
+        "gang": gang,
+        "winner": winner,
+        "cvx_wins": winner == "cvx",
+        # same quantity the core's cvx_last_util gauge reports
+        "cvx_util": round(st["cvx"]["units_norm"]
+                          / max(st["greedy"]["units_norm"], 1e-9), 4),
+        "cvx_iters": cres.iters,
+        "learned_dual": bool(cres.learned_dual),
+        "greedy_warm_ms": round(greedy_ms, 1),
+        "pack_warm_ms": round(pack_ms, 1),
+        "cvx_solve_ms": round(cvx_ms, 1),
+        "latency_ratio": round(cvx_ms / max(pack_ms, 1e-6), 2),
+    }
+    for name, _ in cands:
+        out[f"{name.replace('optimal', 'pack')}_placed"] = st[name]["placed"]
+        out[f"{name.replace('optimal', 'pack')}_units"] = st[name]["units"]
+    return out
+
+
+def quality_failures(last: dict, beat, max_latency_ratio: float) -> list:
+    """Acceptance verdicts on one shape's JSON record (pure; unit-tested
+    against recorded bench lines). Returns failure strings, empty = pass."""
+    fails = []
+    losers = [k for k in beat
+              if f"{k}_units" in last
+              and last[f"{k}_units"] >= last["cvx_units"]]
+    if not last["cvx_wins"] or losers:
+        fails.append(
+            f"cvx did not strictly win the "
+            f"{last['pods']}x{last['nodes']} duel (winner "
+            f"{last['winner']}, not beaten: {losers or 'duel'})")
+    if 0 < max_latency_ratio < last["latency_ratio"]:
+        fails.append(
+            f"warm cvx solve {last['cvx_solve_ms']}ms is "
+            f"{last['latency_ratio']}x the pack solve "
+            f"(bound {max_latency_ratio}x)")
+    return fails
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", default="2048x1024,4096x4096")
+    ap.add_argument("--gang", type=int, default=0,
+                    help="pods per gang group (0 = no gangs)")
+    ap.add_argument("--checkpoint", default="",
+                    help="two-tower checkpoint prefix: adds the learned "
+                         "arm and warm-starts the cvx dual")
+    ap.add_argument("--assert-quality", action="store_true",
+                    help="exit 1 unless the last shape's cvx plan wins the "
+                         "duel strictly within the latency bound")
+    ap.add_argument("--beat", default="greedy,pack,learned",
+                    help="arms the cvx plan must strictly out-pack")
+    ap.add_argument("--max-latency-ratio", type=float, default=3.0,
+                    help="cvx_warm/pack_warm acceptance bound; <= 0 disables")
+    args = ap.parse_args()
+
+    last = None
+    for shape in args.shapes.split(","):
+        n_pods, n_nodes = (int(x) for x in shape.strip().split("x"))
+        last = run_shape(n_pods, n_nodes, gang=args.gang,
+                         checkpoint=args.checkpoint)
+        print(json.dumps(last), flush=True)
+
+    if args.assert_quality and last is not None:
+        beat = [b for b in args.beat.split(",") if b]
+        fails = quality_failures(last, beat, args.max_latency_ratio)
+        if fails:
+            for f in fails:
+                print(f"FAIL: {f}", file=sys.stderr)
+            return 1
+        print(f"OK: cvx wins the duel (util {last['cvx_util']}, latency "
+              f"{last['latency_ratio']}x, bound {args.max_latency_ratio}x)",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,436 @@
+"""CvxCluster solver arm (round 19, solver.pack=cvx).
+
+Pins the arm's safety contracts, mirroring the pack suite's structure:
+  - every placement the full-fleet convex relaxation emits passes the exact
+    greedy-side feasibility (host predicates + per-node capacity) — the
+    rounding/repair path IS the greedy accept machinery;
+  - the duel commits cvx only on a strictly better priority-guarded key
+    (ties keep greedy), and a GARBAGE learned-dual warm start can only cost
+    packed units — degrade to a duel loss, never a mis-commit;
+  - sharded-mesh dispatch is placement-identical to the single-device solve;
+  - the fused learned chunk pass (_learned_chunk_pass, follow-up (e)) is
+    bit-identical to the two separate passes it replaced;
+  - solver.policy=learned on a sharded mesh actually scores (follow-up (c):
+    the mesh wrapper threads the params — no more silent skip);
+  - the conftest durations-ledger guard flags overlong unmarked tests.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import conftest as _root_conftest
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import AllocationAsk
+from yunikorn_tpu.conf import schedulerconf as sc
+from yunikorn_tpu.core.scheduler import CoreScheduler, SolverOptions
+from yunikorn_tpu.ops import cvx_solve as cvx_mod
+from yunikorn_tpu.ops import pack_solve as pack_mod
+from yunikorn_tpu.ops.assign import solve_batch
+from yunikorn_tpu.ops.host_predicates import pod_fits_node
+from yunikorn_tpu.policy import net as pnet
+from yunikorn_tpu.snapshot.encoder import SnapshotEncoder
+
+from tests.test_pack_solve import _CB, build_trace
+
+
+# ---------------------------------------------------------------- unit: gates
+def test_cvx_shape_gate_is_deterministic_in_shape():
+    budget = cvx_mod._CVX_CELL_BUDGET
+    assert cvx_mod.cvx_shape_supported(4096, 8192)
+    assert cvx_mod.cvx_shape_supported(budget // 128, 128)
+    assert not cvx_mod.cvx_shape_supported(budget // 128 + 1, 128)
+    assert not cvx_mod.cvx_shape_supported(0, 128)
+    assert not cvx_mod.cvx_shape_supported(128, 0)
+
+
+def test_project_rows_capped_simplex_properties():
+    """The bisection projection lands inside {p >= 0, sum <= 1, p[~ok]=0}
+    and leaves already-feasible rows (sum <= 1) untouched."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 24).astype(np.float32) * 2.0)
+    ok = jnp.asarray(rng.rand(16, 24) < 0.7)
+    p = np.asarray(cvx_mod._project_rows(x, ok.astype(jnp.float32)))
+    assert (p >= 0.0).all()
+    # τ is bisected to 2^-12 of the mass scale; the row sum can overshoot
+    # 1 by O(M · 2^-PROJ_BISECT) — the capacity projection downstream is
+    # what enforces the hard resource box, not the simplex cap
+    assert (p.sum(axis=1) <= 1.0 + 24 * 2.0 ** -cvx_mod._PROJ_BISECT).all()
+    assert (p[~np.asarray(ok)] == 0.0).all()
+    feas = jnp.asarray(np.clip(rng.rand(8, 24).astype(np.float32) * 0.04,
+                               0, None))
+    kept = np.asarray(cvx_mod._project_rows(feas, jnp.ones((8, 24))))
+    np.testing.assert_allclose(kept, np.asarray(feas), atol=1e-6)
+
+
+def test_cvx_unsupported_batches_raise():
+    """Host-port batches are outside the full-fleet model: explicit
+    CvxUnsupported before any device work, never a silently wrong plan."""
+    cache = SchedulerCache()
+    for i in range(4):
+        cache.update_node(make_node(f"n{i}", cpu_milli=4000,
+                                    memory=8 * 2**30))
+    enc = SnapshotEncoder(cache)
+    enc.sync_nodes(full=True)
+    port_pod = make_pod("pp", cpu_milli=100, memory=2**20)
+    port_pod.spec.containers[0].ports = [{"hostPort": 9000,
+                                          "protocol": "TCP"}]
+    batch = enc.build_batch([AllocationAsk(
+        port_pod.uid, "app", get_pod_resource(port_pod), pod=port_pod)])
+    with pytest.raises(cvx_mod.CvxUnsupported):
+        cvx_mod.cvx_solve_batch(batch, enc.nodes)
+
+
+# ------------------------------------------------------------------ unit: conf
+def test_conf_solver_pack_parsing_and_decision_table():
+    conf = sc.parse_config_map({"solver.pack": "cvx"})
+    assert conf.solver_pack == "cvx"
+    assert SolverOptions.from_conf(conf).pack == "cvx"
+    assert SolverOptions.from_conf(
+        sc.parse_config_map({"solver.pack": "pop"})).pack == "pop"
+    assert SolverOptions.from_conf(sc.parse_config_map({})).pack == "auto"
+    with pytest.raises(ValueError):
+        sc.parse_config_map({"solver.pack": "simplex"})
+
+    def core_for(policy, pack="auto"):
+        c = SchedulerCache()
+        return CoreScheduler(c, solver_options=SolverOptions(
+            policy=policy, pack=pack))
+
+    core = core_for("optimal", "cvx")
+    assert core._cvx_on() and not core._pack_on()
+    core = core_for("optimal", "auto")
+    assert core._pack_on() and not core._cvx_on()
+    core = core_for("all")
+    assert core._pack_on() and core._cvx_on()
+    core = core_for("greedy")
+    assert not core._pack_on() and not core._cvx_on()
+
+
+# ------------------------------------------------------- unit: duel strictness
+def test_duel_commits_cvx_only_on_strict_win():
+    """The N-way fold with a cvx challenger: ties keep the greedy incumbent,
+    a strictly better key commits, the priority guard still vetoes a plan
+    that starves a higher class for units."""
+    req = np.full((4, 2), 10, np.int32)
+    valid = np.ones(4, bool)
+    g = np.array([0, 0, 1, -1], np.int32)
+    tie = np.array([1, 1, 0, -1], np.int32)
+    more = np.array([0, 0, 1, 1], np.int32)
+    winner, _ = pack_mod.choose_plan_n([("greedy", g), ("cvx", tie)],
+                                       req, valid)
+    assert winner == "greedy"
+    winner, _ = pack_mod.choose_plan_n([("greedy", g), ("cvx", more)],
+                                       req, valid)
+    assert winner == "cvx"
+    prio = np.array([100, 0, 0, 0], np.int64)
+    req_p = np.array([[1, 1], [50, 50], [50, 50], [50, 50]], np.int32)
+    g_p = np.array([0, 0, -1, -1], np.int32)       # places the prio-100 ask
+    cvx_p = np.array([-1, 0, 1, 2], np.int32)      # more units, starves it
+    winner, _ = pack_mod.choose_plan_n([("greedy", g_p), ("cvx", cvx_p)],
+                                       req_p, valid, priorities=prio)
+    assert winner == "greedy"
+
+
+# ------------------------------------------- unit: fused learned pass (sat. e)
+@pytest.mark.parametrize("policy", ["binpacking", "align"])
+@pytest.mark.parametrize("with_topo", [False, True])
+def test_fused_learned_pass_bit_identical_to_separate_passes(policy,
+                                                             with_topo):
+    """Follow-up (e) regression pin: the fused _learned_chunk_pass must be
+    bit-identical to the two passes it replaced — its argmax tail to
+    _best_nodes_chunked with the learned score augmentation, and its gated
+    proposal to the argmax-free variant (the two lax.cond branches must
+    agree exactly or round parity would change placements)."""
+    import jax
+    import jax.numpy as jnp
+
+    from yunikorn_tpu.ops.assign import _best_nodes_chunked, \
+        _learned_chunk_pass
+
+    rng = np.random.RandomState(7)
+    N, M, R, E, G, chunk = 64, 32, 2, 8, 16, 32
+    req = jnp.asarray(rng.randint(0, 6, (N, R)).astype(np.int32))
+    gid = jnp.asarray((np.arange(N) % G).astype(np.int32))
+    gfeas = jnp.asarray(rng.rand(G, M) < 0.8)
+    gsoft = jnp.asarray(rng.randn(G, M).astype(np.float32) * 0.1)
+    free = jnp.asarray(rng.randint(0, 12, (M, R)).astype(np.int32))
+    cap = jnp.asarray(np.full((M, R), 12, np.int32))
+    base = jnp.asarray(rng.rand(M).astype(np.float32))
+    pod_emb = jnp.asarray(rng.randn(N, E).astype(np.float32))
+    node_emb = jnp.asarray(rng.randn(M, E).astype(np.float32))
+    active = jnp.asarray(rng.rand(N) < 0.9)
+    key = jax.random.PRNGKey(3)
+    node_dom = (jnp.asarray((np.arange(M) % 4).astype(np.int32))
+                if with_topo else None)
+    pref_pod = (jnp.asarray(rng.randint(-1, 4, N).astype(np.int32))
+                if with_topo else None)
+
+    prop_t, best_t, feas_t = _learned_chunk_pass(
+        pod_emb, node_emb, gid, gfeas, gsoft, free, cap, base, req, active,
+        jnp.float32(0.3), key, chunk, policy, 0, node_dom=node_dom,
+        pref_pod=pref_pod, argmax=True)
+    prop_f, _, _ = _learned_chunk_pass(
+        pod_emb, node_emb, gid, gfeas, gsoft, free, cap, base, req, active,
+        jnp.float32(0.3), key, chunk, policy, 0, node_dom=node_dom,
+        pref_pod=pref_pod, argmax=False)
+    assert np.array_equal(np.asarray(prop_t), np.asarray(prop_f))
+
+    ref_best, ref_feas = _best_nodes_chunked(
+        req, gid, gfeas, gsoft, free, cap, base, chunk, policy, 0,
+        node_dom=node_dom, pref_pod=pref_pod,
+        learned_emb=(pod_emb, node_emb))
+    assert np.array_equal(np.asarray(best_t), np.asarray(ref_best))
+    assert np.array_equal(np.asarray(feas_t), np.asarray(ref_feas))
+
+    # untrained-is-inert: a zero pod tower can never fire the gate
+    prop_z, _, _ = _learned_chunk_pass(
+        jnp.zeros((N, E)), node_emb, gid, gfeas, gsoft, free, cap, base,
+        req, active, jnp.float32(0.3), key, chunk, policy, 0, argmax=False)
+    assert (np.asarray(prop_z) == M).all()
+
+
+# ------------------------------------------------ unit: bench acceptance rule
+def test_cvx_bench_quality_rule_matches_issue_acceptance():
+    """The gang acceptance (--beat greedy,learned) tolerates a pack-arm
+    units tie and an unbounded latency ratio; the smoke default does not."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "cvx_bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "cvx_bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # shape of a real recorded gang line: cvx wins, ties pack on units,
+    # dense solve well past the smoke latency bound
+    gang = {"pods": 4096, "nodes": 4096, "winner": "cvx", "cvx_wins": True,
+            "cvx_solve_ms": 27772.0, "latency_ratio": 9.23,
+            "greedy_units": 5872709, "pack_units": 11383808,
+            "cvx_units": 11383808, "learned_units": 5872709}
+    assert bench.quality_failures(gang, ["greedy", "learned"], 0) == []
+    strict = bench.quality_failures(gang, ["greedy", "pack", "learned"], 3.0)
+    assert len(strict) == 2 and "pack" in strict[0] and "9.23x" in strict[1]
+    # smoke record: strict win over every arm inside the bound
+    smoke = dict(gang, latency_ratio=0.91, cvx_units=11383809)
+    assert bench.quality_failures(
+        smoke, ["greedy", "pack", "learned"], 3.0) == []
+    # a duel loss fails regardless of the beat list
+    lost = dict(smoke, cvx_wins=False, winner="optimal")
+    assert bench.quality_failures(lost, ["greedy"], 0) != []
+
+
+# ----------------------------------------------- unit: durations ledger guard
+def test_durations_ledger_guard_flags_overlong_unmarked():
+    ledger = {"tests/a.py::t_fast": 0.3,
+              "tests/a.py::t_slow_marked": 9.0,
+              "tests/a.py::t_slow_unmarked": 4.2}
+    entries = [("tests/a.py::t_fast", False),
+               ("tests/a.py::t_slow_marked", True),
+               ("tests/a.py::t_slow_unmarked", False),
+               ("tests/a.py::t_unknown", False)]   # no ledger entry: pass
+    bad = _root_conftest.overlong_unmarked(entries, ledger)
+    assert bad == [("tests/a.py::t_slow_unmarked", 4.2)]
+    assert _root_conftest.overlong_unmarked(entries, {}) == []
+
+
+def test_durations_ledger_fails_collection(tmp_path, monkeypatch):
+    """With a ledger present, collection must abort on an unmarked
+    offender — exercised through pytest_collection_modifyitems with stub
+    items (running a child pytest would cost seconds)."""
+    ledger_file = tmp_path / ".durations.json"
+    ledger_file.write_text(json.dumps({"tests/x.py::t": 5.0}))
+    monkeypatch.setattr(_root_conftest, "DURATIONS_LEDGER",
+                        str(ledger_file))
+
+    class _Item:
+        nodeid = "tests/x.py::t"
+
+        def get_closest_marker(self, name):
+            return None
+
+    with pytest.raises(pytest.UsageError):
+        _root_conftest.pytest_collection_modifyitems(None, [_Item()])
+
+
+# ----------------------------------------------------- feasibility (device)
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(4))
+def test_cvx_placements_pass_greedy_side_feasibility(seed):
+    """Every placement the cvx plan emits must satisfy the exact host
+    predicates and per-node capacity on randomized fragmented traces —
+    the rounding/repair path is greedy feasibility by construction."""
+    cache, enc, nodes, pods, asks, batch = build_trace(seed)
+    result = cvx_mod.cvx_solve_batch(batch, enc.nodes, seed=seed)
+    assert bool(np.asarray(result.feasible))
+    assigned = np.asarray(result.assigned)[: batch.num_pods]
+    assert int(np.asarray(result.free_after).min()) >= 0
+
+    by_name = {n.name: n for n in nodes}
+    placed_on = {}
+    for i, pod in enumerate(pods):
+        idx = int(assigned[i])
+        if idx >= 0:
+            placed_on.setdefault(enc.nodes.name_of(idx), []).append(pod)
+    for name, placed in placed_on.items():
+        node = by_name[name]
+        free = cache.get_node(name).available()
+        for k, pod in enumerate(placed):
+            others = placed[:k] + placed[k + 1:]
+            err = pod_fits_node(pod, node, free, others)
+            assert err in (None, "insufficient resources"), (
+                seed, name, pod.name, err)
+        for res in ("cpu", "memory"):
+            total = sum(get_pod_resource(p).get(res) for p in placed)
+            assert total <= free.get(res), (seed, name, res, total)
+
+
+@pytest.mark.slow
+def test_cvx_seeded_determinism():
+    _, enc, _, _, _, batch = build_trace(2)
+    a = np.asarray(cvx_mod.cvx_solve_batch(batch, enc.nodes,
+                                           seed=123).assigned)
+    b = np.asarray(cvx_mod.cvx_solve_batch(batch, enc.nodes,
+                                           seed=123).assigned)
+    assert np.array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_cvx_garbage_dual_degrades_to_loss_never_miscommit():
+    """A garbage learned-dual warm start may cost packed units — the duel
+    then keeps the incumbent — but the emitted plan must STILL be feasible
+    and a commit still requires a strictly better key."""
+    import jax
+
+    _, enc, _, _, _, batch = build_trace(1)
+    n = batch.num_pods
+    ga = np.asarray(solve_batch(batch, enc.nodes).assigned)[:n]
+    garbage = jax.tree_util.tree_map(
+        lambda a: a + 7.0 * jax.random.normal(
+            jax.random.PRNGKey(13), np.shape(a)).astype(np.float32),
+        pnet.init_params(0))
+    res = cvx_mod.cvx_solve_batch(batch, enc.nodes, seed=5, learned=garbage)
+    assert res.learned_dual
+    assert bool(np.asarray(res.feasible))          # never infeasible
+    assert int(np.asarray(res.free_after).min()) >= 0
+    ca = np.asarray(res.assigned)[:n]
+    winner, stats = pack_mod.choose_plan_n(
+        [("greedy", ga), ("cvx", ca)], batch.req.astype(np.int32),
+        batch.valid)
+    if winner == "cvx":                            # commit ⇒ strictly better
+        assert stats["cvx"]["units"] > stats["greedy"]["units"] or \
+            stats["cvx"]["placed"] > stats["greedy"]["placed"]
+
+    # zero params ⇒ dual warm start is exactly the cold start (inert)
+    cold = np.asarray(cvx_mod.cvx_solve_batch(batch, enc.nodes,
+                                              seed=5).assigned)
+    warm0 = np.asarray(cvx_mod.cvx_solve_batch(
+        batch, enc.nodes, seed=5, learned=pnet.init_params(0)).assigned)
+    assert np.array_equal(cold, warm0)
+
+
+@pytest.mark.slow
+def test_cvx_sharded_parity_with_single_device():
+    """parallel.mesh.cvx_solve_sharded over the virtual 8-device mesh must
+    reproduce the single-device plan bit-for-bit (same seed, same trace)."""
+    from yunikorn_tpu.parallel import mesh as mesh_mod
+
+    _, enc, _, _, _, batch = build_trace(4)
+    n = batch.num_pods
+    single = cvx_mod.cvx_solve_batch(batch, enc.nodes, seed=9)
+    sharded = mesh_mod.cvx_solve_sharded(batch, enc.nodes,
+                                         mesh_mod.make_mesh(), seed=9)
+    assert bool(np.asarray(sharded.feasible))
+    assert np.array_equal(np.asarray(single.assigned)[:n],
+                          np.asarray(sharded.assigned)[:n])
+    assert np.array_equal(np.asarray(single.free_after),
+                          np.asarray(sharded.free_after))
+
+
+# ------------------------------------------------------------------ core e2e
+def _make_core(**solver_kw):
+    from yunikorn_tpu.common.si import RegisterResourceManagerRequest
+
+    cache = SchedulerCache()
+    core = CoreScheduler(cache, solver_options=SolverOptions(**solver_kw))
+    core.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues",
+                                       config=""), _CB())
+    return cache, core
+
+
+def _run_trace(core, cache, n_nodes=32, waves=2, per_wave=60, cpu=400):
+    from tests.test_pack_solve import run_core_trace
+
+    return run_core_trace(core, cache, n_nodes=n_nodes, waves=waves,
+                          per_wave=per_wave, cpu=cpu)
+
+
+@pytest.mark.slow
+def test_core_cvx_arm_commits_valid_plan_and_metrics():
+    """solver.pack=cvx through the full core cycle: every committed
+    allocation lands within capacity, the duel ran with the cvx arm
+    (won or fell back — never silently absent), and the cycle entry
+    carries the cvx observability keys."""
+    cache, core = _make_core(policy="optimal", pack="cvx")
+    placements = _run_trace(core, cache)
+    assert len(placements) == 120
+    per_node = {}
+    for _, node in placements.items():
+        per_node[node] = per_node.get(node, 0) + 400
+    for node, used in per_node.items():
+        info = cache.get_node(node)
+        assert info is not None
+        assert used <= info.allocatable.get("cpu")
+    c = core.obs.get("cvx_plans_total")
+    assert c.value(outcome="won") + c.value(outcome="fell_back") >= 1
+    assert c.value(outcome="infeasible") == 0
+    wins = core.obs.get("duel_wins_total")
+    assert sum(wins.value(arm=a)
+               for a in ("greedy", "cvx", "optimal", "learned")) >= 1
+    entry = (core.metrics.get("last_cycle") or {}).get("default") or {}
+    assert "cvx_util" in entry or "cvx_skip" in entry
+    if "cvx_util" in entry:
+        assert "cvx_solve_ms" in entry and "cvx_iters" in entry
+
+
+@pytest.mark.slow
+def test_core_cvx_fault_falls_back_to_greedy_placements():
+    """A faulted cvx path must leave the cycle exactly greedy: placements
+    identical to a policy=greedy run, outcome counted, loop never wedged."""
+    cache_g, core_g = _make_core(policy="greedy")
+    want = _run_trace(core_g, cache_g)
+    cache_c, core_c = _make_core(policy="optimal", pack="cvx")
+    core_c.supervisor.faults.fail("cvx", times=8, tier="device")
+    got = _run_trace(core_c, cache_c)
+    assert got == want
+    c = core_c.obs.get("cvx_plans_total")
+    assert c.value(outcome="failed") + c.value(outcome="skipped") >= 1
+
+
+@pytest.mark.slow
+def test_core_learned_arm_scores_on_sharded_mesh(tmp_path):
+    """Follow-up (c): solver.policy=learned with node-dim sharding enabled
+    must actually run the learned arm (the mesh wrapper threads the params)
+    — placements stay bit-identical to greedy under an untrained checkpoint,
+    and the duel records the learned arm instead of a 'mesh' skip."""
+    prefix = str(tmp_path / "ck")
+    pnet.save_checkpoint(prefix, pnet.init_params(0), epoch=1)
+    cache_l, core_l = _make_core(policy="learned", policy_checkpoint=prefix,
+                                 shard=True)
+    placements_l = _run_trace(core_l, cache_l)
+    cache_g, core_g = _make_core(policy="greedy", shard=True)
+    placements_g = _run_trace(core_g, cache_g)
+    assert placements_l == placements_g
+    assert len(placements_l) == 120
+    assert core_l._mesh is not None            # sharding actually resolved
+    duels = core_l.obs.get("policy_duels_total")
+    assert duels.value(policy="learned", outcome="lost") \
+        + duels.value(policy="learned", outcome="won") == 2
+    entry = core_l.metrics["last_cycle"]["default"]
+    assert entry.get("policy_skip") != "mesh"
+    assert entry.get("learned_util") == 1.0

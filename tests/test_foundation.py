@@ -441,6 +441,8 @@ def test_rmutex_reentrant_and_detects():
         lk.DETECTION_ENABLED, lk.TIMEOUT_SECONDS = old_enabled, old_timeout
 
 
+@pytest.mark.slow  # ~54 s of pure XLA compiles; bucket behavior stays
+# covered by the aot-store suite
 def test_prewarm_buckets_compiles():
     from yunikorn_tpu.utils.jaxtools import prewarm_buckets
 

@@ -89,6 +89,8 @@ def test_sharded_rich_constraints_match_single_device():
     assert not np.isin(a1[a1 >= 0], np.nonzero(~node_mask)[0]).any()
 
 
+@pytest.mark.slow  # ~160 s: 18% of the tier-1 wall by itself; the smaller
+# sharded-parity cases above keep the contract in tier-1
 def test_sharded_production_cycle_at_scale():
     """The FULL CoreScheduler cycle (quota gate → rank → encode → sharded
     solve → commit) over the 8-device CPU mesh at >10k pods with locality +

@@ -63,6 +63,7 @@ CM_SOLVER_GATE = PREFIX_SOLVER + "gateVectorized"       # auto | true | false
 CM_SOLVER_GATE_DEVICE = PREFIX_SOLVER + "gateDevice"    # auto | true | false
 CM_SOLVER_GATE_VERIFY = PREFIX_SOLVER + "gateVerify"    # true | false
 CM_SOLVER_POLICY = PREFIX_SOLVER + "policy"             # auto | greedy | optimal | learned | all
+CM_SOLVER_PACK = PREFIX_SOLVER + "pack"                 # auto | pop | cvx
 # learned-policy checkpoint prefix (policy/net.save_checkpoint's
 # <prefix>.npz + <prefix>.json pair); "" = no checkpoint, the learned arm
 # skips. A checkpoint failing validation REJECTS at load with the previous
@@ -79,6 +80,11 @@ CM_SOLVER_SHARDS = PREFIX_SOLVER + "shards"             # auto | 1..64
 # silently keeping a default the operator did not ask for.
 TRI_STATE = ("auto", "true", "false")
 SOLVER_POLICIES = ("auto", "greedy", "optimal", "learned", "all")
+# pack-arm flavor under solver.policy=optimal: "pop" = the partitioned
+# LP/ADMM solve (ops/pack_solve.py), "cvx" = the full-fleet convex
+# relaxation (ops/cvx_solve.py), "auto" = pop. solver.policy=all always
+# duels BOTH pack flavors next to greedy and learned.
+SOLVER_PACK_ARMS = ("auto", "pop", "cvx")
 
 # observability.* keys (the obs/ registry + tracer + SLO engine)
 CM_OBS_TRACE_SPANS = PREFIX_OBS + "traceBufferSpans"
@@ -188,6 +194,11 @@ class SchedulerConf:
     # (the three-way duel); "auto" = greedy for now (flips when the
     # hardware A/B lands, like PALLAS_TPU_DEFAULT)
     solver_policy: str = "auto"
+    # pack-arm flavor (solver.pack): which global-packing challenger the
+    # optimal policy fields — "pop" partitions (POP), "cvx" solves the
+    # whole fleet as one convex program (CvxCluster); "auto" = pop.
+    # Under solver.policy=all both flavors enter the duel regardless.
+    solver_pack: str = "auto"
     # learned-policy checkpoint prefix (solver.policyCheckpoint): the
     # .npz+manifest pair a policy_train run emits; "" = none
     solver_policy_checkpoint: str = ""
@@ -439,7 +450,8 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             (CM_SOLVER_GATE_VERIFY, "solver_gate_verify", ("true", "false")),
             (CM_SOLVER_AOT_BACKGROUND, "solver_aot_background", TRI_STATE),
             (CM_SOLVER_TOPOLOGY, "solver_topology", TRI_STATE),
-            (CM_SOLVER_POLICY, "solver_policy", SOLVER_POLICIES)):
+            (CM_SOLVER_POLICY, "solver_policy", SOLVER_POLICIES),
+            (CM_SOLVER_PACK, "solver_pack", SOLVER_PACK_ARMS)):
         if key in data:
             setattr(conf, attr, _parse_choice(key, data[key], allowed))
     if CM_SOLVER_SHARDS in data:

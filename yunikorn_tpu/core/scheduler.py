@@ -189,6 +189,13 @@ class SolverOptions:
     # three-way duel greedy vs optimal vs learned). "greedy" = the
     # rank-ordered argmin only.
     policy: str = "greedy"
+    # pack-arm flavor (solver.pack): which global-packing challenger an
+    # "optimal" cycle fields — "pop" = the partitioned LP/ADMM solve
+    # (ops/pack_solve.py), "cvx" = the full-fleet convex relaxation
+    # (ops/cvx_solve.py, round 19); "auto" resolves to "pop" so defaults
+    # stay bit-identical to round 12. Under policy="all" BOTH flavors
+    # enter the duel regardless of this knob.
+    pack: str = "auto"
     # learned-policy checkpoint prefix (solver.policyCheckpoint): the
     # versioned .npz+manifest pair policy/net.save_checkpoint writes. A
     # checkpoint that fails validation REJECTS at load and the previous
@@ -232,6 +239,8 @@ class SolverOptions:
             policy=(lambda v: v if v in ("optimal", "learned", "all")
                     else "greedy")(
                 str(getattr(conf, "solver_policy", "auto")).lower()),
+            pack=(lambda v: v if v in ("pop", "cvx") else "auto")(
+                str(getattr(conf, "solver_pack", "auto")).lower()),
             policy_checkpoint=str(
                 getattr(conf, "solver_policy_checkpoint", "") or ""),
             topology=tri.get(
@@ -296,6 +305,11 @@ class _SolveHandle:
     # because a missing learned plan simply leaves greedy authoritative)
     learned: Optional[object] = None
     learned_t0: float = 0.0           # learned dispatch start (inference ms)
+    # solver.pack=cvx / solver.policy=all: the async full-fleet convex plan
+    # dispatched as its own supervised "cvx" path (None = skipped/failed —
+    # a missing cvx plan leaves the rest of the duel intact)
+    cvx: Optional[object] = None
+    cvx_t0: float = 0.0               # cvx dispatch start (solve-latency ms)
     # the persistent device mirror the greedy device dispatch used (single-
     # device only): the pack dispatch reuses it read-only so an optimal
     # cycle ships O(changed) node state + the row-store req gather, not a
@@ -581,6 +595,38 @@ class CoreScheduler(SchedulerAPI):
             "learned-policy checkpoints REJECTED at load (corrupt payload, "
             "format/feature-schema/shape mismatch) — the previous policy "
             "was retained each time")
+        # ---- cvx full-fleet arm (round 19, solver.pack=cvx) ----
+        self._m_cvx = m.counter(
+            "cvx_plans_total",
+            "cvx-solver (full-fleet convex relaxation, solver.pack=cvx) "
+            "cycles by outcome (won = cvx plan committed, fell_back = the "
+            "incumbent packed at least as well, skipped = batch outside "
+            "the full-fleet model or circuit open, failed = "
+            "dispatch/materialize error, infeasible = plan refused by the "
+            "capacity re-check — any nonzero count is a bug)",
+            labelnames=("outcome",))
+        self._h_cvx_ms = m.histogram(
+            "cvx_solve_latency_ms",
+            "dispatch-to-decision latency of the cvx plan (ms): the "
+            "fixed-trip primal-dual relaxation + rounding + repair, "
+            "overlapped with the greedy solve like the other arms",
+            buckets=MS_BUCKETS)
+        self._g_cvx_ms = m.gauge(
+            "cvx_last_solve_ms",
+            "most recent cycle's cvx plan latency (ms)")
+        self._g_cvx_util = m.gauge(
+            "cvx_last_util",
+            "most recent cycle's packed-units ratio cvx/greedy "
+            "(> 1 = the cvx plan packed more of the cluster)")
+        self._m_duel_wins = m.counter(
+            "duel_wins_total",
+            "choose_plan_n cycles by WINNING arm (one increment per duel "
+            "cycle; policy_duels_total counts per-participant outcomes) — "
+            "the committed-plan mix at a glance",
+            labelnames=("arm",))
+        # stats of the most recent cvx dispatch/duel (skip reason, util
+        # ratio, solve ms, iteration budget); ride the cycle entry
+        self._last_cvx_stats: dict = {}
         # ---- topology-aware placement (round 15, solver.topology) ----
         self._m_topo_cross = m.counter(
             "topology_cross_domain_gangs_total",
@@ -1577,6 +1623,7 @@ class CoreScheduler(SchedulerAPI):
             # drain round clobber a pack-won comparison already recorded
             self._last_pack_stats = {}
             self._last_policy_stats = {}
+            self._last_cvx_stats = {}
 
         def mk(tier):
             return lambda: self._solve_tier_dispatch(h, tier)
@@ -1594,6 +1641,7 @@ class CoreScheduler(SchedulerAPI):
             h.used_mesh = self._last_solve_used_mesh
         if allow_mesh:
             self._pack_dispatch(h)
+            self._cvx_dispatch(h)
             self._learned_dispatch(h)
         return h
 
@@ -1611,7 +1659,18 @@ class CoreScheduler(SchedulerAPI):
     # free_after >= 0 re-check below refuses the plan outright otherwise.
 
     def _pack_on(self) -> bool:
-        return getattr(self.solver, "policy", "greedy") in ("optimal", "all")
+        # "optimal" fields ONE pack flavor (solver.pack chooses; cvx
+        # replaces the partitioned arm); "all" sweeps both
+        p = getattr(self.solver, "policy", "greedy")
+        return p == "all" or (
+            p == "optimal"
+            and getattr(self.solver, "pack", "auto") != "cvx")
+
+    def _cvx_on(self) -> bool:
+        p = getattr(self.solver, "policy", "greedy")
+        return p == "all" or (
+            p == "optimal"
+            and getattr(self.solver, "pack", "auto") == "cvx")
 
     # ------------------------------------------- learned policy (round 17)
     # solver.policy=learned: the two-tower scorer (policy/) runs INSIDE a
@@ -1668,11 +1727,14 @@ class CoreScheduler(SchedulerAPI):
             # counts; the learned override would fight the accept caps for
             # no measured win — these cycles keep the greedy plan
             return "locality"
-        if self._mesh is not None:
-            # the learned variant has no sharded dispatch yet; a
-            # single-device learned solve under a live mesh would re-upload
-            # the full node tensors per cycle (the round-12 rationale that
-            # gates single-device pack under a mesh)
+        if self._mesh is not None and not h.used_mesh:
+            # a mesh cycle whose greedy solve did NOT run on the mesh
+            # (degraded tier, failed mesh dispatch) skips the learned arm:
+            # the single-device fallback would re-upload the full node
+            # tensors per cycle (the round-12 rationale that gates
+            # single-device pack under a mesh). Mesh cycles themselves
+            # score since round 19 — the params thread through the sharded
+            # wrapper (parallel.mesh.solve_sharded, policy follow-up (c)).
             return "mesh"
         return None
 
@@ -1694,9 +1756,26 @@ class CoreScheduler(SchedulerAPI):
         so = self.solver
         h.learned_t0 = time.perf_counter()
 
+        use_mesh = h.used_mesh and self._mesh is not None
+
         def learned_fn(pending):
             # the checkpoint hash rides the AOT fingerprint extra: a
-            # checkpoint swap can never serve a stale stored executable
+            # checkpoint swap can never serve a stale stored executable.
+            # Mesh cycles route through the sharded wrapper with the params
+            # replicated (follow-up (c)) — same layout as the greedy solve,
+            # so the two plans see identical committed state.
+            if use_mesh:
+                from yunikorn_tpu.parallel import mesh as mesh_mod
+
+                return mesh_mod.solve_sharded(
+                    h.batch, self.encoder.nodes, self._mesh,
+                    policy=h.policy, max_rounds=so.max_rounds,
+                    chunk=so.chunk, free_delta=h.overlay,
+                    node_mask=h.node_mask, ports_delta=h.inflight_ports,
+                    max_batch=so.max_batch, device_state=h.mesh_state,
+                    aot_pending=pending,
+                    learned=(ck.params, self._cycle_seq),
+                    aot_extra=("policy", ck.hash))
             return solve_batch(
                 h.batch, self.encoder.nodes, policy=h.policy,
                 max_rounds=so.max_rounds, chunk=so.chunk,
@@ -1830,6 +1909,96 @@ class CoreScheduler(SchedulerAPI):
             logger.exception("pack solve dispatch failed; greedy plan "
                              "stands this cycle")
 
+    def _cvx_eligible(self, h: "_SolveHandle") -> Optional[str]:
+        """None when the full-fleet convex arm models this cycle; else the
+        skip reason. Deterministic scope gates live here, before the
+        supervised dispatch (the _pack_eligible rationale)."""
+        import numpy as np
+
+        from yunikorn_tpu.ops import cvx_solve as cvx_mod
+
+        batch = h.batch
+        if batch.locality is not None:
+            return "locality"
+        if batch.g_ports.view(np.uint32).any():
+            return "ports"
+        if not cvx_mod.cvx_shape_supported(batch.req.shape[0],
+                                           self.encoder.nodes.capacity):
+            # dense [N, M] state over budget — exactly the shapes the
+            # partitioned pack arm exists for
+            return "shape"
+        if self._mesh is not None:
+            from yunikorn_tpu.parallel import mesh as mesh_mod
+
+            if not getattr(mesh_mod, "CVX_SHARDED_SUPPORTED", False):
+                return "mesh"
+            if not h.used_mesh:
+                # greedy degraded off the mesh this cycle: a single-device
+                # cvx solve would re-upload the full node tensors (the
+                # round-12 transfer-cost rationale)
+                return "mesh"
+        return None
+
+    def _cvx_dispatch(self, h: "_SolveHandle") -> None:
+        """Async-dispatch the full-fleet convex solve for an eligible
+        cycle; failures leave h.cvx None (the rest of the duel stands)."""
+        if not self._cvx_on():
+            return
+        reason = self._cvx_eligible(h)
+        if reason is not None:
+            self._m_cvx.inc(outcome="skipped")
+            self._last_cvx_stats = {"skip": reason}
+            return
+        if not self.supervisor.allow("cvx"):
+            self._m_cvx.inc(outcome="skipped")
+            self._last_cvx_stats = {"skip": "circuit"}
+            return
+        from yunikorn_tpu.ops import cvx_solve as cvx_mod
+
+        # the learned-dual warm start rides whenever a validated checkpoint
+        # is active (DOPPLER-style water-fill fill order); its hash keys
+        # the AOT fingerprint so a swap never serves a stale executable
+        ck = self._policy_ckpt
+        learned = ck.params if ck is not None else None
+        extra = ("policy", ck.hash) if ck is not None else ()
+        use_mesh_cvx = h.used_mesh and self._mesh is not None
+        h.cvx_t0 = time.perf_counter()
+        if use_mesh_cvx:
+            from yunikorn_tpu.parallel import mesh as mesh_mod
+
+            def cvx_fn(pending):
+                return mesh_mod.cvx_solve_sharded(
+                    h.batch, self.encoder.nodes, self._mesh,
+                    policy=h.policy, free_delta=h.overlay,
+                    node_mask=h.node_mask, ports_delta=h.inflight_ports,
+                    seed=self._cycle_seq, chunk=self.solver.chunk,
+                    device_state=h.mesh_state, aot_pending=pending,
+                    learned=learned, aot_extra=extra)
+        else:
+            def cvx_fn(pending):
+                return cvx_mod.cvx_solve_batch(
+                    h.batch, self.encoder.nodes, policy=h.policy,
+                    free_delta=h.overlay, node_mask=h.node_mask,
+                    ports_delta=h.inflight_ports, seed=self._cycle_seq,
+                    chunk=self.solver.chunk, device_state=h.device_state,
+                    aot_pending=pending, learned=learned, aot_extra=extra)
+        try:
+            from yunikorn_tpu.aot import pending_enabled
+
+            h.cvx = self.supervisor.run(
+                "cvx", lambda: cvx_fn(pending_enabled()),
+                commit_success=False)
+        except AbandonedDispatch:
+            raise  # zombie thread: stop, don't continue a stale cycle
+        except cvx_mod.CvxUnsupported as e:
+            self._m_cvx.inc(outcome="skipped")
+            self._last_cvx_stats = {"skip": str(e)}
+        except Exception:
+            self._m_cvx.inc(outcome="failed")
+            self._last_cvx_stats = {"skip": "error"}
+            logger.exception("cvx solve dispatch failed; the cvx arm sits "
+                             "out this cycle")
+
     def _plan_duel(self, h: "_SolveHandle", greedy_assigned):
         """Materialize every challenger plan (pack, learned) and run the
         N-way differential comparison; returns the committed assignment —
@@ -1841,7 +2010,7 @@ class CoreScheduler(SchedulerAPI):
 
         n = h.batch.num_pods
         cands = [("greedy", np.asarray(greedy_assigned)[:n])]
-        pack_ms = learned_ms = None
+        pack_ms = learned_ms = cvx_ms = None
         if h.pack is not None:
             try:
                 pack_assigned, feasible = self.supervisor.run(
@@ -1869,6 +2038,32 @@ class CoreScheduler(SchedulerAPI):
                                  "pack arm sits out this cycle")
                 else:
                     cands.append(("optimal", pack_assigned))
+        if h.cvx is not None:
+            try:
+                cvx_assigned, cvx_feasible = self.supervisor.run(
+                    "cvx",
+                    lambda: (np.asarray(h.cvx.assigned)[:n],
+                             bool(np.asarray(h.cvx.feasible))))
+            except AbandonedDispatch:
+                raise  # zombie thread: stop, don't commit a stale cycle
+            except Exception:
+                self._m_cvx.inc(outcome="failed")
+                self._last_cvx_stats = {"skip": "error"}
+                logger.exception("cvx plan materialization failed; the "
+                                 "cvx arm sits out this cycle")
+            else:
+                cvx_ms = (time.perf_counter() - h.cvx_t0) * 1000
+                self._h_cvx_ms.observe(cvx_ms)
+                if not cvx_feasible:
+                    # structurally impossible (the rounding/repair shares
+                    # greedy's fit arithmetic) — belt and braces: never
+                    # commit such a plan
+                    self._m_cvx.inc(outcome="infeasible")
+                    self._last_cvx_stats = {"skip": "infeasible"}
+                    logger.error("cvx plan over-committed capacity; the "
+                                 "cvx arm sits out this cycle")
+                else:
+                    cands.append(("cvx", cvx_assigned))
         if h.learned is not None:
             try:
                 learned_assigned = self.supervisor.run(
@@ -1906,6 +2101,9 @@ class CoreScheduler(SchedulerAPI):
         for name, _ in cands:
             self._m_policy_duels.inc(
                 policy=name, outcome="won" if name == winner else "lost")
+        # one increment per duel CYCLE by winning arm (the committed-plan
+        # mix; policy_duels_total above is per participant)
+        self._m_duel_wins.inc(arm=winner)
         if "optimal" in by_name:
             use_pack = winner == "optimal"
             util_ratio = utils["optimal"]["units_norm"] / g_units
@@ -1923,6 +2121,19 @@ class CoreScheduler(SchedulerAPI):
         else:
             self._last_pack_stats = {**self._last_pack_stats,
                                      "policy": winner}
+        if "cvx" in by_name:
+            use_cvx = winner == "cvx"
+            c_ratio = utils["cvx"]["units_norm"] / g_units
+            self._m_cvx.inc(outcome="won" if use_cvx else "fell_back")
+            self._g_cvx_util.set(c_ratio)
+            self._g_cvx_ms.set(cvx_ms)
+            self._last_cvx_stats = {
+                "cvx_util": round(c_ratio, 4),
+                "cvx_solve_ms": round(cvx_ms, 2),
+                "cvx_iters": getattr(h.cvx, "iters", 0),
+                "cvx_placed": utils["cvx"]["placed"],
+                "learned_dual": bool(getattr(h.cvx, "learned_dual", False)),
+            }
         if "learned" in by_name:
             use_learned = winner == "learned"
             l_ratio = utils["learned"]["units_norm"] / g_units
@@ -2002,8 +2213,9 @@ class CoreScheduler(SchedulerAPI):
             "assign", [(t, mk(t)) for t in ASSIGN_LADDER],
             start_tier=h.tier)
         h.tier = tier
-        if h.pack is not None or h.learned is not None:
-            # optimal/learned policy: the N-way differential comparison
+        if (h.pack is not None or h.cvx is not None
+                or h.learned is not None):
+            # optimal/cvx/learned policy: the N-way differential comparison
             # against the greedy plan decides which assignment commits
             assigned = self._plan_duel(h, assigned)
         return assigned
@@ -2521,6 +2733,7 @@ class CoreScheduler(SchedulerAPI):
             entry.update(_gate_extras(self._last_gate_stats))
             entry.update(_pack_extras(self._last_pack_stats))
             entry.update(_policy_extras(self._last_policy_stats))
+            entry.update(_cvx_extras(self._last_cvx_stats))
             entry.update(_topo_extras(self._last_topo_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -2535,6 +2748,7 @@ class CoreScheduler(SchedulerAPI):
                    reencoded=self.encoder.last_encode_rows_reencoded)
             tr.add("solve", cid, t_encode, t_solve,
                    policy=self._last_pack_stats.get("policy", "greedy"),
+                   **_cvx_extras(self._last_cvx_stats),
                    **self._last_solve_stats)
             tr.add("commit", cid, t_solve, t_commit, allocs=len(new_allocs))
         return len(new_allocs), (pinned, replaced, new_allocs,
@@ -2825,6 +3039,7 @@ class CoreScheduler(SchedulerAPI):
             entry.update(_gate_extras(cyc.gate_stats))
             entry.update(_pack_extras(self._last_pack_stats))
             entry.update(_policy_extras(self._last_policy_stats))
+            entry.update(_cvx_extras(self._last_cvx_stats))
             entry.update(_topo_extras(self._last_topo_stats))
             if fb_rounds:
                 entry["fallback_rounds"] = fb_rounds
@@ -3939,6 +4154,20 @@ def _policy_extras(stats: dict) -> dict:
             out[k] = stats[k]
     if "skip" in stats:
         out["policy_skip"] = stats["skip"]
+    return out
+
+
+def _cvx_extras(stats: dict) -> dict:
+    """Cvx-arm stats (solver.pack=cvx, round 19) for the cycle entry: util
+    ratio / solve ms / iteration budget when the duel ran, or the skip
+    reason when the arm sat out."""
+    out = {}
+    for k in ("cvx_util", "cvx_solve_ms", "cvx_iters", "cvx_placed",
+              "learned_dual"):
+        if k in stats:
+            out[k] = stats[k]
+    if "skip" in stats:
+        out["cvx_skip"] = stats["skip"]
     return out
 
 

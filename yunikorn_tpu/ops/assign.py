@@ -818,29 +818,35 @@ def _topo_node_adj(topo):
         0.0)                                                       # [M]
 
 
-def _learned_proposals(pod_emb, node_emb, group_id, group_feas, free, req,
-                       active, tau, key, chunk: int):
-    """Gated learned proposal override (solver.policy=learned).
+def _learned_chunk_pass(pod_emb, node_emb, group_id, group_feas, group_soft,
+                        free, capacity, base_scores, req, active, tau, key,
+                        chunk: int, policy: str, score_cols: int = 0,
+                        node_dom=None, pref_pod=None, argmax: bool = False):
+    """Fused per-chunk pass for solver.policy=learned (follow-up (e) done).
 
-    For each active pod, the two-tower score picks a candidate node among
-    the pod's feasible-and-fitting nodes, with seeded Gumbel exploration
-    (tau-scaled — identical-featured nodes score identically, and a plain
-    argmax would herd every pod onto the lowest row index, the same failure
-    _water_fill_proposals documents). The override only fires when the
-    CHOSEN node's raw learned score beats the pod's feasible-mean by
-    GATE_MARGIN — a shift-invariant confidence gate, so an untrained or
-    garbage-zero checkpoint (score identically 0) can NEVER override a
-    proposal and the learned program stays bit-identical to greedy.
+    One lax.map computes the fit-margin mask ONCE per chunk and derives both
+    consumers from it:
 
-    Returns [N] int32 proposals (M = no override; fit is re-checked by the
-    round loop's prop_fits exactly like every other proposal source).
+    1. Gated learned proposal override. For each active pod, the two-tower
+       score picks a candidate node among the pod's feasible-and-fitting
+       nodes, with seeded Gumbel exploration (tau-scaled — identical-featured
+       nodes score identically, and a plain argmax would herd every pod onto
+       the lowest row index, the same failure _water_fill_proposals
+       documents). The override only fires when the CHOSEN node's raw
+       learned score beats the pod's feasible-mean by GATE_MARGIN — a
+       shift-invariant confidence gate, so an untrained or garbage-zero
+       checkpoint (score identically 0) can NEVER override a proposal and
+       the learned program stays bit-identical to greedy.
+    2. When `argmax` (odd rounds): the exact per-pod argmax that
+       _best_nodes_chunked computes, with the learned [C, E] x [E, M] score
+       augmentation reusing the SAME ls matmul — previously both the margin
+       and the matmul ran twice (two lax.map bodies; XLA CSE across them is
+       not guaranteed).
 
-    Known cost: this stage re-derives the per-chunk fit-margin mask that
-    _best_nodes_chunked also computes on argmax rounds (two lax.map bodies,
-    so XLA CSE across them is not guaranteed). Fusing the two passes is a
-    ROADMAP follow-up; as shipped, the learned variant's measured warm
-    latency still lands BELOW greedy's on the fragmented win shapes (its
-    placements converge in fewer rounds).
+    Returns (props [N] int32 with M = no override, best [N] int32,
+    feasible [N] bool); best/feasible are zeros when argmax=False so the two
+    variants stay pytree-compatible as lax.cond branches. Fit is re-checked
+    by the round loop's prop_fits exactly like every other proposal source.
     """
     from yunikorn_tpu.policy.net import GATE_MARGIN
 
@@ -866,13 +872,32 @@ def _learned_proposals(pod_emb, node_emb, group_id, group_feas, free, req,
                  / jnp.maximum(nf.astype(jnp.float32), 1.0))
         g = jax.random.gumbel(jax.random.fold_in(key, c), (chunk, M))
         u = jnp.where(ok, ls + tau * g, NEG_INF)
-        best = jnp.argmax(u, axis=1).astype(jnp.int32)
-        ls_best = jnp.take_along_axis(ls, best[:, None], axis=1)[:, 0]
+        pick = jnp.argmax(u, axis=1).astype(jnp.int32)
+        ls_best = jnp.take_along_axis(ls, pick[:, None], axis=1)[:, 0]
         good = (nf > 0) & (ls_best - lmean > GATE_MARGIN)
-        return jnp.where(good, best, M)
+        prop = jnp.where(good, pick, M)
+        if not argmax:
+            z = jnp.zeros((chunk,), jnp.int32)
+            return prop, z, z.astype(bool)
+        scores = (jnp.broadcast_to(base_scores[None, :], (chunk, M))
+                  + group_soft[cgid])
+        if policy == "align":
+            s = score_cols if score_cols > 0 else R
+            scores = scores + alignment_scores(
+                creq[:, :s], free[:, :s], capacity[:, :s])
+        if node_dom is not None and pref_pod is not None:
+            cpref = lax.dynamic_slice(pref_pod, (start,), (chunk,))
+            in_pref = ((cpref[:, None] >= 0) & (node_dom[None, :] >= 0)
+                       & (node_dom[None, :] == cpref[:, None]))
+            scores = scores + jnp.where(in_pref, TOPO_GANG_W, 0.0)
+        scores = jnp.where(ok, scores + ls, NEG_INF)
+        best = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        feasible = jnp.any(ok, axis=1)
+        return prop, best, feasible
 
-    props = lax.map(one_chunk, jnp.arange(n_chunks)).reshape(N)
-    return jnp.where(active, props, M)
+    props, best, feasible = lax.map(one_chunk, jnp.arange(n_chunks))
+    return (jnp.where(active, props.reshape(N), M),
+            best.reshape(N), feasible.reshape(N))
 
 
 def _learned_prep(learned, req, rank, capacity, score_cols: int, salt=None):
@@ -925,7 +950,7 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
     CURRENT free capacity each round (tiny [M, F] x [F, H] matmuls, the
     same per-round refresh the base score gets), the gated learned
     proposals override the water-fill where the scorer is confident
-    (strictly positive advantage — see _learned_proposals), and the argmax
+    (strictly positive advantage — see _learned_chunk_pass), and the argmax
     stage's score matrix is augmented with the same bilinear term.
     learned_rt=None (and equally a zero/untrained checkpoint) recovers the
     exact greedy round body — the untrained-is-inert contract."""
@@ -980,7 +1005,7 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
         proposals = _water_fill_proposals(req, group_id, rank, active,
                                           feas_round, cur_free, base_scores,
                                           soft_round, g_rr_dom, g_capped)
-        learned_emb = None
+        learned_best = None
         if learned_rt is not None:
             from yunikorn_tpu.policy import features as _pf
             from yunikorn_tpu.policy import net as _pnet
@@ -989,11 +1014,22 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
             node_emb = _pnet.node_tower(
                 l_params, _pf.node_features(cur_free[:, :sc],
                                             capacity[:, :sc], inv_sc))
-            learned_emb = (pod_emb, node_emb)
-            lprop = _learned_proposals(
-                pod_emb, node_emb, group_id, feas_round, cur_free, req,
-                active, l_params["tau"], jax.random.fold_in(l_key, rnd),
-                chunk)
+            # one fused chunk pass: the fit margin and the [C, E] x [E, M]
+            # matmul are shared between the gated proposal and the odd-round
+            # argmax (the two lax.cond branches trace the pass with and
+            # without the argmax tail, so even rounds pay only the proposal)
+            fused = lambda do_argmax: _learned_chunk_pass(
+                pod_emb, node_emb, group_id, feas_round, soft_round,
+                cur_free, capacity, base_scores, req, active,
+                l_params["tau"], jax.random.fold_in(l_key, rnd), chunk,
+                policy, score_cols,
+                node_dom=topo_rt[0] if topo_rt is not None else None,
+                pref_pod=topo_rt[1] if topo_rt is not None else None,
+                argmax=do_argmax)
+            lprop, am_best, am_feas = lax.cond(
+                rnd % 2 == 1, lambda _: fused(True), lambda _: fused(False),
+                None)
+            learned_best = (am_best, am_feas)
             # confident learned proposals override the water-fill; the topo
             # gang proposals below still win over both (gang contiguity is
             # a structural constraint, the learned term a packing score)
@@ -1031,13 +1067,15 @@ def _solve_rounds(req, group_id, rank, valid, group_feas, group_soft,
                     req, group_id, feas_round, soft_round, cur_free,
                     base_scores, interpret=pallas_interpret,
                     has_soft=pallas_soft)
+            elif learned_best is not None:
+                # already computed by the fused learned pass above
+                best, feasible = learned_best
             else:
                 best, feasible = _best_nodes_chunked(
                     req, group_id, feas_round, soft_round, cur_free, capacity,
                     base_scores, chunk, policy, score_cols,
                     node_dom=topo_rt[0] if topo_rt is not None else None,
-                    pref_pod=topo_rt[1] if topo_rt is not None else None,
-                    learned_emb=learned_emb)
+                    pref_pod=topo_rt[1] if topo_rt is not None else None)
             merged = jnp.where(prop_fits, proposals, best)
             return merged, active & (feasible | prop_fits)
 

@@ -1,0 +1,375 @@
+"""CvxCluster solver arm: ONE jitted full-fleet convex relaxation of the
+ask×node assignment, behind `solver.pack=cvx` (and `solver.policy=all`).
+
+The pack solver (ops/pack_solve.py) bounds its dense relaxation state with
+POP-style random partitioning: K disjoint subproblems, each solved blind to
+the others. CvxCluster (arXiv 2605.01614) is the opposite bet — granular
+allocation problems solve fastest AND best as one relaxed convex program
+over the whole fleet, because the relaxation is what removes the
+combinatorial coupling, not the partition. This module is that arm: a
+projected-gradient primal-dual solve over the FULL [N, M] soft assignment,
+every trip count compile-time static, rounded through the greedy solver's
+own accept machinery so feasibility is greedy feasibility by construction.
+
+The relaxed program is the same packing LP the partitioned arm optimizes —
+maximize Σ x_ij·v_i (v = capacity-normalized request mass) subject to
+per-node-per-resource capacity, x row-stochastic-or-less — solved here by a
+fixed `lax.fori_loop` of primal-dual steps:
+
+  primal      gradient ascent on the priced objective: X += η_p·(v − ⟨req,λ⟩
+              + score tiebreak), then projection onto the feasible box —
+              per-row simplex cap {x ≥ 0, Σ_m x ≤ 1} by bisection on the
+              simplex threshold (a FIXED bisection trip count; the standard
+              sort-based projection would cost an [N, M] sort per step).
+  gang        all-or-nothing coupling as a projection: a constraint group's
+              pods are capped toward the group's minimum placed mass
+              (segment-min over the group axis) — a gang member the prices
+              squeezed out pulls its siblings' mass down with it, instead of
+              the group half-placing. Applied as a soft blend so one
+              unplaceable straggler dims its group rather than zeroing it;
+              the rounding accept + greedy repair make the final call.
+  capacity    per-node downscale to the capacity box (load ≤ free per
+              resource), and dual ascent λ += η_d·overload⁺ on the relative
+              overload — prices make contended nodes expensive exactly like
+              the partitioned LP, but over the whole fleet at once.
+
+The LEARNED-DUAL variant (solver.policy=all wiring, DOPPLER-style) warm
+starts λ from the round-17 two-tower scorer: nodes the policy scores BELOW
+the demand-weighted fleet mean start with a positive price, so the first
+primal steps water-fill the policy's preferred nodes first. An untrained or
+garbage-zero checkpoint embeds every pod to the zero vector, the per-node
+score is identically 0, and the warm start is exactly the cold λ = 0 — the
+untrained-is-inert contract extends to the dual. A BAD warm start can only
+cost iterations (the dual ascent re-prices within the fixed budget) and
+therefore packed units — the duel then keeps the incumbent; it can never
+admit an infeasible plan, because rounding + repair never trust X.
+
+Rounding reuses `pack_solve._round_part` verbatim over the full node set
+(Gumbel-max proposals ∝ the relaxation's reduced costs + log soft-assignment
+mass, per-node-segment prefix accept, best-fit-decreasing), and leftovers
+run the unmodified greedy round loop (`ops.assign._solve_rounds`) — so
+every placement clears the exact feasibility arithmetic greedy placements
+do, and `free_after >= min(free, 0)` holds structurally. The core still
+re-checks before committing (cvx_plans_total{outcome=infeasible}).
+
+Scope gates mirror pack: locality batches and host-port batches raise
+CvxUnsupported (greedy keeps the cycle); shapes whose dense [N, M] state
+exceeds the cell budget are not cvx-solvable (the partitioned arm exists
+precisely for those). Sharded-mesh dispatch lives in
+`parallel.mesh.cvx_solve_sharded` (node-dim GSPMD sharding — X, feas and
+soft all shard along M, the fleet axis).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from yunikorn_tpu.models.policies import node_base_scores
+from yunikorn_tpu.ops.assign import (
+    _hoist_group_state,
+    _solve_rounds,
+    _topo_node_adj,
+    prepare_solve_args,
+)
+from yunikorn_tpu.ops.pack_solve import _LAM_MAX, _round_part
+
+# fixed iteration counts: the compiled program's cost is bounded no matter
+# what the trace looks like (the tentpole contract — never data-dependent)
+CVX_ITERS = 24         # primal-dual steps over the full fleet
+CVX_ROUND_ROUNDS = 4   # seeded rounding accept rounds
+CVX_REPAIR_ROUNDS = 8  # greedy rounds for what the rounding stranded
+_PROJ_BISECT = 12      # bisection steps of the row-simplex projection
+                       # (threshold resolved to 2^-12 of the mass scale)
+
+# step sizes: utilities are O(1) (v is a sum of column-normalized requests,
+# base scores ∈ [0, 1]); η_p must move a row to O(1) mass inside CVX_ITERS
+_ETA_P = 0.35          # primal step on the priced gradient
+_ETA_D = 0.5           # dual step on relative overload (pack's _LP_ETA)
+_GANG_W = 0.5          # gang-projection blend: 1 = hard min-coupling
+_MASS_W = 0.5          # weight of log(X) in the rounding scores
+_MASS_EPS = 1e-4       # floor under the log (zero-mass cells stay finite
+                       # but ~unsampleable under the Gumbel temperature)
+_DUAL_W = 4.0          # learned warm-start price scale (≤ _LAM_MAX/16:
+                       # a wrong prior must stay erasable by the ascent)
+
+# full-fleet cell budget: ONE dense [N, M] f32 buffer per loop temp (X, u,
+# feas, soft) — 1<<25 cells = 128 MiB f32. Covers every standard bucket up
+# to 4096 pods × 8192 nodes / 2048 pods × 16384 nodes; beyond that the
+# partitioned pack arm is the right tool and the core's gate skips cvx.
+_CVX_CELL_BUDGET = 1 << 25
+
+
+class CvxUnsupported(Exception):
+    """This batch (or shape) is outside the full-fleet convex model; the
+    caller must keep the greedy plan (and the partitioned pack arm, when
+    on) for the cycle."""
+
+
+def cvx_shape_supported(n_pods: int, n_nodes: int) -> bool:
+    """Whether a (padded pods, node capacity) shape fits the dense [N, M]
+    relaxation state. Deterministic in the shape alone — the core pre-gates
+    on this BEFORE the supervised dispatch, like pack's shape gate."""
+    if n_pods < 1 or n_nodes < 1:
+        return False
+    return n_pods * n_nodes <= _CVX_CELL_BUDGET
+
+
+@dataclasses.dataclass
+class CvxResult:
+    assigned: jnp.ndarray      # [N] int32 node row, -1 unassigned
+    free_after: jnp.ndarray    # [M, R] int32
+    # bool scalar: every cell of free_after >= min(initial free, 0)
+    feasible: jnp.ndarray
+    iters: int
+    seed: int
+    learned_dual: bool = False
+
+    def block_until_ready(self):
+        self.assigned.block_until_ready()
+        return self
+
+
+def _project_rows(x, ok, bisect_iters: int = _PROJ_BISECT):
+    """Project each row of x onto {p : p >= 0, sum(p) <= 1, p[~ok] = 0}.
+
+    Euclidean projection onto the capped simplex: p = max(x − τ, 0) with
+    τ = 0 when Σ max(x, 0) ≤ 1, else the water level where the thresholded
+    mass hits exactly 1. τ lives in [rowmax − 1, rowmax] (at τ = rowmax the
+    mass is 0; at rowmax − 1 the max element alone contributes 1), resolved
+    by a FIXED bisection trip count — the sort-free form, O(M) per step."""
+    x = jnp.where(ok, x, 0.0)
+    relu_sum = jnp.sum(jnp.maximum(x, 0.0), axis=1, keepdims=True)  # [N, 1]
+    rowmax = jnp.max(jnp.where(ok, x, 0.0), axis=1, keepdims=True)
+
+    def body(_, bounds):
+        lo, hi = bounds
+        mid = 0.5 * (lo + hi)
+        mass = jnp.sum(jnp.maximum(x - mid, 0.0) * ok, axis=1,
+                       keepdims=True)
+        return (jnp.where(mass > 1.0, mid, lo),
+                jnp.where(mass > 1.0, hi, mid))
+
+    lo, hi = lax.fori_loop(0, bisect_iters, body,
+                           (rowmax - 1.0, rowmax))
+    tau = jnp.where(relu_sum > 1.0, 0.5 * (lo + hi), 0.0)
+    return jnp.maximum(x - tau, 0.0) * ok
+
+
+def _learned_dual_init(params, req, free, capacity, valid, v,
+                       score_cols: int, R: int):
+    """DOPPLER-style warm start: λ0 from the two-tower scorer's per-node
+    scores. Nodes scoring below the demand-weighted fleet mean start with a
+    positive price (the policy says "fill these last"); preferred nodes
+    start free. Broadcast over the resource axis — the prior is about node
+    desirability, not any one resource. Zero/untrained params → per-node
+    score identically 0 → λ0 exactly 0 (the cold start)."""
+    from yunikorn_tpu.policy import features as _pf
+    from yunikorn_tpu.policy import net as _pnet
+
+    sc = score_cols if score_cols > 0 else R
+    inv_sc = _pf.inv_capacity_scale(capacity[:, :sc])
+    pod_emb = _pnet.pod_tower(params, _pf.pod_features(req[:, :sc], inv_sc))
+    node_emb = _pnet.node_tower(
+        params, _pf.node_features(free[:, :sc], capacity[:, :sc], inv_sc))
+    w = v * valid.astype(jnp.float32)                           # [N]
+    pe = (w @ pod_emb) / jnp.maximum(jnp.sum(w), 1e-6)          # [E]
+    s = node_emb @ pe                                           # [M]
+    lam0 = _DUAL_W * jnp.maximum(jnp.mean(s) - s, 0.0)
+    return jnp.broadcast_to(lam0[:, None], (free.shape[0], R))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("iters", "round_rounds", "repair_rounds", "chunk",
+                     "policy", "score_cols"),
+)
+def cvx_solve(
+    req, group_id, rank, valid,
+    g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+    g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+    node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+    free, capacity, host_group_mask=None, host_group_soft=None, loc=None,
+    topo=None,
+    seed=0,
+    learned=None,
+    *,
+    iters: int = CVX_ITERS,
+    round_rounds: int = CVX_ROUND_ROUNDS,
+    repair_rounds: int = CVX_REPAIR_ROUNDS,
+    chunk: int = 512,
+    policy: str = "binpacking",
+    score_cols: int = 0,
+):
+    """One full-fleet convex solve. Positional args mirror `ops.assign.solve`
+    (the prepare_solve_args tuple) so the arms cannot drift on arg prep;
+    `seed` is a traced int32 (reseeding never recompiles); `learned` is the
+    two-tower params pytree or None (treedef keys the compiled variant, the
+    checkpoint hash keys the AOT fingerprint via the caller's extra).
+    Returns (assigned [N] i32, free_after [M, R] i32, feasible bool)."""
+    if loc is not None:
+        raise CvxUnsupported("locality batches take the greedy path")
+    N, R = req.shape
+    M = free.shape[0]
+    sc = score_cols if score_cols > 0 else R
+
+    group_feas, group_soft = _hoist_group_state(
+        g_term_req, g_term_forb, g_term_valid, g_anyof, g_anyof_valid,
+        g_tol, g_ports, g_pref_req, g_pref_forb, g_pref_weight,
+        node_labels, node_taints, node_taints_soft, node_ports, node_ok,
+        host_group_mask, host_group_soft)
+    if topo is not None:
+        # same node-level contention/empty-domain term as the greedy and
+        # pack objectives — the relaxation optimizes what the fleet runs
+        group_soft = group_soft + _topo_node_adj(topo)[None, :]
+    G = group_feas.shape[0]
+
+    # the ONE place this module materializes [N, M]: the relaxation state
+    # (the cell budget exists for exactly these)
+    feas = group_feas[group_id]                                 # [N, M]
+    soft = group_soft[group_id]                                 # [N, M]
+    ok = feas & valid[:, None]
+
+    # column normalization, identical to pack: prices and loads compare
+    # per-resource magnitudes spanning orders of magnitude (milliCPU vs
+    # bytes) — normalize by the mean node capacity
+    inv_scale = 1.0 / jnp.maximum(
+        jnp.mean(capacity.astype(jnp.float32), axis=0), 1.0)    # [R]
+    req_f = req.astype(jnp.float32) * inv_scale[None, :]        # [N, R]
+    free_f = jnp.maximum(free, 0).astype(jnp.float32) \
+        * inv_scale[None, :]                                    # [M, R]
+    v = jnp.sum(req_f, axis=1)                                  # [N] value
+    base = node_base_scores(free[:, :sc], capacity[:, :sc], policy)
+    tie = 0.05 * (base[None, :] + soft)
+
+    lam0 = (jnp.zeros((M, R), jnp.float32) if learned is None
+            else _learned_dual_init(learned, req, free, capacity, valid, v,
+                                    score_cols, R))
+
+    okf = ok.astype(jnp.float32)
+
+    def body(_, state):
+        X, lam = state
+        u = v[:, None] - req_f @ lam.T + tie                    # [N, M]
+        X = _project_rows(X + _ETA_P * u, okf)
+        # gang projection: pull every member toward the group's minimum
+        # placed mass (invalid pods must not drag the min — they carry no
+        # mass by construction, so they are filled past any real mass)
+        mass = jnp.sum(X, axis=1)                               # [N]
+        gmass = jnp.where(valid, mass, 2.0)
+        gmin = jnp.minimum(
+            jax.ops.segment_min(gmass, group_id, num_segments=G,
+                                indices_are_sorted=False), 1.0)  # [G]
+        gang = jnp.minimum(gmin[group_id] / jnp.maximum(mass, 1e-6), 1.0)
+        X = X * ((1.0 - _GANG_W) + _GANG_W * gang)[:, None]
+        # capacity projection + dual ascent: the PRE-projection load drives
+        # the prices (the overload signal), the projection keeps the primal
+        # iterate inside the capacity box between steps
+        load = X.T @ req_f                                      # [M, R]
+        shrink = jnp.min(
+            jnp.where(load > free_f,
+                      free_f / jnp.maximum(load, 1e-6), 1.0), axis=1)
+        X = X * shrink[None, :]
+        over = (load - free_f) / jnp.maximum(free_f, 1e-3)
+        lam = jnp.clip(lam + _ETA_D * over, 0.0, _LAM_MAX)
+        return X, lam
+
+    X, lam = lax.fori_loop(
+        0, iters, body, (jnp.zeros((N, M), jnp.float32), lam0))
+
+    # rounding scores: the final reduced costs (pack's proven recipe — the
+    # prices are what stay fixed across rounds, base re-scores live) plus
+    # the primal mass as a log-bonus — the rounding samples in proportion
+    # to where the relaxation actually put assignment mass
+    scores = (v[:, None] - req_f @ lam.T + 0.05 * soft
+              + _MASS_W * jnp.log(X + _MASS_EPS))
+    assigned, free_left = _round_part(
+        req, rank, valid, feas, scores, free, capacity, v,
+        jax.random.PRNGKey(seed), round_rounds, policy, sc)
+
+    # repair: asks the rounding stranded run the unmodified greedy round
+    # loop with the residual capacity — the proof-by-construction that cvx
+    # feasibility is exactly greedy feasibility
+    leftover = valid & (assigned < 0)
+    rep_assigned, _, free_after, _, _ = _solve_rounds(
+        req, group_id, rank, leftover, group_feas, group_soft, free_left,
+        jnp.zeros((1, 1), jnp.int32), capacity, None, None,
+        max_rounds=repair_rounds, chunk=min(chunk, N), policy=policy,
+        use_pallas=False, pallas_interpret=False, has_loc_soft=False,
+        pallas_soft=False, score_cols=score_cols)
+    assigned = jnp.where(assigned >= 0, assigned, rep_assigned)
+    feasible = jnp.all(free_after >= jnp.minimum(free, 0))
+    return assigned, free_after, feasible
+
+
+def cvx_solve_batch(batch, node_arrays, *, policy: str = "binpacking",
+                    free_delta=None, node_mask=None, ports_delta=None,
+                    seed: int = 0, iters: int = CVX_ITERS,
+                    round_rounds: int = CVX_ROUND_ROUNDS,
+                    repair_rounds: int = CVX_REPAIR_ROUNDS,
+                    chunk: int = 512, device_state=None,
+                    aot_pending: bool = False, learned=None,
+                    aot_extra: tuple = (),
+                    compile_only: bool = False) -> "CvxResult | None":
+    """Host wrapper: PodBatch + NodeArrays in → async CvxResult out.
+
+    Shares `prepare_solve_args` with the greedy/pack paths (same dtype
+    views, same in-flight free/ports overlays, same node masking) so the
+    cvx arm can never see different cluster state than the plans it duels.
+    learned: the two-tower params pytree for the warm-started dual (pass
+    aot_extra=("policy", ckpt_hash) with it — a checkpoint swap must never
+    serve a stale compiled executable). Raises CvxUnsupported for batches
+    outside the model (locality, host ports, over-budget shapes).
+    compile_only=True builds/loads the executable and returns None (the
+    prewarm path)."""
+    if batch.locality is not None:
+        raise CvxUnsupported("locality batches take the greedy path")
+    if batch.g_ports.view(np.uint32).any():
+        raise CvxUnsupported("host-port batches take the greedy path")
+    np_args, static_kwargs = prepare_solve_args(
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
+        ports_delta=ports_delta, device_state=device_state,
+        allow_req_device=device_state is not None)
+    from yunikorn_tpu.ops.assign import SOLVE_ARG_NAMES
+
+    N = np_args[SOLVE_ARG_NAMES.index("req")].shape[0]
+    M = np_args[SOLVE_ARG_NAMES.index("free")].shape[0]
+    if not cvx_shape_supported(N, M):
+        raise CvxUnsupported(
+            f"shape ({N} pods, {M} nodes) exceeds the full-fleet cell "
+            "budget (the partitioned pack arm covers it)")
+    solve_args = jax.tree_util.tree_map(jnp.asarray, np_args)
+    learned_arg = (None if learned is None
+                   else jax.tree_util.tree_map(jnp.asarray, learned))
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    call_args = (*solve_args, jnp.int32(seed), learned_arg)
+    call_statics = dict(iters=iters, round_rounds=round_rounds,
+                        repair_rounds=repair_rounds, chunk=chunk,
+                        policy=policy,
+                        score_cols=static_kwargs["score_cols"])
+    if compile_only:
+        aot_rt.aot_compile("cvx.solve", cvx_solve, call_args, call_statics,
+                           extra=aot_extra)
+        return None
+    assigned, free_after, feasible = aot_rt.aot_call(
+        "cvx.solve", cvx_solve, call_args, call_statics,
+        pending_ok=aot_pending, extra=aot_extra)
+    return CvxResult(assigned=assigned, free_after=free_after,
+                     feasible=feasible, iters=iters, seed=seed,
+                     learned_dual=learned is not None)
+
+
+def jit_cache_entries() -> int:
+    """Compiled-variant count of the cvx entry point (compile-vs-cache-hit
+    telemetry, the ops.assign.jit_cache_entries convention)."""
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    try:
+        return cvx_solve._cache_size() + aot_rt.compile_count("cvx.")
+    except Exception:
+        return -1

@@ -44,6 +44,20 @@ NODE_AXIS = "nodes"
 # the same trace is pinned by tests/test_topology.py.
 PACK_SHARDED_SUPPORTED = True
 
+# Learned policy under a mesh (solver.policy=learned + shardSolve):
+# supported since round 19 — the two-tower params ride `solve_sharded`'s
+# learned tail replicated (tiny pytree; the per-round node-tower re-embed
+# is an [M_shard, F] matmul per chip, node-dim local), so sharded cycles
+# score instead of silently skipping (policy follow-up (c)).
+LEARNED_SHARDED_SUPPORTED = True
+
+# Cvx full-fleet arm under a mesh (solver.pack=cvx + shardSolve): the dense
+# [N, M] relaxation state shards along M like every node-dim tensor (X,
+# feasibility, soft scores all partition on the fleet axis; the row-simplex
+# projection's row reductions become ICI all-reduces), `cvx_solve_sharded`
+# below. Single-device parity is pinned by tests/test_cvx_solve.py.
+CVX_SHARDED_SUPPORTED = True
+
 # Host bytes of the pod-side (replicated) solve args assembled by the LAST
 # solve_sharded call. Node-side tensors ride the persistent device mirror
 # (DeviceNodeState tracks those uploads); the replicated pod batch re-ships
@@ -71,6 +85,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                   compile_only: bool = False,
                   max_batch: int = assign_mod.MAX_SOLVE_PODS,
                   device_state=None, aot_pending: bool = False,
+                  learned=None, aot_extra: tuple = (),
                   ) -> Optional[assign_mod.SolveResult]:
     """Like ops.assign.solve_batch but with node-dimension sharding over mesh.
 
@@ -82,6 +97,12 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     sharded program stays on the XLA path (no pallas): pallas_call under
     GSPMD auto-partitioning would need a shard_map wrapper, and the sharded
     argmax-over-M already reduces over ICI.
+
+    learned: the (params pytree, seed) tuple of the two-tower scorer —
+    replicated like the pod-side args (the params are KiB-scale; the
+    per-round node-tower matmuls stay node-dim local). Pass
+    aot_extra=("policy", ckpt_hash) with it so a checkpoint swap can never
+    serve a stale stored executable (the solve_batch contract).
     """
     na = node_arrays
     n_dev = mesh.devices.size
@@ -154,6 +175,15 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
                 put(a, repl) for a in topo[1:])
         return args, mask_arg, soft_arg, loc_arg, topo_arg
 
+    # learned tail, replicated (params leaves are tiny; the seed is a
+    # traced int32, reseeding never recompiles)
+    learned_arg = None
+    if learned is not None:
+        learned_arg = (
+            jax.tree_util.tree_map(lambda a: put(jnp.asarray(a), repl),
+                                   learned[0]),
+            put(jnp.asarray(learned[1], jnp.int32), repl))
+
     solve_kwargs = dict(
         max_rounds=max_rounds, chunk=min(chunk, min(N, mb)),
         policy=policy, has_loc_soft=static_kwargs["has_loc_soft"],
@@ -164,7 +194,7 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
     # the mesh tag keeps sharded programs in their own AOT-fingerprint space:
     # a single-device executable and a sharded one can share identical avals
     # (same shapes/dtypes) but are different compiled programs
-    aot_extra = ("mesh", n_dev)
+    aot_extra = ("mesh", n_dev) + tuple(aot_extra)
     if N > mb:
         # one compiled lax.scan program over [mb]-pod rank-ordered slices
         # (assign.solve_chunked) — same sharding layout, group state hoisted
@@ -175,13 +205,14 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
             if compile_only:
                 aot_rt.aot_compile(
                     "mesh.solve_chunked", assign_mod.solve_chunked,
-                    (*args, mask_arg, soft_arg, loc_arg, topo_arg), ck,
+                    (*args, mask_arg, soft_arg, loc_arg, topo_arg,
+                     learned_arg), ck,
                     extra=aot_extra, lower_cm=mesh)
                 return None
             assigned, around, free_after, rounds, _ = aot_rt.aot_call(
                 "mesh.solve_chunked", assign_mod.solve_chunked,
-                (*args, mask_arg, soft_arg, loc_arg, topo_arg), ck,
-                pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
+                (*args, mask_arg, soft_arg, loc_arg, topo_arg, learned_arg),
+                ck, pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
         if order is not None:
             assigned, around = assign_mod._unsort(order, assigned, around)
         return assign_mod.SolveResult(
@@ -193,13 +224,14 @@ def solve_sharded(batch, node_arrays, mesh: Mesh, *, max_rounds: int = 16,
         if compile_only:
             aot_rt.aot_compile(
                 "mesh.solve", assign_mod.solve,
-                (*args, mask_arg, soft_arg, loc_arg, topo_arg), solve_kwargs,
-                extra=aot_extra, lower_cm=mesh)
+                (*args, mask_arg, soft_arg, loc_arg, topo_arg, learned_arg),
+                solve_kwargs, extra=aot_extra, lower_cm=mesh)
             return None
         assigned, around, free_after, rounds, _ = aot_rt.aot_call(
             "mesh.solve", assign_mod.solve,
-            (*args, mask_arg, soft_arg, loc_arg, topo_arg), solve_kwargs,
-            pending_ok=aot_pending, extra=aot_extra, lower_cm=mesh)
+            (*args, mask_arg, soft_arg, loc_arg, topo_arg, learned_arg),
+            solve_kwargs, pending_ok=aot_pending, extra=aot_extra,
+            lower_cm=mesh)
     return assign_mod.SolveResult(assigned=assigned, free_after=free_after,
                                   rounds=rounds, accept_round=around)
 
@@ -282,6 +314,83 @@ def pack_solve_sharded(batch, node_arrays, mesh: Mesh, *,
     return pack_mod.PackResult(assigned=assigned, free_after=free_after,
                                feasible=feasible, n_parts=n_parts,
                                seed=seed, partitioner="topo")
+
+
+def cvx_solve_sharded(batch, node_arrays, mesh: Mesh, *,
+                      policy: str = "binpacking", free_delta=None,
+                      node_mask=None, ports_delta=None, seed: int = 0,
+                      chunk: int = 512, device_state=None,
+                      aot_pending: bool = False, learned=None,
+                      aot_extra: tuple = ()):
+    """Node-dimension sharded dispatch of ops.cvx_solve.cvx_solve.
+
+    Same layout contract as solve_sharded — pod/group args replicate,
+    node-side tensors shard along M. The full-fleet relaxation state X
+    [N, M] and the per-pod feasibility/soft gathers partition along the
+    node axis by GSPMD propagation (they derive from the [G, M]-sharded
+    group tensors); the row-simplex projection's row reductions and the
+    rounding's argmax-over-M become ICI all-reduces. learned: the
+    two-tower params pytree for the warm-started dual, replicated (pass
+    aot_extra=("policy", ckpt_hash) with it). Raises CvxUnsupported for
+    batches outside the model."""
+    from yunikorn_tpu.ops import cvx_solve as cvx_mod
+    from yunikorn_tpu.ops.assign import SOLVE_ARG_NAMES
+
+    if batch.locality is not None:
+        raise cvx_mod.CvxUnsupported("locality batches take the greedy path")
+    if batch.g_ports.view(np.uint32).any():
+        raise cvx_mod.CvxUnsupported("host-port batches take the greedy path")
+    n_dev = mesh.devices.size
+    np_args, static_kwargs = assign_mod.prepare_solve_args(
+        batch, node_arrays, free_delta=free_delta, node_mask=node_mask,
+        ports_delta=ports_delta, device_state=device_state,
+        allow_req_device=False)
+    N = np_args[SOLVE_ARG_NAMES.index("req")].shape[0]
+    M = np_args[SOLVE_ARG_NAMES.index("free")].shape[0]
+    if not cvx_mod.cvx_shape_supported(N, M):
+        raise cvx_mod.CvxUnsupported(
+            f"shape ({N} pods, {M} nodes) exceeds the full-fleet cell "
+            "budget (the partitioned pack arm covers it)")
+
+    node_s, node_s2, repl = _shardings(mesh)
+    group_node_s = NamedSharding(mesh, P(None, NODE_AXIS))
+    put = jax.device_put
+    (req, group_id, rank, valid, g_term_req, g_term_forb, g_term_valid,
+     g_anyof, g_anyof_valid, g_tol, g_ports, g_pref_req, g_pref_forb,
+     g_pref_weight, labels, taints_hard, taints_soft, ports, node_ok,
+     free_i, cap_i, host_mask, host_soft, loc, topo) = np_args
+    args = (
+        put(req, repl), put(group_id, repl), put(rank, repl),
+        put(valid, repl),
+        put(g_term_req, repl), put(g_term_forb, repl),
+        put(g_term_valid, repl), put(g_anyof, repl),
+        put(g_anyof_valid, repl), put(g_tol, repl), put(g_ports, repl),
+        put(g_pref_req, repl), put(g_pref_forb, repl),
+        put(g_pref_weight, repl),
+        put(labels, node_s2), put(taints_hard, node_s2),
+        put(taints_soft, node_s2), put(ports, node_s2),
+        put(node_ok, node_s), put(free_i, node_s2), put(cap_i, node_s2),
+        put(host_mask, group_node_s) if host_mask is not None else None,
+        put(host_soft, group_node_s) if host_soft is not None else None,
+        None,  # loc: gated above
+        ((put(topo[0], node_s),) + tuple(put(a, repl) for a in topo[1:])
+         if topo is not None else None),
+    )
+    learned_arg = (None if learned is None else jax.tree_util.tree_map(
+        lambda a: put(jnp.asarray(a), repl), learned))
+    from yunikorn_tpu.aot import runtime as aot_rt
+
+    with mesh:
+        assigned, free_after, feasible = aot_rt.aot_call(
+            "mesh.cvx_solve", cvx_mod.cvx_solve,
+            (*args, jnp.int32(seed), learned_arg),
+            dict(chunk=chunk, policy=policy,
+                 score_cols=static_kwargs["score_cols"]),
+            pending_ok=aot_pending, extra=("mesh", n_dev) + tuple(aot_extra),
+            lower_cm=mesh)
+    return cvx_mod.CvxResult(assigned=assigned, free_after=free_after,
+                             feasible=feasible, iters=cvx_mod.CVX_ITERS,
+                             seed=seed, learned_dual=learned is not None)
 
 
 def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int,

@@ -15,7 +15,7 @@ the solve.
 UNTRAINED-IS-INERT CONTRACT: `init_params` zero-initializes the pod tower's
 output layer, so an untrained net scores exactly 0.0 for every (pod, node)
 pair, and the solver's learned branch is arithmetically bit-identical to the
-greedy program (the gate in ops/assign._learned_proposals needs a strictly
+greedy program (the gate in ops/assign._learned_chunk_pass needs a strictly
 positive advantage, and the additive term is zero). A freshly-initialized or
 garbage-zero checkpoint therefore commits plans bit-identical to greedy —
 pinned by tests/test_policy.py.
@@ -75,7 +75,7 @@ def init_params(seed: int = 0, hidden: int = HIDDEN, emb: int = EMB) -> Dict:
                  lin(hidden, emb, 1.0 / np.sqrt(hidden))),
         # gumbel exploration temperature of the proposal override (spreads
         # proposals across equally-scored nodes instead of herding onto the
-        # lowest row index; ops/assign._learned_proposals)
+        # lowest row index; ops/assign._learned_chunk_pass)
         "tau": np.float32(0.25),
     }
 
