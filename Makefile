@@ -3,7 +3,7 @@
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke \
 	aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke \
-	failover-smoke \
+	failover-smoke trace-smoke \
 	smoke lint run-scheduler run-admission dryrun clean image \
 	sched_image adm_image webtest_image
 
@@ -164,7 +164,14 @@ failover-smoke:  ## shard failure domains + true fresh-process restart: the chao
 		--takeover-window 25 --aot-store /tmp/yk_failover_store \
 		--slo-cold-budget-ms 120000 --assert-slo
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke  ## all tier-1 smoke targets
+trace-smoke:  ## fleet flight recorder (round 20): fleet-trace/journey/recorder unit suites, then the end-to-end acceptance — a 4-shard gang-storm with shard 1 killed mid-storm must export ONE merged Chrome trace (>= 5 pids, Perfetto-valid), a journey for every bound pod whose stage sum tiles its e2e latency within 5%, and exactly one quarantine bundle holding the dead shard's final cycle spans; then a hang-fault run must fire exactly one slo_violation bundle that round-trips
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_fleettrace.py tests/test_flightrec.py \
+		-q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_smoke.py
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke trace-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

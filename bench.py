@@ -132,6 +132,9 @@ def _backend_unavailable_json(error: str, init_secs: float) -> str:
         "policy": {"active": "greedy", "checkpoint_hash": "",
                    "checkpoint_epoch": 0, "duels": {}, "duel_wins": {},
                    "last_inference_ms": 0.0},
+        "trace": {"spans_by_stage": {}, "journeys": 0,
+                  "journey_complete_ratio": 1.0, "recordings": 0,
+                  "recordings_by_trigger": {}},
     })
 
 
@@ -427,6 +430,31 @@ def _topology_block(core) -> dict:
         return {"mode": "error", "error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _trace_block(core) -> dict:
+    """Observability evidence for the bench JSON (round 20): span counts
+    by stage from the tracer (the fleet-merged one when sharded), the
+    journey-complete ratio from the per-pod journey ledger, and how many
+    flight-recorder bundles fired this run. Same contract as
+    _slo_block/_topology_block: present in every JSON shape (incl.
+    backend-unavailable), carrying the error instead of fabricated
+    zeros when the evidence path breaks."""
+    try:
+        by_stage: dict = {}
+        for s in core.tracer.spans(pods=True):
+            by_stage[s.name] = by_stage.get(s.name, 0) + 1
+        j = core.journey.stats()
+        fr = core.flightrec.stats()
+        return {
+            "spans_by_stage": by_stage,
+            "journeys": j["admitted"],
+            "journey_complete_ratio": j["complete_ratio"],
+            "recordings": fr["recordings"],
+            "recordings_by_trigger": fr["by_trigger"],
+        }
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _duel_wins(core) -> dict:
     """Committed-plan mix by winning arm (duel_wins_total{arm}): one count
     per duel CYCLE, unlike policy_duels_total's per-participant rows."""
@@ -648,7 +676,8 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
         return (stats.throughput(), wall, stats.success_count, len(pods),
                 _preempt_stat(ms.core), _degradations(ms.core),
                 _cycle_stats(ms.core), _slo_block(ms.core),
-                _topology_block(ms.core), _policy_block(ms.core))
+                _topology_block(ms.core), _policy_block(ms.core),
+                _trace_block(ms.core))
     finally:
         ms.stop()
 
@@ -803,6 +832,7 @@ def main() -> int:
         "slo": _slo_block(core),
         "topology": _topology_block(core),
         "policy": _policy_block(core),
+        "trace": _trace_block(core),
     }
 
     if MODE == "both":
@@ -828,7 +858,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
     shim e2e rides along; standalone shim mode publishes the shim number."""
     (shim_tp, shim_wall, bound, total, shim_preempt_ms, shim_degr,
      shim_cycle_stats, shim_slo, shim_topo,
-     shim_policy) = run_shim_mode(N_PODS, N_NODES)
+     shim_policy, shim_trace) = run_shim_mode(N_PODS, N_NODES)
     print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
           f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
     if core_pods_per_s is None:
@@ -847,6 +877,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             "slo": shim_slo,
             "topology": shim_topo,
             "policy": shim_policy,
+            "trace": shim_trace,
         }
     return {
         "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
@@ -874,6 +905,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         "slo": shim_slo,
         "topology": shim_topo,
         "policy": shim_policy,
+        "trace": shim_trace,
     }
 
 

@@ -90,6 +90,10 @@ def main() -> int:
             "yunikorn_slo_violations_total",
             "yunikorn_slo_verdict",
             "yunikorn_slo_objective_value",
+            "yunikorn_journey_stage_ms",
+            "yunikorn_journey_completed_total",
+            "yunikorn_journey_terminal_total",
+            "yunikorn_flight_recordings_total",
         ))
         fams = parse_exposition(text)
         # the slo_* series must carry the declared TYPEs and labels (a
@@ -106,6 +110,26 @@ def main() -> int:
                 errors.append(f"{name}: TYPE {fam.kind!r}, expected {kind!r}")
             if not all(s.labels.get("objective") for s in fam.samples):
                 errors.append(f"{name}: samples missing the objective label")
+        # round-20 journey/flight-recorder families: declared TYPEs (the
+        # Grafana row's histogram_quantile/rate() rules depend on them)
+        for name, kind in (
+                ("yunikorn_journey_stage_ms", "histogram"),
+                ("yunikorn_journey_completed_total", "counter"),
+                ("yunikorn_journey_terminal_total", "counter"),
+                ("yunikorn_flight_recordings_total", "counter")):
+            fam = fams.get(name)
+            if fam is None:
+                continue  # missing already reported by `required` above
+            if fam.kind != kind:
+                errors.append(f"{name}: TYPE {fam.kind!r}, expected {kind!r}")
+        jterm = fams.get("yunikorn_journey_terminal_total")
+        if jterm and not all(s.labels.get("outcome") for s in jterm.samples):
+            errors.append("journey_terminal_total: samples missing the "
+                          "outcome label")
+        frec = fams.get("yunikorn_flight_recordings_total")
+        if frec and not all(s.labels.get("trigger") for s in frec.samples):
+            errors.append("flight_recordings_total: samples missing the "
+                          "trigger label")
         burn = fams.get("yunikorn_slo_burn_rate")
         if burn:
             windows = {s.labels.get("window") for s in burn.samples}

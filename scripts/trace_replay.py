@@ -308,6 +308,7 @@ class ReplayStack:
         from yunikorn_tpu.core.scheduler import SolverOptions
         from yunikorn_tpu.core.shard import make_core_scheduler
         from yunikorn_tpu.dispatcher import dispatcher as dispatch_mod
+        from yunikorn_tpu.obs.flightrec import FlightRecorderOptions
         from yunikorn_tpu.obs.slo import SloOptions
         from yunikorn_tpu.robustness.failover import FailoverOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
@@ -327,7 +328,9 @@ class ReplayStack:
             solver_options=SolverOptions.from_conf(conf),
             supervisor_options=SupervisorOptions.from_conf(conf),
             slo_options=SloOptions.from_conf(conf),
-            failover_options=FailoverOptions.from_conf(conf))
+            failover_options=FailoverOptions.from_conf(conf),
+            journey_capacity=conf.obs_journey_capacity,
+            flightrec_options=FlightRecorderOptions.from_conf(conf))
         if self.recorder is not None:
             target = getattr(self.core, "primary", self.core)
             if hasattr(target, "policy_recorder"):
@@ -670,6 +673,16 @@ def run_replay(args, policy: str) -> dict:
         "robustness.failoverProbeSeconds": str(args.failover_probe),
         "robustness.failoverRejoinSeconds": str(args.failover_rejoin),
     }
+    if args.flightrec_dir:
+        # triggered flight recorder (round 20): SLO violations, shard
+        # quarantines, breaker exhaustion and watchdog abandonment each
+        # dump a bounded post-mortem bundle into this dir mid-replay. The
+        # debounce outlives the run: one bundle per trigger per replay
+        # (the first edge is the evidence; repeats within a run are the
+        # same incident)
+        conf_map["observability.flightRecorderDir"] = args.flightrec_dir
+        conf_map["observability.flightRecorderDebounceSeconds"] = str(
+            args.duration * 2 + args.drain_timeout + 600)
     if args.policy_checkpoint:
         # learned-policy checkpoint (round 17): only the learned arm
         # dispatches it, but the conf rides every arm so the A/B replays
@@ -1022,6 +1035,50 @@ def run_replay(args, policy: str) -> dict:
         timings["bound_e2e_observations"] = (
             e2e.child_state()[0] if e2e is not None else 0)
 
+        # ---- tracing block (round 20): merged chrome trace export,
+        # journey-ledger audit, flight-recorder tally. Stable booleans in
+        # the fingerprint; span/journey COUNTS are cycle-batching-
+        # dependent and ride `timings` ----
+        trace_doc = core.tracer.chrome_trace()
+        if args.trace_out:
+            with open(args.trace_out, "w") as f:
+                json.dump(trace_doc, f)
+            print(f"[replay] merged chrome trace written to "
+                  f"{args.trace_out} ({len(trace_doc['traceEvents'])} "
+                  "events)", file=sys.stderr, flush=True)
+        spans_by_stage: Dict[str, int] = {}
+        for ev in trace_doc["traceEvents"]:
+            if ev.get("ph") == "X":
+                spans_by_stage[ev["name"]] = \
+                    spans_by_stage.get(ev["name"], 0) + 1
+        jstats = core.journey.stats()
+        # every bound trace pod must have a COMPLETE journey whose stage
+        # sum tiles its measured e2e latency (the exactness contract);
+        # verified in-process over the whole retained tail
+        worst_err, checked = 0.0, 0
+        for j in core.journey.tail(max(len(want), 64)):
+            if j.get("outcome") != "bound" or not j.get("e2e_ms"):
+                continue
+            checked += 1
+            err = (abs(sum(j["stages_ms"].values()) - j["e2e_ms"])
+                   / j["e2e_ms"])
+            worst_err = max(worst_err, err)
+        frstats = core.flightrec.stats()
+        tracing_block = {
+            "trace_out": bool(args.trace_out),
+            "flightrec_enabled": bool(frstats["enabled"]),
+            "journeys_bound_complete": bool(
+                jstats["completed"] >= len(want & bound)),
+            "stage_sum_within_5pct": bool(checked and worst_err <= 0.05),
+        }
+        timings["tracing"] = {
+            "spans_by_stage": spans_by_stage,
+            "journey": jstats,
+            "journeys_checked": checked,
+            "stage_sum_worst_err": round(worst_err, 6),
+            "recordings_by_trigger": frstats["by_trigger"],
+        }
+
         violated = sorted(n for n, c in violations.items() if c)
         all_bound = want <= bound
         # the fresh-process restart is part of the run's pass verdict: a
@@ -1069,6 +1126,9 @@ def run_replay(args, policy: str) -> dict:
                 "process_restart": process_block,
                 "topology": topo_block,
                 "shards": shard_block,
+                # `trace` above is the trace NAME; this is the round-20
+                # observability block (merged export + journey audit)
+                "tracing": tracing_block,
                 # the learned-policy hash makes A/B reports seed-
                 # reproducible ACROSS checkpoints (two runs only
                 # fingerprint-match when the same params served); duel
@@ -1226,6 +1286,15 @@ def main() -> int:
     ap.add_argument("--drain-timeout", type=float, default=180.0)
     ap.add_argument("--report", default="",
                     help="write the replay report JSON here")
+    ap.add_argument("--trace-out", default="",
+                    help="write the merged Chrome trace JSON here (the "
+                         "fleet export: one pid per shard plus the front-"
+                         "end lane; open in Perfetto)")
+    ap.add_argument("--flightrec-dir", default="",
+                    help="enable the triggered flight recorder "
+                         "(observability.flightRecorderDir) — SLO "
+                         "violations / quarantines / breaker exhaustion "
+                         "dump bounded post-mortem bundles here mid-run")
     ap.add_argument("--assert-slo", action="store_true",
                     help="exit nonzero (naming the objectives) unless the "
                          "run passes: every pod bound, zero violations")

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Fleet flight-recorder smoke (round 20): the end-to-end acceptance run.
+
+Two seeded replays through scripts/trace_replay.py, asserted from their
+artifacts (report JSON, merged Chrome trace, flight-recorder bundles):
+
+1. A 4-shard gang-storm with shard 1 killed mid-storm must produce
+   - ONE merged Chrome trace (--trace-out) that is valid trace-event
+     JSON: >= 5 pids (4 shard lanes + the front-end lane), every
+     metadata event before every data event, a process_name for every
+     pid and a thread_name for every (pid, tid) that carries data —
+     i.e. the file Perfetto loads without complaint;
+   - a journey record for every bound pod whose stage sum tiles the
+     measured e2e latency within 5% (the report's tracing block
+     asserts it in-process over the full tail);
+   - EXACTLY one quarantine-triggered bundle whose dead_shard_trace.json
+     holds the dead shard's final cycle spans on the dead shard's pid.
+
+2. A hang-fault run (--expect-violation) must fire EXACTLY one
+   slo_violation bundle, and that bundle must round-trip: manifest.json
+   parses, and every file the manifest lists parses as JSON.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPLAY = os.path.join(REPO, "scripts", "trace_replay.py")
+FRONT_PID = 1  # obs/trace.py: shard k exports on pid FRONT_PID + 1 + k
+
+
+def _run(args, timeout=1200):
+    cmd = [sys.executable, REPLAY] + args
+    print(f"[trace-smoke] $ {' '.join(cmd)}", file=sys.stderr, flush=True)
+    return subprocess.run(cmd, timeout=timeout).returncode
+
+
+def _fail(msg: str) -> None:
+    print(f"[trace-smoke] FAIL: {msg}", file=sys.stderr, flush=True)
+    sys.exit(1)
+
+
+def _check_chrome_trace(path: str, min_pids: int) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    evs = doc.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        _fail(f"{path}: no traceEvents")
+    metas = [i for i, e in enumerate(evs) if e.get("ph") == "M"]
+    datas = [i for i, e in enumerate(evs) if e.get("ph") != "M"]
+    if not datas:
+        _fail(f"{path}: metadata only, no data events")
+    if max(metas) > min(datas):
+        _fail(f"{path}: metadata event after a data event (Perfetto "
+              "names tracks from metadata seen BEFORE the data)")
+    pids = {e["pid"] for e in evs}
+    if len(pids) < min_pids:
+        _fail(f"{path}: {len(pids)} pids {sorted(pids)} < {min_pids} "
+              "(expected one per shard + the front-end lane)")
+    named = {e["pid"] for e in evs
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    if pids - named:
+        _fail(f"{path}: pids without process_name metadata: "
+              f"{sorted(pids - named)}")
+    tid_named = {(e["pid"], e.get("tid")) for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    data_lanes = {(e["pid"], e.get("tid")) for e in evs
+                  if e.get("ph") == "X"}
+    if data_lanes - tid_named:
+        _fail(f"{path}: data lanes without thread_name metadata: "
+              f"{sorted(data_lanes - tid_named)}")
+    for e in evs:
+        if e.get("ph") == "X" and (e.get("ts") is None
+                                   or e.get("dur", -1) < 0):
+            _fail(f"{path}: malformed complete event {e}")
+    return doc
+
+
+def _bundles(d: str, trigger: str):
+    return sorted(b for b in os.listdir(d)
+                  if b.startswith("rec-") and b.endswith("-" + trigger))
+
+
+def _check_bundle_roundtrip(path: str) -> dict:
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    for fname in manifest.get("files", []):
+        with open(os.path.join(path, fname)) as f:
+            json.load(f)
+    return manifest
+
+
+def main() -> int:
+    t0 = time.time()
+    work = tempfile.mkdtemp(prefix="yk_trace_smoke_")
+    trace_out = os.path.join(work, "fleet_trace.json")
+    report_a = os.path.join(work, "report_kill.json")
+    report_b = os.path.join(work, "report_hang.json")
+    frec_a = os.path.join(work, "frec_kill")
+    frec_b = os.path.join(work, "frec_hang")
+    os.makedirs(frec_a)
+    os.makedirs(frec_b)
+    try:
+        # ---- run 1: 4 shards, kill shard 1 mid-storm ----
+        rc = _run(["--trace", "gang-storm", "--nodes", "400",
+                   "--pods", "320", "--tenants", "4", "--duration", "12",
+                   "--shards", "4", "--kill-shard", "1",
+                   "--failover-stale", "30", "--failover-probe", "0.3",
+                   "--assert-failover",
+                   "--trace-out", trace_out, "--flightrec-dir", frec_a,
+                   "--report", report_a])
+        if rc != 0:
+            _fail(f"kill-shard replay exited {rc}")
+        with open(report_a) as f:
+            rep = json.load(f)
+        tracing = rep["fingerprint"]["tracing"]
+        if not tracing["flightrec_enabled"]:
+            _fail("flight recorder disabled in replay despite "
+                  "--flightrec-dir (conf wiring broke)")
+        if not tracing["journeys_bound_complete"]:
+            _fail(f"journey ledger incomplete: "
+                  f"{rep['timings'].get('tracing')}")
+        if not tracing["stage_sum_within_5pct"]:
+            _fail(f"journey stage sums do not tile the e2e latency: "
+                  f"{rep['timings'].get('tracing')}")
+
+        doc = _check_chrome_trace(trace_out, min_pids=5)
+        dead_pid = FRONT_PID + 1 + 1  # shard 1's stable lane
+        front_names = {e["name"] for e in doc["traceEvents"]
+                       if e.get("ph") == "X" and e["pid"] == FRONT_PID}
+        if "route" not in front_names:
+            _fail(f"front-end lane has no route spans (got "
+                  f"{sorted(front_names)})")
+
+        quar = _bundles(frec_a, "quarantine")
+        if len(quar) != 1:
+            _fail(f"expected exactly 1 quarantine bundle, got {quar}")
+        bundle = os.path.join(frec_a, quar[0])
+        manifest = _check_bundle_roundtrip(bundle)
+        if "dead_shard_trace.json" not in manifest.get("files", []):
+            _fail(f"quarantine bundle missing dead_shard_trace.json: "
+                  f"{manifest.get('files')}")
+        with open(os.path.join(bundle, "dead_shard_trace.json")) as f:
+            dead = json.load(f)
+        devs = [e for e in dead["traceEvents"] if e.get("ph") == "X"]
+        if not devs:
+            _fail("dead_shard_trace.json holds no spans — the freeze "
+                  "must run BEFORE the engine detaches")
+        wrong = {e["pid"] for e in devs} - {dead_pid}
+        if wrong:
+            _fail(f"dead shard spans on wrong pids {wrong} "
+                  f"(expected {dead_pid})")
+        print(f"[trace-smoke] kill-shard run OK: trace "
+              f"{len(doc['traceEvents'])} events / "
+              f"{len({e['pid'] for e in doc['traceEvents']})} pids, "
+              f"dead-shard snapshot {len(devs)} spans, journeys exact",
+              file=sys.stderr, flush=True)
+
+        # ---- run 2: hang fault -> exactly one slo_violation bundle ----
+        rc = _run(["--trace", "gang-storm", "--nodes", "400",
+                   "--pods", "320", "--tenants", "4", "--duration", "12",
+                   "--fault", "hang", "--slo-staleness", "4",
+                   "--expect-violation",
+                   "--flightrec-dir", frec_b, "--report", report_b])
+        if rc != 0:
+            _fail(f"hang-fault replay exited {rc}")
+        slo = _bundles(frec_b, "slo_violation")
+        if len(slo) != 1:
+            _fail(f"expected exactly 1 slo_violation bundle, got {slo} "
+                  f"(all: {sorted(os.listdir(frec_b))})")
+        manifest = _check_bundle_roundtrip(os.path.join(frec_b, slo[0]))
+        if "slo_violation" not in manifest.get("trigger", ""):
+            _fail(f"bundle manifest trigger {manifest.get('trigger')!r}")
+        for want in ("trace.json", "metrics.json", "journeys.json"):
+            if want not in manifest.get("files", []):
+                _fail(f"slo_violation bundle missing {want}: "
+                      f"{manifest.get('files')}")
+        print(f"[trace-smoke] hang-fault run OK: one slo_violation "
+              f"bundle ({len(manifest['files'])} files), round-trips",
+              file=sys.stderr, flush=True)
+
+        print(f"trace-smoke OK in {time.time() - t0:.1f}s: merged fleet "
+              "trace valid, journeys exact, quarantine + slo_violation "
+              "bundles fired exactly once each and round-trip",
+              flush=True)
+        return 0
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

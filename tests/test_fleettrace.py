@@ -1,0 +1,227 @@
+"""Fleet trace correlation + per-pod journey ledger (round 20).
+
+Covers the tentpole contracts: the two-ring tracer's eviction isolation
+(a bind storm must never evict the cycle skeleton), the pid-parameterized
+Chrome export, the FleetTracer merge (shared epoch, one pid per shard,
+meta-before-data — the Perfetto-loadability fixture), the freeze/replace
+lifecycle the quarantine path depends on, and the journey ledger's
+exactness invariant (stage durations tile the measured e2e latency)."""
+import json
+
+from yunikorn_tpu.obs.journey import JourneyLedger
+from yunikorn_tpu.obs.metrics import MetricsRegistry
+from yunikorn_tpu.obs.trace import FRONT_PID, CycleTracer, FleetTracer
+
+T0 = 1_700_000_000.0  # fixed wall-clock base: spans are pure arithmetic
+
+
+# ---------------------------------------------------------------- two rings
+def test_pod_storm_never_evicts_cycle_spans():
+    """10k bind spans against a small tracer: the pod ring wraps, the
+    cycle skeleton survives untouched (the round-14 two-ring contract)."""
+    tr = CycleTracer(capacity=64, pod_capacity=128)
+    for c in range(10):
+        tr.add("gate", c, T0 + c, T0 + c + 0.001)
+        tr.add("solve", c, T0 + c + 0.001, T0 + c + 0.002)
+    for i in range(10_000):
+        tr.add_pod("bind", 0, T0 + i * 1e-4, T0 + i * 1e-4 + 1e-5)
+    cyc = tr.spans(pods=False)
+    assert len(cyc) == 20  # every cycle span still present
+    assert {s.name for s in cyc} == {"gate", "solve"}
+    assert len(tr.spans(pods=True)) == 20 + 128  # pod ring capped
+
+
+def test_chrome_trace_pid_parameterized():
+    """pid/process_name are caller-chosen (pre-round-20 both were
+    hardcoded to pid=1, so two tracers' exports collided)."""
+    tr = CycleTracer()
+    tr.add("gate", 1, T0, T0 + 0.01)
+    doc = tr.chrome_trace(pid=7, process_name="shard 6")
+    evs = doc["traceEvents"]
+    assert {e["pid"] for e in evs} == {7}
+    pn = [e for e in evs if e.get("name") == "process_name"]
+    assert pn and pn[0]["args"]["name"] == "shard 6"
+
+
+# ------------------------------------------------------------- fleet merge
+def _fleet_with_work():
+    fleet = FleetTracer()
+    shards = [CycleTracer() for _ in range(4)]
+    for k, tr in enumerate(shards):
+        fleet.register(k, tr, name=f"shard {k}")
+        # staggered work: shard k's cycle starts k*10ms after shard 0's
+        tr.add("gate", 1, T0 + k * 0.01, T0 + k * 0.01 + 0.002)
+        tr.add("solve", 1, T0 + k * 0.01 + 0.002, T0 + k * 0.01 + 0.005)
+        tr.add_pod("bind", 1, T0 + k * 0.01 + 0.006, T0 + k * 0.01 + 0.007)
+    fleet.add("route", 0, T0 - 0.002, T0 - 0.001, asks=8)
+    return fleet, shards
+
+
+def test_fleet_merge_is_valid_chrome_trace():
+    """The Perfetto-loadability fixture: merged export round-trips JSON,
+    every metadata event precedes every data event, every pid carries a
+    process_name, every data (pid, tid) lane carries a thread_name."""
+    fleet, _ = _fleet_with_work()
+    doc = json.loads(json.dumps(fleet.chrome_trace()))
+    evs = doc["traceEvents"]
+    metas = [i for i, e in enumerate(evs) if e["ph"] == "M"]
+    datas = [i for i, e in enumerate(evs) if e["ph"] != "M"]
+    assert max(metas) < min(datas)
+    # one pid per shard plus the front-end lane
+    assert {e["pid"] for e in evs} == {FRONT_PID, 2, 3, 4, 5}
+    named = {e["pid"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {e["pid"] for e in evs} <= named
+    lanes = {(e["pid"], e["tid"]) for e in evs if e["ph"] == "X"}
+    tnamed = {(e["pid"], e["tid"]) for e in evs
+              if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert lanes <= tnamed
+    assert all(e["dur"] >= 0 for e in evs if e["ph"] == "X")
+
+
+def test_fleet_merge_shares_one_epoch():
+    """Every source subtracts the SAME epoch: shard 3's gate starts 30ms
+    (in trace µs) after shard 0's, and the front-end route span — the
+    earliest span — sits at ts 0."""
+    fleet, _ = _fleet_with_work()
+    evs = fleet.chrome_trace()["traceEvents"]
+    by = {(e["pid"], e["name"]): e["ts"] for e in evs if e["ph"] == "X"}
+    assert by[(FRONT_PID, "route")] == 0.0
+    assert abs((by[(5, "gate")] - by[(2, "gate")]) - 30_000) < 1.0
+    # data events arrive timeline-sorted (a merged trace is a timeline,
+    # not a concatenation)
+    ts = [e["ts"] for e in evs if e["ph"] == "X"]
+    assert ts == sorted(ts)
+
+
+def test_fleet_window_bounds_export():
+    """window_s drops spans that ended before the window — the flight
+    recorder's bounded-bundle contract."""
+    import time
+
+    fleet = FleetTracer()
+    tr = CycleTracer()
+    fleet.register(0, tr)
+    now = time.time()
+    tr.add("gate", 1, now - 3600, now - 3599)   # an hour stale
+    tr.add("solve", 2, now - 1.0, now - 0.5)    # fresh
+    names = {e["name"] for e in fleet.chrome_trace(window_s=30)
+             ["traceEvents"] if e["ph"] == "X"}
+    assert names == {"solve"}
+
+
+def test_fleet_freeze_and_replace():
+    """The quarantine lifecycle: freeze(k) snapshots the dying shard's
+    rings (zombie writes after the freeze are dropped), replace(k)
+    re-points the SAME pid at a rebuilt core's tracer on rejoin."""
+    fleet = FleetTracer()
+    tr = CycleTracer()
+    fleet.register(1, tr, name="shard 1")
+    tr.add("gate", 7, T0, T0 + 0.01)
+    frozen = fleet.freeze(1)
+    assert [s.name for s in frozen.spans()] == ["gate"]
+    tr.add("solve", 8, T0 + 1, T0 + 2)  # the zombie unwedges and writes
+    assert [s.name for s in fleet.spans()] == ["gate"]  # not merged
+    # freeze is idempotent (re-entered quarantine paths)
+    assert fleet.freeze(1) is frozen
+    dead_pid = FRONT_PID + 1 + 1
+    doc = frozen.chrome_trace(pid=dead_pid, process_name="shard 1 (dead)")
+    assert {e["pid"] for e in doc["traceEvents"]} == {dead_pid}
+    # rejoin: a rebuilt core's tracer takes the lane back over
+    tr2 = CycleTracer()
+    tr2.add("gate", 9, T0 + 5, T0 + 5.01)
+    fleet.register(1, tr2, name="shard 1")
+    assert [s.cycle_id for s in fleet.spans()] == [9]
+
+
+# ----------------------------------------------------------------- journey
+def test_journey_stage_sum_tiles_e2e_exactly():
+    """The exactness invariant: four stage durations, five marks, their
+    sum IS bound - admitted (same clock readings, no sampling)."""
+    j = JourneyLedger()
+    j.admit(["p1"], T0, shard="0")
+    j.mark(["p1"], "gated", T0 + 0.004, gate_path="device")
+    j.mark(["p1"], "solved", T0 + 0.010, arm="greedy")
+    j.mark(["p1"], "committed", T0 + 0.011)
+    j.bound("p1", T0 + 0.020)
+    rec = j.get("p1")
+    assert rec["outcome"] == "bound"
+    # the marks telescope: the only slack is the 6-decimal rounding of
+    # each stage (sub-nanosecond) — never a sampling gap
+    assert abs(sum(rec["stages_ms"].values()) - rec["e2e_ms"]) < 1e-5
+    want = {"gated": 4.0, "solved": 6.0, "committed": 1.0, "bound": 9.0}
+    assert set(rec["stages_ms"]) == set(want)
+    assert all(abs(rec["stages_ms"][k] - v) < 1e-3
+               for k, v in want.items())
+    assert rec["attrs"]["gate_path"] == "device"
+
+
+def test_journey_missing_marks_fold_into_next_stage():
+    """A pinned ask that bypassed gate+solve still tiles exactly — the
+    absent stages fold into the next present one."""
+    j = JourneyLedger()
+    j.admit(["p2"], T0)
+    j.mark(["p2"], "committed", T0 + 0.006)
+    j.bound("p2", T0 + 0.010)
+    rec = j.get("p2")
+    assert set(rec["stages_ms"]) == {"committed", "bound"}
+    assert abs(rec["stages_ms"]["committed"] - 6.0) < 1e-3
+    assert abs(rec["stages_ms"]["bound"] - 4.0) < 1e-3
+    assert abs(sum(rec["stages_ms"].values()) - rec["e2e_ms"]) < 1e-5
+
+
+def test_journey_readmit_resets_uncommitted():
+    """A repair migration re-admits the ask: the admitted mark resets
+    (the e2e span restarts at re-submission) and the detour stays
+    attributable via hops; committed journeys are immutable."""
+    j = JourneyLedger()
+    j.admit(["p3"], T0, shard="1")
+    j.mark(["p3"], "gated", T0 + 0.001)
+    j.annotate("p3", hop="repaired:s1->s2")
+    j.admit(["p3"], T0 + 0.5, shard="2")
+    j.mark(["p3"], "gated", T0 + 0.504)
+    j.bound("p3", T0 + 0.510)
+    rec = j.get("p3")
+    assert rec["marks"]["admitted"] == round(T0 + 0.5, 6)
+    assert "repaired:s1->s2" in rec["hops"]
+    assert any(h.startswith("readmitted") for h in rec["hops"])
+    assert abs(sum(rec["stages_ms"].values()) - rec["e2e_ms"]) < 1e-5
+    # bound == committed-equivalent: a late re-admit must not reset it
+    j.admit(["p3"], T0 + 9.0)
+    assert j.get("p3")["marks"]["admitted"] == round(T0 + 0.5, 6)
+
+
+def test_journey_skipped_then_bound_recovers():
+    """skipped_fleetwide is terminal-for-now, not forever: a bind after
+    the repair cooldown completes the journey, keeping the skip in hops."""
+    j = JourneyLedger()
+    j.admit(["p4"], T0)
+    j.terminal("p4", "skipped_fleetwide")
+    assert j.get("p4")["outcome"] == "skipped_fleetwide"
+    j.bound("p4", T0 + 2.0)
+    rec = j.get("p4")
+    assert rec["outcome"] == "bound"
+    assert "recovered:skipped_fleetwide" in rec["hops"]
+    # and a preemption of the BOUND pod rides hops, not the outcome
+    j.terminal("p4", "preempted")
+    assert j.get("p4")["outcome"] == "bound"
+
+
+def test_journey_bounded_capacity_and_metrics():
+    """The ledger is bounded (oldest evicted past the cap, floor 64) and
+    feeds the exact journey_stage_ms / terminal counter families."""
+    m = MetricsRegistry()
+    j = JourneyLedger(capacity=10, registry=m)  # clamps to the 64 floor
+    j.admit([f"p{i}" for i in range(100)], T0)
+    assert j.stats()["evicted"] == 36 and j.stats()["open"] == 64
+    j.mark(["p99"], "gated", T0 + 0.002)
+    j.bound("p99", T0 + 0.005)
+    j.terminal("p50", "preempted")
+    assert m.get("journey_completed_total").value() == 1
+    assert m.get("journey_terminal_total").value(outcome="bound") == 1
+    assert m.get("journey_terminal_total").value(outcome="preempted") == 1
+    # stable zero series for dashboards
+    assert m.get("journey_terminal_total").value(
+        outcome="skipped_fleetwide") == 0
+    n, total = m.get("journey_stage_ms").child_state(stage="gated")[:2]
+    assert n == 1 and abs(total - 2.0) < 1e-3

@@ -1,0 +1,133 @@
+"""Triggered flight recorder (round 20): bundle atomicity, the capped
+ring, per-trigger debounce, staged pre-trigger evidence, per-source error
+capture, reentrancy, and the metrics family."""
+import json
+import os
+import threading
+
+from yunikorn_tpu.obs.flightrec import (TRIGGERS, FlightRecorder,
+                                        FlightRecorderOptions)
+from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+
+def _rec(tmp_path, **kw):
+    opts = FlightRecorderOptions(dir=str(tmp_path), **kw)
+    return FlightRecorder(opts)
+
+
+def test_disabled_recorder_never_touches_disk(tmp_path):
+    fr = FlightRecorder(FlightRecorderOptions(dir=""))
+    fr.add_source("x", lambda: {"a": 1})
+    assert fr.record("manual", force=True) is None
+    assert fr.list_recordings() == []
+    assert fr.stats()["enabled"] is False
+
+
+def test_bundle_contents_and_manifest(tmp_path):
+    fr = _rec(tmp_path)
+    fr.add_source("metrics", lambda: {"pods": 3})
+    fr.stage("dead_shard_trace", {"traceEvents": []})
+    path = fr.record("quarantine", reason="shard 1: wedged")
+    assert path is not None and os.path.basename(path).endswith("quarantine")
+    with open(os.path.join(path, "manifest.json")) as f:
+        m = json.load(f)
+    assert m["trigger"] == "quarantine"
+    assert m["reason"] == "shard 1: wedged"
+    assert sorted(m["files"]) == ["dead_shard_trace.json", "metrics.json"]
+    assert m["source_errors"] == {}
+    with open(os.path.join(path, "dead_shard_trace.json")) as f:
+        assert json.load(f) == {"traceEvents": []}
+    # staged evidence is consumed: the next bundle must not re-carry it
+    p2 = fr.record("manual", force=True)
+    with open(os.path.join(p2, "manifest.json")) as f:
+        assert "dead_shard_trace.json" not in json.load(f)["files"]
+
+
+def test_debounce_one_bundle_per_window_and_force(tmp_path):
+    fr = _rec(tmp_path, debounce_s=3600.0)
+    assert fr.record("slo_violation") is not None
+    # a violation storm within the window yields ONE bundle
+    assert fr.record("slo_violation") is None
+    assert fr.stats()["debounced"] == 1
+    # independent triggers debounce independently
+    assert fr.record("quarantine") is not None
+    # manual/REST dumps bypass the debounce
+    assert fr.record("manual", force=True) is not None
+    assert fr.record("manual", force=True) is not None
+    assert fr.stats()["by_trigger"] == {"slo_violation": 1, "quarantine": 1,
+                                        "manual": 2}
+
+
+def test_ring_prunes_oldest_past_cap(tmp_path):
+    fr = _rec(tmp_path, max_recordings=2)
+    for _ in range(4):
+        assert fr.record("manual", force=True) is not None
+    recs = sorted(d for d in os.listdir(tmp_path) if d.startswith("rec-"))
+    assert recs == ["rec-0003-manual", "rec-0004-manual"]  # newest two
+    assert all(not d.startswith(".tmp") for d in os.listdir(tmp_path))
+
+
+def test_failing_source_recorded_not_fatal(tmp_path):
+    fr = _rec(tmp_path)
+    fr.add_source("good", lambda: {"ok": True})
+    fr.add_source("bad", lambda: 1 / 0)
+    path = fr.record("breaker_exhausted", reason="path device")
+    assert path is not None
+    with open(os.path.join(path, "manifest.json")) as f:
+        m = json.load(f)
+    assert m["files"] == ["good.json"]
+    assert "ZeroDivisionError" in m["source_errors"]["bad"]
+
+
+def test_reentrant_trigger_from_source_noops(tmp_path):
+    """A bundle source that re-enters record() (metrics snapshot -> SLO
+    tick -> fresh violation edge) must no-op, not deadlock or recurse."""
+    fr = _rec(tmp_path)
+    inner = []
+
+    def source():
+        inner.append(fr.record("slo_violation", force=True))
+        return {"ticked": True}
+
+    fr.add_source("metrics", source)
+    done = []
+    t = threading.Thread(
+        target=lambda: done.append(fr.record("manual", force=True)))
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "record() deadlocked on reentrancy"
+    assert done and done[0] is not None
+    assert inner == [None]  # the reentrant call dropped out
+    assert fr.stats()["recordings"] == 1
+
+
+def test_write_failure_returns_none_and_cleans_tmp(tmp_path):
+    missing = os.path.join(str(tmp_path), "gone")
+    fr = FlightRecorder(FlightRecorderOptions(dir=missing))
+    os.makedirs(missing)
+    os.rmdir(missing)  # dir vanishes before the dump (disk contract)
+    # os.makedirs(tmp) recreates it, so break it harder: a FILE in the way
+    with open(missing, "w") as f:
+        f.write("not a dir")
+    assert fr.record("manual", force=True) is None
+    assert fr.stats()["recordings"] == 0
+
+
+def test_metrics_family_by_trigger(tmp_path):
+    m = MetricsRegistry()
+    fr = FlightRecorder(FlightRecorderOptions(dir=str(tmp_path)), registry=m)
+    c = m.get("flight_recordings_total")
+    # stable zero series for every trigger (dashboards rate() them)
+    assert all(c.value(trigger=t) == 0 for t in TRIGGERS)
+    fr.record("watchdog_abandoned", reason="path device tier host")
+    assert c.value(trigger="watchdog_abandoned") == 1
+    assert c.value(trigger="slo_violation") == 0
+
+
+def test_list_recordings_skips_partial_bundles(tmp_path):
+    fr = _rec(tmp_path)
+    fr.record("manual", force=True)
+    # a concurrent writer's tmp dir must stay invisible to readers
+    os.makedirs(os.path.join(str(tmp_path), ".tmp-0099"))
+    recs = fr.list_recordings()
+    assert len(recs) == 1 and recs[0]["trigger"] == "manual"

@@ -156,6 +156,7 @@ def main(argv=None) -> int:
         solver_opts.policy = args.policy
     if args.policy_checkpoint:
         solver_opts.policy_checkpoint = args.policy_checkpoint
+    from yunikorn_tpu.obs.flightrec import FlightRecorderOptions
     from yunikorn_tpu.robustness.failover import FailoverOptions
 
     core = make_core_scheduler(
@@ -165,7 +166,9 @@ def main(argv=None) -> int:
         supervisor_options=SupervisorOptions.from_conf(holder.get()),
         slo_options=SloOptions.from_conf(holder.get()),
         epoch_seconds=args.shard_epoch_seconds,
-        failover_options=FailoverOptions.from_conf(holder.get()))
+        failover_options=FailoverOptions.from_conf(holder.get()),
+        journey_capacity=holder.get().obs_journey_capacity,
+        flightrec_options=FlightRecorderOptions.from_conf(holder.get()))
     if n_shards > 1:
         logger.info("control-plane sharding: %d shards (epoch %ss, "
                     "failover stale budget %ss)",

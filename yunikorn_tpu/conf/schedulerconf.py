@@ -95,6 +95,12 @@ CM_OBS_SLO_STALENESS = PREFIX_OBS + "sloCycleStalenessSeconds"
 CM_OBS_SLO_DWELL_BUDGET = PREFIX_OBS + "sloDegradedDwellBudget"
 CM_OBS_SLO_COLD_BUDGET = PREFIX_OBS + "sloColdStartBudgetMs"
 CM_OBS_SLO_BURN_FAST = PREFIX_OBS + "sloBurnFastThreshold"
+# journey ledger + flight recorder (round 20; obs/journey.py, obs/flightrec.py)
+CM_OBS_JOURNEY_CAPACITY = PREFIX_OBS + "journeyCapacity"
+CM_OBS_FLIGHTREC_DIR = PREFIX_OBS + "flightRecorderDir"
+CM_OBS_FLIGHTREC_MAX = PREFIX_OBS + "flightRecorderMaxRecordings"
+CM_OBS_FLIGHTREC_WINDOW = PREFIX_OBS + "flightRecorderWindowSeconds"
+CM_OBS_FLIGHTREC_DEBOUNCE = PREFIX_OBS + "flightRecorderDebounceSeconds"
 
 # robustness.* keys (supervised device dispatches, robustness/supervisor.py)
 PREFIX_ROBUSTNESS = "robustness."
@@ -239,6 +245,15 @@ class SchedulerConf:
     obs_slo_degraded_dwell_budget: float = 0.05
     obs_slo_cold_start_budget_ms: float = 15000.0
     obs_slo_burn_fast_threshold: float = 6.0
+    # --- journey ledger + flight recorder (round 20) --- the journey cap
+    # bounds the per-pod hop-timeline map; an empty flight-recorder dir
+    # DISABLES post-mortem bundles (no disk writes without an operator
+    # opting into a location — the bounded-disk contract starts there)
+    obs_journey_capacity: int = 8192
+    obs_flightrec_dir: str = ""
+    obs_flightrec_max: int = 8
+    obs_flightrec_window_s: float = 30.0
+    obs_flightrec_debounce_s: float = 30.0
     # --- robustness knobs --- (SupervisedExecutor: every device dispatch
     # gets a deadline, classified bounded retry, and a per-path circuit
     # breaker degrading device → cpu → host; see robustness/supervisor.py)
@@ -414,6 +429,20 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
                       (CM_OBS_SLO_BURN_FAST, "obs_slo_burn_fast_threshold")):
         if key in data:
             setattr(conf, attr, _parse_float(data[key], getattr(conf, attr)))
+    if CM_OBS_JOURNEY_CAPACITY in data:
+        conf.obs_journey_capacity = _parse_int(
+            data[CM_OBS_JOURNEY_CAPACITY], conf.obs_journey_capacity)
+    if CM_OBS_FLIGHTREC_DIR in data:
+        conf.obs_flightrec_dir = str(data[CM_OBS_FLIGHTREC_DIR]).strip()
+    if CM_OBS_FLIGHTREC_MAX in data:
+        conf.obs_flightrec_max = _parse_int(
+            data[CM_OBS_FLIGHTREC_MAX], conf.obs_flightrec_max)
+    if CM_OBS_FLIGHTREC_WINDOW in data:
+        conf.obs_flightrec_window_s = _parse_duration(
+            data[CM_OBS_FLIGHTREC_WINDOW], conf.obs_flightrec_window_s)
+    if CM_OBS_FLIGHTREC_DEBOUNCE in data:
+        conf.obs_flightrec_debounce_s = _parse_duration(
+            data[CM_OBS_FLIGHTREC_DEBOUNCE], conf.obs_flightrec_debounce_s)
     if CM_ROBUST_DEADLINE in data:
         conf.robustness_dispatch_deadline_s = _parse_duration(
             data[CM_ROBUST_DEADLINE], conf.robustness_dispatch_deadline_s)

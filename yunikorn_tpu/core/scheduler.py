@@ -78,6 +78,8 @@ from yunikorn_tpu.obs.metrics import (
     MS_BUCKETS,
     MetricsRegistry,
 )
+from yunikorn_tpu.obs.flightrec import FlightRecorder, FlightRecorderOptions
+from yunikorn_tpu.obs.journey import JourneyLedger
 from yunikorn_tpu.obs.slo import SloEngine, SloOptions
 from yunikorn_tpu.obs.trace import CycleTracer
 from yunikorn_tpu.ops import assign as assign_mod
@@ -332,7 +334,9 @@ class CoreScheduler(SchedulerAPI):
                  supervisor_options: Optional[SupervisorOptions] = None,
                  slo_options: Optional[SloOptions] = None,
                  registry=None, shard_label: Optional[str] = None,
-                 quota_ledger=None, aot_namespace: Optional[str] = None):
+                 quota_ledger=None, aot_namespace: Optional[str] = None,
+                 journey=None, journey_capacity: int = 8192,
+                 flightrec=None, flightrec_options=None):
         self._lock = locking.RMutex()
         self.cache = cache
         # ---- control-plane sharding hooks (core/shard.py) ----
@@ -745,6 +749,9 @@ class CoreScheduler(SchedulerAPI):
         from collections import deque
 
         self._recent_preemptions = deque(maxlen=128)
+        # last-K cycle entries (flight-recorder bundle payload; the
+        # last_cycle dict only keeps one entry per partition)
+        self._cycle_log = deque(maxlen=64)
         # ---- SLO engine (round 14, obs/slo.py) ----
         # per-partition completion stamps feeding the cycle-staleness
         # objective; written by _note_cycle_success (run-loop ticks only —
@@ -757,6 +764,32 @@ class CoreScheduler(SchedulerAPI):
         self._first_cycle_ms: Optional[float] = None
         self.slo = SloEngine(slo_options, registry=m)
         self.slo.attach_core(self)
+        # ---- journey ledger + flight recorder (round 20) ----
+        # journey: per-pod hop timeline admitted → gated → solved →
+        # committed → bound, stamped with the SAME wall clocks as the
+        # pod-span e2e histogram so the stage sum tiles the measured
+        # latency exactly. A sharded front passes ONE shared ledger to
+        # every shard (it owns the metrics); solo cores build their own.
+        self.journey = (journey if journey is not None
+                        else JourneyLedger(capacity=journey_capacity,
+                                           registry=m))
+        # flight recorder: post-mortem bundles on SLO violation / breaker
+        # exhaustion / watchdog abandonment (+ quarantine and manual
+        # triggers wired by the owner). A sharded front likewise shares
+        # one recorder fleet-wide and registers the fleet-level sources;
+        # a solo core records its own rings.
+        if flightrec is None:
+            flightrec = FlightRecorder(
+                flightrec_options or FlightRecorderOptions(), registry=m)
+            self._register_flightrec_sources(flightrec)
+        self.flightrec = flightrec
+        # both hooks fire OUTSIDE their engines' locks (see slo.py /
+        # supervisor.py) — the recorder's sources re-enter them
+        self.slo.on_violation = self._on_slo_violation
+        self.supervisor.on_exhausted = self._on_breaker_exhausted
+        # per-cycle delta baselines for the journey's solved-mark attrs
+        self._aot_hits_seen = 0.0
+        self._ledger_retries_seen = 0
 
     # ------------------------------------------------------------ SchedulerAPI
     def register_resource_manager(self, request: RegisterResourceManagerRequest,
@@ -1028,7 +1061,7 @@ class CoreScheduler(SchedulerAPI):
             # free the fleet-wide app-COUNT slot (guests never held one)
             self.quota_ledger.release(SHARD_APP_SLOT_PREFIX + app_id)
         for key in list(app.pending_asks) + list(app.allocations):
-            self._span_discard(key)
+            self._span_discard(key, outcome="released")
             if self.quota_ledger is not None:
                 self.quota_ledger.release(key)
         leaf = self.queues.resolve(app.queue_name, create=False)
@@ -1140,7 +1173,17 @@ class CoreScheduler(SchedulerAPI):
         queue-accounting walk is deferred and accumulated — a 50k-pod mass
         release pays one ancestor walk per leaf instead of one per pod
         (_apply_release_accounting applies the sums)."""
-        self._span_discard(release.allocation_key)
+        # journey terminal outcome: preemption victims are attributed as
+        # such; the sharded repair pass's pull-release is NOT a terminal
+        # (the front re-submits the same ask to another shard — its
+        # journey re-admits with a repair hop, it did not end)
+        if (getattr(release, "message", "") or "").startswith("shard repair"):
+            _j_outcome = None
+        elif release.termination_type == TerminationType.PREEMPTED_BY_SCHEDULER:
+            _j_outcome = "preempted"
+        else:
+            _j_outcome = "released"
+        self._span_discard(release.allocation_key, outcome=_j_outcome)
         if self.quota_ledger is not None:
             # drops whatever the key holds on the shared ledger: a pending
             # ask's reservation, a committed allocation's usage, or nothing
@@ -1418,10 +1461,76 @@ class CoreScheduler(SchedulerAPI):
         means wrong free-capacity tensors, i.e. wrong placements). Orphan
         the mirror so the late writes land on an unreferenced object; the
         replacement starts with one full upload."""
+        # capture the evidence BEFORE touching any lock: the abandonment
+        # is the incident, the rings still hold the wedged cycle
+        self.flightrec.record("watchdog_abandoned",
+                              reason=f"path {path} tier {tier}")
         if tier in ("cpu", "host"):
             return  # host-side tiers never touch the device mirror
         with self._lock:
             self.encoder.discard_device_mirror()
+
+    def _on_slo_violation(self, objectives: List[str]) -> None:
+        """SLO hook (fires after tick() releases its lock): one bundle per
+        violation episode — the recorder's debounce folds an episode that
+        flaps across objectives into a single dump."""
+        self.flightrec.record("slo_violation",
+                              reason="objectives: " + ",".join(objectives))
+
+    def _on_breaker_exhausted(self, path: str) -> None:
+        """Supervisor hook: every tier of a supervised path failed."""
+        self.flightrec.record("breaker_exhausted", reason=f"path {path}")
+
+    def _register_flightrec_sources(self, fr) -> None:
+        """Bundle sources for a SOLO core's recorder (the sharded front
+        registers fleet-level equivalents instead). Each reads leaf-locked
+        state only — never the core lock, which the triggering thread (SLO
+        tick, watchdog, run loop) may already hold or be wedged under."""
+        fr.add_source("trace", lambda: self.tracer.chrome_trace())
+        fr.add_source("metrics", lambda: self.obs.snapshot())
+        fr.add_source("cycles", lambda: list(self._cycle_log))
+        fr.add_source(
+            "journeys", lambda: self.journey.tail(fr.options.journey_tail))
+        fr.add_source("duel", lambda: {
+            "last_solve": dict(self._last_solve_stats),
+            "last_pack": dict(self._last_pack_stats),
+            "last_policy": dict(self._last_policy_stats),
+            "last_cvx": dict(self._last_cvx_stats),
+        })
+        fr.add_source("slo", lambda: {"verdicts": self.slo.verdicts(),
+                                      "violations": self.slo.violations()})
+        fr.add_source("supervisor", lambda: self.supervisor.snapshot())
+        if self.quota_ledger is not None:
+            fr.add_source("ledger_audit",
+                          lambda: self.quota_ledger.audit())
+
+    def _aot_outcome(self) -> str:
+        """Journey solved-mark attr: did THIS cycle's dispatch load an
+        executable from the AOT store ('hit'), or run entirely on already-
+        warm jit caches / fresh compiles ('warm')? Delta-based on the
+        store's counter so it costs one registry read per cycle."""
+        c = self.obs.get("aot_store_hits_total")
+        hits = float(c.value()) if c is not None else 0.0
+        prev, self._aot_hits_seen = self._aot_hits_seen, hits
+        return "hit" if hits > prev else "warm"
+
+    def _journey_cycle_marks(self, keys: List[str], t_gate: float,
+                             t_solve: float, gate_stats: dict,
+                             solve_ms: float) -> None:
+        """Stamp the sequential cycle's gated + solved journey marks (the
+        pipelined cycle stamps them at its own stage boundaries)."""
+        jattrs = {}
+        if gate_stats.get("path") is not None:
+            jattrs["gate_path"] = gate_stats["path"]
+        if self.quota_ledger is not None:
+            r = self.quota_ledger.contention_retries
+            jattrs["ledger_retries"] = r - self._ledger_retries_seen
+            self._ledger_retries_seen = r
+        self.journey.mark(keys, "gated", t_gate, **jattrs)
+        self.journey.mark(keys, "solved", t_solve,
+                          arm=self._last_pack_stats.get("policy", "greedy"),
+                          solve_ms=round(solve_ms, 2),
+                          aot=self._aot_outcome())
 
     def _dispatch_solve(self, batch, policy, overlay, node_mask,
                         inflight_ports, allow_mesh=True, mirror_epoch=None):
@@ -2751,6 +2860,11 @@ class CoreScheduler(SchedulerAPI):
                    **_cvx_extras(self._last_cvx_stats),
                    **self._last_solve_stats)
             tr.add("commit", cid, t_solve, t_commit, allocs=len(new_allocs))
+            # journey hop marks from the SAME stage stamps as the tracer
+            # spans; the committed mark rides _record_committed_spans
+            self._journey_cycle_marks(
+                [a.allocation_key for a in admitted], t_gate, t_solve,
+                self._last_gate_stats, (t_solve - t_encode) * 1000)
         return len(new_allocs), (pinned, replaced, new_allocs,
                                  preempt_releases, skipped_keys, fallback_keys)
 
@@ -2870,6 +2984,15 @@ class CoreScheduler(SchedulerAPI):
                             cached=int(cyc.encode_cached),
                             overlapped=int(cyc.overlapped),
                             reencoded=cyc.encode_reencoded)
+            jattrs = {}
+            if cyc.gate_stats.get("path") is not None:
+                jattrs["gate_path"] = cyc.gate_stats["path"]
+            if self.quota_ledger is not None:
+                r = self.quota_ledger.contention_retries
+                jattrs["ledger_retries"] = r - self._ledger_retries_seen
+                self._ledger_retries_seen = r
+            self.journey.mark([a.allocation_key for a in admitted],
+                              "gated", t_gate, **jattrs)
             return cyc
 
     def _pipeline_housekeeping(self) -> Optional[tuple]:
@@ -2985,6 +3108,11 @@ class CoreScheduler(SchedulerAPI):
         self.tracer.add("solve", cyc.cycle_id, cyc.t_dispatched, t_mat0,
                         policy=self._last_pack_stats.get("policy", "greedy"))
         self.tracer.add("materialize", cyc.cycle_id, t_mat0, t_mat1)
+        self.journey.mark(
+            [a.allocation_key for a in cyc.admitted], "solved", t_mat1,
+            arm=self._last_pack_stats.get("policy", "greedy"),
+            solve_ms=round((t_mat1 - cyc.t_dispatched) * 1000, 2),
+            aot=self._aot_outcome())
         with self._lock:
             self._use_partition("default")
             self._inflight_ask_keys = set()
@@ -3986,6 +4114,7 @@ class CoreScheduler(SchedulerAPI):
         (Prometheus), and the stage-latency histograms (tail behavior —
         single-number gauges can't show a pipelined stage's distribution)."""
         self._last_cycle = {**self._last_cycle, pname: entry}
+        self._cycle_log.append({"partition": pname, **entry})
         if self._first_cycle_ms is None and entry.get("pods"):
             # AOT cold-start objective: the first cycle that actually
             # admitted pods (idle ticks don't pay the compile/load cost
@@ -4061,17 +4190,28 @@ class CoreScheduler(SchedulerAPI):
             self._m_unschedulable.inc(rest, reason="undiagnosed")
 
     def _span_submit(self, keys) -> None:
-        """Open per-pod latency spans at ask arrival (submit timestamp)."""
+        """Open per-pod latency spans at ask arrival (submit timestamp).
+        Journeys admit with the SAME `now`: the journey's admitted mark and
+        the e2e span's t_submit must be one clock reading, or the stage sum
+        stops tiling the measured latency. Only FRESH keys reach the
+        journey — a re-sent ask keeps its original span, so it must keep
+        its original admitted mark too (journey.admit would reset it)."""
         now = time.time()
+        fresh = []
         with self._span_mu:
             spans = self._pod_spans
             for k in keys:
                 if k not in spans and len(spans) < self.POD_SPAN_CAP:
                     spans[k] = [now, 0.0, 0]
+                    fresh.append(k)
+        if fresh:
+            self.journey.admit(fresh, now, shard=self.shard_label)
 
-    def _span_discard(self, key: str) -> None:
+    def _span_discard(self, key: str, outcome: Optional[str] = None) -> None:
         with self._span_mu:
             self._pod_spans.pop(key, None)
+        if outcome is not None:
+            self.journey.terminal(key, outcome)
 
     def _record_committed_spans(self, keys, cycle_id: Optional[int] = None) -> None:
         """Close the schedule half of the pod spans (submit->commit) in one
@@ -4086,6 +4226,7 @@ class CoreScheduler(SchedulerAPI):
         cid = self._cycle_seq if cycle_id is None else cycle_id
         now = time.time()
         lats = []
+        closed = []
         with self._span_mu:
             for k in keys:
                 rec = self._pod_spans.get(k)
@@ -4093,8 +4234,12 @@ class CoreScheduler(SchedulerAPI):
                     rec[1] = now
                     rec[2] = cid
                     lats.append(now - rec[0])
+                    closed.append(k)
         if lats:
             self._m_pod_stage.observe_batch(lats, stage="schedule")
+        if closed:
+            # the journey's committed mark = the span's t_commit, exactly
+            self.journey.mark(closed, "committed", now, cycle=cid)
 
     def observe_pod_bound(self, allocation_key: str) -> None:
         """Shim bind-path upcall: close the pod's end-to-end span (the bind
@@ -4112,6 +4257,10 @@ class CoreScheduler(SchedulerAPI):
             self.tracer.add_pod("bind", cyc, t_commit, now,
                                 key=allocation_key)
         self._m_pod_e2e.observe(now - t_submit)
+        # same `now` as the e2e observation above: journey stage sum ==
+        # measured e2e, exactly (the acceptance criterion's 5% bound holds
+        # with zero slack)
+        self.journey.bound(allocation_key, now)
 
     # ------------------------------------------------------------- inspection
     def get_partition_dao(self) -> dict:

@@ -80,7 +80,10 @@ from yunikorn_tpu.core.scheduler import (
     CoreScheduler,
 )
 from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.obs.flightrec import FlightRecorder, FlightRecorderOptions
+from yunikorn_tpu.obs.journey import JourneyLedger
 from yunikorn_tpu.obs.metrics import MetricsRegistry
+from yunikorn_tpu.obs.trace import FRONT_PID, FleetTracer
 
 logger = log("core.shard")
 
@@ -593,37 +596,6 @@ class _ShardCallback:
 # ---------------------------------------------------------------------------
 # Facades (REST/replay compatibility surfaces)
 # ---------------------------------------------------------------------------
-class _MergedTracer:
-    """Read-only merge of the shards' cycle tracers."""
-
-    def __init__(self, shards: List[CoreScheduler]):
-        self._shards = shards
-
-    def spans(self) -> list:
-        out = []
-        for core in self._shards:
-            out.extend(core.tracer.spans())
-        out.sort(key=lambda s: s.t0)
-        return out
-
-    def chrome_trace(self) -> dict:
-        merged = None
-        for k, core in enumerate(self._shards):
-            t = core.tracer.chrome_trace()
-            if merged is None:
-                merged = dict(t)
-                merged["traceEvents"] = list(t.get("traceEvents", []))
-                continue
-            for ev in t.get("traceEvents", []):
-                ev = dict(ev)
-                ev["pid"] = ev.get("pid", 0) + k * 1000
-                merged["traceEvents"].append(ev)
-        return merged or {"traceEvents": []}
-
-    def add(self, *a, **kw) -> None:   # front-level spans land on shard 0
-        self._shards[0].tracer.add(*a, **kw)
-
-
 class _ShardSlo:
     """SLO facade: ticks/resets fan out to every shard's engine; the report
     comes from the first ACTIVE shard (all engines consume the same shared
@@ -729,7 +701,8 @@ class ShardedCoreScheduler(SchedulerAPI):
                  solver_options=None, trace_spans: int = 4096,
                  supervisor_options=None, slo_options=None,
                  epoch_seconds: float = 0.0, aot_namespace: bool = False,
-                 failover_options=None):
+                 failover_options=None, journey_capacity: int = 8192,
+                 flightrec_options=None):
         # aot_namespace=True gives each shard its own executable namespace
         # in the AOT store (corruption/variant isolation for multi-process
         # deployments) at the cost of N compiles per program AND of the
@@ -819,11 +792,22 @@ class ShardedCoreScheduler(SchedulerAPI):
         self._quarantined: Set[int] = set()
         self._rehomed_nodes_total = 0
         self._failover_last: Optional[dict] = None
+        # -- fleet observability (round 20) ----------------------------------
+        # ONE journey ledger and ONE flight recorder fleet-wide, built
+        # BEFORE the shards so every core shares them (the front owns the
+        # metrics families); the FleetTracer merges each shard's cycle
+        # tracer with the front end's own routing/repair/ledger/failover
+        # spans into one Chrome trace — one pid per shard plus pid 1 for
+        # the front lane.
+        self.journey = JourneyLedger(capacity=journey_capacity, registry=m)
+        self.flightrec = FlightRecorder(
+            flightrec_options or FlightRecorderOptions(), registry=m)
+        self.tracer = FleetTracer()
         self.shards: List[CoreScheduler] = []
         self._callbacks: List[Optional[_ShardCallback]] = [None] * n_shards
         for k in range(n_shards):
             self.shards.append(self._build_shard(k))
-        self.tracer = _MergedTracer(self.shards)
+        self._register_flightrec_sources()
         self.slo = _ShardSlo(self.shards, front=self)
         self.supervisor = _ShardSupervisor(self.shards)
         from yunikorn_tpu.robustness.failover import (FailoverOptions,
@@ -852,8 +836,10 @@ class ShardedCoreScheduler(SchedulerAPI):
             solver_options=so, trace_spans=self._trace_spans,
             supervisor_options=sup, slo_options=slo, registry=self.obs,
             shard_label=str(k), quota_ledger=self.ledger,
-            aot_namespace=(f"shard{k}" if self._aot_namespace else None))
+            aot_namespace=(f"shard{k}" if self._aot_namespace else None),
+            journey=self.journey, flightrec=self.flightrec)
         core.shard_index = k
+        self.tracer.register(k, core.tracer, name=f"shard {k}")
         return core
 
     # ------------------------------------------------------- compat surface
@@ -1033,6 +1019,37 @@ class ShardedCoreScheduler(SchedulerAPI):
             "failover": fo,
         }
 
+    def _register_flightrec_sources(self) -> None:
+        """Fleet-level bundle sources. Every source reads leaf-locked or
+        front-owned state only — never a shard's core lock, which on the
+        quarantine trigger may be held forever by the wedged cycle."""
+        fr = self.flightrec
+        fr.add_source(
+            "trace",
+            lambda: self.tracer.chrome_trace(window_s=fr.options.window_s))
+        fr.add_source("metrics", lambda: self.obs.snapshot())
+        fr.add_source("journeys",
+                      lambda: self.journey.tail(fr.options.journey_tail))
+        fr.add_source("ledger_audit", lambda: {
+            "violations": self.ledger.audit(),
+            "stats": self.ledger.stats()})
+        fr.add_source("cycles", lambda: {
+            f"s{k}": list(core._cycle_log)
+            for k, core in enumerate(self.shards)})
+        fr.add_source("duel", lambda: {
+            f"s{k}": {"last_solve": dict(core._last_solve_stats),
+                      "last_pack": dict(core._last_pack_stats)}
+            for k, core in enumerate(self.shards)})
+        # NOT shard_report: it takes the front _mu, and a trigger can fire
+        # on a shard cycle thread while a quarantine transaction holds _mu
+        # and is delivering into that same shard (classic ABBA)
+        fr.add_source("shards", lambda: {
+            "count": self.n,
+            "epoch": self.epoch,
+            "states": self.failover.states(),
+            "failover": self.failover.report(),
+        })
+
     # ---------------------------------------------------------- SchedulerAPI
     def register_resource_manager(self, request, callback) -> None:
         self.callback = callback
@@ -1170,6 +1187,7 @@ class ShardedCoreScheduler(SchedulerAPI):
             self.shards[shard].update_application(req)
 
     def update_allocation(self, request: AllocationRequest) -> None:
+        t_route0 = time.time()
         routed: Dict[int, AllocationRequest] = {}
         guest_apps: Dict[int, ApplicationRequest] = {}
         with self._mu:
@@ -1241,6 +1259,13 @@ class ShardedCoreScheduler(SchedulerAPI):
             self.shards[shard].update_application(req)
         for shard, req in routed.items():
             self.shards[shard].update_allocation(req)
+        if request.asks or request.releases:
+            # front-lane span: the routing + delivery hop every ask pays
+            # before any shard's gate sees it
+            self.tracer.add("route", 0, t_route0, time.time(),
+                            asks=len(request.asks),
+                            releases=len(request.releases),
+                            shards=len(routed))
 
     def _ensure_guest_app_locked(self, app_id: str, shard: int,
                                  routed: Optional[
@@ -1369,6 +1394,7 @@ class ShardedCoreScheduler(SchedulerAPI):
         stay bound — node occupancy lives in the shared cache and the
         ledger keeps their confirmed usage under the same keys."""
         done_apps: List[str] = []
+        t_q0 = time.time()
         with self._mu:
             if idx in self._quarantined or idx < 0 or idx >= self.n:
                 return False
@@ -1380,6 +1406,18 @@ class ShardedCoreScheduler(SchedulerAPI):
             cb = self._callbacks[idx]
             if cb is not None:
                 cb.dead = True  # zombie emissions fenced from the fleet
+            # snapshot the dying shard's trace rings BEFORE the engine is
+            # detached: the frozen lane keeps its final cycle spans
+            # exportable, and the staged copy guarantees the quarantine
+            # bundle written after this transaction still contains them
+            # even if the zombie object is dropped by a later rejoin
+            frozen = self.tracer.freeze(idx)
+            if frozen is not None:
+                self.flightrec.stage(
+                    "dead_shard_trace",
+                    frozen.chrome_trace(
+                        pid=FRONT_PID + 1 + idx,
+                        process_name=f"shard {idx} (quarantined)"))
             # fence the zombie off the ledger too: a cycle that unwedges
             # later must not force-charge keys the fleet re-admitted
             old_core.quota_ledger = None
@@ -1503,6 +1541,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                 self.shards[shard].update_allocation(req)
 
             self._rehomed_nodes_total += len(moves)
+            t_q1 = time.time()
             self._failover_last = {
                 "shard": idx,
                 "reason": reason,
@@ -1510,8 +1549,16 @@ class ShardedCoreScheduler(SchedulerAPI):
                 "apps": len(app_moves),
                 "allocations": sum(len(v) for v in restores.values()),
                 "asks": sum(len(r.asks) for r in ask_routes.values()),
-                "at": round(time.time(), 3),
+                "at": round(t_q1, 3),
             }
+            # front-lane spans: the whole quarantine transaction, and the
+            # domain re-homing inside it, on the failover lane
+            self.tracer.add("quarantine", 0, t_q0, t_q1, shard=idx,
+                            reason=reason, apps=len(app_moves),
+                            asks=self._failover_last["asks"])
+            if moves:
+                self.tracer.add("rehome", 0, t_q0, t_q1, shard=idx,
+                                nodes=len(moves))
         if done_apps and self.callback is not None:
             from yunikorn_tpu.common.si import (ApplicationResponse,
                                                 UpdatedApplication)
@@ -1525,6 +1572,9 @@ class ShardedCoreScheduler(SchedulerAPI):
             "re-admitted %d asks", idx, reason,
             self._failover_last["nodes"], self._failover_last["apps"],
             self._failover_last["asks"])
+        # trigger AFTER the _mu release: bundle sources must never run
+        # while the quarantine transaction holds the front lock
+        self.flightrec.record("quarantine", reason=f"shard {idx}: {reason}")
         return True
 
     def rejoin_shard(self, idx: int) -> bool:
@@ -1598,6 +1648,9 @@ class ShardedCoreScheduler(SchedulerAPI):
                     tried = set(st["tried"])
             if tried is None:
                 self._m_repair.inc(outcome="exhausted")
+                # journey terminal: every active shard tried and refused.
+                # Not final forever — a post-cooldown bind "recovers" it
+                self.journey.terminal(key, "skipped_fleetwide")
                 return False
             if cooling:
                 return False
@@ -1631,6 +1684,10 @@ class ShardedCoreScheduler(SchedulerAPI):
                     st["tried"].add(target)
         self._m_repair.inc(outcome="migrated")
         self._m_asks.inc(shard=str(target))
+        self.tracer.add("repair", 0, now, time.time(), key=key,
+                        src=shard_idx, dst=target)
+        self.journey.annotate(key, hop=f"repaired:s{shard_idx}->s{target}",
+                              repaired_to=target)
         logger.info("shard repair: ask %s migrated s%d -> s%d", key,
                     shard_idx, target)
         return True
@@ -1655,6 +1712,7 @@ class ShardedCoreScheduler(SchedulerAPI):
         Completed re-emit goes straight to the REAL callback — async on
         the shim side, so safe from any lock context)."""
         done_apps: List[str] = []
+        t_lc0 = time.time() if response.new else 0.0
         with self._stats_mu:
             for alloc in response.new:
                 self._bound_per_shard[shard_idx] += 1
@@ -1686,6 +1744,11 @@ class ShardedCoreScheduler(SchedulerAPI):
                             self._suppressed_apps.discard(
                                 rel.application_id)
                             done_apps.append(rel.application_id)
+        if response.new:
+            # front-lane span: the fleet-level commit confirmation pass
+            # (ledger re-attribution bookkeeping per committed batch)
+            self.tracer.add("ledger_confirm", 0, t_lc0, time.time(),
+                            allocs=len(response.new), shard=shard_idx)
         if done_apps and self.callback is not None:
             from yunikorn_tpu.common.si import (ApplicationResponse,
                                                 UpdatedApplication)
@@ -1747,7 +1810,8 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
                         solver_policy=None, solver_options=None,
                         trace_spans: int = 4096, supervisor_options=None,
                         slo_options=None, epoch_seconds: float = 0.0,
-                        failover_options=None):
+                        failover_options=None, journey_capacity: int = 8192,
+                        flightrec_options=None):
     """Build the scheduler for a shard count: a plain CoreScheduler for 1
     (bit-identical to the pre-shard scheduler — no ledger, no views, no
     namespaces, no failover machinery), the sharded front end for N >= 2."""
@@ -1758,9 +1822,13 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
                              solver_options=solver_options,
                              trace_spans=trace_spans,
                              supervisor_options=supervisor_options,
-                             slo_options=slo_options)
+                             slo_options=slo_options,
+                             journey_capacity=journey_capacity,
+                             flightrec_options=flightrec_options)
     return ShardedCoreScheduler(
         cache, n, interval=interval, solver_policy=solver_policy,
         solver_options=solver_options, trace_spans=trace_spans,
         supervisor_options=supervisor_options, slo_options=slo_options,
-        epoch_seconds=epoch_seconds, failover_options=failover_options)
+        epoch_seconds=epoch_seconds, failover_options=failover_options,
+        journey_capacity=journey_capacity,
+        flightrec_options=flightrec_options)

@@ -50,10 +50,13 @@ of (good, bad) pairs; both advance by wall time and never grow with traffic.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import math
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
 
 VERDICT_OK = "ok"
 VERDICT_BURNING = "burning"
@@ -295,6 +298,13 @@ class SloEngine:
         self._last_eval: Dict[str, dict] = {}
         self._g_burn = self._m_violations = self._g_verdict = None
         self._g_value = None
+        # called as on_violation([objective, ...]) when objectives EDGE
+        # into the violated verdict, after tick() releases _mu — the
+        # flight recorder's slo_violation trigger lives here, and its
+        # sources re-enter report()/violations(), so firing under the
+        # lock would deadlock. Edge-triggered like slo_violations_total:
+        # one call per violation episode, not one per tick.
+        self.on_violation: Optional[Callable[[List[str]], None]] = None
         if registry is not None:
             self.attach_metrics(registry)
 
@@ -382,6 +392,7 @@ class SloEngine:
         objective, publish gauges, edge-count violations."""
         if now is None:
             now = self._now()
+        fired: List[str] = []
         with self._mu:
             self._last_tick = now
             self._sample_probes(now)
@@ -392,9 +403,16 @@ class SloEngine:
             out["mis_evictions"] = self._evaluate_misevict(now)
             out["aot_cold_start"] = self._evaluate_coldstart(now)
             for name, ev in out.items():
-                self._publish(name, ev)
+                if self._publish(name, ev):
+                    fired.append(name)
             self._last_eval = out
-            return out
+        hook = self.on_violation
+        if fired and hook is not None:
+            try:
+                hook(fired)
+            except Exception:
+                logger.exception("on_violation hook failed for %s", fired)
+        return out
 
     def _sample_probes(self, now: float) -> None:
         if self._staleness_fn is not None:
@@ -561,11 +579,14 @@ class SloEngine:
                 "burn_rate": {"fast": self._round(burn),
                               "slow": self._round(burn)}}
 
-    def _publish(self, name: str, ev: dict) -> None:
+    def _publish(self, name: str, ev: dict) -> bool:
+        """Publish one objective's evaluation; True iff it EDGED into
+        violated this pass (tick() fans those to on_violation)."""
         prev = self._verdicts.get(name, VERDICT_OK)
         cur = ev["verdict"]
         self._verdicts[name] = cur
-        if cur == VERDICT_VIOLATED and prev != VERDICT_VIOLATED:
+        edged = cur == VERDICT_VIOLATED and prev != VERDICT_VIOLATED
+        if edged:
             self._violations[name] += 1
             if self._m_violations is not None:
                 self._m_violations.inc(objective=name)
@@ -587,6 +608,7 @@ class SloEngine:
         # from the first scrape (dashboards rate() it)
         if self._m_violations is not None and self._violations[name] == 0:
             self._m_violations.inc(0, objective=name)
+        return edged
 
     # ------------------------------------------------------------ read API
     def verdicts(self) -> Dict[str, str]:

@@ -257,6 +257,12 @@ class SupervisedExecutor:
         # orphan that state (core: encoder.discard_device_mirror) so the
         # late writes land on unreferenced objects.
         self.on_abandon: Optional[Callable[[str, str], None]] = None
+        # called as on_exhausted(path) when execute() walks OFF the end of
+        # a ladder (AllTiersFailed — even the host fallback refused), just
+        # before the raise and outside the mutex. The flight recorder
+        # hangs its breaker_exhausted trigger here; its sources re-enter
+        # snapshot()/degraded_paths(), so firing under _mu would deadlock.
+        self.on_exhausted: Optional[Callable[[str], None]] = None
         self._m_dispatch = self._m_transitions = self._g_state = None
         self._g_watchdogs = None
         if registry is not None:
@@ -526,6 +532,12 @@ class SupervisedExecutor:
                     break  # degrade to the next tier
                 self._record(path, tier, "ok", commit=commit_success)
                 return result, tier
+        hook = self.on_exhausted
+        if hook is not None:
+            try:
+                hook(path)
+            except Exception:
+                logger.exception("on_exhausted hook failed for %s", path)
         raise AllTiersFailed(
             f"every tier of supervised path {path!r} failed") from last_exc
 

@@ -50,6 +50,7 @@ class MockScheduler:
         from yunikorn_tpu.core.shard import make_core_scheduler
 
         self._solver_policy = solver_policy
+        from yunikorn_tpu.obs.flightrec import FlightRecorderOptions
         from yunikorn_tpu.obs.slo import SloOptions
         from yunikorn_tpu.robustness.failover import FailoverOptions
         from yunikorn_tpu.robustness.supervisor import SupervisorOptions
@@ -60,7 +61,9 @@ class MockScheduler:
             solver_options=SolverOptions.from_conf(holder.get()),
             supervisor_options=SupervisorOptions.from_conf(holder.get()),
             slo_options=SloOptions.from_conf(holder.get()),
-            failover_options=FailoverOptions.from_conf(holder.get()))
+            failover_options=FailoverOptions.from_conf(holder.get()),
+            journey_capacity=holder.get().obs_journey_capacity,
+            flightrec_options=FlightRecorderOptions.from_conf(holder.get()))
         self.context = Context(self.cluster, self.core, cache=cache)
         self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
 

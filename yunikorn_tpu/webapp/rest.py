@@ -110,11 +110,33 @@ class RestServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                if path in ("/debug/traces", "/ws/v1/traces"):
+                if path in ("/debug/traces", "/ws/v1/traces", "/ws/v1/trace"):
                     # Chrome trace-event JSON of the ring-buffered cycle
                     # spans (open in Perfetto / chrome://tracing): the
-                    # pipelined overlap renders as parallel lanes
+                    # pipelined overlap renders as parallel lanes. On the
+                    # sharded scheduler, core.tracer is the FleetTracer —
+                    # one merged trace, one pid per shard + a front lane
                     return self._reply(200, core.tracer.chrome_trace())
+                if path.startswith("/ws/v1/journey/"):
+                    # per-pod journey record: hop timeline, stage durations
+                    # (their sum tiles the e2e latency exactly), outcome
+                    if not hasattr(core, "journey"):
+                        return self._reply(404, {"error": "journey ledger "
+                                                          "unavailable"})
+                    uid = parsed.path[len("/ws/v1/journey/"):].strip("/")
+                    rec = core.journey.get(uid)
+                    if rec is None:
+                        return self._reply(
+                            404, {"error": f"no journey for {uid}"})
+                    return self._reply(200, rec)
+                if path == "/ws/v1/flightrec":
+                    # flight-recorder state: bundles on disk + trigger stats
+                    if not hasattr(core, "flightrec"):
+                        return self._reply(404, {"error": "flight recorder "
+                                                          "unavailable"})
+                    return self._reply(200, {
+                        "stats": core.flightrec.stats(),
+                        "recordings": core.flightrec.list_recordings()})
                 if path == "/ws/v1/metrics":
                     # same registry snapshot that backs /metrics, as JSON
                     return self._reply(200, core.metrics_snapshot())
@@ -249,6 +271,23 @@ class RestServer:
                         self._reply(200, {"tracing": True, "dir": trace_dir})
                     except Exception as e:
                         self._reply(409, {"error": str(e)})
+                elif path == "/ws/v1/flightrec/dump":
+                    # operator-triggered post-mortem bundle; bypasses the
+                    # per-trigger debounce (an operator hitting dump wants
+                    # a bundle NOW, not "one fired 10s ago")
+                    if not hasattr(core, "flightrec"):
+                        return self._reply(404, {"error": "flight recorder "
+                                                          "unavailable"})
+                    q = parse_qs(parsed.query)
+                    reason = q.get("reason", ["operator dump"])[0]
+                    p = core.flightrec.record("manual", reason=reason,
+                                              force=True)
+                    if p is None:
+                        return self._reply(
+                            409, {"error": "recorder disabled (no "
+                                           "flightRecorderDir) or dump "
+                                           "failed"})
+                    self._reply(200, {"recorded": True, "path": p})
                 elif path == "/ws/v1/profile/stop":
                     import jax
 
