@@ -3,7 +3,7 @@
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke \
 	aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke \
-	failover-smoke trace-smoke \
+	failover-smoke trace-smoke async-smoke \
 	smoke lint run-scheduler run-admission dryrun clean image \
 	sched_image adm_image webtest_image
 
@@ -171,7 +171,20 @@ trace-smoke:  ## fleet flight recorder (round 20): fleet-trace/journey/recorder 
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 		python scripts/trace_smoke.py
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke trace-smoke  ## all tier-1 smoke targets
+async-smoke:  ## async shard front end (round 20): delivery-queue/mirror/bind-pool unit suite, a 4-shard gang-storm with shard 1 WEDGED pre-detection under --assert-slo (front-end calls must stay bounded while the failover supervisor closes in), and the shard A/B's wedged SLO pass (front call + survivor enqueue->ack p99 <= 100ms)
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_async_front.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --shards 4 --kill-shard 1 \
+		--kill-mode wedge --failover-stale 30 --failover-probe 0.3 \
+		--slo-staleness 45 --assert-failover --assert-slo
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/shard_bench.py --shape 2000x1000x64 --shards 1,4 \
+		--wedge-shard 1 --assert-quality --stall 6 \
+		--min-speedup 0.5 --min-drain 0.3
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke trace-smoke async-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -73,6 +73,15 @@ def _probe_backend(timeout: float):
 # a wedged relay yields a parsed, self-labelled CPU result, never a timeout.
 TOTAL_BUDGET = float(os.environ.get("YK_BENCH_TOTAL_BUDGET", 1500))
 CPU_RESERVE = float(os.environ.get("YK_BENCH_CPU_RESERVE", 600))
+# HARD ceiling on the whole dial phase, independent of the per-attempt
+# math (BENCH_r04/r05: 9 wedged dial attempts still summed to 1666 s
+# because attempts x timeout grew with the knobs). Whatever the attempt
+# cap, timeout, and window say, dialing ends here — and a real-time
+# watchdog backs the arithmetic up: if the dial phase is somehow still
+# alive past the wall (+grace), the process emits the parseable
+# backend-unavailable JSON shape and exits 0, so the bench row is a
+# labelled unavailable result, never a driver rc=124 with parsed:null.
+DIAL_WALL = float(os.environ.get("YK_BENCH_DIAL_WALL", 300))
 _T_START = time.time()
 _HARD_DEADLINE = _T_START + TOTAL_BUDGET
 
@@ -204,108 +213,135 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
     # the attempt cap bounds WALL TIME too: N capped probes plus one
     # parent dial plus backoff slack — 2 attempts documents as ~5 min of
     # dialing, never the whole driver window
-    wall_cap = min(budget, max_attempts * dial_timeout + 60.0)
+    wall_cap = min(budget, max_attempts * dial_timeout + 60.0, DIAL_WALL)
+    # the real-time backstop: per-attempt math can only bound what it can
+    # see (injected clocks, subprocess deadlines); a dial phase wedged in
+    # a way none of that math covers still ends at the wall. Daemon timer,
+    # disarmed the moment the dial phase resolves either way.
+    def _wall_tripped():
+        print(f"# bench: dial watchdog tripped at the hard dial wall "
+              f"({DIAL_WALL:.0f}s + grace); emitting backend-unavailable",
+              file=sys.stderr, flush=True)
+        print(_backend_unavailable_json(
+            "hard dial wall exceeded (watchdog)", time.time() - _T_START),
+            flush=True)
+        sys.stderr.flush()
+        _hard_exit(0)
+
+    watchdog = threading.Timer(DIAL_WALL + min(60.0, DIAL_WALL * 0.2),
+                               _wall_tripped)
+    watchdog.daemon = True
+    watchdog.start()
     attempt = 0
     backoff = 5.0
     probed = None
     devs = None
-    while True:
-        if attempt >= max_attempts:
-            print(f"# bench: dial attempt cap ({max_attempts}) reached; "
-                  f"conceding to the CPU fallback early",
-                  file=sys.stderr, flush=True)
-            break
-        attempt += 1
-        remaining = min(budget, wall_cap) - (clock() - t0)
-        if remaining <= 0:
-            break
-        # the last attempt may not stretch past the budget: a wedged probe
-        # consumes min(dial_timeout, remaining), so the retries' SUM stays
-        # inside the window and the CPU reserve survives (r5 regression)
-        t_a = clock()
-        platform, n, cause = probe_fn(min(dial_timeout, remaining))
-        if platform is not None:
-            probed = (platform, n)
-            print(f"# bench: dial attempt {attempt} ok in "
-                  f"{clock() - t_a:.1f}s: {n}x {platform}",
-                  file=sys.stderr, flush=True)
-            # The probe just held and released a relay claim, so the parent's
-            # own dial is expected to be fast — but it can still wedge
-            # (another client stole the claim) or raise. A raise resumes the
-            # probe loop. A wedge can't be killed in-process, so the dial
-            # runs on a joined thread bounded by the REMAINING dial wall
-            # budget (heartbeat-logged while waiting): r05's parent dial
-            # waited on the claim queue until the driver window died rc=124
-            # with parsed:null — now a blown wall budget emits the
-            # backend-unavailable JSON shape and exits while the budget
-            # still has headroom.
-            t_d = time.time()
-            hb_stop = threading.Event()
-
-            def _hb():
-                while not hb_stop.wait(30):
-                    print(f"# bench: parent dial still waiting "
-                          f"({time.time() - t_d:.0f}s; claim queued behind "
-                          f"another client?)", file=sys.stderr, flush=True)
-
-            threading.Thread(target=_hb, daemon=True).start()
-            dial_box: dict = {}
-
-            def _dial():
-                try:
-                    dial_box["devs"] = parent_dial()
-                except Exception as e:  # delivered to the waiter below
-                    dial_box["error"] = e
-
-            dial_thread = threading.Thread(target=_dial, daemon=True)
-            dial_thread.start()
-            dial_wall = max(wall_cap - (clock() - t0),
-                            float(os.environ.get(
-                                "YK_BENCH_PARENT_DIAL_MIN", 30)))
-            dial_thread.join(dial_wall)
-            hb_stop.set()
-            if dial_thread.is_alive():
-                # wedged past the whole dial wall budget: the zombie thread
-                # cannot be reclaimed and the backend is half-initialized,
-                # so a CPU fallback in this process is not safe — emit the
-                # parseable backend-unavailable shape and exit NOW, inside
-                # the driver budget (os._exit: interpreter teardown under a
-                # wedged XLA dial can segfault after the verdict printed)
-                print(f"# bench: parent dial wedged past the dial wall "
-                      f"budget ({dial_wall:.0f}s); emitting "
-                      f"backend-unavailable and exiting",
+    try:
+        while True:
+            if attempt >= max_attempts:
+                print(f"# bench: dial attempt cap ({max_attempts}) reached; "
+                      f"conceding to the CPU fallback early",
                       file=sys.stderr, flush=True)
-                print(_backend_unavailable_json(
-                    "parent dial wedged past the dial wall budget",
-                    clock() - t0), flush=True)
-                sys.stderr.flush()
-                _hard_exit(1)
-            if "error" in dial_box:
-                e = dial_box["error"]
-                print(f"# bench: parent dial failed after "
-                      f"{time.time() - t_d:.1f}s: {type(e).__name__}: "
-                      f"{str(e)[:300]}; resuming probe loop",
-                      file=sys.stderr, flush=True)
-                probed = None
-                try:
-                    # drop the failed backend-init memo so the next dial
-                    # actually re-dials instead of replaying the error
-                    import jax.extend.backend as jeb
-                    jeb.clear_backends()
-                except Exception:
-                    pass
-            else:
-                devs = dial_box.get("devs")
-            if devs is not None:
                 break
-        else:
-            print(f"# bench: dial attempt {attempt} failed after "
-                  f"{clock() - t_a:.1f}s ({clock() - t0:.0f}s total): "
-                  f"{cause}", file=sys.stderr, flush=True)
-        if clock() - t0 >= budget:
-            break
-        sleep(min(backoff, max(budget - (clock() - t0), 1.0)))
-        backoff = min(backoff * 2, 60.0)
+            attempt += 1
+            remaining = min(budget, wall_cap) - (clock() - t0)
+            if remaining <= 0:
+                break
+            # the last attempt may not stretch past the budget: a wedged probe
+            # consumes min(dial_timeout, remaining), so the retries' SUM stays
+            # inside the window and the CPU reserve survives (r5 regression)
+            t_a = clock()
+            platform, n, cause = probe_fn(min(dial_timeout, remaining))
+            if platform is not None:
+                probed = (platform, n)
+                print(f"# bench: dial attempt {attempt} ok in "
+                      f"{clock() - t_a:.1f}s: {n}x {platform}",
+                      file=sys.stderr, flush=True)
+                # The probe just held and released a relay claim, so the parent's
+                # own dial is expected to be fast — but it can still wedge
+                # (another client stole the claim) or raise. A raise resumes the
+                # probe loop. A wedge can't be killed in-process, so the dial
+                # runs on a joined thread bounded by the REMAINING dial wall
+                # budget (heartbeat-logged while waiting): r05's parent dial
+                # waited on the claim queue until the driver window died rc=124
+                # with parsed:null — now a blown wall budget emits the
+                # backend-unavailable JSON shape and exits while the budget
+                # still has headroom.
+                t_d = time.time()
+                hb_stop = threading.Event()
+
+                def _hb():
+                    while not hb_stop.wait(30):
+                        print(f"# bench: parent dial still waiting "
+                              f"({time.time() - t_d:.0f}s; claim queued behind "
+                              f"another client?)", file=sys.stderr, flush=True)
+
+                threading.Thread(target=_hb, daemon=True).start()
+                dial_box: dict = {}
+
+                def _dial():
+                    try:
+                        dial_box["devs"] = parent_dial()
+                    except Exception as e:  # delivered to the waiter below
+                        dial_box["error"] = e
+
+                dial_thread = threading.Thread(target=_dial, daemon=True)
+                dial_thread.start()
+                dial_wall = max(wall_cap - (clock() - t0),
+                                float(os.environ.get(
+                                    "YK_BENCH_PARENT_DIAL_MIN", 30)))
+                dial_thread.join(dial_wall)
+                hb_stop.set()
+                if dial_thread.is_alive():
+                    # wedged past the whole dial wall budget: the zombie thread
+                    # cannot be reclaimed and the backend is half-initialized,
+                    # so a CPU fallback in this process is not safe — emit the
+                    # parseable backend-unavailable shape and exit NOW, inside
+                    # the driver budget (os._exit: interpreter teardown under a
+                    # wedged XLA dial can segfault after the verdict printed)
+                    print(f"# bench: parent dial wedged past the dial wall "
+                          f"budget ({dial_wall:.0f}s); emitting "
+                          f"backend-unavailable and exiting",
+                          file=sys.stderr, flush=True)
+                    print(_backend_unavailable_json(
+                        "parent dial wedged past the dial wall budget",
+                        clock() - t0), flush=True)
+                    sys.stderr.flush()
+                    # exit 0: the driver keeps the labelled unavailable row
+                    # instead of losing the round to a timeout/rc
+                    _hard_exit(0)
+                if "error" in dial_box:
+                    e = dial_box["error"]
+                    print(f"# bench: parent dial failed after "
+                          f"{time.time() - t_d:.1f}s: {type(e).__name__}: "
+                          f"{str(e)[:300]}; resuming probe loop",
+                          file=sys.stderr, flush=True)
+                    probed = None
+                    try:
+                        # drop the failed backend-init memo so the next dial
+                        # actually re-dials instead of replaying the error
+                        import jax.extend.backend as jeb
+                        jeb.clear_backends()
+                    except Exception:
+                        pass
+                else:
+                    devs = dial_box.get("devs")
+                if devs is not None:
+                    break
+            else:
+                print(f"# bench: dial attempt {attempt} failed after "
+                      f"{clock() - t_a:.1f}s ({clock() - t0:.0f}s total): "
+                      f"{cause}", file=sys.stderr, flush=True)
+            if clock() - t0 >= budget:
+                break
+            sleep(min(backoff, max(budget - (clock() - t0), 1.0)))
+            backoff = min(backoff * 2, 60.0)
+    finally:
+        # disarm on EVERY exit — an exceptional unwind (a raising
+        # parent_dial, or a test's _hard_exit stand-in raising
+        # SystemExit) must not leave a live timer whose os._exit
+        # fires into whatever process is still alive 6 minutes later
+        watchdog.cancel()
     if probed is None or devs is None:
         print(f"# bench: TPU dial window ({wall_cap:.0f}s of the "
               f"{TOTAL_BUDGET:.0f}s total budget) exhausted after {attempt} "
@@ -319,7 +355,7 @@ def _init_backend_or_die(probe_fn=None, clock=time.time, sleep=time.sleep,
         except Exception as e2:  # no backend at all: one diagnostic JSON line
             print(_backend_unavailable_json(f"{type(e2).__name__}: {e2}",
                                             clock() - t0))
-            sys.exit(1)
+            sys.exit(0)
     platform = devs[0].platform
     print(f"# bench: backend up in {clock() - t0:.1f}s "
           f"({attempt} dial attempts): {len(devs)}x {platform} ({devs[0]})",

@@ -94,6 +94,8 @@ def main() -> int:
             "yunikorn_journey_completed_total",
             "yunikorn_journey_terminal_total",
             "yunikorn_flight_recordings_total",
+            "yunikorn_bind_pool_depth",
+            "yunikorn_bind_pool_tasks_total",
         ))
         fams = parse_exposition(text)
         # the slo_* series must carry the declared TYPEs and labels (a
@@ -146,6 +148,17 @@ def main() -> int:
         uns = fams.get("yunikorn_unschedulable_total")
         if not uns or not any(s.labels.get("reason") for s in uns.samples):
             errors.append("unschedulable_total has no reason-labelled samples")
+        # round-20 bind pool: the wave quiesced, so depth must be a STABLE
+        # ZERO (queued+inflight drained) while tasks_total carries the binds
+        bpd = fams.get("yunikorn_bind_pool_depth")
+        if bpd and any(s.value != 0 for s in bpd.samples):
+            errors.append("bind_pool_depth nonzero after quiesce: "
+                          f"{[(s.labels, s.value) for s in bpd.samples]}")
+        bpt = fams.get("yunikorn_bind_pool_tasks_total")
+        bound_binds = sum(s.value for s in (bpt.samples if bpt else []))
+        if bound_binds < n_pods:
+            errors.append(f"bind_pool_tasks_total {bound_binds} < bound "
+                          f"pods {n_pods}")
 
         trace = json.loads(_get(port, "/debug/traces"))
         trace_names = {e.get("name") for e in trace.get("traceEvents", [])}
@@ -163,6 +176,67 @@ def main() -> int:
         if rest is not None:
             rest.stop()
         ms.stop()
+
+    # ---- round-20 async front end: the sharded boot's families ----------
+    # the default boot is single-shard (plain CoreScheduler — no delivery
+    # queues), so the queue-depth/ack/shed/mirror families need a small
+    # 2-shard boot of the SAME full stack; after the wave quiesces every
+    # depth gauge and the shed/divergence series must read a stable zero
+    ms2 = MockScheduler()
+    ms2.init(interval=0.05, core_interval=0.02,
+             conf_extra={"log.level": "WARN", "solver.shards": "2"})
+    rest2 = None
+    try:
+        for node in make_kwok_nodes(8):
+            ms2.cluster.add_node(node)
+        for p in make_sleep_pods(24, "obs-sharded", queue="root.obs",
+                                 name_prefix="obs2"):
+            ms2.cluster.add_pod(p)
+        ms2.start()
+        ms2.wait_for_bound_count(24, timeout=120)
+        rest2 = RestServer(ms2.core, ms2.context, port=0)
+        port2 = rest2.start()
+        fams2 = parse_exposition(_get(port2, "/metrics").decode())
+        for name in ("yunikorn_shard_queue_depth",
+                     "yunikorn_shard_delivery_ack_ms",
+                     "yunikorn_shard_queue_shed_total",
+                     "yunikorn_shard_ledger_mirror_divergence",
+                     "yunikorn_bind_pool_depth",
+                     "yunikorn_bind_pool_tasks_total"):
+            if name not in fams2:
+                errors.append(f"sharded boot: /metrics missing {name}")
+        qd = fams2.get("yunikorn_shard_queue_depth")
+        if qd:
+            shards_seen = {s.labels.get("shard") for s in qd.samples}
+            if not {"0", "1"} <= shards_seen:
+                errors.append(f"shard_queue_depth shards {shards_seen} "
+                              "missing 0/1")
+            if any(s.value != 0 for s in qd.samples):
+                errors.append("shard_queue_depth nonzero after quiesce")
+        ack = fams2.get("yunikorn_shard_delivery_ack_ms")
+        if ack and not any(s.name.endswith("_count") and s.value > 0
+                           for s in ack.samples):
+            errors.append("shard_delivery_ack_ms never observed an ack")
+        shed = fams2.get("yunikorn_shard_queue_shed_total")
+        if shed and any(s.value != 0 for s in shed.samples):
+            errors.append("shard_queue_shed_total nonzero under a load "
+                          "far below high-water")
+        div = fams2.get("yunikorn_shard_ledger_mirror_divergence")
+        if div and any(s.value != 0 for s in div.samples):
+            errors.append("shard_ledger_mirror_divergence nonzero: device "
+                          "mirror disagrees with the ledger")
+        bpd2 = fams2.get("yunikorn_bind_pool_depth")
+        if bpd2:
+            if {s.labels.get("shard") for s in bpd2.samples} < {"0", "1"}:
+                errors.append("sharded bind_pool_depth missing per-shard "
+                              "series")
+            if any(s.value != 0 for s in bpd2.samples):
+                errors.append("sharded bind_pool_depth nonzero after "
+                              "quiesce")
+    finally:
+        if rest2 is not None:
+            rest2.stop()
+        ms2.stop()
     if errors:
         print("obs-smoke FAILED:")
         for e in errors:

@@ -95,11 +95,52 @@ def build_workload(n_pods: int, n_nodes: int, n_domains: int, seed: int = 0):
     return nodes, cotenants, asks
 
 
+def _percentiles(samples, qs=(0.5, 0.95, 0.99)):
+    """Exact percentiles of a sample list (ms), nearest-rank."""
+    if not samples:
+        return {f"p{int(q * 100)}": 0.0 for q in qs} | {"max": 0.0}
+    xs = sorted(samples)
+    out = {}
+    for q in qs:
+        idx = min(len(xs) - 1, max(0, int(round(q * len(xs))) - 1))
+        out[f"p{int(q * 100)}"] = round(xs[idx], 3)
+    out["max"] = round(xs[-1], 3)
+    return out
+
+
+def _hist_percentile(state, buckets, q):
+    """Upper-bound percentile estimate from a histogram child_state
+    snapshot (the enqueue->ack ladder): the bucket edge where the
+    cumulative count crosses the quantile. +Inf overflow reports the top
+    edge. Works on a SNAPSHOT so teardown traffic (quarantine re-homing
+    floods the survivors) cannot pollute the measured window."""
+    count, _total, counts = state
+    if count == 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return float(buckets[i]) if i < len(buckets) \
+                else float(buckets[-1])
+    return float(buckets[-1])
+
+
 def run_pass(shards: int, nodes, cotenants, asks, interval: float,
              stall_s: float, timeout_s: float, wave: int = 256,
-             wave_gap_s: float = 0.01):
+             wave_gap_s: float = 0.01, wedge_shard=None):
     """One measured pass: fresh cache+scheduler, the shards' own cycle
-    loops drain the wave. Returns the result dict."""
+    loops drain the wave. Returns the result dict.
+
+    wedge_shard (sharded counts only): after the fleet registers, that
+    shard's assign dispatch is slow-faulted past every deadline — the
+    cycle thread wedges INSIDE the core holding its lock, exactly the
+    pre-detection stall shape. The front-end call-latency percentiles
+    then measure what the async delivery queues bought: every submit
+    must return in fast constant time even though one shard is dead and
+    the failover supervisor (default generous budgets) has not noticed.
+    """
     import threading
 
     from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
@@ -175,12 +216,23 @@ def run_pass(shards: int, nodes, cotenants, asks, interval: float,
     # fleet flows through one pipelined cycle loop), and one monolithic
     # submit would let a single giant batched solve hide it
     bursts = [asks[i:i + wave] for i in range(0, len(asks), wave)]
+    if wedge_shard is not None and shards > 1:
+        k = int(wedge_shard) % shards
+        # wedge INSIDE the dispatch: deadline too big to trip, the cycle
+        # thread blocks holding the core lock (pre-detection, the
+        # supervisor's default stale budget is far past this bench)
+        core.shards[k].supervisor.options.deadline_s = 3600.0
+        core.shards[k].supervisor.faults.slow("assign", seconds=3600.0,
+                                              times=1_000_000)
+    call_ms = []
     t0 = time.time()
     core.start()
     try:
         for burst in bursts:
+            t_c = time.time()
             core.update_allocation(
                 AllocationRequest(asks=[a for _, a in burst]))
+            call_ms.append((time.time() - t_c) * 1000.0)
             time.sleep(wave_gap_s)
         while True:
             with cb.mu:
@@ -194,7 +246,27 @@ def run_pass(shards: int, nodes, cotenants, asks, interval: float,
             if placed and now - last > stall_s:
                 break  # quiesced: whatever is left is unplaceable
             time.sleep(0.02)
+        # snapshot the ack ladder BEFORE teardown: the wedge-teardown
+        # quarantine re-homes the victim's asks through the survivors'
+        # queues, and those (legitimately slow) teardown acks must not
+        # land in the measured percentiles
+        if shards > 1:
+            h = core.obs.get("shard_delivery_ack_ms")
+            ack_state = {k: h.child_state(shard=str(k))
+                         for k in range(shards)} if h else {}
+            ack_buckets = h.buckets if h else ()
+        else:
+            ack_state, ack_buckets = {}, ()
     finally:
+        if wedge_shard is not None and shards > 1:
+            # the victim is wedged but UNDETECTED, so stop() would join
+            # into its held lock; quarantine first — the teardown path
+            # built for wedged cores — and stop() skips the zombie
+            try:
+                core.quarantine_shard(int(wedge_shard) % shards,
+                                      reason="bench wedge teardown")
+            except Exception:
+                pass
         core.stop()
     with cb.mu:
         placed_allocs = list(cb.placed.values())
@@ -217,10 +289,30 @@ def run_pass(shards: int, nodes, cotenants, asks, interval: float,
         srep = core.shard_report()
         per_shard = [admitted_cycles(shard=str(k)) for k in range(shards)]
         cycles = sum(per_shard)
+        # wedged shard excluded: its pump never acks (that IS the wedge);
+        # the survivors' ack ladder shows what delivery actually costs
+        live = [k for k in range(shards)
+                if wedge_shard is None or k != int(wedge_shard) % shards]
+
+        def ack_pct(q):
+            return max((_hist_percentile(ack_state[k], ack_buckets, q)
+                        for k in live if k in ack_state), default=0.0)
+
         extra = {"bound_per_shard": [s["bound"] for s in srep["shards"]],
                  "cycles_per_shard": per_shard,
                  "repair": srep["repair"],
-                 "ledger": srep["ledger"]}
+                 "ledger": srep["ledger"],
+                 "delivery": [s["delivery"] for s in srep["shards"]],
+                 # enqueue->APPLY (the pump finished applying the payload,
+                 # bucket upper bounds): solve-bound by design — a delivery
+                 # landing mid-solve waits for the core lock. Context for
+                 # the gated number, which is front_call_ms (enqueue->ack
+                 # back to the caller — what the async front end bounds)
+                 "delivery_apply_ms": {"p50": ack_pct(0.5),
+                                       "p95": ack_pct(0.95),
+                                       "p99": ack_pct(0.99)},
+                 "wedged_shard": (None if wedge_shard is None
+                                  else int(wedge_shard) % shards)}
     else:
         violations = []
         cycles = admitted_cycles()
@@ -238,6 +330,10 @@ def run_pass(shards: int, nodes, cotenants, asks, interval: float,
         "throughput_cycles_s": round(cycles / wall, 2),
         "throughput_pods_s": round(len(placed_allocs) / wall, 1),
         "quota_violations": len(violations),
+        # the async-front measurement: wall time each front-end submit
+        # call spent before returning (enqueue-and-return — bounded even
+        # with a wedged shard; pre-round-20 a wedge made this unbounded)
+        "front_call_ms": _percentiles(call_ms),
         **extra,
     }
 
@@ -274,6 +370,17 @@ def main() -> int:
                          "— the REAL throughput gate (sharding must never "
                          "cost more than this factor; >1 asserts a win, "
                          "as at the 10k streaming shape)")
+    ap.add_argument("--wedge-shard", type=int, default=None,
+                    help="after the normal passes, run ONE extra pass at "
+                         "the last shard count with this shard wedged "
+                         "inside its dispatch (pre-detection) and report "
+                         "front-end call + enqueue->ack percentiles for "
+                         "the survivors — the async-front-end SLO run")
+    ap.add_argument("--assert-call-p99", type=float, default=100.0,
+                    help="with --assert-quality and --wedge-shard: fail "
+                         "unless the wedged pass's front-end call "
+                         "(enqueue->ack) p99 stays at or under this many "
+                         "ms — the pre-detection-stall SLO")
     args = ap.parse_args()
 
     n_pods, n_nodes, n_domains = (int(x) for x in args.shape.split("x"))
@@ -298,6 +405,18 @@ def main() -> int:
                        wave_gap_s=args.wave_gap)
         results.append(res)
         print(json.dumps(res), flush=True)
+    wedged_res = None
+    if args.wedge_shard is not None and counts[-1] > 1:
+        # the SLO pass: same workload, last shard count, one shard wedged
+        # pre-detection. Placement CANNOT complete (the victim's partition
+        # is dead) — the stall window quiesces the pass; what this pass
+        # measures is that every front-end call stays bounded anyway.
+        wedged_res = run_pass(counts[-1], nodes, cotenants, asks,
+                              args.interval, args.stall, args.timeout,
+                              wave=args.wave, wave_gap_s=args.wave_gap,
+                              wedge_shard=args.wedge_shard)
+        wedged_res["wedged"] = True
+        print(json.dumps(wedged_res), flush=True)
     if args.assert_quality:
         base, best = results[0], results[-1]
         q_placed = best["placed"] / max(base["placed"], 1)
@@ -316,6 +435,19 @@ def main() -> int:
               f"{q_packed:.3f}x, cycle throughput {speedup:.2f}x, drain "
               f"{drain:.2f}x, violations {best['quota_violations']} -> "
               f"{'PASS' if ok else 'FAIL'}", file=sys.stderr, flush=True)
+        if wedged_res is not None:
+            call_p99 = wedged_res["front_call_ms"]["p99"]
+            apply_p99 = wedged_res["delivery_apply_ms"]["p99"]
+            slo_ok = (call_p99 <= args.assert_call_p99
+                      and wedged_res["quota_violations"] == 0)
+            print(f"# shard_bench SLO (shard {wedged_res['wedged_shard']} "
+                  f"wedged pre-detection): front call (enqueue->ack) p99 "
+                  f"{call_p99}ms vs budget {args.assert_call_p99}ms; "
+                  f"survivor delivery-apply p99 <= {apply_p99}ms "
+                  f"(solve-bound, not gated) -> "
+                  f"{'PASS' if slo_ok else 'FAIL'}",
+                  file=sys.stderr, flush=True)
+            ok = ok and slo_ok
         if not ok:
             return 1
     return 0

@@ -1,0 +1,409 @@
+"""Round-21 async front end: per-shard delivery queues, the device-resident
+ledger mirror, and the sharded bind pool.
+
+  * delivery: FIFO per queue, flush barriers, fence-drops-backlog +
+    revive-restores (the quarantine/rejoin hooks);
+  * the pre-detection stall regression: a front-end call into a WEDGED
+    shard (its core lock held by a stuck cycle) returns bounded-fast
+    BEFORE the failover supervisor has noticed anything;
+  * backpressure: a queue past its high-water mark sheds NEW unpinned
+    asks to the least-loaded survivor — and no ask is ever lost;
+  * the ledger mirror: bit-equality against GlobalQuotaLedger confirmed
+    usage (the commit-time-authority invariant), including across a
+    quarantine, and the conservative direction of provably_exceeds;
+  * reserve_many: sequentially exact vs N reserve() calls;
+  * ShardedBindPool: per-key FIFO ordering with cross-key parallelism.
+
+Everything here is deterministic (wedges are a held core lock, not a
+timed fault), so the suite stays in tier-1 without @pytest.mark.slow.
+"""
+import threading
+import time
+import zlib
+
+from yunikorn_tpu.cache.external.scheduler_cache import SchedulerCache
+from yunikorn_tpu.common.objects import make_node, make_pod
+from yunikorn_tpu.common.resource import get_pod_resource
+from yunikorn_tpu.common.si import (
+    AddApplicationRequest,
+    AllocationAsk,
+    AllocationRequest,
+    ApplicationRequest,
+    NodeAction,
+    NodeInfo,
+    NodeRequest,
+    RegisterResourceManagerRequest,
+    ResourceManagerCallback,
+    UserGroupInfo,
+)
+from yunikorn_tpu.core.delivery import ShardDeliveryQueue
+from yunikorn_tpu.core.shard import GlobalQuotaLedger, ShardedCoreScheduler
+from yunikorn_tpu.ops.ledger_mirror import DeviceUsageMirror
+from yunikorn_tpu.robustness.failover import FailoverOptions
+from yunikorn_tpu.utils.workers import ShardedBindPool
+
+# failover pushed out of the picture: these tests exercise the window
+# BEFORE detection, so nothing must quarantine underneath them
+INERT = FailoverOptions(stale_budget_s=3600.0, probe_interval_s=3600.0,
+                        rejoin_after_s=3600.0)
+
+
+class Recorder(ResourceManagerCallback):
+    def __init__(self):
+        self.new = []
+        self.released = []
+        self.accepted_apps = []
+        self.rejected_apps = []
+
+    def update_allocation(self, response):
+        self.new.extend(response.new)
+        self.released.extend(response.released)
+
+    def update_application(self, response):
+        self.accepted_apps.extend(a.application_id for a in response.accepted)
+        self.rejected_apps.extend(
+            (r.application_id, r.reason) for r in response.rejected)
+
+    def update_node(self, response):
+        pass
+
+    def predicates(self, args):
+        return None
+
+    def preemption_predicates(self, args):
+        return []
+
+    def send_event(self, events):
+        pass
+
+    def update_container_scheduling_state(self, request):
+        pass
+
+    def get_state_dump(self):
+        return "{}"
+
+
+def _front(n=2, nodes=4, cpu=8000, high_water=1024):
+    cache = SchedulerCache()
+    cb = Recorder()
+    front = ShardedCoreScheduler(cache, n, interval=0.03,
+                                 failover_options=INERT,
+                                 delivery_high_water=high_water)
+    front.register_resource_manager(
+        RegisterResourceManagerRequest(rm_id="t", policy_group="queues",
+                                      config=""), cb)
+    infos = []
+    for i in range(nodes):
+        node = make_node(f"an-{i}", cpu_milli=cpu)
+        cache.update_node(node)
+        infos.append(NodeInfo(node_id=node.name, action=NodeAction.CREATE,
+                              node=node))
+    front.update_node(NodeRequest(nodes=infos))
+    front.flush()
+    return front, cb
+
+
+def _submit_app(front, app_id):
+    front.update_application(ApplicationRequest(new=[AddApplicationRequest(
+        application_id=app_id, queue_name="root.default",
+        user=UserGroupInfo(user="alice", groups=["devs"]))]))
+
+
+def _ask(app_id, key, cpu=500):
+    pod = make_pod(key, cpu_milli=cpu, memory=2 ** 28)
+    return AllocationAsk(allocation_key=key, application_id=app_id,
+                         resource=get_pod_resource(pod), pod=pod)
+
+
+def _apps_homed(n, shard, prefix, count):
+    """App ids whose home shard (crc32 routing) is `shard`."""
+    return [a for a in (f"{prefix}-{i}" for i in range(512))
+            if zlib.crc32(a.encode()) % n == shard][:count]
+
+
+# ----------------------------------------------------------- delivery queues
+class _SpyCore:
+    def __init__(self, block_on=None):
+        self.calls = []
+        self._block = block_on  # threading.Event the core waits on
+
+    def poke(self, *args):
+        if self._block is not None:
+            self._block.wait()
+        self.calls.append(("poke",) + args)
+
+    def other(self, *args):
+        self.calls.append(("other",) + args)
+
+
+def test_delivery_queue_is_fifo_and_flush_drains():
+    core = _SpyCore()
+    q = ShardDeliveryQueue(0, core)
+    try:
+        for i in range(16):
+            assert q.enqueue("poke" if i % 2 == 0 else "other", i)
+        assert q.flush(timeout=5.0)
+        assert [c[1] for c in core.calls] == list(range(16))
+        st = q.stats()
+        assert st["enqueued"] == 16 and st["delivered"] == 16
+        assert st["depth"] == 0 and st["dropped"] == 0
+    finally:
+        q.stop()
+
+
+def test_delivery_queue_fence_drops_backlog_and_revive_restores():
+    gate = threading.Event()
+    core = _SpyCore(block_on=gate)
+    q = ShardDeliveryQueue(0, core)
+    try:
+        for i in range(5):
+            q.enqueue("poke", i)
+        time.sleep(0.1)  # pump picks item 0 and blocks on the gate
+        dropped = q.fence()
+        # the inflight delivery is NOT in the dropped backlog (the zombie
+        # core consumed it); the queued remainder is returned for re-derive
+        assert [a[0] for _m, a in dropped] == [1, 2, 3, 4]
+        assert q.dead
+        assert q.enqueue("poke", 99) is False  # fenced: drop, never block
+        assert q.flush(timeout=0.2) is False
+        core2 = _SpyCore()
+        q.revive(core2)
+        assert not q.dead
+        assert q.enqueue("other", 7)
+        assert q.flush(timeout=5.0)
+        assert core2.calls == [("other", 7)]
+        gate.set()  # unwedge the zombie pump: it must exit on stale epoch
+        time.sleep(0.1)
+        assert q.stats()["delivered"] == 1  # only the post-revive delivery
+    finally:
+        gate.set()
+        q.stop()
+
+
+def test_front_calls_bounded_while_shard_wedged_pre_detection():
+    """THE round-18 pre-detection stall, pinned dead: with one shard's
+    core lock held by a stuck cycle (the supervisor has detected nothing),
+    every front-end call into that shard still returns in milliseconds —
+    it lands on the delivery queue, not on the dead lock."""
+    front, cb = _front(n=2, nodes=4)
+    try:
+        victim = 0
+        apps = _apps_homed(2, victim, "wapp", 3)
+        # wedge: the cycle thread equivalent — hold the victim core's lock
+        front.shards[victim]._lock.acquire()  # RMutex: returns None
+        try:
+            t0 = time.time()
+            for i, app in enumerate(apps):
+                _submit_app(front, app)
+                front.update_allocation(AllocationRequest(
+                    asks=[_ask(app, f"wpod-{i}")]))
+            front.update_node(NodeRequest(nodes=[]))
+            dt = time.time() - t0
+            # bounded: 7 calls into a wedged shard, well under a second
+            # (pre-async each would block until the lock freed)
+            assert dt < 1.0, f"front-end calls stalled {dt:.2f}s on a wedge"
+            assert front.delivery[victim].depth() > 0
+        finally:
+            front.shards[victim]._lock.release()
+        # after the wedge clears, the backlog drains and asks place
+        assert front.flush(timeout=10.0)
+        front.schedule_once()
+        got = {a.allocation_key for a in cb.new}
+        assert got == {f"wpod-{i}" for i in range(len(apps))}
+    finally:
+        front.stop()
+
+
+def test_queue_overflow_sheds_to_survivor_without_losing_asks():
+    front, cb = _front(n=2, nodes=4, high_water=3)
+    try:
+        victim = 0
+        apps = _apps_homed(2, victim, "sapp", 8)
+        for app in apps:
+            _submit_app(front, app)
+        front.flush()
+        front.shards[victim]._lock.acquire()  # RMutex: returns None
+        try:
+            for i, app in enumerate(apps):
+                front.update_allocation(AllocationRequest(
+                    asks=[_ask(app, f"spod-{i}", cpu=100)]))
+            # the victim queue saturated at its high-water mark; the
+            # overflow went to the survivor instead of deepening it
+            shed = front.obs.get("shard_queue_shed_total").value(
+                shard=str(victim))
+            assert shed > 0, "no asks shed past the high-water mark"
+            # the wedged queue absorbed strictly fewer asks than submitted
+            # (shedding only reroutes when the survivor is shallower, so
+            # a burst may still land some asks home — but never all)
+            assert front.delivery[victim].depth() < len(apps)
+        finally:
+            front.shards[victim]._lock.release()
+        assert front.flush(timeout=10.0)
+        front.schedule_once()
+        # every ask placed exactly once: shed rerouted, never dropped
+        got = sorted(a.allocation_key for a in cb.new)
+        assert got == sorted(f"spod-{i}" for i in range(len(apps)))
+    finally:
+        front.stop()
+
+
+# ------------------------------------------------------------- ledger mirror
+def _charges(tid, lim, amt):
+    return [(tid, [("cpu", lim)], [("cpu", amt)])]
+
+
+def test_mirror_bit_equal_to_ledger_through_lifecycle():
+    ledger = GlobalQuotaLedger()
+    mirror = DeviceUsageMirror(2)
+    ledger.attach_mirror(mirror)
+    for i in range(6):
+        assert ledger.reserve(f"k{i}", _charges("q:root.a", 100_000, 100))
+        ledger.commit(f"k{i}", _charges("q:root.a", 100_000, 100))
+    ledger.commit("forced", _charges("u:alice", 10_000, 7))  # force path
+    for i in range(0, 6, 2):
+        ledger.release(f"k{i}")
+    assert mirror.divergence(ledger) == 0
+    assert mirror.host_usage() == ledger.usage_snapshot()
+    assert mirror.host_usage() == {"q:root.a": {"cpu": 300},
+                                   "u:alice": {"cpu": 7}}
+    # a reservation alone must NOT appear in the mirror (confirmed only)
+    assert ledger.reserve("pend", _charges("q:root.a", 100_000, 50))
+    assert mirror.divergence(ledger) == 0
+    ledger.release("forced")
+    for i in (1, 3, 5):
+        ledger.release(f"k{i}")
+    assert mirror.divergence(ledger) == 0
+    assert mirror.host_usage() == {}
+
+
+def test_mirror_attach_seeds_preexisting_usage():
+    ledger = GlobalQuotaLedger()
+    ledger.commit("old", _charges("q:root.b", 1000, 42))
+    mirror = DeviceUsageMirror(4)
+    ledger.attach_mirror(mirror)  # must seed, not start from zero
+    assert mirror.divergence(ledger) == 0
+    assert mirror.host_usage() == {"q:root.b": {"cpu": 42}}
+
+
+def test_provably_exceeds_is_conservative():
+    ledger = GlobalQuotaLedger()
+    mirror = DeviceUsageMirror(1)
+    ledger.attach_mirror(mirror)
+    ledger.commit("base", _charges("q:root.c", 1000, 900))
+    mirror.refresh(0, ledger)
+    # 900 + 200 > 1000: provable on confirmed usage alone
+    assert mirror.provably_exceeds(
+        [("q:root.c", [("cpu", 1000)], [("cpu", 200)])])
+    # 900 + 50 fits: NOT provable (the ledger decides with reservations)
+    assert not mirror.provably_exceeds(
+        [("q:root.c", [("cpu", 1000)], [("cpu", 50)])])
+    # unknown tracker: zero confirmed usage, never provable
+    assert not mirror.provably_exceeds(
+        [("q:root.zzz", [("cpu", 10)], [("cpu", 5)])])
+
+
+def test_mirror_bit_equal_across_quarantine():
+    front, cb = _front(n=3, nodes=6)
+    try:
+        assert front.usage_mirror is not None
+        victim = 1
+        apps = _apps_homed(3, victim, "mapp", 2)
+        for i, app in enumerate(apps):
+            _submit_app(front, app)
+            front.update_allocation(AllocationRequest(
+                asks=[_ask(app, f"mpod-{i}")]))
+        front.flush()
+        front.schedule_once()
+        assert len(cb.new) == len(apps)
+        assert front.quarantine_shard(victim, "manual")
+        front.schedule_once()
+        assert front.usage_mirror.divergence(front.ledger) == 0
+        assert front.ledger.audit() == []
+        assert front.obs.get(
+            "shard_ledger_mirror_divergence").value() == 0
+    finally:
+        front.stop()
+
+
+def test_reserve_many_sequentially_exact():
+    a = GlobalQuotaLedger()
+    b = GlobalQuotaLedger()
+    items = []
+    # 5 asks of 300 against a 1000 cap: exactly 3 fit, and the batched
+    # path must agree with back-to-back reserve() calls bit-for-bit
+    for i in range(5):
+        items.append((f"r{i}", _charges("q:root.d", 1000, 300)))
+    items.append(("free", []))  # empty charges always succeed
+    seq = [a.reserve(k, c) for k, c in items]
+    bat = b.reserve_many(items)
+    assert bat == seq == [True, True, True, False, False, True]
+    assert a.stats()["reservations"] == b.stats()["reservations"]
+    assert a.stats()["reserve_held"] == b.stats()["reserve_held"]
+
+
+# ---------------------------------------------------------------- bind pools
+def test_bind_pool_per_key_fifo_ordering():
+    pool = ShardedBindPool(n_shards=2, workers_per_shard=4, name="t")
+    try:
+        order = {k: [] for k in range(4)}
+        done = []
+        mu = threading.Lock()
+
+        def task(key, seq):
+            def run():
+                time.sleep(0.001 * (seq % 3))  # jitter to expose races
+                with mu:
+                    order[key].append(seq)
+                    done.append(1)
+            return run
+
+        n_each = 20
+        for seq in range(n_each):
+            for key in range(4):
+                assert pool.submit(task(key, seq), key=f"uid-{key}",
+                                   shard=key % 2)
+        deadline = time.time() + 10
+        while len(done) < 4 * n_each and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(done) == 4 * n_each
+        for key in range(4):
+            assert order[key] == list(range(n_each)), \
+                f"per-key FIFO broken for uid-{key}"
+        assert pool.depth(0) == 0 and pool.depth(1) == 0
+    finally:
+        pool.shutdown()
+
+
+def test_bind_pool_shutdown_refuses_new_work():
+    pool = ShardedBindPool(n_shards=1, workers_per_shard=2, name="t2")
+    ran = []
+    assert pool.submit(lambda: ran.append(1), key="x")
+    deadline = time.time() + 5
+    while not ran and time.time() < deadline:
+        time.sleep(0.01)
+    pool.shutdown()
+    assert pool.submit(lambda: ran.append(2), key="x") is False
+    assert ran == [1]
+
+
+def test_bind_pool_metrics_publish_stable_zeros():
+    from yunikorn_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    pool = ShardedBindPool(n_shards=2, workers_per_shard=2, name="t3")
+    try:
+        pool.attach_metrics(reg)
+        assert reg.get("bind_pool_depth").value(shard="0") == 0
+        assert reg.get("bind_pool_depth").value(shard="1") == 0
+        assert reg.get("bind_pool_tasks_total").value(shard="1") == 0
+        done = threading.Event()
+        pool.submit(done.set, key="k", shard=1)
+        assert done.wait(5)
+        deadline = time.time() + 5
+        while (reg.get("bind_pool_tasks_total").value(shard="1") < 1
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert reg.get("bind_pool_tasks_total").value(shard="1") == 1
+        assert reg.get("bind_pool_tasks_total").value(shard="0") == 0
+    finally:
+        pool.shutdown()

@@ -119,6 +119,9 @@ def test_probe_failure_then_success(monkeypatch):
     """A relay that comes back mid-window is still picked up (the fallback
     only fires after the window)."""
     monkeypatch.setenv("YK_BENCH_TPU_DIAL_ATTEMPTS", "5")
+    # two wedged attempts must fit inside the hard dial wall (300 s) with
+    # room for the third, successful one
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_TIMEOUT", "60")
     clock = FakeClock()
     calls = []
 
@@ -199,8 +202,9 @@ def test_dial_wall_cap_bounds_total_dial_time(monkeypatch):
 def test_parent_dial_wedge_emits_backend_unavailable(monkeypatch, capsys):
     """A parent dial that wedges AFTER a successful probe (the r05 rc=124
     shape: claim queue never drains) must emit the parseable
-    backend-unavailable JSON and hard-exit inside the dial wall budget
-    instead of waiting on the claim forever."""
+    backend-unavailable JSON and hard-exit ZERO inside the dial wall
+    budget instead of waiting on the claim forever — rc 0, so the driver
+    keeps the labelled row rather than losing the round to a timeout."""
     import threading
 
     monkeypatch.setattr(bench, "TOTAL_BUDGET", 1500.0)
@@ -231,7 +235,7 @@ def test_parent_dial_wedge_emits_backend_unavailable(monkeypatch, capsys):
             sleep=clock.sleep, cpu_fallback=lambda: "cpu",
             parent_dial=wedged_parent_dial)
     release.set()
-    assert exited == [1]
+    assert exited == [0]
     out = capsys.readouterr().out
     last = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
     parsed = json.loads(last)
@@ -240,3 +244,76 @@ def test_parent_dial_wedge_emits_backend_unavailable(monkeypatch, capsys):
     # the full key set rides the shape (drivers parse these unconditionally)
     for key in ("degradations", "slo", "topology", "aot_hits"):
         assert key in parsed
+
+
+def test_hard_dial_wall_caps_attempt_math(monkeypatch):
+    """The round-21 hardening: whatever the attempt cap and per-dial
+    timeout multiply to, the dial phase ends at the hard wall
+    (YK_BENCH_DIAL_WALL, default 300 s) — the BENCH_r04/r05 shape was
+    9 attempts x 150 s = 1666 s of dialing that no other bound caught."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 100_000.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE", 600.0)
+    monkeypatch.setattr(bench, "DIAL_WALL", 300.0)
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_ATTEMPTS", "9")
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_TIMEOUT", "150")
+    monkeypatch.delenv("YK_BENCH_TPU_WAIT", raising=False)
+    monkeypatch.delenv("YK_BENCH_FORCE_CPU", raising=False)
+    clock = FakeClock()
+    attempts = []
+
+    def wedged_probe(timeout):
+        attempts.append(timeout)
+        clock.sleep(timeout)
+        return None, 0, "dial timed out (fake wedge)"
+
+    t0 = clock()
+    platform = bench._init_backend_or_die(
+        probe_fn=wedged_probe, clock=clock, sleep=clock.sleep,
+        cpu_fallback=lambda: "cpu")
+    assert platform == "cpu"
+    # bounded by the HARD wall (+ one backoff), not 9 x 150 s
+    assert clock() - t0 <= 300.0 + 60.0, (clock() - t0, attempts)
+    # and no probe was handed a deadline past the wall remainder
+    assert all(t <= 300.0 for t in attempts)
+
+
+def test_dial_watchdog_fires_on_real_wall_and_exits_zero(monkeypatch, capsys):
+    """The real-time backstop: a dial phase wedged in a way the attempt
+    math cannot see (here: a probe blocked on real wall time while the
+    injected clock stands still) is ended by the watchdog, which emits the
+    backend-unavailable JSON shape and exits ZERO."""
+    import threading
+
+    monkeypatch.setattr(bench, "TOTAL_BUDGET", 1500.0)
+    monkeypatch.setattr(bench, "CPU_RESERVE", 600.0)
+    monkeypatch.setattr(bench, "DIAL_WALL", 0.2)   # watchdog at ~0.24 s real
+    monkeypatch.setenv("YK_BENCH_TPU_DIAL_ATTEMPTS", "2")
+    monkeypatch.delenv("YK_BENCH_TPU_WAIT", raising=False)
+    monkeypatch.delenv("YK_BENCH_FORCE_CPU", raising=False)
+
+    tripped = threading.Event()
+    exited = []
+
+    def fake_exit(code):
+        exited.append(code)
+        tripped.set()          # stand-in for os._exit from the timer thread
+
+    monkeypatch.setattr(bench, "_hard_exit", fake_exit)
+    clock = FakeClock()
+
+    def stuck_probe(timeout):
+        # blocks on REAL time; the fake clock never advances, so the
+        # per-attempt window math never concedes — only the watchdog can
+        tripped.wait(10)
+        return None, 0, "unwedged by the watchdog"
+
+    platform = bench._init_backend_or_die(
+        probe_fn=stuck_probe, clock=clock, sleep=clock.sleep,
+        cpu_fallback=lambda: "cpu")
+    assert platform == "cpu"   # after the (test-only) unwedge it concedes
+    assert exited == [0]
+    out = capsys.readouterr().out
+    last = [l for l in out.strip().splitlines() if l.startswith("{")][-1]
+    parsed = json.loads(last)
+    assert parsed["metric"] == "backend-unavailable"
+    assert "watchdog" in parsed["error"]

@@ -500,6 +500,7 @@ def test_app_count_limit_exact_across_shards():
                 AddApplicationRequest(
                     application_id=f"cap-{i}", queue_name="root.capped",
                     user=UserGroupInfo(user="alice", groups=[]))]))
+        front.flush()  # async delivery: registrations decide at the pumps
         homes = {front._app_home[f"cap-{i}"] for i in range(8)
                  if f"cap-{i}" in front._app_home}
         assert len(homes) > 1, "test needs apps spread over several shards"
@@ -513,10 +514,12 @@ def test_app_count_limit_exact_across_shards():
         victim_app = cb.accepted_apps[0]
         front.update_application(ApplicationRequest(
             remove=[RemoveApplicationRequest(application_id=victim_app)]))
+        front.flush()  # the remove must land before cap-late decides
         front.update_application(ApplicationRequest(new=[
             AddApplicationRequest(
                 application_id="cap-late", queue_name="root.capped",
                 user=UserGroupInfo(user="alice", groups=[]))]))
+        front.flush()
         assert "cap-late" in cb.accepted_apps
         assert front.ledger.audit() == []
     finally:
@@ -534,6 +537,7 @@ def test_guest_registration_consumes_no_app_slot():
                 AddApplicationRequest(
                     application_id=f"g-{i}", queue_name="root.capped",
                     user=UserGroupInfo(user="alice", groups=[]))]))
+        front.flush()
         assert len(cb.accepted_apps) == 2
         # deliver a GUEST registration for g-0 straight to its non-home
         # shard (what the repair pass does)
@@ -554,6 +558,7 @@ def test_guest_registration_consumes_no_app_slot():
             AddApplicationRequest(
                 application_id="g-late", queue_name="root.capped",
                 user=UserGroupInfo(user="alice", groups=[]))]))
+        front.flush()
         assert any(a == "g-late" for a, _r in cb.rejected_apps)
         st = front.ledger.stats()
         assert st["charged_keys"] == 2  # exactly two app slots held

@@ -206,3 +206,24 @@ def test_sharded_chunked_matches_single_chunked(env):
     np.testing.assert_array_equal(a1, a2)
     np.testing.assert_array_equal(np.asarray(single.free_after),
                                   np.asarray(sharded.free_after))
+
+
+def test_usage_fold_sharded_matches_single_device():
+    """The ledger-mirror fleet fold: the psum-style sharded reduction must
+    equal the single-device fold bit-for-bit (int64 end-to-end — exactness
+    is the whole point of the device usage mirror)."""
+    from jax.experimental import enable_x64
+
+    from yunikorn_tpu.ops.gate_solve import usage_fold
+    from yunikorn_tpu.parallel.mesh import usage_fold_sharded
+
+    rng = np.random.default_rng(7)
+    host = rng.integers(0, 2**40, size=(8, 16, 4)).astype(np.int64)
+    with enable_x64():
+        import jax.numpy as jnp
+
+        usage = jnp.asarray(host)
+        single = np.asarray(usage_fold(usage))
+        folded = np.asarray(usage_fold_sharded(usage, make_mesh()))
+    np.testing.assert_array_equal(single, host.sum(axis=0))
+    np.testing.assert_array_equal(single, folded)

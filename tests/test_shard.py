@@ -663,6 +663,7 @@ def test_release_routes_to_holder_not_broadcast():
             calls[k].clear()
         front.update_allocation(AllocationRequest(releases=[
             AllocationRelease(application_id=app, allocation_key="rk-1")]))
+        front.flush()  # async delivery: wait for the pumps before spying
         hit = [k for k, reqs in calls.items()
                if any(r.releases for r in reqs)]
         assert hit == [home]
@@ -672,6 +673,7 @@ def test_release_routes_to_holder_not_broadcast():
         front.update_allocation(AllocationRequest(releases=[
             AllocationRelease(application_id="ghost",
                               allocation_key="never-seen")]))
+        front.flush()
         hit = sorted(k for k, reqs in calls.items()
                      if any(r.releases for r in reqs))
         assert hit == [0, 1, 2, 3]
@@ -717,6 +719,7 @@ def test_rejected_and_removed_asks_do_not_leak_routing_state():
         # ask for an app that was never registered -> core rejects it
         front.update_allocation(AllocationRequest(asks=[
             _mk_ask("ghost-app", "ghost-key")]))
+        front.flush()  # async delivery: the rejection arrives at the pump
         with front._mu:
             assert "ghost-key" not in front._asks
             assert "ghost-key" not in front._ask_home

@@ -282,9 +282,19 @@ class Context:
         # (task.go:348-394, cheap in Go); a Python thread per task would spike
         # to tens of thousands at the 50k bucket. Daemon workers: a bind hung
         # on an unresponsive API server must not block interpreter exit.
-        from yunikorn_tpu.utils.workers import DaemonPool
+        # One worker group per scheduler shard (ShardedCoreScheduler.n,
+        # duck-typed — 1 for the plain core) so binds fan out with the
+        # shards instead of re-serializing behind one FIFO; ordering is
+        # preserved per task_id. service.bindPoolWorkers overrides the
+        # per-shard size (0 = auto: total stays 32 up to 4 shards).
+        from yunikorn_tpu.utils.workers import ShardedBindPool
 
-        self.bind_pool = DaemonPool(max_workers=32, name="bind")
+        n_shards = max(1, int(getattr(scheduler_api, "n", 1) or 1))
+        per_shard = int(getattr(self.conf, "bind_pool_workers", 0) or 0)
+        if per_shard <= 0:
+            per_shard = max(8, 32 // n_shards)
+        self.bind_pool = ShardedBindPool(
+            n_shards=n_shards, workers_per_shard=per_shard, name="bind")
 
     # convenience alias matching the reference naming
     @property

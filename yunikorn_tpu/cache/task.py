@@ -180,6 +180,20 @@ class Task:
         self.node_name = node_name
         self.scheduling_state = TaskSchedulingState.ALLOCATED
 
+    def _bind_shard(self):
+        """Which scheduler shard owns this task's node (duck-typed against
+        ShardedCoreScheduler.fanout; None for the plain core — the pool
+        maps it to group 0). Attributes the bind to the shard that placed
+        it so per-shard bind groups drain independently."""
+        api = getattr(self.context, "scheduler_api", None)
+        fan = getattr(api, "fanout", None)
+        if fan is not None and self.node_name:
+            try:
+                return fan.owner_of(self.node_name)
+            except Exception:
+                return None
+        return None
+
     def _post_allocated(self) -> None:
         """Bind volumes + pod asynchronously (reference task.go:348-394)."""
 
@@ -219,7 +233,8 @@ class Task:
         if pool is None:  # minimal contexts in tests
             threading.Thread(target=bind, name=f"bind-{self.task_id}",
                              daemon=True).start()
-        elif not pool.submit(bind):
+        elif not pool.submit(bind, key=self.task_id,
+                             shard=self._bind_shard()):
             # pool already shut down (shim stopping): run the failure path so
             # the allocation is not leaked as forever-ALLOCATED
             logger.warning("bind pool shut down; failing task %s", self.alias)
@@ -240,7 +255,8 @@ class Task:
         # this hook) is not serialized behind 50k of them in a bind storm
         pool = getattr(self.context, "bind_pool", None)
         if pool is None or not pool.submit(
-                lambda: self.update_pod_condition(cond)):
+                lambda: self.update_pod_condition(cond),
+                key=self.task_id, shard=self._bind_shard()):
             self.update_pod_condition(cond)
 
     def _post_rejected(self, reason: str = "") -> None:

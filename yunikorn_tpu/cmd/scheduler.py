@@ -168,7 +168,8 @@ def main(argv=None) -> int:
         epoch_seconds=args.shard_epoch_seconds,
         failover_options=FailoverOptions.from_conf(holder.get()),
         journey_capacity=holder.get().obs_journey_capacity,
-        flightrec_options=FlightRecorderOptions.from_conf(holder.get()))
+        flightrec_options=FlightRecorderOptions.from_conf(holder.get()),
+        delivery_high_water=holder.get().solver_delivery_high_water)
     if n_shards > 1:
         logger.info("control-plane sharding: %d shards (epoch %ss, "
                     "failover stale budget %ss)",

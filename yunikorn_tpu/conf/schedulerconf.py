@@ -43,6 +43,9 @@ CM_SVC_PLACEHOLDER_RUN_AS_GROUP = PREFIX_SERVICE + "placeholderRunAsGroup"
 CM_SVC_PLACEHOLDER_FS_GROUP = PREFIX_SERVICE + "placeholderFsGroup"
 CM_SVC_INSTANCE_TYPE_LABEL = PREFIX_SERVICE + "nodeInstanceTypeNodeLabelKey"
 CM_SVC_OPERATOR_PLUGINS = PREFIX_SERVICE + "operatorPlugins"
+# per-shard bind worker count (cache/context ShardedBindPool); 0 = auto
+# (total stays 32 up to 4 shards). Pool structure: NOT hot-reloadable.
+CM_SVC_BIND_POOL_WORKERS = PREFIX_SERVICE + "bindPoolWorkers"
 
 # kubernetes.* keys
 CM_KUBE_QPS = PREFIX_KUBERNETES + "qps"
@@ -73,6 +76,10 @@ CM_SOLVER_AOT_STORE = PREFIX_SOLVER + "aotStore"        # dir path; "" = off
 CM_SOLVER_AOT_BACKGROUND = PREFIX_SOLVER + "aotBackground"  # auto | true | false
 CM_SOLVER_TOPOLOGY = PREFIX_SOLVER + "topology"         # auto | true | false
 CM_SOLVER_SHARDS = PREFIX_SOLVER + "shards"             # auto | 1..64
+# sharded front end: per-shard delivery-queue high-water mark — past it
+# new unpinned asks shed to the least-loaded survivor (core/delivery.py).
+# Queue structure like the shard count: NOT hot-reloadable.
+CM_SOLVER_DELIVERY_HIGH_WATER = PREFIX_SOLVER + "deliveryHighWater"
 
 # the tri-state device-path gates share one value domain; solver.policy and
 # solver.gateVerify have their own. All parse through _parse_choice: an
@@ -231,6 +238,11 @@ class SchedulerConf:
     # has hardware numbers. NOT hot-reloadable (shards are process
     # structure, like the scheduling interval).
     solver_shards: str = "auto"
+    # async front end (core/delivery.py): shed-to-repair high-water mark
+    # per shard delivery queue
+    solver_delivery_high_water: int = 1024
+    # per-shard bind workers (utils/workers.ShardedBindPool); 0 = auto
+    bind_pool_workers: int = 0
     # ring capacity of the cycle tracer (spans kept for /debug/traces and
     # bench --trace-out; per-pod bind spans ride a separate fixed ring)
     obs_trace_spans: int = 4096
@@ -300,6 +312,8 @@ _NON_RELOADABLE = [
     CM_SVC_PLACEHOLDER_RUN_AS_GROUP,
     CM_SVC_PLACEHOLDER_FS_GROUP,
     CM_SOLVER_SHARDS,
+    CM_SOLVER_DELIVERY_HIGH_WATER,
+    CM_SVC_BIND_POOL_WORKERS,
 ]
 
 
@@ -373,6 +387,9 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
     conf.cluster_id = s(CM_SVC_CLUSTER_ID, conf.cluster_id)
     conf.policy_group = s(CM_SVC_POLICY_GROUP, conf.policy_group)
     conf.operator_plugins = s(CM_SVC_OPERATOR_PLUGINS, conf.operator_plugins)
+    if CM_SVC_BIND_POOL_WORKERS in data:
+        conf.bind_pool_workers = _parse_int(
+            data[CM_SVC_BIND_POOL_WORKERS], conf.bind_pool_workers)
     conf.placeholder.image = s(CM_SVC_PLACEHOLDER_IMAGE, conf.placeholder.image)
     conf.instance_type_node_label_key = s(CM_SVC_INSTANCE_TYPE_LABEL, conf.instance_type_node_label_key)
     conf.solver_scoring_policy = s(CM_SOLVER_SCORING_POLICY, conf.solver_scoring_policy)
@@ -485,6 +502,10 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
             setattr(conf, attr, _parse_choice(key, data[key], allowed))
     if CM_SOLVER_SHARDS in data:
         conf.solver_shards = _parse_shards(data[CM_SOLVER_SHARDS])
+    if CM_SOLVER_DELIVERY_HIGH_WATER in data:
+        conf.solver_delivery_high_water = _parse_int(
+            data[CM_SOLVER_DELIVERY_HIGH_WATER],
+            conf.solver_delivery_high_water)
     return conf
 
 
@@ -561,6 +582,10 @@ def check_non_reloadable(old: SchedulerConf, new: SchedulerConf) -> List[str]:
         CM_SVC_PLACEHOLDER_RUN_AS_GROUP: (old.placeholder.run_as_group, new.placeholder.run_as_group),
         CM_SVC_PLACEHOLDER_FS_GROUP: (old.placeholder.fs_group, new.placeholder.fs_group),
         CM_SOLVER_SHARDS: (old.solver_shards, new.solver_shards),
+        CM_SOLVER_DELIVERY_HIGH_WATER: (old.solver_delivery_high_water,
+                                        new.solver_delivery_high_water),
+        CM_SVC_BIND_POOL_WORKERS: (old.bind_pool_workers,
+                                   new.bind_pool_workers),
     }
     for key, (a, b) in pairs.items():
         if a != b:
@@ -620,6 +645,9 @@ class ConfHolder:
                 new_conf.disable_gang_scheduling = keep.disable_gang_scheduling
                 new_conf.instance_type_node_label_key = keep.instance_type_node_label_key
                 new_conf.solver_shards = keep.solver_shards
+                new_conf.solver_delivery_high_water = \
+                    keep.solver_delivery_high_water
+                new_conf.bind_pool_workers = keep.bind_pool_workers
                 new_conf.placeholder = dataclasses.replace(keep.placeholder)
             self._conf = new_conf
             # queues.yaml payload keyed by "<policyGroup>.yaml" or the bare policy group

@@ -351,6 +351,10 @@ class CoreScheduler(SchedulerAPI):
         self.quota_ledger = quota_ledger
         self.shard_label = shard_label
         self.shard_index = 0
+        # device-resident usage mirror (ops/ledger_mirror): set by the
+        # sharded front; None means every reserve goes straight to the
+        # ledger (single-shard — no coupling to take off the hot path)
+        self.usage_mirror = None
         self.aot_namespace = aot_namespace
         self._stage_kw = ({"shard": shard_label}
                           if shard_label is not None else {})
@@ -3622,11 +3626,22 @@ class CoreScheduler(SchedulerAPI):
         the global check refuses are held (returns (kept, held_count)).
         Looks apps up per ADMITTED ask only — an O(pending) flatten of
         by_queue would put per-entity Python cost back on the gate's
-        critical path."""
+        critical path.
+
+        Hot path (round 20): the device usage mirror drains the ledger's
+        commit journal ONCE per cycle and publishes pre-reduced fleet
+        usage; the precheck below holds provably-over asks with zero lock
+        acquisitions (the ledger would refuse them anyway — reservations
+        only add to its left-hand side), and the survivors batch through
+        reserve_many under ONE lock round-trip instead of one per ask.
+        The ledger stays the commit-time authority throughout."""
         ledger = self.quota_ledger
         applications = self.partition.applications
-        kept = []
+        mirror = self.usage_mirror
+        if mirror is not None:
+            mirror.refresh(self.shard_index, ledger)
         held = 0
+        pending = []
         for ask in admitted:
             app = applications.get(ask.application_id)
             charges = []
@@ -3635,7 +3650,16 @@ class CoreScheduler(SchedulerAPI):
                 charges = gate_mod.ledger_charges(
                     entry[0] if entry else None, app.user.user,
                     app.user.groups, ask.resource)
-            if ledger.reserve(ask.allocation_key, charges):
+            if (charges and mirror is not None
+                    and mirror.provably_exceeds(charges)):
+                held += 1
+                continue
+            pending.append((ask, charges))
+        kept = []
+        results = ledger.reserve_many(
+            [(ask.allocation_key, charges) for ask, charges in pending])
+        for (ask, _charges), ok in zip(pending, results):
+            if ok:
                 kept.append(ask)
             else:
                 held += 1

@@ -74,6 +74,7 @@ from yunikorn_tpu.common.si import (
     UpdateContainerSchedulingStateRequest,
 )
 from yunikorn_tpu.common.si import NodeInfo as SiNodeInfo
+from yunikorn_tpu.core.delivery import ShardDeliveryQueue
 from yunikorn_tpu.core.scheduler import (
     SHARD_GUEST_APP_TAG,
     SHARD_REHOME_APP_TAG,
@@ -82,7 +83,7 @@ from yunikorn_tpu.core.scheduler import (
 from yunikorn_tpu.log.logger import log
 from yunikorn_tpu.obs.flightrec import FlightRecorder, FlightRecorderOptions
 from yunikorn_tpu.obs.journey import JourneyLedger
-from yunikorn_tpu.obs.metrics import MetricsRegistry
+from yunikorn_tpu.obs.metrics import MS_BUCKETS, MetricsRegistry
 from yunikorn_tpu.obs.trace import FRONT_PID, FleetTracer
 
 logger = log("core.shard")
@@ -129,8 +130,50 @@ class GlobalQuotaLedger:
         self.forced_charges = 0        # commits with no prior reservation
         self.expired = 0               # TTL-reaped leaked reservations
         self._m_violations = self._m_contention = None
+        # confirmed-usage delta journal for the device mirror (ops/
+        # ledger_mirror): every _used mutation appends (tid, items, sign);
+        # the mirror drains with ONE lock-swap per refresh. None until a
+        # mirror attaches — the single-shard ledger pays nothing.
+        self._deltas: Optional[list] = None
         if registry is not None:
             self.attach_metrics(registry)
+
+    def attach_mirror(self, mirror) -> None:
+        """Start journaling confirmed-usage deltas for `mirror` (the
+        device-resident usage mirror). The ledger remains the commit-time
+        authority; the mirror is a read-optimized projection."""
+        mirror.bind_ledger(self)
+        with self._mu:
+            self._deltas = []
+            # seed with current usage so a late attach starts bit-equal
+            for tid, items in self._used.items():
+                vals = tuple((rk, v) for rk, v in items.items() if v)
+                if vals:
+                    self._deltas.append((tid, vals, 1))
+
+    def _journal_locked(self, tid: str, items, sign: int) -> None:
+        if self._deltas is not None and items:
+            self._deltas.append((tid, tuple(items), sign))
+
+    def drain_deltas(self) -> list:
+        """Swap out the pending confirmed-usage deltas (mirror refresh)."""
+        with self._mu:
+            if not self._deltas:
+                return []
+            out = self._deltas
+            self._deltas = []
+            return out
+
+    def usage_snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Confirmed usage per tracker (zero entries filtered) — the host
+        truth the device mirror must match bit-for-bit."""
+        with self._mu:
+            out: Dict[str, Dict[str, int]] = {}
+            for tid, items in self._used.items():
+                live = {rk: v for rk, v in items.items() if v}
+                if live:
+                    out[tid] = live
+            return out
 
     def attach_metrics(self, registry: MetricsRegistry) -> None:
         self._m_violations = registry.counter(
@@ -160,6 +203,38 @@ class GlobalQuotaLedger:
                            "unconfirmed (abandoned cycle?)", key)
 
     # -- API ----------------------------------------------------------------
+    def _reserve_locked(self, key: str, charges: list, now: float) -> bool:
+        held = self._res_by_key.get(key)
+        if held is not None:
+            # already held (pipelined re-gate overlap): refresh the
+            # stamp so a long-lived legitimate hold never TTL-expires
+            self._res_by_key[key] = (now, held[1])
+            return True
+        if key in self._use_by_key:
+            return True
+        contended = False
+        for tid, limit, amount in charges:
+            used = self._used.get(tid, {})
+            reserved = self._reserved.get(tid, {})
+            self._limits[tid] = dict(limit)
+            for rk, lim_v in limit:
+                if (used.get(rk, 0) + reserved.get(rk, 0)
+                        + dict(amount).get(rk, 0)) > lim_v:
+                    if reserved.get(rk, 0) > 0:
+                        contended = True
+                    self.reserve_held += 1
+                    if contended:
+                        self.contention_retries += 1
+                        if self._m_contention is not None:
+                            self._m_contention.inc()
+                    return False
+        rec = []
+        for tid, _limit, amount in charges:
+            self._add(self._reserved.setdefault(tid, {}), amount)
+            rec.append((tid, amount))
+        self._res_by_key[key] = (now, rec)
+        return True
+
     def reserve(self, key: str, charges: list) -> bool:
         """Atomically reserve every charge, or none. charges comes from
         gate.ledger_charges: [(tracker_id, limit_items, amount_items)].
@@ -169,37 +244,27 @@ class GlobalQuotaLedger:
             return True
         now = time.time()
         with self._mu:
-            held = self._res_by_key.get(key)
-            if held is not None:
-                # already held (pipelined re-gate overlap): refresh the
-                # stamp so a long-lived legitimate hold never TTL-expires
-                self._res_by_key[key] = (now, held[1])
-                return True
-            if key in self._use_by_key:
-                return True
             self._expire_locked(now)
-            contended = False
-            for tid, limit, amount in charges:
-                used = self._used.get(tid, {})
-                reserved = self._reserved.get(tid, {})
-                self._limits[tid] = dict(limit)
-                for rk, lim_v in limit:
-                    if (used.get(rk, 0) + reserved.get(rk, 0)
-                            + dict(amount).get(rk, 0)) > lim_v:
-                        if reserved.get(rk, 0) > 0:
-                            contended = True
-                        self.reserve_held += 1
-                        if contended:
-                            self.contention_retries += 1
-                            if self._m_contention is not None:
-                                self._m_contention.inc()
-                        return False
-            rec = []
-            for tid, _limit, amount in charges:
-                self._add(self._reserved.setdefault(tid, {}), amount)
-                rec.append((tid, amount))
-            self._res_by_key[key] = (now, rec)
-            return True
+            return self._reserve_locked(key, charges, now)
+
+    def reserve_many(self, items: list) -> List[bool]:
+        """Batched reserve: [(key, charges)] under ONE lock acquisition —
+        the per-cycle gate path (core/scheduler._ledger_reserve) pays one
+        lock round-trip per cycle instead of one per admitted ask.
+        Sequentially exact: each entry sees the reservations the entries
+        before it made, identical to N reserve() calls back-to-back."""
+        if not items:
+            return []
+        now = time.time()
+        out: List[bool] = []
+        with self._mu:
+            self._expire_locked(now)
+            for key, charges in items:
+                if not charges:
+                    out.append(True)
+                else:
+                    out.append(self._reserve_locked(key, charges, now))
+        return out
 
     def commit(self, key: str, charges: list) -> None:
         """Commit one allocation: confirm its reservation (the normal solve
@@ -223,6 +288,7 @@ class GlobalQuotaLedger:
                 for tid, amount in reserved:
                     self._add(self._reserved.setdefault(tid, {}), amount, -1)
                     self._add(self._used.setdefault(tid, {}), amount)
+                    self._journal_locked(tid, amount, 1)
                 self._use_by_key[key] = reserved
                 return
             if not charges:
@@ -234,6 +300,7 @@ class GlobalQuotaLedger:
                 used = self._used.setdefault(tid, {})
                 self._limits[tid] = dict(limit)
                 self._add(used, amount)
+                self._journal_locked(tid, amount, 1)
                 rec2.append((tid, amount))
                 for rk, lim_v in limit:
                     if used.get(rk, 0) > lim_v:
@@ -263,6 +330,7 @@ class GlobalQuotaLedger:
             if used is not None:
                 for tid, amount in used:
                     self._add(self._used.setdefault(tid, {}), amount, -1)
+                    self._journal_locked(tid, amount, -1)
 
     def audit(self) -> List[str]:
         """Tracker ids whose CONFIRMED usage exceeds the last-seen limit —
@@ -702,7 +770,8 @@ class ShardedCoreScheduler(SchedulerAPI):
                  supervisor_options=None, slo_options=None,
                  epoch_seconds: float = 0.0, aot_namespace: bool = False,
                  failover_options=None, journey_capacity: int = 8192,
-                 flightrec_options=None):
+                 flightrec_options=None, delivery_high_water: int = 1024,
+                 usage_mirror: bool = True):
         # aot_namespace=True gives each shard its own executable namespace
         # in the AOT store (corruption/variant isolation for multi-process
         # deployments) at the cost of N compiles per program AND of the
@@ -778,6 +847,28 @@ class ShardedCoreScheduler(SchedulerAPI):
             "nodes moved between shards by epoch re-seeding")
         self._m_epochs = m.counter(
             "shard_epoch_total", "shard-partition re-seed epochs completed")
+        # -- async front end (round 20) --------------------------------------
+        self._m_qdepth = m.gauge(
+            "shard_queue_depth",
+            "pending deliveries in each shard's async delivery queue "
+            "(inflight delivery counts as 1)", labelnames=("shard",))
+        self._m_ack = m.histogram(
+            "shard_delivery_ack_ms",
+            "enqueue-to-ack latency of async shard deliveries — the time a "
+            "front-end call's payload waits before its shard's pump thread "
+            "finishes applying it", labelnames=("shard",),
+            buckets=MS_BUCKETS)
+        self._m_shed = m.counter(
+            "shard_queue_shed_total",
+            "asks shed AWAY from a shard whose delivery queue passed its "
+            "high-water mark (re-routed to the least-loaded active shard — "
+            "the backpressure path; the ask is never dropped)",
+            labelnames=("shard",))
+        self._m_mirror_div = m.gauge(
+            "shard_ledger_mirror_divergence",
+            "cells where the device-resident usage mirror differs from the "
+            "GlobalQuotaLedger's confirmed usage after a drain — commit-time "
+            "authority exactness is gated on this pinning at 0")
         # -- the shards -------------------------------------------------------
         # build kwargs retained: shard failover REBUILDS a quarantined
         # shard's core from scratch at rejoin (the in-process analog of a
@@ -803,10 +894,36 @@ class ShardedCoreScheduler(SchedulerAPI):
         self.flightrec = FlightRecorder(
             flightrec_options or FlightRecorderOptions(), registry=m)
         self.tracer = FleetTracer()
+        # device-resident usage mirror (round 20): the ledger stays the
+        # commit-time authority; the mirror carries confirmed usage on
+        # device, pre-reduced across shards, so each shard's gate precheck
+        # reads fleet usage with zero lock acquisitions. Built BEFORE the
+        # shards so every core shares it.
+        self.usage_mirror = None
+        if usage_mirror:
+            from yunikorn_tpu.ops.ledger_mirror import DeviceUsageMirror
+            self.usage_mirror = DeviceUsageMirror(
+                n_shards, divergence_gauge=self._m_mirror_div)
+            self.ledger.attach_mirror(self.usage_mirror)
         self.shards: List[CoreScheduler] = []
         self._callbacks: List[Optional[_ShardCallback]] = [None] * n_shards
         for k in range(n_shards):
             self.shards.append(self._build_shard(k))
+        # async delivery queues (round 20): one pump per shard owns every
+        # front-end call into that core; front-end update_* enqueue+return
+        self._delivery_high_water = int(delivery_high_water)
+        self.delivery: List[ShardDeliveryQueue] = [
+            ShardDeliveryQueue(
+                k, self.shards[k], high_water=self._delivery_high_water,
+                ack_observe=self._on_delivery_ack,
+                depth_set=self._on_delivery_depth)
+            for k in range(n_shards)]
+        # stable zeros from boot: dashboards (and obs_smoke) read these
+        # families before any delivery, shed, or mirror drain has happened
+        for k in range(n_shards):
+            self._m_qdepth.set(0, shard=str(k))
+            self._m_shed.inc(0, shard=str(k))
+        self._m_mirror_div.set(0)
         self._register_flightrec_sources()
         self.slo = _ShardSlo(self.shards, front=self)
         self.supervisor = _ShardSupervisor(self.shards)
@@ -839,6 +956,7 @@ class ShardedCoreScheduler(SchedulerAPI):
             aot_namespace=(f"shard{k}" if self._aot_namespace else None),
             journey=self.journey, flightrec=self.flightrec)
         core.shard_index = k
+        core.usage_mirror = self.usage_mirror
         self.tracer.register(k, core.tracer, name=f"shard {k}")
         return core
 
@@ -996,6 +1114,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                 # is shared across shards, i.e. fleet-total)
                 "cycles": int(core._cycle_seq),
                 "degraded": core.supervisor.degraded_paths(),
+                "delivery": self.delivery[k].stats(),
             })
         fo = self.failover.report()
         with self._mu:
@@ -1015,6 +1134,8 @@ class ShardedCoreScheduler(SchedulerAPI):
                 "exhausted": int(self._m_repair.value(outcome="exhausted")),
             },
             "ledger": self.ledger.stats(),
+            "mirror": (self.usage_mirror.stats()
+                       if self.usage_mirror is not None else None),
             "suppressed_completions": suppressed,
             "failover": fo,
         }
@@ -1050,6 +1171,33 @@ class ShardedCoreScheduler(SchedulerAPI):
             "failover": self.failover.report(),
         })
 
+    # ------------------------------------------------------- async delivery
+    def _on_delivery_ack(self, idx: int, dt_s: float) -> None:
+        self._m_ack.observe(dt_s * 1000.0, shard=str(idx))
+
+    def _on_delivery_depth(self, idx: int, depth: int) -> None:
+        self._m_qdepth.set(depth, shard=str(idx))
+
+    def _deliver(self, shard: int, method: str, *args) -> bool:
+        """Enqueue one delivery for `shard`'s pump thread. Safe under _mu
+        (leaf lock only — never calls into a core). A False return means
+        the queue is fenced (shard quarantined between routing and
+        delivery); the quarantine transaction re-derives everything from
+        the front's routing state, so the drop is safe."""
+        return self.delivery[shard].enqueue(method, *args)
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Drain every live delivery queue (test/bench barrier; production
+        never waits). Fenced/wedged queues are skipped — a wedged shard
+        must bound this call, not extend it."""
+        deadline = time.time() + max(0.0, timeout)
+        ok = True
+        for k, q in enumerate(self.delivery):
+            if k in self._quarantined or q.dead:
+                continue
+            ok = q.flush(timeout=max(0.0, deadline - time.time())) and ok
+        return ok
+
     # ---------------------------------------------------------- SchedulerAPI
     def register_resource_manager(self, request, callback) -> None:
         self.callback = callback
@@ -1065,9 +1213,10 @@ class ShardedCoreScheduler(SchedulerAPI):
             # retained so a failover-rebuilt shard replays the live config
             self._last_config = (config, extra_config)
             quarantined = set(self._quarantined)
-        for k, core in enumerate(self.shards):
+        for k in range(self.n):
             if k not in quarantined:
-                core.update_configuration(config, extra_config)
+                self._deliver(k, "update_configuration", config,
+                              extra_config)
 
     def update_node(self, request: NodeRequest) -> None:
         # routed per shard under ONE _mu pass, delivered as one batched
@@ -1112,7 +1261,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                 if shard is not None and shard not in self._quarantined:
                     routed.setdefault(shard, []).append(info)
         for shard, infos in routed.items():
-            self.shards[shard].update_node(NodeRequest(nodes=infos))
+            self._deliver(shard, "update_node", NodeRequest(nodes=infos))
 
     def _node_labels(self, info: SiNodeInfo) -> Optional[Dict[str, str]]:
         node = getattr(info, "node", None)
@@ -1184,7 +1333,7 @@ class ShardedCoreScheduler(SchedulerAPI):
                         routed.setdefault(
                             shard, ApplicationRequest()).remove.append(rem)
         for shard, req in routed.items():
-            self.shards[shard].update_application(req)
+            self._deliver(shard, "update_application", req)
 
     def update_allocation(self, request: AllocationRequest) -> None:
         t_route0 = time.time()
@@ -1195,13 +1344,31 @@ class ShardedCoreScheduler(SchedulerAPI):
                 shard = None
                 if ask.preferred_node:
                     shard = self.fanout.owner_of(ask.preferred_node)
-                    if (shard is not None
-                            and shard != self._home_shard(
-                                ask.application_id)):
-                        self._ensure_guest_app_locked(ask.application_id,
-                                                      shard, guest_apps)
                 if shard is None:
                     shard = self._home_shard(ask.application_id)
+                    # backpressure: when the home queue is past its
+                    # high-water mark, shed this UNPINNED ask to the
+                    # least-loaded active shard (the shed-to-repair path —
+                    # the ask re-enters scheduling there as a repair
+                    # guest, never dropped) instead of deepening a
+                    # possibly-wedged backlog. Pinned asks must reach the
+                    # node's owner; non-ask traffic is never shed.
+                    if self.delivery[shard].over_high_water():
+                        alts = [k for k in range(self.n)
+                                if k != shard
+                                and k not in self._quarantined
+                                and not self.delivery[k].dead]
+                        if alts:
+                            tgt = min(alts,
+                                      key=lambda k: self.delivery[k].depth())
+                            if (self.delivery[tgt].depth()
+                                    < self.delivery[shard].depth()):
+                                self._m_shed.inc(shard=str(shard))
+                                self._m_repair.inc(outcome="shed")
+                                shard = tgt
+                if shard != self._home_shard(ask.application_id):
+                    self._ensure_guest_app_locked(ask.application_id,
+                                                  shard, guest_apps)
                 self._ask_home[ask.allocation_key] = shard
                 self._asks[ask.allocation_key] = ask
                 routed.setdefault(
@@ -1254,11 +1421,13 @@ class ShardedCoreScheduler(SchedulerAPI):
                 for shard in targets:
                     routed.setdefault(
                         shard, AllocationRequest()).releases.append(rel)
-        # guest registrations must land BEFORE the asks that need them
+        # guest registrations must land BEFORE the asks that need them:
+        # both ride the same per-shard FIFO, so enqueue order is delivery
+        # order
         for shard, req in guest_apps.items():
-            self.shards[shard].update_application(req)
+            self._deliver(shard, "update_application", req)
         for shard, req in routed.items():
-            self.shards[shard].update_allocation(req)
+            self._deliver(shard, "update_allocation", req)
         if request.asks or request.releases:
             # front-lane span: the routing + delivery hop every ask pays
             # before any shard's gate sees it
@@ -1274,8 +1443,8 @@ class ShardedCoreScheduler(SchedulerAPI):
         """Register the app in `shard` as a repair guest if absent (front
         _mu held). `routed` must be an ApplicationRequest-keyed map (the
         caller delivers it BEFORE any asks that depend on the guest);
-        None sends the registration inline — _mu before shard locks is
-        the sanctioned order."""
+        None enqueues the registration immediately — the shard's FIFO
+        keeps it ahead of any ask the caller enqueues afterwards."""
         shards = self._app_shards.setdefault(app_id, set())
         if shard in shards:
             return False
@@ -1288,8 +1457,8 @@ class ShardedCoreScheduler(SchedulerAPI):
         if routed is not None:
             routed.setdefault(shard, ApplicationRequest()).new.append(guest)
         else:
-            self.shards[shard].update_application(
-                ApplicationRequest(new=[guest]))
+            self._deliver(shard, "update_application",
+                          ApplicationRequest(new=[guest]))
         return True
 
     # ------------------------------------------------------------ lifecycle
@@ -1313,6 +1482,11 @@ class ShardedCoreScheduler(SchedulerAPI):
         if self._epoch_thread is not None:
             self._epoch_thread.join(timeout=5)
             self._epoch_thread = None
+        # bounded: let in-flight deliveries land, then stop the pumps (a
+        # wedged queue is skipped by flush and its pump is epoch-fenced)
+        self.flush(timeout=5.0)
+        for q in self.delivery:
+            q.stop()
         for k, core in enumerate(self.shards):
             if k in self._quarantined:
                 # a quarantined core may be WEDGED with its pipeline mutex
@@ -1331,7 +1505,11 @@ class ShardedCoreScheduler(SchedulerAPI):
 
     def schedule_once(self) -> int:
         """Drive one cycle on every serving shard (test/bench surface;
-        production runs the shards' own staggered loops)."""
+        production runs the shards' own staggered loops). Flushes the
+        async delivery queues first so a just-submitted ask is visible to
+        the cycle it drives — the synchronous semantics direct drivers
+        have always had."""
+        self.flush(timeout=10.0)
         total = 0
         for k, core in enumerate(self.shards):
             if k not in self._quarantined:
@@ -1365,14 +1543,15 @@ class ShardedCoreScheduler(SchedulerAPI):
                              self._node_sched.get(name, True)))
         for name, old, new, reg, schedulable in plan:
             if old not in self._quarantined:
-                self.shards[old].update_node(NodeRequest(nodes=[SiNodeInfo(
-                    node_id=name, action=NodeAction.DECOMISSION)]))
+                self._deliver(old, "update_node", NodeRequest(nodes=[
+                    SiNodeInfo(node_id=name,
+                               action=NodeAction.DECOMISSION)]))
             create = dataclasses.replace(
                 reg,
                 action=(NodeAction.CREATE if schedulable
                         else NodeAction.CREATE_DRAIN),
                 existing_allocations=[])
-            self.shards[new].update_node(NodeRequest(nodes=[create]))
+            self._deliver(new, "update_node", NodeRequest(nodes=[create]))
         if plan:
             self._m_node_migrations.inc(len(plan))
             logger.info("shard epoch %d: migrated %d nodes", self.epoch,
@@ -1388,8 +1567,9 @@ class ShardedCoreScheduler(SchedulerAPI):
         to each app's new home — audit() stays zero-violation throughout),
         re-register its apps on survivors and re-admit its parked asks.
 
-        Runs entirely under the front _mu (the sanctioned _mu -> shard
-        order), and NEVER calls into the quarantined core: a wedged cycle
+        Runs entirely under the front _mu, delivers only via the async
+        queues (never a direct core call — _mu is held only for routing
+        state), and NEVER touches the quarantined core: a wedged cycle
         may hold that core's lock and pipeline mutex forever. Bound pods
         stay bound — node occupancy lives in the shared cache and the
         ledger keeps their confirmed usage under the same keys."""
@@ -1406,6 +1586,18 @@ class ShardedCoreScheduler(SchedulerAPI):
             cb = self._callbacks[idx]
             if cb is not None:
                 cb.dead = True  # zombie emissions fenced from the fleet
+            # fence the delivery queue: drop its undelivered backlog (the
+            # front's routing state re-derives it below — parked asks
+            # re-admit, node domains re-home from _node_reg) and epoch-
+            # fence the pump so a later unwedge cannot deliver into the
+            # zombie. Dropped RELEASES are the one class with no other
+            # source of truth once the holder re-attributes — collect them
+            # for a survivor re-broadcast in step 6.
+            dropped = self.delivery[idx].fence()
+            dropped_releases = [
+                rel for method, args in dropped
+                if method == "update_allocation"
+                for rel in args[0].releases]
             # snapshot the dying shard's trace rings BEFORE the engine is
             # detached: the frozen lane keeps its final cycle spans
             # exportable, and the staged copy guarantees the quarantine
@@ -1529,16 +1721,28 @@ class ShardedCoreScheduler(SchedulerAPI):
                     target, AllocationRequest()).asks.append(ask)
                 self._m_asks.inc(shard=str(target))
 
-            # -- 6. deliver (still under _mu: _mu -> shard order) --
+            # -- 6. deliver (enqueues only: _mu never crosses a core call;
+            #       per-shard FIFO keeps registrations ahead of the state
+            #       that depends on them) --
             for shard, req in reg.items():
-                self.shards[shard].update_application(req)
+                self._deliver(shard, "update_application", req)
             for shard, allocs in restores.items():
-                self.shards[shard].update_allocation(
-                    AllocationRequest(allocations=list(allocs)))
+                self._deliver(shard, "update_allocation",
+                              AllocationRequest(allocations=list(allocs)))
             for shard, infos in node_creates.items():
-                self.shards[shard].update_node(NodeRequest(nodes=infos))
+                self._deliver(shard, "update_node", NodeRequest(nodes=infos))
             for shard, req in ask_routes.items():
-                self.shards[shard].update_allocation(req)
+                self._deliver(shard, "update_allocation", req)
+            if dropped_releases:
+                # releases fenced out of the dead queue: broadcast to the
+                # survivors (only the holder acts) so a release routed to
+                # the dying shard in its final window is never lost
+                for shard in range(self.n):
+                    if shard not in self._quarantined:
+                        self._deliver(
+                            shard, "update_allocation",
+                            AllocationRequest(
+                                releases=list(dropped_releases)))
 
             self._rehomed_nodes_total += len(moves)
             t_q1 = time.time()
@@ -1572,6 +1776,10 @@ class ShardedCoreScheduler(SchedulerAPI):
             "re-admitted %d asks", idx, reason,
             self._failover_last["nodes"], self._failover_last["apps"],
             self._failover_last["asks"])
+        # the step-6 re-homing went through the async queues: wait for
+        # the survivors to absorb it so the quarantine stays a synchronous
+        # transaction for its callers (supervisor, REST, tests)
+        self.flush(timeout=10.0)
         # trigger AFTER the _mu release: bundle sources must never run
         # while the quarantine transaction holds the front lock
         self.flightrec.record("quarantine", reason=f"shard {idx}: {reason}")
@@ -1597,6 +1805,9 @@ class ShardedCoreScheduler(SchedulerAPI):
                 core.update_configuration(*self._last_config)
             self._quarantined.discard(idx)
             self.partitioner.set_active(idx, True)
+            # fresh pump for the rebuilt core (the fenced pump exits on
+            # its stale epoch if the zombie ever unwedges)
+            self.delivery[idx].revive(core)
         core.start()
         # re-admission happens at the next epoch — advance it now so the
         # rebuilt shard is not an idle passenger until the epoch timer
@@ -1667,7 +1878,14 @@ class ShardedCoreScheduler(SchedulerAPI):
             # pull the pending ask out of the reporting shard, then
             # re-submit to the target: _release_allocation pops a pending
             # ask without emitting a release (the allocation never
-            # existed). Still under _mu: sanctioned _mu -> shard order.
+            # existed). The src release stays a DIRECT core call — the
+            # ask must leave the reporting shard before the target's pump
+            # can deliver its copy, or both shards would hold it pending
+            # and could double-place. Safe: we run on the reporting
+            # shard's own cycle thread (callbacks are emitted outside the
+            # core lock), the core lock is reacquired briefly, and pumps
+            # never hold a core lock while taking _mu, so no reverse edge
+            # exists. The target delivery is an ordinary enqueue.
             from yunikorn_tpu.common.si import (AllocationRelease,
                                                 TerminationType)
 
@@ -1676,8 +1894,8 @@ class ShardedCoreScheduler(SchedulerAPI):
                     application_id=app_id, allocation_key=key,
                     termination_type=TerminationType.STOPPED_BY_RM,
                     message="shard repair: migrating stranded ask")]))
-            self.shards[target].update_allocation(
-                AllocationRequest(asks=[ask]))
+            self._deliver(target, "update_allocation",
+                          AllocationRequest(asks=[ask]))
             with self._stats_mu:
                 st = self._repair.get(key)
                 if st is not None:
@@ -1811,7 +2029,8 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
                         trace_spans: int = 4096, supervisor_options=None,
                         slo_options=None, epoch_seconds: float = 0.0,
                         failover_options=None, journey_capacity: int = 8192,
-                        flightrec_options=None):
+                        flightrec_options=None,
+                        delivery_high_water: int = 1024):
     """Build the scheduler for a shard count: a plain CoreScheduler for 1
     (bit-identical to the pre-shard scheduler — no ledger, no views, no
     namespaces, no failover machinery), the sharded front end for N >= 2."""
@@ -1831,4 +2050,5 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
         supervisor_options=supervisor_options, slo_options=slo_options,
         epoch_seconds=epoch_seconds, failover_options=failover_options,
         journey_capacity=journey_capacity,
-        flightrec_options=flightrec_options)
+        flightrec_options=flightrec_options,
+        delivery_high_water=delivery_high_water)

@@ -344,3 +344,28 @@ def jit_cache_entries() -> int:
         return _gate_scan._cache_size() + aot_rt.compile_count("gate.scan")
     except Exception:
         return -1
+
+
+# ---------------------------------------------------------------------------
+# Device-resident usage mirror kernels (ops/ledger_mirror.DeviceUsageMirror)
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, donate_argnums=(0,))
+def usage_apply(dev, shard, t_idx, k_idx, deltas):
+    """Scatter one shard's drained confirmed-usage deltas into its row of
+    the [S, T, K] int64 mirror: dev[shard, t_idx[b], k_idx[b]] += deltas[b].
+    Padded entries carry delta 0 (index (0, 0) — the add is identity), so
+    batches bucket to power-of-two sizes (one compile per bucket). Donated:
+    the mirror is a persistent device array updated in place."""
+    return dev.at[shard, t_idx, k_idx].add(deltas)
+
+
+@jax.jit
+def usage_fold(dev):
+    """Fleet usage: fold the per-shard [S, T, K] mirror over the shard
+    axis to the [T, K] pre-reduced totals every shard's gate precheck
+    reads. On one device this is a jitted sum; under a mesh
+    parallel/mesh.usage_fold_sharded runs the same fold as a psum-style
+    cross-shard all-reduce."""
+    import jax.numpy as jnp
+
+    return jnp.sum(dev, axis=0)

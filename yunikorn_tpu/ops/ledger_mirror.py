@@ -1,0 +1,263 @@
+"""Device-resident mirror of the GlobalQuotaLedger's confirmed usage.
+
+The round-16 sharded control plane couples shards through ONE Python
+ledger lock: every admitted ask paid a reserve() round-trip under it, so
+at N shards the gate's admission tail serialized on the ledger exactly
+the way the front end serialized on _mu. This module takes the ledger off
+the per-ask hot path without weakening exactness:
+
+  commit-time authority (the invariant)
+      The Python GlobalQuotaLedger stays the ONLY authority: reserve at
+      admission, confirm at commit, release on release/eviction — all
+      plain-int exact under its lock, unchanged. The mirror is a
+      read-optimized PROJECTION of the ledger's confirmed usage: the
+      ledger journals every _used mutation (one tuple append under the
+      lock it already holds), and each shard's gate drains that journal
+      once per cycle into a [shards, trackers, resources] int64 device
+      array (ops/gate_solve.usage_apply — a jitted scatter-add), then
+      re-reduces the fleet totals (ops/gate_solve.usage_fold; under a
+      mesh, parallel/mesh.usage_fold_sharded runs the same fold as a
+      psum-style ICI all-reduce).
+
+  zero-lock admission precheck
+      provably_exceeds(charges) reads the pre-reduced [T, K] fleet-usage
+      array (a host numpy view refreshed after each drain) with ZERO lock
+      acquisitions: an ask whose charges already exceed a limit on
+      CONFIRMED usage alone is held immediately — the ledger would refuse
+      it anyway (reservations only add to the left-hand side). Survivors
+      then batch through GlobalQuotaLedger.reserve_many — one lock
+      acquisition per cycle, not one per ask. Staleness is safe by
+      direction: a racing commit makes the mirror UNDERstate (the ledger
+      still refuses exactly); a racing release makes it OVERstate, which
+      can only hold an ask one extra cycle — the same semantics as a
+      ledger contention retry.
+
+  bit-equality (the oracle)
+      After a drain, host_usage() must equal ledger.usage_snapshot()
+      bit-for-bit (divergence() counts differing cells and pins the
+      shard_ledger_mirror_divergence gauge, gated at 0 by
+      tests/test_async_front.py across the failover suite). This holds
+      because the mirror applies the SAME plain-int deltas the ledger
+      applied, in aggregate — int64 end-to-end, no floats anywhere.
+
+Shard attribution note: rows index the shard that DRAINED a delta, not
+the shard that committed it (any shard's cycle may drain the shared
+journal). The fold — the only consumer — is attribution-invariant; the
+per-shard rows exist so drains scatter into disjoint rows and the mesh
+fold has a shard axis to reduce over.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from yunikorn_tpu.log.logger import log
+from yunikorn_tpu.snapshot.vocab import _next_pow2
+
+logger = log("ops.ledger_mirror")
+
+
+class DeviceUsageMirror:
+    """[shards, trackers, resources] int64 confirmed-usage array on device,
+    folded across shards after every drain; the host keeps a numpy view of
+    the fleet totals for the zero-lock admission precheck."""
+
+    def __init__(self, n_shards: int, mesh=None, divergence_gauge=None):
+        self.n = int(n_shards)
+        self._mesh = mesh
+        self._gauge = divergence_gauge
+        # serializes device updates (drains from different shard cycle
+        # threads); NEVER on the precheck read path — provably_exceeds
+        # reads the published numpy snapshot lock-free
+        self._mu = threading.Lock()
+        self._ledger = None
+        self._t_vocab: Dict[str, int] = {}
+        self._k_vocab: Dict[str, int] = {}
+        self._t_names: List[str] = []
+        self._k_names: List[str] = []
+        self._t_cap = 8
+        self._k_cap = 4
+        self._dev = None            # jax [S, T_cap, K_cap] int64
+        # published fleet view: (fleet [T_cap, K_cap] np.int64, t_vocab,
+        # k_vocab) swapped atomically — readers never see a half-update
+        self._fleet: Optional[np.ndarray] = None
+        self.drains = 0
+        self.applied_deltas = 0
+        self.folds = 0
+
+    # ----------------------------------------------------------- internals
+    def bind_ledger(self, ledger) -> None:
+        self._ledger = ledger
+
+    def _ensure_dev_locked(self):
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            if self._dev is None:
+                self._dev = jnp.zeros(
+                    (self.n, self._t_cap, self._k_cap), jnp.int64)
+            return self._dev
+
+    def _grow_locked(self, t_need: int, k_need: int) -> None:
+        """Re-pad the device array when a vocab outgrows its capacity
+        (rare: tracker/resource vocabularies are config-bounded)."""
+        import jax.numpy as jnp
+        from jax.experimental import enable_x64
+
+        new_t = _next_pow2(max(t_need, self._t_cap), 8)
+        new_k = _next_pow2(max(k_need, self._k_cap), 4)
+        if new_t == self._t_cap and new_k == self._k_cap:
+            return
+        host = (np.asarray(self._dev) if self._dev is not None
+                else np.zeros((self.n, self._t_cap, self._k_cap), np.int64))
+        grown = np.zeros((self.n, new_t, new_k), np.int64)
+        grown[:, :host.shape[1], :host.shape[2]] = host
+        self._t_cap, self._k_cap = new_t, new_k
+        with enable_x64():
+            self._dev = jnp.asarray(grown)
+
+    def _index_locked(self, vocab: Dict[str, int], names: List[str],
+                      key: str) -> int:
+        idx = vocab.get(key)
+        if idx is None:
+            idx = len(names)
+            vocab[key] = idx
+            names.append(key)
+        return idx
+
+    # ------------------------------------------------------------------ API
+    def refresh(self, shard: int = 0, ledger=None) -> int:
+        """Drain the ledger's confirmed-usage journal into this shard's
+        device row and re-fold the fleet totals. One short ledger-lock
+        swap for the drain; the device work is jitted. Returns the number
+        of deltas applied."""
+        ledger = ledger if ledger is not None else self._ledger
+        if ledger is None:
+            return 0
+        deltas = ledger.drain_deltas()
+        if not deltas:
+            return 0
+        from jax.experimental import enable_x64
+
+        from yunikorn_tpu.ops.gate_solve import usage_apply, usage_fold
+
+        with self._mu:
+            rows: List[Tuple[int, int, int]] = []
+            t_need = len(self._t_names)
+            k_need = len(self._k_names)
+            for tid, items, sign in deltas:
+                t = self._index_locked(self._t_vocab, self._t_names, tid)
+                for rk, v in items:
+                    k = self._index_locked(self._k_vocab, self._k_names, rk)
+                    rows.append((t, k, sign * int(v)))
+            t_need = len(self._t_names)
+            k_need = len(self._k_names)
+            if t_need > self._t_cap or k_need > self._k_cap:
+                self._grow_locked(t_need, k_need)
+            dev = self._ensure_dev_locked()
+            b = len(rows)
+            b_pad = _next_pow2(b, 8)
+            t_idx = np.zeros((b_pad,), np.int32)
+            k_idx = np.zeros((b_pad,), np.int32)
+            vals = np.zeros((b_pad,), np.int64)
+            for i, (t, k, v) in enumerate(rows):
+                t_idx[i], k_idx[i], vals[i] = t, k, v
+            with enable_x64():
+                import jax.numpy as jnp
+
+                self._dev = usage_apply(
+                    dev, jnp.int32(shard % self.n), jnp.asarray(t_idx),
+                    jnp.asarray(k_idx), jnp.asarray(vals))
+                if (self._mesh is not None
+                        and self.n % self._mesh.devices.size == 0):
+                    from yunikorn_tpu.parallel.mesh import usage_fold_sharded
+
+                    fleet = usage_fold_sharded(self._dev, self._mesh)
+                else:
+                    fleet = usage_fold(self._dev)
+                self._fleet = np.asarray(fleet)
+            self.drains += 1
+            self.applied_deltas += b
+            self.folds += 1
+        return b
+
+    def provably_exceeds(self, charges) -> bool:
+        """True when the fleet's CONFIRMED usage plus this ask's charges
+        already breaks some limit — a hold the ledger is guaranteed to
+        agree with (its check only ADDS live reservations on top). Reads
+        the published fleet snapshot: zero locks, numpy probes only.
+        charges: [(tracker_id, limit_items, amount_items)]."""
+        fleet = self._fleet
+        if fleet is None:
+            return False
+        t_vocab = self._t_vocab
+        k_vocab = self._k_vocab
+        for tid, limit, amount in charges:
+            t = t_vocab.get(tid)
+            if t is None or t >= fleet.shape[0]:
+                continue  # tracker never charged: confirmed usage is 0
+            amt = dict(amount)
+            for rk, lim_v in limit:
+                k = k_vocab.get(rk)
+                used = int(fleet[t, k]) if (k is not None
+                                            and k < fleet.shape[1]) else 0
+                if used + amt.get(rk, 0) > lim_v:
+                    return True
+        return False
+
+    def host_usage(self) -> Dict[str, Dict[str, int]]:
+        """The mirror's fleet usage as {tracker: {resource: int}} (zero
+        entries filtered) — the side compared bit-for-bit against
+        GlobalQuotaLedger.usage_snapshot()."""
+        with self._mu:
+            fleet = self._fleet
+            t_names = list(self._t_names)
+            k_names = list(self._k_names)
+        out: Dict[str, Dict[str, int]] = {}
+        if fleet is None:
+            return out
+        for t, tid in enumerate(t_names):
+            row = {k_names[k]: int(fleet[t, k])
+                   for k in range(len(k_names)) if int(fleet[t, k]) != 0}
+            if row:
+                out[tid] = row
+        return out
+
+    def divergence(self, ledger=None) -> int:
+        """Cells where the mirror differs from the ledger's confirmed
+        usage, after draining any pending journal. The exactness oracle:
+        pinned at 0 by test across the failover suite; also published on
+        the shard_ledger_mirror_divergence gauge."""
+        ledger = ledger if ledger is not None else self._ledger
+        if ledger is None:
+            return 0
+        self.refresh(0, ledger)
+        truth = ledger.usage_snapshot()
+        mine = self.host_usage()
+        diff = 0
+        for tid in set(truth) | set(mine):
+            a = truth.get(tid, {})
+            b = mine.get(tid, {})
+            for rk in set(a) | set(b):
+                if a.get(rk, 0) != b.get(rk, 0):
+                    diff += 1
+        if self._gauge is not None:
+            self._gauge.set(diff)
+        return diff
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "trackers": len(self._t_names),
+                "resources": len(self._k_names),
+                "capacity": [self.n, self._t_cap, self._k_cap],
+                "drains": self.drains,
+                "applied_deltas": self.applied_deltas,
+                "folds": self.folds,
+                "sharded_fold": bool(
+                    self._mesh is not None
+                    and self.n % self._mesh.devices.size == 0),
+            }

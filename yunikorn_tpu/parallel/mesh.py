@@ -432,3 +432,25 @@ def preempt_solve_sharded(np_args, mesh: Mesh, *, max_candidates: int,
             {"max_candidates": max_candidates},
             pending_ok=aot_pending,
             extra=("mesh", mesh.devices.size), lower_cm=mesh)
+
+
+def usage_fold_sharded(usage, mesh: Mesh):
+    """psum-style cross-shard fold of the ledger usage mirror: the
+    [S, T, K] per-shard confirmed-usage array, sharded over the shard
+    axis like every node-dim tensor, reduces to the replicated [T, K]
+    fleet totals with ONE ICI all-reduce — the admission precheck then
+    reads pre-reduced fleet usage with zero lock acquisitions and zero
+    host gathers. S must be divisible by the mesh size (shards and
+    meshes are both powers of two by construction); parity with the
+    single-device ops/gate_solve.usage_fold is pinned by test."""
+    from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.devices.size
+    S = usage.shape[0]
+    assert S % n_dev == 0, f"shard count {S} not divisible by mesh {n_dev}"
+
+    fold = shard_map(
+        lambda u: jax.lax.psum(jnp.sum(u, axis=0), NODE_AXIS),
+        mesh=mesh, in_specs=P(NODE_AXIS), out_specs=P())
+    sharded = jax.device_put(usage, NamedSharding(mesh, P(NODE_AXIS)))
+    return fold(sharded)

@@ -63,7 +63,8 @@ class MockScheduler:
             slo_options=SloOptions.from_conf(holder.get()),
             failover_options=FailoverOptions.from_conf(holder.get()),
             journey_capacity=holder.get().obs_journey_capacity,
-            flightrec_options=FlightRecorderOptions.from_conf(holder.get()))
+            flightrec_options=FlightRecorderOptions.from_conf(holder.get()),
+            delivery_high_water=holder.get().solver_delivery_high_water)
         self.context = Context(self.cluster, self.core, cache=cache)
         self.shim = KubernetesShim(self.cluster, self.core, context=self.context)
 

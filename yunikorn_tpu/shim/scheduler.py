@@ -57,6 +57,12 @@ class KubernetesShim:
             if hasattr(api_provider, "attach_metrics"):
                 # reflector restarts + last-sync-age gauges (real provider)
                 api_provider.attach_metrics(obs)
+            pool = getattr(self.context, "bind_pool", None)
+            if pool is not None and hasattr(pool, "attach_metrics"):
+                # per-shard bind-pool depth/throughput next to the queue
+                # depth gauges: the whole async ingest→bind path scrapes
+                # from one registry
+                pool.attach_metrics(obs)
         # health sources beyond the core's own (scheduling loop + solver
         # circuits): informer staleness and dispatcher backlog join the
         # /ws/v1/health report when the core carries a monitor

@@ -151,7 +151,9 @@ class RestServer:
                                                       "unavailable"})
                 if path == "/ws/v1/shards":
                     # control-plane sharding (core/shard.py): per-shard
-                    # node/commit/cycle counts, repair-pass + quota-ledger
+                    # node/commit/cycle counts + async delivery-queue
+                    # stats (depth/delivered/shed/dead per shard),
+                    # repair-pass + quota-ledger + device-usage-mirror
                     # + partition-epoch state. 404 on the single-shard
                     # scheduler — the surface exists only when sharded
                     if hasattr(core, "shard_report"):
