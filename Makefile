@@ -3,7 +3,7 @@
 .PHONY: test bench bench-small bench-smoke obs-smoke preempt-smoke \
 	chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke \
 	aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke \
-	failover-smoke trace-smoke async-smoke \
+	failover-smoke trace-smoke async-smoke ledger-smoke \
 	smoke lint run-scheduler run-admission dryrun clean image \
 	sched_image adm_image webtest_image
 
@@ -184,7 +184,26 @@ async-smoke:  ## async shard front end (round 20): delivery-queue/mirror/bind-po
 		--wedge-shard 1 --assert-quality --stall 6 \
 		--min-speedup 0.5 --min-drain 0.3
 
-smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke trace-smoke async-smoke  ## all tier-1 smoke targets
+ledger-smoke:  ## ledger-as-a-service (round 22): protocol/idempotency/degraded-mode/lease unit suite (incl. slow chaos shapes), then the chaos drills — a 4-shard gang-storm with the quota authority behind the socket and a mid-storm NETSPLIT under --assert-slo (degraded-mode admission carries the storm, journal replay reconverges, zero violations), a host-kill drill (--kill-mode lease: a stale peer lease on the liveness authority expires and its shard is quarantined/re-homed under --assert-failover), and the fail-closed starvation shape under --expect-violation (admission REJECTS while partitioned; the SLO engine must detect it)
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python -m pytest tests/test_ledger_service.py -q -p no:cacheprovider
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --shards 4 \
+		--ledger-socket --quota-max-vcore 10000000 --fault netsplit \
+		--assert-slo
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --shards 4 \
+		--ledger-socket --quota-max-vcore 10000000 --kill-shard 1 \
+		--kill-mode lease --lease-ttl 4 --assert-failover --assert-slo
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+		python scripts/trace_replay.py --trace gang-storm --nodes 400 \
+		--pods 320 --tenants 4 --duration 12 --shards 4 \
+		--ledger-socket --quota-max-vcore 10000000 --fault netsplit \
+		--ledger-fail-closed --slo-e2e 15 --expect-violation
+
+smoke: bench-smoke obs-smoke preempt-smoke chaos-smoke gate-smoke gate-device-smoke pack-smoke cvx-smoke aot-smoke slo-smoke topology-smoke shard-smoke policy-smoke failover-smoke trace-smoke async-smoke ledger-smoke  ## all tier-1 smoke targets
 
 run-scheduler:  ## scheduler binary with synthetic nodes + REST on :9080
 	python -m yunikorn_tpu.cmd.scheduler --nodes 100

@@ -144,6 +144,7 @@ def _backend_unavailable_json(error: str, init_secs: float) -> str:
         "trace": {"spans_by_stage": {}, "journeys": 0,
                   "journey_complete_ratio": 1.0, "recordings": 0,
                   "recordings_by_trigger": {}},
+        "ledger": {"mode": "local", "rpc": False},
     })
 
 
@@ -491,6 +492,36 @@ def _trace_block(core) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _ledger_block(core) -> dict:
+    """Quota-boundary evidence for the bench JSON (round 22): which
+    admission plane the run used. mode "local" is the direct in-process
+    ledger object; rpc=true means the shards rode the
+    core/ledger_service.py socket boundary, and mode then reports the
+    client's live state (remote / degraded / fail_closed) plus its
+    degraded-admission and replay counters. The microbench itself always
+    runs the direct ledger — the direct-vs-socket overhead table lives in
+    PERF.md — but the block rides every JSON shape (incl.
+    backend-unavailable) so a socket-coupled run is always attributable.
+    Same contract as _slo_block: errors carried, never fabricated
+    zeros."""
+    try:
+        rpc = bool(getattr(core, "_ledger_rpc", False))
+        ledger = (getattr(core, "ledger", None)
+                  or getattr(core, "quota_ledger", None))
+        block = {"rpc": rpc,
+                 "mode": str(getattr(ledger, "mode", "local"))}
+        if rpc and ledger is not None:
+            block.update({
+                "degraded_admits": int(ledger.degraded_admits),
+                "degraded_rejects": int(ledger.degraded_rejects),
+                "replayed_ops": int(ledger.replayed_ops),
+                "contention_retries": int(ledger.contention_retries),
+            })
+        return block
+    except Exception as e:
+        return {"mode": "error", "error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _duel_wins(core) -> dict:
     """Committed-plan mix by winning arm (duel_wins_total{arm}): one count
     per duel CYCLE, unlike policy_duels_total's per-participant rows."""
@@ -713,7 +744,7 @@ def run_shim_mode(shim_pods: int, shim_nodes: int):
                 _preempt_stat(ms.core), _degradations(ms.core),
                 _cycle_stats(ms.core), _slo_block(ms.core),
                 _topology_block(ms.core), _policy_block(ms.core),
-                _trace_block(ms.core))
+                _trace_block(ms.core), _ledger_block(ms.core))
     finally:
         ms.stop()
 
@@ -869,6 +900,7 @@ def main() -> int:
         "topology": _topology_block(core),
         "policy": _policy_block(core),
         "trace": _trace_block(core),
+        "ledger": _ledger_block(core),
     }
 
     if MODE == "both":
@@ -894,7 +926,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
     shim e2e rides along; standalone shim mode publishes the shim number."""
     (shim_tp, shim_wall, bound, total, shim_preempt_ms, shim_degr,
      shim_cycle_stats, shim_slo, shim_topo,
-     shim_policy, shim_trace) = run_shim_mode(N_PODS, N_NODES)
+     shim_policy, shim_trace, shim_ledger) = run_shim_mode(N_PODS, N_NODES)
     print(f"# shim e2e: {bound}/{total} bound in {shim_wall:.1f}s "
           f"(first→last bind throughput {shim_tp:.0f} pods/s)", file=sys.stderr)
     if core_pods_per_s is None:
@@ -914,6 +946,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
             "topology": shim_topo,
             "policy": shim_policy,
             "trace": shim_trace,
+            "ledger": shim_ledger,
         }
     return {
         "metric": (f"pods-scheduled/sec (core cycle: quota+rank+encode+"
@@ -942,6 +975,7 @@ def _shim_result(platform: str, core_pods_per_s=None, core_warm_s=None,
         "topology": shim_topo,
         "policy": shim_policy,
         "trace": shim_trace,
+        "ledger": shim_ledger,
     }
 
 

@@ -76,6 +76,7 @@ Exit 0 = asserted condition holds; nonzero names the objective(s).
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import math
 import os
@@ -108,7 +109,8 @@ def _queues_yaml(tenants: List[str], max_vcore: int = 0) -> str:
 
 def generate_trace(trace: str, *, seed: int, nodes: int, pods: int,
                    tenants: int, duration: float,
-                   overcommit: float = 1.0) -> Tuple[List[tuple], dict]:
+                   overcommit: float = 1.0,
+                   quota_max_vcore: int = 0) -> Tuple[List[tuple], dict]:
     """Build the deterministic event list for one replay.
 
     Returns (events, meta): events is a time-sorted list of
@@ -245,7 +247,10 @@ def generate_trace(trace: str, *, seed: int, nodes: int, pods: int,
     events.sort(key=lambda e: (e[0], e[1]))
     meta = {
         "tenants": tnames,
-        "queues_yaml": _queues_yaml(tnames),
+        # a nonzero quota max creates one ledger tracker per tenant queue:
+        # every pod then rides reserve/confirm/release through the quota
+        # plane — the ledger chaos drills need that traffic on the wire
+        "queues_yaml": _queues_yaml(tnames, max_vcore=quota_max_vcore),
         "max_wave": max_wave,
         "pods_total": counter[0],
         "overcommit": overcommit,
@@ -280,11 +285,16 @@ class ReplayStack:
     does not own."""
 
     def __init__(self, server, port: int, conf_map: Dict[str, str],
-                 policy: str, recorder=None):
+                 policy: str, recorder=None, ledger_serve: bool = False):
         self.server = server
         self.port = port
         self.conf_map = dict(conf_map)
         self.policy = policy
+        # --ledger-socket: the quota authority serves behind a local
+        # socket and every shard couples through LedgerClient (the RPC
+        # boundary the netsplit/ledger-lag faults and the host-kill
+        # lease drill act on)
+        self.ledger_serve = bool(ledger_serve)
         # policy duel recorder (policy/train.DatasetWriter): re-attached on
         # every (re)boot so a restart-storm rebuild keeps recording
         self.recorder = recorder
@@ -323,6 +333,13 @@ class ReplayStack:
         self.provider = RealAPIProvider(cfg)
         cache = SchedulerCache()
         conf = holder.get()
+        ledger_kw = {}
+        if self.ledger_serve:
+            from yunikorn_tpu.core.ledger_service import LedgerClientOptions
+
+            ledger_kw = {"ledger_serve": True,
+                         "ledger_client_options":
+                             LedgerClientOptions.from_conf(conf)}
         self.core = make_core_scheduler(
             cache, shards=conf.solver_shards, interval=conf.interval,
             solver_options=SolverOptions.from_conf(conf),
@@ -330,7 +347,8 @@ class ReplayStack:
             slo_options=SloOptions.from_conf(conf),
             failover_options=FailoverOptions.from_conf(conf),
             journey_capacity=conf.obs_journey_capacity,
-            flightrec_options=FlightRecorderOptions.from_conf(conf))
+            flightrec_options=FlightRecorderOptions.from_conf(conf),
+            **ledger_kw)
         if self.recorder is not None:
             target = getattr(self.core, "primary", self.core)
             if hasattr(target, "policy_recorder"):
@@ -608,7 +626,8 @@ def run_replay(args, policy: str) -> dict:
     events, meta = generate_trace(
         args.trace, seed=args.seed, nodes=args.nodes, pods=args.pods,
         tenants=args.tenants, duration=args.duration,
-        overcommit=args.overcommit)
+        overcommit=args.overcommit,
+        quota_max_vcore=getattr(args, "quota_max_vcore", 0))
 
     t_run0 = time.time()
     server = FakeAPIServer()
@@ -673,6 +692,14 @@ def run_replay(args, policy: str) -> dict:
         "robustness.failoverProbeSeconds": str(args.failover_probe),
         "robustness.failoverRejoinSeconds": str(args.failover_rejoin),
     }
+    if args.ledger_socket:
+        # ledger-as-a-service (round 22): the lease TTL is compressed so
+        # the --kill-mode lease drill detects the dead peer inside the
+        # trace window; fail-closed flips degraded-mode admission from
+        # conservative-local to reject-everything
+        conf_map["robustness.ledgerLeaseTtlSeconds"] = str(args.lease_ttl)
+        conf_map["robustness.ledgerFailClosed"] = (
+            "true" if args.ledger_fail_closed else "false")
     if args.flightrec_dir:
         # triggered flight recorder (round 20): SLO violations, shard
         # quarantines, breaker exhaustion and watchdog abandonment each
@@ -717,7 +744,8 @@ def run_replay(args, policy: str) -> dict:
         recorder = DatasetWriter(ds_path,
                                  max_cycles=args.dataset_max_cycles)
 
-    stack = ReplayStack(server, port, conf_map, policy, recorder=recorder)
+    stack = ReplayStack(server, port, conf_map, policy, recorder=recorder,
+                        ledger_serve=args.ledger_socket)
     ledger = {"completed": set()}
     timings: Dict[str, object] = {}
     try:
@@ -880,15 +908,36 @@ def run_replay(args, policy: str) -> dict:
                 idx = int(payload)
                 print(f"[replay] killing shard {idx} mid-storm "
                       f"({args.kill_mode})", file=sys.stderr, flush=True)
-                core_k = stack.core.shards[idx]
-                if args.kill_mode == "crash":
+                if args.kill_mode == "lease":
+                    # host-kill drill: a peer host registers ownership of
+                    # this shard on the ledger liveness authority and then
+                    # never heartbeats — its lease expires after the
+                    # compressed TTL and the HostLeaseMonitor drives the
+                    # shard through quarantine/re-home exactly as if the
+                    # owning HOST had died
+                    stack.core.ledger.register_host_shards(
+                        f"peer-{idx}", [idx])
+                elif args.kill_mode == "crash":
                     # the next assign dispatch unwinds the loop thread
-                    core_k.supervisor.faults.crash("assign")
+                    stack.core.shards[idx].supervisor.faults.crash("assign")
                 else:
-                    core_k.supervisor.faults.slow(
+                    stack.core.shards[idx].supervisor.faults.slow(
                         "assign", seconds=3.0 * args.dispatch_deadline,
                         times=100_000)
             elif kind == "fault_set":
+                if payload in ("netsplit", "ledger-lag"):
+                    nf = stack.core.ledger.netfaults
+                    if payload == "netsplit":
+                        print("[replay] partitioning the ledger transport "
+                              "(netsplit): breaker opens, degraded-mode "
+                              "admission takes over", file=sys.stderr,
+                              flush=True)
+                        nf.partition()
+                    else:
+                        print("[replay] injecting 150ms per-frame ledger "
+                              "lag", file=sys.stderr, flush=True)
+                        nf.delay(0.15)
+                    continue
                 print(f"[replay] injecting fault {payload!r} on the assign "
                       f"path", file=sys.stderr, flush=True)
                 if payload == "hang":
@@ -900,9 +949,15 @@ def run_replay(args, policy: str) -> dict:
                 else:
                     stack.core.supervisor.faults.fail_forever("assign")
             elif kind == "fault_clear":
-                print("[replay] clearing injected fault", file=sys.stderr,
-                      flush=True)
-                stack.core.supervisor.faults.clear()
+                if args.fault in ("netsplit", "ledger-lag"):
+                    print("[replay] healing the ledger transport (journal "
+                          "replay reconverges the authority)",
+                          file=sys.stderr, flush=True)
+                    stack.core.ledger.netfaults.heal()
+                else:
+                    print("[replay] clearing injected fault",
+                          file=sys.stderr, flush=True)
+                    stack.core.supervisor.faults.clear()
         timings["trace_s"] = round(time.time() - t_trace0, 2)
 
         # ---- drain: everything created must bind (even across the fault
@@ -978,7 +1033,40 @@ def run_replay(args, policy: str) -> dict:
                 "repair_migrated": srep["repair"]["migrated"],
                 "quota_violations": len(core.ledger.audit()),
             }
+            # ledger reconvergence contract (round 22): audit() must come
+            # back clean (quota_violations above pins it), and the
+            # AGGREGATE confirmed usage at drain end is a pure function
+            # of the surviving pod set — equal for a same-seed run with
+            # the ledger behind the socket, even across a netsplit +
+            # degraded window. (The per-tenant split is racy — which
+            # queue a churned pod's replacement lands on is timing-
+            # dependent — so the raw snapshot rides timings, not the
+            # fingerprint.)
+            lrpc = bool(getattr(core, "_ledger_rpc", False))
+            usage = core.ledger.usage_snapshot()
+            totals: Dict[str, int] = {}
+            for items in usage.values():
+                for rk, v in items.items():
+                    totals[rk] = totals.get(rk, 0) + v
+            shard_block["ledger"] = {"rpc": lrpc, "usage_totals": totals}
             timings["shard_ledger"] = srep["ledger"]
+            timings["ledger_usage"] = usage
+            timings["ledger_usage_hash"] = hashlib.sha256(json.dumps(
+                usage, sort_keys=True,
+                separators=(",", ":")).encode()).hexdigest()[:16]
+            if lrpc:
+                # RPC-plane facts are timing-dependent (how many cycles
+                # landed inside the fault window) and ride timings
+                timings["ledger_rpc"] = {
+                    "mode": core.ledger.mode,
+                    "contention_retries": core.ledger.contention_retries,
+                    "degraded_admits": core.ledger.degraded_admits,
+                    "degraded_rejects": core.ledger.degraded_rejects,
+                    "replayed_ops": core.ledger.replayed_ops,
+                    "lease_expiries": (
+                        core.lease_monitor.expiries_seen
+                        if core.lease_monitor is not None else 0),
+                }
             if args.kill_shard >= 0:
                 # which asks landed on the dying shard before the kill is
                 # detection-timing-dependent: per-shard splits and repair
@@ -1181,10 +1269,17 @@ def main() -> int:
     ap.add_argument("--overcommit", type=float, default=1.0,
                     help=">1.0 scales pod cpu to create contention "
                          "(preemption A/B); default fully placeable")
-    ap.add_argument("--fault", choices=("none", "hang", "fail"),
+    ap.add_argument("--fault",
+                    choices=("none", "hang", "fail", "netsplit",
+                             "ledger-lag"),
                     default="none",
-                    help="inject a robustness/faults.py fault on the "
-                         "supervised assign path mid-trace")
+                    help="inject a robustness/faults.py fault mid-trace: "
+                         "hang/fail act on the supervised assign path; "
+                         "netsplit/ledger-lag act on the ledger RPC "
+                         "transport (need --ledger-socket) — netsplit "
+                         "partitions it (degraded-mode admission must "
+                         "carry the storm, journal replay reconverges on "
+                         "heal), ledger-lag adds 150ms per frame")
     ap.add_argument("--restart-mode", choices=("inprocess", "process"),
                     default="inprocess",
                     help="restart-storm restart shape: inprocess rebuilds "
@@ -1201,10 +1296,14 @@ def main() -> int:
                     help="kill this shard's scheduling loop mid-trace "
                          "(needs --shards >= 2): the failover supervisor "
                          "must quarantine it and re-home its domains")
-    ap.add_argument("--kill-mode", choices=("crash", "wedge"),
+    ap.add_argument("--kill-mode", choices=("crash", "wedge", "lease"),
                     default="crash",
                     help="crash = faults.crash unwinds the loop thread; "
-                         "wedge = slow fault past every dispatch deadline")
+                         "wedge = slow fault past every dispatch deadline; "
+                         "lease = host-kill drill (needs --ledger-socket): "
+                         "a stale peer lease on the ledger liveness "
+                         "authority expires and the HostLeaseMonitor "
+                         "quarantines/re-homes the dead host's shard")
     ap.add_argument("--failover-stale", type=float, default=120.0,
                     help="robustness.failoverStaleSeconds for the replay")
     ap.add_argument("--failover-probe", type=float, default=0.5,
@@ -1216,6 +1315,32 @@ def main() -> int:
                          "shard was quarantined, 100%% of its nodes "
                          "re-homed, the ledger audit stayed clean and "
                          "every pod bound")
+    ap.add_argument("--ledger-socket", action="store_true",
+                    help="serve the quota-ledger authority behind a local "
+                         "socket (core/ledger_service.py) and couple "
+                         "every shard through LedgerClient: reserve/"
+                         "confirm/release ride the RPC boundary with "
+                         "deadlines, idempotent replay, a circuit breaker "
+                         "and degraded-mode admission (needs --shards "
+                         ">= 2); the fingerprint's ledger usage hash must "
+                         "stay bit-equal to the in-process run")
+    ap.add_argument("--ledger-fail-closed", action="store_true",
+                    help="robustness.ledgerFailClosed=true: degraded-mode "
+                         "admission REJECTS while the ledger is "
+                         "unreachable — pair with --fault netsplit "
+                         "--expect-violation (the starvation IS the "
+                         "detected violation)")
+    ap.add_argument("--lease-ttl", type=float, default=6.0,
+                    help="robustness.ledgerLeaseTtlSeconds for the replay "
+                         "(compressed so --kill-mode lease detects the "
+                         "dead peer mid-trace)")
+    ap.add_argument("--quota-max-vcore", type=int, default=0,
+                    help="per-tenant-queue vcore max in the trace's "
+                         "queues.yaml (0 = unlimited = NO ledger "
+                         "trackers): set a generous value so every pod "
+                         "rides reserve/confirm/release through the quota "
+                         "plane — required for the ledger chaos drills to "
+                         "put real traffic on the RPC boundary")
     # --takeover*: internal (the fresh-process child)
     ap.add_argument("--takeover", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--takeover-port", type=int, default=0,
@@ -1312,6 +1437,19 @@ def main() -> int:
         print(f"[replay] FAIL: --kill-shard {args.kill_shard} needs "
               f"--shards >= 2 with the index in range (got --shards "
               f"{args.shards})", file=sys.stderr, flush=True)
+        return 2
+    needs_ledger = (args.fault in ("netsplit", "ledger-lag")
+                    or args.kill_mode == "lease" or args.ledger_fail_closed)
+    if needs_ledger and not args.ledger_socket:
+        print("[replay] FAIL: --fault netsplit|ledger-lag, --kill-mode "
+              "lease and --ledger-fail-closed act on the ledger RPC "
+              "transport — add --ledger-socket", file=sys.stderr,
+              flush=True)
+        return 2
+    if args.ledger_socket and args.shards < 2:
+        print("[replay] FAIL: --ledger-socket needs --shards >= 2 (a "
+              "single shard keeps the direct in-process ledger by "
+              "contract)", file=sys.stderr, flush=True)
         return 2
 
     if args.ab:

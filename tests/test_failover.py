@@ -444,6 +444,11 @@ def test_crash_recover_crash_storm_leaks_no_threads():
         assert front.failover.quarantines == 3
         assert front.failover.rejoins == 3
         assert front.ledger.audit() == []
+        # round-22 journal-fence pin: three fence/rebuild cycles (each
+        # quarantine bumps the victim's journal epoch, each zombie drain
+        # requeues) must leave the device mirror bit-equal to the ledger
+        if front.usage_mirror is not None:
+            assert front.usage_mirror.divergence(front.ledger) == 0
     finally:
         front.stop()
 

@@ -1,0 +1,437 @@
+"""Ledger-as-a-service suite (core/ledger_service.py + the round-22 fault
+plane in robustness/faults.py):
+
+  * wire parity: a scripted reserve/commit/release workload driven through
+    LedgerServer/LedgerClient leaves the authority bit-equal to the same
+    workload applied to an in-process GlobalQuotaLedger;
+  * randomized idempotency property: every op delivered 1-3 times in
+    shuffled order (the exact abuse the RPC retry path produces) leaves
+    audit() clean and usage equal to exactly-once delivery; a second arm
+    drops per-key suffixes entirely (0 deliveries — the client gave up)
+    and the audit must STILL be clean;
+  * the server's duplicate cache and per-(client,key) seq fence, counted;
+  * degraded mode: a netsplit pushes the client into conservative local
+    admission, the unacked journal replays on reconnect, and the
+    authority's usage re-converges bit-equal with audit() clean;
+  * failClosed admits nothing while partitioned and recovers cleanly;
+  * a flapping transport neither wedges the caller nor leaks threads;
+  * victim-credit ops round-trip the socket (one credit = one attempt);
+  * HostLeaseMonitor: an expired peer lease quarantines exactly that
+    peer's shards; an expired OWN lease re-registers instead of
+    self-amputating; ShardSupervisor.note_quarantined records the
+    lease-driven quarantine in the failover report;
+  * the DeviceUsageMirror journal fence: a zombie refresh presenting a
+    stale epoch folds nothing, its drained deltas requeue, and
+    divergence() stays 0.
+
+Multi-second scenarios (the flap storm) carry @pytest.mark.slow; the
+fast tests ride tier-1.
+"""
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from yunikorn_tpu.core.ledger_service import (
+    MODE_DEGRADED,
+    MODE_FAIL_CLOSED,
+    MODE_REMOTE,
+    LedgerClient,
+    LedgerClientOptions,
+    LedgerServer,
+)
+from yunikorn_tpu.core.shard import GlobalQuotaLedger
+from yunikorn_tpu.robustness.failover import (
+    QUARANTINED,
+    FailoverOptions,
+    HostLeaseMonitor,
+    ShardSupervisor,
+)
+from yunikorn_tpu.robustness.faults import NetFaultPlane
+
+
+def _ch(tid, lim, amt, rk="vcore"):
+    """One-tracker charge list in gate.ledger_charges shape."""
+    return [(tid, [(rk, lim)], [(rk, amt)])]
+
+
+def _snapshot(ledger):
+    return json.dumps(ledger.usage_snapshot(), sort_keys=True)
+
+
+class _Served:
+    """Authority ledger behind a LedgerServer plus one LedgerClient,
+    torn down reliably."""
+
+    def __init__(self, options=None, faults=None, server_faults=None):
+        self.authority = GlobalQuotaLedger()
+        self.server = LedgerServer(self.authority, faults=server_faults)
+        self.server.start()
+        self.client = LedgerClient(
+            self.server.endpoint,
+            options or LedgerClientOptions(deadline_s=2.0),
+            faults=faults, client_id="t")
+
+    def close(self):
+        self.client.close()
+        self.server.stop()
+
+
+@pytest.fixture
+def served():
+    s = _Served()
+    yield s
+    s.close()
+
+
+# ---------------------------------------------------------------------------
+# wire parity
+# ---------------------------------------------------------------------------
+def _scripted_workload(led):
+    """A lifecycle mix: confirmed, reserved-then-dropped, released,
+    refused (tight limit), and an empty-charge no-op."""
+    out = []
+    out.append(led.reserve("a1", _ch("tq", 100, 40)))
+    led.commit("a1", _ch("tq", 100, 40))
+    out.append(led.reserve("a2", _ch("tq", 100, 30)))
+    led.commit("a2", _ch("tq", 100, 30))
+    out.append(led.reserve("a3", _ch("tq", 100, 50)))   # 40+30+50 > 100
+    out.append(led.reserve("a4", _ch("tq", 100, 20)))
+    led.release_reservation("a4")
+    out.append(led.reserve("a5", _ch("uq", 10, 10)))
+    led.commit("a5", _ch("uq", 10, 10))
+    led.release("a2")
+    out.append(led.reserve("a6", []))                    # no limits anywhere
+    out.extend(led.reserve_many([
+        ("b1", _ch("tq", 100, 25)),
+        ("b2", _ch("tq", 100, 60)),                      # 40+25+60 > 100
+        ("b3", []),
+    ]))
+    led.commit("b1", _ch("tq", 100, 25))
+    return out
+
+
+def test_wire_parity_scripted(served):
+    direct = GlobalQuotaLedger()
+    want = _scripted_workload(direct)
+    got = _scripted_workload(served.client)
+    assert got == want
+    assert _snapshot(served.authority) == _snapshot(direct)
+    assert served.client.audit() == direct.audit() == []
+    ds, ss = direct.stats(), served.authority.stats()
+    for k in ("trackers", "reservations", "charged_keys", "reserve_held"):
+        assert ss[k] == ds[k], k
+    # refusal counters piggyback on reserve responses
+    assert served.client.reserve_held == direct.reserve_held > 0
+    assert served.client.mode == MODE_REMOTE
+    assert served.server.requests > 0
+
+
+# ---------------------------------------------------------------------------
+# randomized idempotency property
+# ---------------------------------------------------------------------------
+def _random_tape(rng, n_keys):
+    """Per-key op tapes in the shapes the client actually produces:
+    commit only ever follows an acked reserve; limits are generous so
+    every reserve succeeds (the client never commits a refused ask)."""
+    tape = []
+    for i in range(n_keys):
+        key = f"k{i}"
+        tid = f"t{rng.randrange(3)}"
+        amt = rng.randrange(1, 9)
+        charges = _ch(tid, 10_000, amt)
+        tape.append(("reserve", key, charges))
+        shape = rng.randrange(4)
+        if shape == 0:
+            tape.append(("release_reservation", key, None))
+        elif shape >= 1:
+            tape.append(("commit", key, charges))
+            if shape == 3:
+                tape.append(("release", key, None))
+    return tape
+
+
+def _apply_direct(led, op, key, charges):
+    if op == "reserve":
+        led.reserve(key, charges)
+    elif op == "commit":
+        led.commit(key, charges)
+    elif op == "release":
+        led.release(key)
+    else:
+        led.release_reservation(key)
+
+
+def _frame(op, key, charges, seq):
+    args = {"key": key}
+    if op in ("reserve", "commit"):
+        args["charges"] = charges
+    return {"op": op, "args": args, "client": "c", "seq": seq,
+            "id": f"c:{seq}"}
+
+
+def test_idempotency_dup_reorder_property():
+    """Every op delivered 1-3 times, fully shuffled: the duplicate cache
+    and the per-key seq fence must make the result equal to exactly-once
+    in-order delivery — clean audit, identical usage AND reservations."""
+    for trial in range(6):
+        rng = random.Random(4200 + trial)
+        tape = _random_tape(rng, n_keys=12)
+        direct = GlobalQuotaLedger()
+        for op, key, charges in tape:
+            _apply_direct(direct, op, key, charges)
+
+        authority = GlobalQuotaLedger()
+        server = LedgerServer(authority)
+        deliveries = []
+        for seq, (op, key, charges) in enumerate(tape, start=1):
+            deliveries += [_frame(op, key, charges, seq)] * rng.randrange(
+                1, 4)
+        rng.shuffle(deliveries)
+        for frame in deliveries:
+            resp = server._apply(frame)
+            assert resp["ok"], resp
+        assert _snapshot(authority) == _snapshot(direct), f"trial {trial}"
+        assert authority.audit() == direct.audit() == []
+        assert (authority.stats()["reservations"]
+                == direct.stats()["reservations"])
+        assert server.duplicates > 0
+
+
+def test_idempotency_dropped_suffix_stays_clean():
+    """0-delivery arm: per key, a random SUFFIX of its ops never arrives
+    (the client died with them journaled). The audit must stay clean,
+    and the end state must equal exactly-once in-order delivery of the
+    ops that DID arrive."""
+    rng = random.Random(77)
+    tape = _random_tape(rng, n_keys=15)
+    drop_from = {}   # key -> tape position past which its ops are dropped
+    for i in range(15):
+        if rng.random() < 0.4:
+            drop_from[f"k{i}"] = rng.randrange(len(tape))
+    delivered = [(seq, op, key, charges)
+                 for seq, (op, key, charges) in enumerate(tape, start=1)
+                 if seq - 1 < drop_from.get(key, len(tape))]
+    assert len(delivered) < len(tape)         # the drops actually happened
+    direct = GlobalQuotaLedger()
+    for _seq, op, key, charges in delivered:
+        _apply_direct(direct, op, key, charges)
+
+    authority = GlobalQuotaLedger()
+    server = LedgerServer(authority)
+    deliveries = []
+    for seq, op, key, charges in delivered:
+        deliveries += [_frame(op, key, charges, seq)] * rng.randrange(1, 4)
+    rng.shuffle(deliveries)
+    for frame in deliveries:
+        assert server._apply(frame)["ok"]
+    assert authority.audit() == []
+    assert _snapshot(authority) == _snapshot(direct)
+
+
+def test_server_duplicate_cache_and_stale_fence():
+    authority = GlobalQuotaLedger()
+    server = LedgerServer(authority)
+    f1 = _frame("reserve", "x", _ch("tq", 100, 10), seq=1)
+    r1 = server._apply(f1)
+    assert r1 == server._apply(f1)          # cached byte-equal response
+    assert server.duplicates == 1
+    f3 = _frame("release", "x", None, seq=3)
+    assert server._apply(f3)["ok"]
+    # a stale reorder (seq 2 < applied seq 3 on key x) is a success no-op
+    f2 = _frame("commit", "x", _ch("tq", 100, 10), seq=2)
+    r2 = server._apply(f2)
+    assert r2["ok"] and r2.get("stale")
+    assert server.stale_drops == 1
+    assert authority.usage_snapshot() == {}
+    assert authority.stats()["reservations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# degraded mode
+# ---------------------------------------------------------------------------
+def _chaos_options(**kw):
+    base = dict(deadline_s=0.2, max_retries=0, backoff_base_s=0.01,
+                backoff_cap_s=0.02, breaker_threshold=1,
+                probe_interval_s=0.15)
+    base.update(kw)
+    return LedgerClientOptions(**base)
+
+
+def test_degraded_reconverges_bit_equal():
+    faults = NetFaultPlane()
+    s = _Served(options=_chaos_options(), faults=faults)
+    try:
+        c = s.client
+        assert c.reserve("a1", _ch("tq", 100, 40))
+        c.commit("a1", _ch("tq", 100, 40))
+        assert c.usage_snapshot() == {"tq": {"vcore": 40}}  # warms the cache
+        faults.partition()
+        # conservative local admission: last cached usage (40) + pending
+        assert c.reserve("a2", _ch("tq", 100, 30))
+        c.commit("a2", _ch("tq", 100, 30))
+        assert not c.reserve("a3", _ch("tq", 100, 50))   # 40+30+50 > 100
+        assert c.mode == MODE_DEGRADED
+        assert c.degraded_admits == 1 and c.degraded_rejects == 1
+        # the authority saw none of it yet
+        assert s.authority.usage_snapshot() == {"tq": {"vcore": 40}}
+        faults.heal()
+        time.sleep(c.options.probe_interval_s + 0.05)
+        # the next call is the half-open probe: journal replays FIRST
+        assert c.reserve("a4", _ch("tq", 100, 20))
+        assert c.mode == MODE_REMOTE
+        assert c.replayed_ops >= 2        # reserve(a2) + commit(a2)
+        assert not c._unacked and not c._local_charges
+        # bit-equal to the same workload applied exactly once in-process
+        direct = GlobalQuotaLedger()
+        direct.reserve("a1", _ch("tq", 100, 40))
+        direct.commit("a1", _ch("tq", 100, 40))
+        direct.reserve("a2", _ch("tq", 100, 30))
+        direct.commit("a2", _ch("tq", 100, 30))
+        direct.reserve("a4", _ch("tq", 100, 20))
+        assert _snapshot(s.authority) == _snapshot(direct)
+        assert s.authority.audit() == []
+    finally:
+        s.close()
+
+
+def test_fail_closed_admits_nothing():
+    faults = NetFaultPlane()
+    s = _Served(options=_chaos_options(fail_closed=True), faults=faults)
+    try:
+        c = s.client
+        assert c.reserve("a1", _ch("tq", 100, 40))
+        faults.partition()
+        assert not c.reserve("a2", _ch("tq", 100, 1))
+        assert not c.reserve("a3", _ch("tq", 100, 1))
+        assert c.mode == MODE_FAIL_CLOSED
+        assert c.degraded_admits == 0 and c.degraded_rejects == 2
+        assert not c._local_charges
+        faults.heal()
+        time.sleep(c.options.probe_interval_s + 0.05)
+        assert c.reserve("a4", _ch("tq", 100, 20))
+        assert c.mode == MODE_REMOTE
+        # refused degraded reserves must not have replayed as reserves
+        assert s.authority.stats()["reservations"] == 2   # a1 + a4
+        assert s.authority.audit() == []
+    finally:
+        s.close()
+
+
+@pytest.mark.slow
+def test_flap_storm_never_wedges_or_leaks():
+    """Repeated open/half-open/close breaker cycles with journal replay
+    on every heal: the pump thread never wedges and nothing leaks."""
+    faults = NetFaultPlane()
+    s = _Served(options=_chaos_options(deadline_s=0.1), faults=faults)
+    try:
+        c = s.client
+        before = threading.active_count()
+        faults.flap(period_s=0.3, down_fraction=0.5)
+        deadline = time.time() + 2.5
+        i = 0
+        while time.time() < deadline:
+            key = f"f{i}"
+            if c.reserve(key, _ch("tq", 1_000_000, 1)):
+                c.commit(key, _ch("tq", 1_000_000, 1))
+            i += 1
+            time.sleep(0.01)
+        assert i > 50, "caller wedged under flap"
+        faults.heal()
+        time.sleep(c.options.probe_interval_s + 0.05)
+        for _ in range(3):                 # drain the journal fully
+            assert c.reserve("final", _ch("tq", 1_000_000, 1))
+            if not c._unacked:
+                break
+        assert c.mode == MODE_REMOTE
+        assert not c._unacked
+        assert s.authority.audit() == []
+        assert threading.active_count() <= before + 1
+    finally:
+        s.close()
+    time.sleep(0.1)
+
+
+# ---------------------------------------------------------------------------
+# victim credits + host leases over the boundary
+# ---------------------------------------------------------------------------
+def test_victim_credits_over_socket(served):
+    c = served.client
+    c.post_victim_credit("pod-1", shard=1)
+    c.post_victim_credit("pod-2", shard=0)
+    assert c.victim_credits(1) == ["pod-1"]
+    assert c.consume_victim_credit("pod-1") is True
+    assert c.consume_victim_credit("pod-1") is False   # one credit, once
+    c.clear_victim_credit("pod-2")
+    assert c.victim_credits(0) == []
+    assert served.authority.stats()["victim_credits"] == 0
+
+
+def test_host_lease_monitor_quarantines_expired_peer():
+    led = GlobalQuotaLedger()
+    calls = []
+    mon = HostLeaseMonitor(led, "h0", [0], lambda i, r: calls.append((i, r)),
+                           ttl_s=0.08, interval_s=60.0)
+    mon.poll_once()
+    led.register_host_shards("h1", [1, 2])   # peer that never heartbeats
+    t0 = time.time()
+    while time.time() - t0 < 0.2:
+        mon.poll_once()                       # own heartbeats keep h0 alive
+        if calls:
+            break
+        time.sleep(0.02)
+    assert calls == [(1, "lease:h1"), (2, "lease:h1")]
+    assert mon.expiries_seen == 1             # counted per host, not shard
+    assert "h0" in led.host_leases() and "h1" not in led.host_leases()
+    assert mon.poll_once() == []              # expiry fired exactly once
+
+
+def test_host_lease_monitor_own_expiry_reregisters():
+    led = GlobalQuotaLedger()
+    calls = []
+    mon = HostLeaseMonitor(led, "h0", [0], lambda i, r: calls.append((i, r)),
+                           ttl_s=0.05, interval_s=60.0)
+    mon.poll_once()
+    time.sleep(0.1)                           # let our own lease lapse
+    dead = mon.poll_once()                    # sees itself expired
+    assert dead == [] and calls == []         # never self-amputates
+    mon.poll_once()                           # re-registers
+    assert "h0" in led.host_leases()
+
+
+def test_note_quarantined_records_lease_driven_quarantine():
+    sup = ShardSupervisor(2, FailoverOptions(), lambda i, r: True,
+                          lambda i: True)
+    sup.note_quarantined(1, "lease:h1", rehome_s=0.02)
+    rep = sup.report()
+    assert rep["states"]["1"] == QUARANTINED
+    assert rep["quarantines"] == 1
+    assert sup.last_event["reason"] == "lease:h1"
+    sup.note_quarantined(1, "lease:h1")       # idempotent on a dead shard
+    assert sup.report()["quarantines"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mirror journal fence
+# ---------------------------------------------------------------------------
+def test_mirror_epoch_fence_requeues_and_divergence_zero():
+    from yunikorn_tpu.ops.ledger_mirror import DeviceUsageMirror
+
+    led = GlobalQuotaLedger()
+    mirror = DeviceUsageMirror(2)
+    led.attach_mirror(mirror)
+    led.reserve("a1", _ch("tq", 100, 40))
+    led.commit("a1", _ch("tq", 100, 40))
+    stale = mirror.epoch_of(0)
+    mirror.fence_shard(0)                     # quarantine bumps the epoch
+    # the zombie presents its pre-fence stamp: nothing folds, the drained
+    # deltas land back on the ledger journal
+    assert mirror.refresh(0, led, epoch=stale) == 0
+    assert mirror.stats()["fenced_refreshes"] >= 1
+    assert mirror.host_usage().get("tq", {}).get("vcore", 0) == 0
+    # a live refresh with the current stamp applies the requeued deltas
+    assert mirror.refresh(0, led, epoch=mirror.epoch_of(0)) >= 1
+    assert mirror.host_usage() == {"tq": {"vcore": 40}}
+    assert mirror.divergence(led) == 0

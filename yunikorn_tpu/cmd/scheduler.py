@@ -87,6 +87,21 @@ def main(argv=None) -> int:
                              "(0 = never): moved ICI domains migrate "
                              "between shards so fragmentation cannot "
                              "ossify")
+    parser.add_argument("--ledger-endpoint", type=str, default="",
+                        help="couple the sharded control plane to a quota "
+                             "ledger served at host:port in ANOTHER "
+                             "process (core/ledger_service.py): every "
+                             "reserve/confirm/release rides the RPC "
+                             "boundary with deadlines, idempotent replay, "
+                             "circuit breaker and degraded-mode admission. "
+                             "Default: conf solver.ledgerEndpoint; empty = "
+                             "in-process direct ledger")
+    parser.add_argument("--ledger-serve", action="store_true",
+                        help="host the ledger authority behind a local "
+                             "socket in THIS process and couple the shards "
+                             "through LedgerClient anyway (the single-box "
+                             "service shape; peers join via "
+                             "--ledger-endpoint). Requires --shards >= 2")
     args = parser.parse_args(argv)
 
     ensure_compilation_cache()
@@ -159,6 +174,10 @@ def main(argv=None) -> int:
     from yunikorn_tpu.obs.flightrec import FlightRecorderOptions
     from yunikorn_tpu.robustness.failover import FailoverOptions
 
+    from yunikorn_tpu.core.ledger_service import LedgerClientOptions
+
+    ledger_endpoint = (args.ledger_endpoint
+                       or holder.get().solver_ledger_endpoint)
     core = make_core_scheduler(
         cache, shards=n_shards,
         solver_options=solver_opts,
@@ -169,12 +188,21 @@ def main(argv=None) -> int:
         failover_options=FailoverOptions.from_conf(holder.get()),
         journey_capacity=holder.get().obs_journey_capacity,
         flightrec_options=FlightRecorderOptions.from_conf(holder.get()),
-        delivery_high_water=holder.get().solver_delivery_high_water)
+        delivery_high_water=holder.get().solver_delivery_high_water,
+        ledger_endpoint=ledger_endpoint, ledger_serve=args.ledger_serve,
+        ledger_client_options=LedgerClientOptions.from_conf(holder.get()))
     if n_shards > 1:
         logger.info("control-plane sharding: %d shards (epoch %ss, "
                     "failover stale budget %ss)",
                     n_shards, args.shard_epoch_seconds or "off",
                     holder.get().robustness_failover_stale_s)
+        if args.ledger_serve:
+            logger.info("ledger service: authority on %s (fail-closed=%s)",
+                        core.ledger_server.endpoint,
+                        holder.get().robustness_ledger_fail_closed)
+        elif ledger_endpoint:
+            logger.info("ledger service: coupling to remote authority at "
+                        "%s", ledger_endpoint)
     if aot_rt is not None:
         # hit/miss/compile metrics land in this core's /metrics; compile
         # spans land on its cycle timeline

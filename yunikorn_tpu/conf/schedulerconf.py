@@ -125,6 +125,17 @@ CM_ROBUST_FAILOVER_STALE = PREFIX_ROBUSTNESS + "failoverStaleSeconds"
 CM_ROBUST_FAILOVER_PROBE = PREFIX_ROBUSTNESS + "failoverProbeSeconds"
 CM_ROBUST_FAILOVER_REJOIN = PREFIX_ROBUSTNESS + "failoverRejoinSeconds"
 CM_ROBUST_FAILOVER_ENABLED = PREFIX_ROBUSTNESS + "failoverEnabled"  # true | false
+# ledger as a service (round 22; core/ledger_service.py, active only when
+# the sharded front end couples through the RPC boundary):
+# ledgerEndpoint "host:port" connects to an authority in ANOTHER process
+# (empty = serve in-process when --ledger-serve is set); NOT hot-reloadable
+# (process structure, like the shard count). failClosed: true = a shard
+# that loses the ledger past its breaker budget REJECTS admissions instead
+# of degraded local admission (quota exactness over availability).
+CM_SOLVER_LEDGER_ENDPOINT = PREFIX_SOLVER + "ledgerEndpoint"
+CM_ROBUST_LEDGER_FAIL_CLOSED = PREFIX_ROBUSTNESS + "ledgerFailClosed"  # true | false
+CM_ROBUST_LEDGER_DEADLINE = PREFIX_ROBUSTNESS + "ledgerDeadlineSeconds"
+CM_ROBUST_LEDGER_LEASE_TTL = PREFIX_ROBUSTNESS + "ledgerLeaseTtlSeconds"
 
 # The queues.yaml payload key inside the configmap (opaque to the shim).
 POLICY_GROUP_DEFAULT = "queues"
@@ -288,6 +299,14 @@ class SchedulerConf:
     # orchestrator owns shard health, or failover is being ruled out
     # while debugging); the quarantine mechanics stay callable directly
     robustness_failover_enabled: str = "true"
+    # --- ledger service (round 22; core/ledger_service.py) --- endpoint
+    # of an out-of-process quota authority ("" = in-process; NOT
+    # hot-reloadable); per-RPC deadline; degraded-mode admission policy;
+    # host lease TTL on the ledger liveness authority
+    solver_ledger_endpoint: str = ""
+    robustness_ledger_deadline_s: float = 2.0
+    robustness_ledger_fail_closed: str = "false"
+    robustness_ledger_lease_ttl_s: float = 15.0
 
     def clone(self) -> "SchedulerConf":
         c = dataclasses.replace(self)
@@ -506,6 +525,21 @@ def parse_config_map(data: Dict[str, str], base: Optional[SchedulerConf] = None)
         conf.solver_delivery_high_water = _parse_int(
             data[CM_SOLVER_DELIVERY_HIGH_WATER],
             conf.solver_delivery_high_water)
+    if CM_SOLVER_LEDGER_ENDPOINT in data:
+        conf.solver_ledger_endpoint = str(
+            data[CM_SOLVER_LEDGER_ENDPOINT]).strip()
+    if CM_ROBUST_LEDGER_FAIL_CLOSED in data:
+        conf.robustness_ledger_fail_closed = _parse_choice(
+            CM_ROBUST_LEDGER_FAIL_CLOSED,
+            data[CM_ROBUST_LEDGER_FAIL_CLOSED], ("true", "false"))
+    if CM_ROBUST_LEDGER_DEADLINE in data:
+        conf.robustness_ledger_deadline_s = _parse_duration(
+            data[CM_ROBUST_LEDGER_DEADLINE],
+            conf.robustness_ledger_deadline_s)
+    if CM_ROBUST_LEDGER_LEASE_TTL in data:
+        conf.robustness_ledger_lease_ttl_s = _parse_duration(
+            data[CM_ROBUST_LEDGER_LEASE_TTL],
+            conf.robustness_ledger_lease_ttl_s)
     return conf
 
 
@@ -584,6 +618,8 @@ def check_non_reloadable(old: SchedulerConf, new: SchedulerConf) -> List[str]:
         CM_SOLVER_SHARDS: (old.solver_shards, new.solver_shards),
         CM_SOLVER_DELIVERY_HIGH_WATER: (old.solver_delivery_high_water,
                                         new.solver_delivery_high_water),
+        CM_SOLVER_LEDGER_ENDPOINT: (old.solver_ledger_endpoint,
+                                    new.solver_ledger_endpoint),
         CM_SVC_BIND_POOL_WORKERS: (old.bind_pool_workers,
                                    new.bind_pool_workers),
     }
@@ -647,6 +683,8 @@ class ConfHolder:
                 new_conf.solver_shards = keep.solver_shards
                 new_conf.solver_delivery_high_water = \
                     keep.solver_delivery_high_water
+                new_conf.solver_ledger_endpoint = \
+                    keep.solver_ledger_endpoint
                 new_conf.bind_pool_workers = keep.bind_pool_workers
                 new_conf.placeholder = dataclasses.replace(keep.placeholder)
             self._conf = new_conf

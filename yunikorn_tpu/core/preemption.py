@@ -134,6 +134,7 @@ def plan_preemptions(
     candidate_nodes: Optional[List[str]] = None,
     already_victim: Optional[set] = None,
     max_asks: int = MAX_PREEMPTING_ASKS_PER_CYCLE,
+    credit_keys: Optional[frozenset] = None,
 ) -> Tuple[List[PreemptionPlan], List[str]]:
     """Compute preemption plans for unplaced asks (HOST planner).
 
@@ -148,22 +149,34 @@ def plan_preemptions(
     device planner: victims chosen there must not be claimed twice);
     max_asks caps the asks considered (the per-cycle budget remainder).
 
+    credit_keys (round 22, ROADMAP (d)): allocation keys holding a
+    cross-shard victim credit — the fleet-wide repair pass proved free
+    capacity cannot hold them, so they plan with effective priority
+    max(priority, 1): a credited priority-0 ask may evict strictly-lower
+    (negative-priority, i.e. preemptible/spot tier) pods it could never
+    touch on its own priority. Un-credited semantics are bit-identical.
+
     Returns (plans, attempted_ask_keys) — attempted includes failed plans so
     the caller can put them on cooldown too.
     """
     plans: List[PreemptionPlan] = []
     attempted: List[str] = []
     already_victim = set() if already_victim is None else already_victim
+    credit_keys = credit_keys or frozenset()
     node_list = (candidate_nodes if candidate_nodes is not None
                  else cache.node_names())
     tables = _NodeTables(cache, app_of_pod)
     candidates = sorted(unplaced_asks, key=lambda a: -(a.priority or 0))
     for ask in candidates[:max(max_asks, 0)]:
-        if (ask.priority or 0) <= 0 or not _may_preempt(ask) or ask.pod is None:
+        credited = ask.allocation_key in credit_keys
+        eff_priority = (max(ask.priority or 0, 1) if credited
+                        else (ask.priority or 0))
+        if eff_priority <= 0 or not _may_preempt(ask) or ask.pod is None:
             continue
         attempted.append(ask.allocation_key)
         plan = _plan_for_ask(cache, ask, already_victim,
-                             inflight_by_node or {}, node_list, tables)
+                             inflight_by_node or {}, node_list, tables,
+                             ask_priority=eff_priority)
         if plan is not None:
             for v in plan.victims:
                 already_victim.add(v.uid)
@@ -174,8 +187,12 @@ def plan_preemptions(
 def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
                   inflight_by_node: Dict[str, object],
                   node_list: List[str],
-                  tables: _NodeTables) -> Optional[PreemptionPlan]:
+                  tables: _NodeTables,
+                  ask_priority: Optional[int] = None
+                  ) -> Optional[PreemptionPlan]:
     pod = ask.pod
+    if ask_priority is None:
+        ask_priority = ask.priority or 0
     best: Optional[Tuple[int, int, str, List[Pod]]] = None  # (count, prio_sum, node, victims)
 
     searched = 0
@@ -196,7 +213,7 @@ def _plan_for_ask(cache, ask: AllocationAsk, already_victim: set,
         # rows, so this equals the device kernel's slot masking exactly.
         victims = [
             v for v in tables.table(name)
-            if pod_priority(v) < (ask.priority or 0)
+            if pod_priority(v) < ask_priority
             and v.uid not in already_victim
         ]
         if not victims:

@@ -2520,6 +2520,23 @@ class CoreScheduler(SchedulerAPI):
         so = self.solver
         return True if so.preempt_device is None else so.preempt_device
 
+    def _victim_credit_keys(self) -> frozenset:
+        """Live cross-shard victim credits targeted at THIS shard (round
+        22, ROADMAP (d)): allocation keys the fleet-wide repair pass gave
+        up on, granted one eviction attempt here. Empty for the unsharded
+        scheduler (no ledger) and on any ledger/RPC failure — credits are
+        an optimization, never a liveness dependency."""
+        ledger = self.quota_ledger
+        if ledger is None:
+            return frozenset()
+        fn = getattr(ledger, "victim_credits", None)
+        if fn is None:
+            return frozenset()
+        try:
+            return frozenset(fn(self.shard_index))
+        except Exception:
+            return frozenset()
+
     def _preempt_dispatch(self, admitted, batch, assigned):
         """Async-dispatch the batched victim-selection solve for the rows
         the just-materialized assignment left unplaced (core lock held).
@@ -2549,6 +2566,13 @@ class CoreScheduler(SchedulerAPI):
         # dispatch (the residue budget cannot be allowed to starve them)
         deferred = (set(batch.deferred)
                     if self.solver.fallback_rounds > 0 else set())
+        # cross-shard victim credits (round 22): a fleet-starved repaired
+        # ask's credit bypasses the attempt cooldown — the fleet already
+        # proved free capacity cannot hold it, so the planner may try
+        # again. Credited priority<=0 asks stay off the DEVICE dispatch
+        # (its victim arrays rank by real priority and would find
+        # nothing); the host planner lifts them via credit_keys instead.
+        credits = self._victim_credit_keys()
         prospective = []
         for i in unassigned.tolist():
             if i >= len(admitted) or i in deferred:
@@ -2558,7 +2582,8 @@ class CoreScheduler(SchedulerAPI):
                 continue
             if (ask.priority or 0) <= 0:
                 continue
-            if ask.allocation_key in self._preempted_for:
+            if (ask.allocation_key in self._preempted_for
+                    and ask.allocation_key not in credits):
                 continue
             prospective.append(ask)
         if not prospective:
@@ -2617,6 +2642,7 @@ class CoreScheduler(SchedulerAPI):
         self._purge_preempt_cooldown(now)
         app_of_pod = self._app_of_pod()
         inflight_by_node = self._inflight_by_node()
+        credits = self._victim_credit_keys()
         stats: Dict[str, object] = {}
         if handle is not None:
             planner = "device"
@@ -2655,26 +2681,38 @@ class CoreScheduler(SchedulerAPI):
             budget = MAX_PREEMPTING_ASKS_PER_CYCLE - len(handle.asks)
             residue = [a for a in unplaced_asks
                        if a.allocation_key not in handled
-                       and a.allocation_key not in self._preempted_for]
+                       and (a.allocation_key not in self._preempted_for
+                            or a.allocation_key in credits)]
             if residue and budget > 0:
                 claimed = {v.uid for p in plans for v in p.victims}
                 r_plans, r_att = plan_preemptions(
                     self.cache, residue, app_of_pod, inflight_by_node,
                     candidate_nodes=handle.node_list,
-                    already_victim=claimed, max_asks=budget)
+                    already_victim=claimed, max_asks=budget,
+                    credit_keys=credits)
                 plans += r_plans
                 attempted += r_att
         else:
             planner = "host"
             eligible = [a for a in unplaced_asks
-                        if a.allocation_key not in self._preempted_for]
+                        if a.allocation_key not in self._preempted_for
+                        or a.allocation_key in credits]
             plans, attempted = plan_preemptions(
                 self.cache, eligible, app_of_pod, inflight_by_node,
-                candidate_nodes=self._preempt_candidate_nodes())
+                candidate_nodes=self._preempt_candidate_nodes(),
+                credit_keys=credits)
         for key in attempted:
             # cooldown failed attempts too: an unplaceable ask must not
             # rescan the cluster every cycle
             self._preempted_for[key] = now
+            if key in credits:
+                # one credit buys one eviction attempt — consume it so a
+                # still-unplaceable ask cannot re-scan every cycle on the
+                # same grant (the repair loop may post a fresh one)
+                try:
+                    self.quota_ledger.consume_victim_credit(key)
+                except Exception:
+                    pass
         for plan in plans:
             released = 0
             for rel in plan.releases(app_of_pod):
@@ -3639,7 +3677,10 @@ class CoreScheduler(SchedulerAPI):
         applications = self.partition.applications
         mirror = self.usage_mirror
         if mirror is not None:
-            mirror.refresh(self.shard_index, ledger)
+            # the epoch stamp fences a quarantined zombie's late refresh
+            # out of the fold (round 22; None for unsharded callers)
+            mirror.refresh(self.shard_index, ledger,
+                           epoch=getattr(self, "_mirror_epoch", None))
         held = 0
         pending = []
         for ask in admitted:

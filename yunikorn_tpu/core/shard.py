@@ -60,6 +60,7 @@ scripts/shard_bench.py scales the same comparison to the 10k-node bench.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 import zlib
@@ -103,6 +104,11 @@ RESERVE_TTL_S = 300.0
 # cycle
 REPAIR_COOLDOWN_S = 10.0
 
+# a victim credit (cross-shard preemption grant for a fleet-starved ask)
+# expires unredeemed after this long — an ask that placed, died, or whose
+# shard quarantined must not hold a standing eviction right
+VICTIM_CREDIT_TTL_S = 60.0
+
 
 # ---------------------------------------------------------------------------
 # Global quota ledger
@@ -135,6 +141,13 @@ class GlobalQuotaLedger:
         # the mirror drains with ONE lock-swap per refresh. None until a
         # mirror attaches — the single-shard ledger pays nothing.
         self._deltas: Optional[list] = None
+        # cross-shard preemption credits: allocation_key -> (posted_at,
+        # target shard) — see post_victim_credit
+        self._victim_credits: Dict[str, Tuple[float, int]] = {}
+        # host liveness leases: host_id -> last heartbeat; the shard
+        # indices each host owns, for cross-host failover
+        self._leases: Dict[str, float] = {}
+        self._host_shards: Dict[str, List[int]] = {}
         if registry is not None:
             self.attach_metrics(registry)
 
@@ -143,13 +156,35 @@ class GlobalQuotaLedger:
         device-resident usage mirror). The ledger remains the commit-time
         authority; the mirror is a read-optimized projection."""
         mirror.bind_ledger(self)
+        self.enable_journal()
+
+    def enable_journal(self) -> None:
+        """Turn on the confirmed-usage delta journal (idempotent). Split
+        from attach_mirror so the RPC boundary can enable it for a REMOTE
+        mirror: the LedgerClient's attach_mirror binds the mirror locally
+        and sends one enable_journal op to the authority."""
         with self._mu:
+            if self._deltas is not None:
+                return
             self._deltas = []
             # seed with current usage so a late attach starts bit-equal
             for tid, items in self._used.items():
                 vals = tuple((rk, v) for rk, v in items.items() if v)
                 if vals:
                     self._deltas.append((tid, vals, 1))
+
+    def requeue_deltas(self, deltas: list) -> None:
+        """Put drained-but-unapplied deltas BACK at the journal head (in
+        order, ahead of anything journaled since). The mirror's quarantine
+        fence uses this: a zombie shard that drained the journal and then
+        got fenced before folding must not LOSE those deltas — they
+        re-drain on the next live refresh and the fold stays bit-equal."""
+        if not deltas:
+            return
+        with self._mu:
+            if self._deltas is None:
+                return
+            self._deltas[:0] = deltas
 
     def _journal_locked(self, tid: str, items, sign: int) -> None:
         if self._deltas is not None and items:
@@ -355,7 +390,73 @@ class GlobalQuotaLedger:
                 "contention_retries": self.contention_retries,
                 "forced_charges": self.forced_charges,
                 "expired": self.expired,
+                "victim_credits": len(self._victim_credits),
+                "host_leases": len(self._leases),
             }
+
+    # -- victim credits (ROADMAP (d): cross-shard preemption) ---------------
+    def post_victim_credit(self, key: str, shard: int) -> None:
+        """A starved repaired ask (every shard's repair gate refused it)
+        posts a credit against the shard it stays pending on: that shard's
+        preemption planner may now EVICT for it instead of only repairing
+        onto free capacity. TTL-bounded so a credit for an ask that later
+        places (or dies) cannot linger forever."""
+        with self._mu:
+            self._victim_credits[key] = (time.time(), int(shard))
+
+    def victim_credits(self, shard: int) -> List[str]:
+        """Live credit keys targeted at `shard` (expired entries reaped)."""
+        now = time.time()
+        with self._mu:
+            dead = [k for k, (ts, _s) in self._victim_credits.items()
+                    if now - ts > VICTIM_CREDIT_TTL_S]
+            for k in dead:
+                del self._victim_credits[k]
+            return [k for k, (_ts, s) in self._victim_credits.items()
+                    if s == int(shard)]
+
+    def consume_victim_credit(self, key: str) -> bool:
+        """Pop the credit when the planner actually attempts eviction for
+        it — one credit buys one preemption attempt, not a standing right."""
+        with self._mu:
+            return self._victim_credits.pop(key, None) is not None
+
+    def clear_victim_credit(self, key: str) -> None:
+        """Drop a credit whose ask no longer needs it (placed/forgotten)."""
+        with self._mu:
+            self._victim_credits.pop(key, None)
+
+    # -- host leases (ROADMAP (e): ledger as liveness authority) ------------
+    def register_host_shards(self, host: str, shards: List[int]) -> None:
+        """Declare which shard indices `host` owns and start its lease."""
+        with self._mu:
+            self._host_shards[host] = [int(s) for s in shards]
+            self._leases[host] = time.time()
+
+    def heartbeat_host(self, host: str) -> None:
+        with self._mu:
+            if host in self._leases:
+                self._leases[host] = time.time()
+
+    def expired_hosts(self, ttl_s: float) -> List[Tuple[str, List[int]]]:
+        """Hosts whose lease aged past `ttl_s`, POPPED so each expiry fires
+        exactly once (the surviving supervisor that observes it owns the
+        quarantine; a re-registered host starts a fresh lease)."""
+        now = time.time()
+        out: List[Tuple[str, List[int]]] = []
+        with self._mu:
+            dead = [h for h, ts in self._leases.items()
+                    if now - ts > ttl_s]
+            for h in dead:
+                del self._leases[h]
+                out.append((h, self._host_shards.pop(h, [])))
+        return out
+
+    def host_leases(self) -> Dict[str, float]:
+        """Seconds since each live host's last heartbeat (diagnostics)."""
+        now = time.time()
+        with self._mu:
+            return {h: now - ts for h, ts in self._leases.items()}
 
 
 # ---------------------------------------------------------------------------
@@ -771,7 +872,9 @@ class ShardedCoreScheduler(SchedulerAPI):
                  epoch_seconds: float = 0.0, aot_namespace: bool = False,
                  failover_options=None, journey_capacity: int = 8192,
                  flightrec_options=None, delivery_high_water: int = 1024,
-                 usage_mirror: bool = True):
+                 usage_mirror: bool = True, ledger_endpoint: str = "",
+                 ledger_serve: bool = False, ledger_client_options=None,
+                 host_id: str = ""):
         # aot_namespace=True gives each shard its own executable namespace
         # in the AOT store (corruption/variant isolation for multi-process
         # deployments) at the cost of N compiles per program AND of the
@@ -786,6 +889,33 @@ class ShardedCoreScheduler(SchedulerAPI):
         self._interval = interval
         self.obs = MetricsRegistry()
         self.ledger = GlobalQuotaLedger(registry=self.obs)
+        # -- ledger as a service (round 22) -----------------------------------
+        # with ledger_serve: the in-process GlobalQuotaLedger stays the
+        # commit-time authority but moves BEHIND a LedgerServer socket, and
+        # every shard couples through a LedgerClient (deadlines, breaker,
+        # degraded mode, idempotent replay) — the RPC boundary that lets a
+        # shard live in another process. With a bare ledger_endpoint the
+        # authority lives in ANOTHER process and only the client is built.
+        # Default (neither): self.ledger stays the direct object, byte-
+        # identical to rounds 16-21 (pinned by test).
+        self.ledger_authority = self.ledger
+        self.ledger_server = None
+        self.lease_monitor = None
+        self.host_id = host_id or f"host-{os.getpid()}"
+        self._ledger_rpc = bool(ledger_serve or ledger_endpoint)
+        if self._ledger_rpc:
+            from yunikorn_tpu.core.ledger_service import (
+                LedgerClient, LedgerClientOptions, LedgerServer)
+            copts = ledger_client_options or LedgerClientOptions()
+            if ledger_serve:
+                self.ledger_server = LedgerServer(self.ledger_authority)
+                srv_host, srv_port = self.ledger_server.start()
+                endpoint = ledger_endpoint or f"{srv_host}:{srv_port}"
+            else:
+                self.ledger_authority = None  # lives in another process
+                endpoint = ledger_endpoint
+            self.ledger = LedgerClient(endpoint, copts, registry=self.obs,
+                                       client_id=self.host_id)
         self.fanout = ShardCacheFanout(cache, n_shards)
         self.partitioner = ShardTopologyPartitioner(n_shards, seed=0)
         self.epoch_seconds = float(epoch_seconds)
@@ -807,6 +937,9 @@ class ShardedCoreScheduler(SchedulerAPI):
         # take while a shard lock is held, never held across shard calls)
         self._stats_mu = threading.Lock()
         self._repair: Dict[str, dict] = {}
+        # asks holding a live victim credit on the ledger (posted by the
+        # exhausted-repair branch; cleared on placement/forget)
+        self._credited: Set[str] = set()
         self._repair_allocs: Dict[str, Set[str]] = {}   # app -> repaired keys
         # allocation key -> (committing shard, app id); the app id makes
         # app-removal purge possible (removal emits no per-key releases)
@@ -939,6 +1072,32 @@ class ShardedCoreScheduler(SchedulerAPI):
         self.health = HealthMonitor()
         self.health.register("shards", self._shards_health)
         self.health.register("failover", failover_source(self.failover))
+        if self._ledger_rpc:
+            # the client records a ledger_degraded post-mortem trigger on
+            # every transition into degraded/fail_closed
+            self.ledger.attach_flightrec(self.flightrec)
+            # cross-host failover (ROADMAP (e)): the ledger's lease table
+            # is the liveness authority — this host heartbeats over the
+            # ledger connection, and a peer host whose lease expires gets
+            # its shards quarantined/re-homed by OUR supervisor through
+            # the round-18 machinery
+            from yunikorn_tpu.robustness.failover import HostLeaseMonitor
+            self.lease_monitor = HostLeaseMonitor(
+                self.ledger, self.host_id, list(range(n_shards)),
+                self._lease_quarantine,
+                ttl_s=getattr(self.ledger.options, "lease_ttl_s", 15.0),
+                registry=self.obs)
+
+    def _lease_quarantine(self, idx: int, reason: str) -> bool:
+        """Lease-expiry quarantines run the identical re-home transaction
+        as the in-process failure-domain supervisor, then record the
+        transition on it — states, counters and the rejoin ladder must
+        reflect cross-host detections too."""
+        t0 = time.time()
+        ok = self.quarantine_shard(idx, reason)
+        if ok:
+            self.failover.note_quarantined(idx, reason, time.time() - t0)
+        return ok
 
     def _build_shard(self, k: int) -> CoreScheduler:
         view = ShardCacheView(self.fanout, k)
@@ -957,6 +1116,11 @@ class ShardedCoreScheduler(SchedulerAPI):
             journey=self.journey, flightrec=self.flightrec)
         core.shard_index = k
         core.usage_mirror = self.usage_mirror
+        # mirror journal epoch stamp: a quarantined zombie's stale stamp
+        # fences its late refreshes out of the fold (rebuilt cores get the
+        # post-fence epoch here)
+        core._mirror_epoch = (self.usage_mirror.epoch_of(k)
+                              if self.usage_mirror is not None else 0)
         self.tracer.register(k, core.tracer, name=f"shard {k}")
         return core
 
@@ -1475,8 +1639,12 @@ class ShardedCoreScheduler(SchedulerAPI):
                 target=self._epoch_loop, name="shard-epoch", daemon=True)
             self._epoch_thread.start()
         self.failover.start()
+        if self.lease_monitor is not None:
+            self.lease_monitor.start()
 
     def stop(self) -> None:
+        if self.lease_monitor is not None:
+            self.lease_monitor.stop()
         self.failover.stop()
         self._epoch_stop.set()
         if self._epoch_thread is not None:
@@ -1497,6 +1665,10 @@ class ShardedCoreScheduler(SchedulerAPI):
                 core._running.clear()
                 continue
             core.stop()
+        if self._ledger_rpc:
+            self.ledger.close()
+        if self.ledger_server is not None:
+            self.ledger_server.stop()
 
     def trigger(self) -> None:
         for k, core in enumerate(self.shards):
@@ -1613,6 +1785,14 @@ class ShardedCoreScheduler(SchedulerAPI):
             # fence the zombie off the ledger too: a cycle that unwedges
             # later must not force-charge keys the fleet re-admitted
             old_core.quota_ledger = None
+            # and off the usage mirror, the same way the delivery fence
+            # drops stale backlog: bump the shard's mirror epoch so a
+            # zombie cycle's late refresh (its _mirror_epoch is now stale)
+            # cannot scatter usage_apply rows into the fold — any deltas
+            # it drained but never folded are requeued on the authority
+            old_core.usage_mirror = None
+            if self.usage_mirror is not None:
+                self.usage_mirror.fence_shard(idx)
             old_core._running.clear()  # soft-stop; never join a wedged loop
             try:
                 with old_core._wake:
@@ -1859,6 +2039,17 @@ class ShardedCoreScheduler(SchedulerAPI):
                     tried = set(st["tried"])
             if tried is None:
                 self._m_repair.inc(outcome="exhausted")
+                # ROADMAP (d): every active shard refused the ask on FREE
+                # capacity — post a victim credit on the ledger so the
+                # shard it stays pending on may EVICT for it (the planner
+                # bypasses its repair-only stance for credited keys)
+                try:
+                    self.ledger.post_victim_credit(key, shard_idx)
+                    with self._stats_mu:
+                        self._credited.add(key)
+                    self.journey.annotate(key, victim_credit=shard_idx)
+                except Exception:
+                    logger.exception("victim credit post failed: %s", key)
                 # journey terminal: every active shard tried and refused.
                 # Not final forever — a post-cooldown bind "recovers" it
                 self.journey.terminal(key, "skipped_fleetwide")
@@ -1923,6 +2114,15 @@ class ShardedCoreScheduler(SchedulerAPI):
             with self._stats_mu:
                 for _app_id, key in pairs:
                     self._repair.pop(key, None)
+                credited = [key for _a, key in pairs
+                            if key in self._credited]
+                self._credited.difference_update(credited)
+        for key in credited:
+            # the ask is gone for good: its eviction right dies with it
+            try:
+                self.ledger.clear_victim_credit(key)
+            except Exception:
+                pass
 
     def _note_allocations(self, shard_idx: int, response) -> None:
         """Per-shard commit accounting + repair settlement (may run under
@@ -1930,6 +2130,7 @@ class ShardedCoreScheduler(SchedulerAPI):
         Completed re-emit goes straight to the REAL callback — async on
         the shim side, so safe from any lock context)."""
         done_apps: List[str] = []
+        uncredit: List[str] = []
         t_lc0 = time.time() if response.new else 0.0
         with self._stats_mu:
             for alloc in response.new:
@@ -1938,6 +2139,9 @@ class ShardedCoreScheduler(SchedulerAPI):
                     shard_idx, alloc.application_id)
                 self._allocs[alloc.allocation_key] = alloc
                 self._m_bound.inc(shard=str(shard_idx))
+                if alloc.allocation_key in self._credited:
+                    self._credited.discard(alloc.allocation_key)
+                    uncredit.append(alloc.allocation_key)
                 if self._repair.pop(alloc.allocation_key, None) is not None:
                     self._repair_placed += 1
                     self._m_repair.inc(outcome="placed")
@@ -1962,6 +2166,13 @@ class ShardedCoreScheduler(SchedulerAPI):
                             self._suppressed_apps.discard(
                                 rel.application_id)
                             done_apps.append(rel.application_id)
+        for key in uncredit:
+            # the credited ask placed after all — drop its standing
+            # eviction right before any planner redeems it
+            try:
+                self.ledger.clear_victim_credit(key)
+            except Exception:
+                pass
         if response.new:
             # front-lane span: the fleet-level commit confirmation pass
             # (ledger re-attribution bookkeeping per committed batch)
@@ -2030,12 +2241,20 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
                         slo_options=None, epoch_seconds: float = 0.0,
                         failover_options=None, journey_capacity: int = 8192,
                         flightrec_options=None,
-                        delivery_high_water: int = 1024):
+                        delivery_high_water: int = 1024,
+                        ledger_endpoint: str = "",
+                        ledger_serve: bool = False,
+                        ledger_client_options=None, host_id: str = ""):
     """Build the scheduler for a shard count: a plain CoreScheduler for 1
     (bit-identical to the pre-shard scheduler — no ledger, no views, no
-    namespaces, no failover machinery), the sharded front end for N >= 2."""
+    namespaces, no failover machinery; ledger-service flags are ignored,
+    pinned by test), the sharded front end for N >= 2."""
     n = shards if isinstance(shards, int) else resolve_shards(shards)
     if n <= 1:
+        if ledger_endpoint or ledger_serve:
+            logger.warning("ledger service requested with shards=1; "
+                           "ignored — the single-shard scheduler has no "
+                           "ledger coupling to move behind a socket")
         return CoreScheduler(cache, interval=interval,
                              solver_policy=solver_policy,
                              solver_options=solver_options,
@@ -2051,4 +2270,6 @@ def make_core_scheduler(cache, *, shards=1, interval: float = 0.1,
         epoch_seconds=epoch_seconds, failover_options=failover_options,
         journey_capacity=journey_capacity,
         flightrec_options=flightrec_options,
-        delivery_high_water=delivery_high_water)
+        delivery_high_water=delivery_high_water,
+        ledger_endpoint=ledger_endpoint, ledger_serve=ledger_serve,
+        ledger_client_options=ledger_client_options, host_id=host_id)

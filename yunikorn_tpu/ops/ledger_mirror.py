@@ -83,9 +83,15 @@ class DeviceUsageMirror:
         # published fleet view: (fleet [T_cap, K_cap] np.int64, t_vocab,
         # k_vocab) swapped atomically — readers never see a half-update
         self._fleet: Optional[np.ndarray] = None
+        # per-shard journal epochs (round 22): quarantine bumps a shard's
+        # epoch the way ShardDeliveryQueue.fence() epoch-fences its pump —
+        # a zombie cycle's late refresh carries the stale epoch and is
+        # refused before its drained deltas can dirty the fold
+        self._epochs = [0] * self.n
         self.drains = 0
         self.applied_deltas = 0
         self.folds = 0
+        self.fenced_refreshes = 0
 
     # ----------------------------------------------------------- internals
     def bind_ledger(self, ledger) -> None:
@@ -129,16 +135,43 @@ class DeviceUsageMirror:
         return idx
 
     # ------------------------------------------------------------------ API
-    def refresh(self, shard: int = 0, ledger=None) -> int:
+    def epoch_of(self, shard: int) -> int:
+        """The shard's current journal epoch (stamped onto each core at
+        build/rejoin; a refresh presenting an older stamp is fenced)."""
+        with self._mu:
+            return self._epochs[shard % self.n]
+
+    def fence_shard(self, shard: int) -> None:
+        """Quarantine fence: refreshes stamped with the shard's PREVIOUS
+        epoch are refused from here on — a zombie that already drained the
+        journal gets its deltas requeued on the ledger instead of folded,
+        so nothing is lost and nothing stale lands."""
+        with self._mu:
+            self._epochs[shard % self.n] += 1
+
+    def refresh(self, shard: int = 0, ledger=None,
+                epoch: Optional[int] = None) -> int:
         """Drain the ledger's confirmed-usage journal into this shard's
         device row and re-fold the fleet totals. One short ledger-lock
         swap for the drain; the device work is jitted. Returns the number
-        of deltas applied."""
+        of deltas applied. `epoch` is the caller's journal-epoch stamp
+        (None = unfenced caller: divergence checks, tests)."""
         ledger = ledger if ledger is not None else self._ledger
         if ledger is None:
             return 0
+        if epoch is not None and epoch != self.epoch_of(shard):
+            self.fenced_refreshes += 1
+            return 0
         deltas = ledger.drain_deltas()
         if not deltas:
+            return 0
+        if epoch is not None and epoch != self.epoch_of(shard):
+            # fenced BETWEEN the check and the drain: the deltas this
+            # zombie swallowed belong to the fleet — put them back
+            self.fenced_refreshes += 1
+            requeue = getattr(ledger, "requeue_deltas", None)
+            if requeue is not None:
+                requeue(deltas)
             return 0
         from jax.experimental import enable_x64
 
@@ -257,6 +290,8 @@ class DeviceUsageMirror:
                 "drains": self.drains,
                 "applied_deltas": self.applied_deltas,
                 "folds": self.folds,
+                "epochs": list(self._epochs),
+                "fenced_refreshes": self.fenced_refreshes,
                 "sharded_fold": bool(
                     self._mesh is not None
                     and self.n % self._mesh.devices.size == 0),

@@ -197,6 +197,32 @@ class ShardSupervisor:
         if self._h_rehome is not None:
             self._h_rehome.observe(seconds)
 
+    def note_quarantined(self, idx: int, reason: str,
+                         rehome_s: float = 0.0) -> None:
+        """Record a quarantine performed OUTSIDE the detection loop — the
+        host-lease monitor drives the owner's quarantine transaction
+        directly on lease expiry, and the failure-domain states, counters
+        and report must still reflect it (the cross-host drill's
+        assertion surface reads them). A shard already quarantined is a
+        no-op; the rejoin ladder picks the shard up from here exactly as
+        if the probe loop had diagnosed it."""
+        now = time.time()
+        with self._mu:
+            if not (0 <= idx < self.n) or self._state[idx] == QUARANTINED:
+                return
+            self._state[idx] = QUARANTINED
+            self._since[idx] = now
+            self._reasons[idx] = reason
+            self.quarantines += 1
+            self.last_event = {"shard": idx, "event": "quarantine",
+                               "reason": reason, "at": round(now, 3),
+                               "rehome_s": round(rehome_s, 3)}
+        if self._m_quarantines is not None:
+            self._m_quarantines.inc(reason=reason)
+        if self._g_state is not None:
+            self._g_state.set(STATE_GAUGE[QUARANTINED], shard=str(idx))
+        self.note_rehome_seconds(rehome_s)
+
     def report(self) -> dict:
         with self._mu:
             return {
@@ -326,6 +352,101 @@ class ShardSupervisor:
         if self._cores_fn is None:
             return []
         return self._cores_fn()
+
+
+class HostLeaseMonitor:
+    """Cross-HOST failover (round 22, ROADMAP (e)): the ledger service as
+    liveness authority.
+
+    Each shard host registers the shard indices it owns and heartbeats its
+    lease over the same ledger connection its quota ops ride — liveness
+    and quota coupling share fate on purpose: a host that cannot reach the
+    ledger cannot ADMIT anything fleet-visible either, so an expired lease
+    really means the host's shards are out of the admission plane. Every
+    poll, the monitor heartbeats its OWN lease and asks the ledger for
+    expired PEER leases; a dead peer's shards are driven through the
+    round-18 quarantine/evacuate/re-home machinery on THIS (surviving)
+    host's supervisor — bound pods preserved, audit clean, exactly the
+    in-process quarantine contract.
+
+    Degraded note: while the ledger is unreachable the client's breaker
+    answers expired_hosts() with the empty default — a partitioned
+    SURVIVOR never mass-quarantines the fleet on its own blindness (the
+    ledger side sees the survivor's lease expire instead)."""
+
+    def __init__(self, ledger, host_id: str, self_shards: List[int],
+                 quarantine_fn: Callable[[int, str], bool],
+                 ttl_s: float = 15.0, interval_s: float = 2.0,
+                 registry=None):
+        self.ledger = ledger
+        self.host_id = host_id
+        self.self_shards = list(self_shards)
+        self.quarantine_fn = quarantine_fn
+        self.ttl_s = float(ttl_s)
+        self.interval_s = float(interval_s)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._registered = False
+        self.heartbeats = 0
+        self.expiries_seen = 0
+        self._m_expiries = None
+        if registry is not None:
+            self._m_expiries = registry.counter(
+                "ledger_lease_expiries_total",
+                "peer host leases this supervisor observed expiring on the "
+                "ledger liveness authority (each drives the dead host's "
+                "shards through quarantine/re-home)")
+            self._m_expiries.inc(0)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="host-lease", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("host lease poll failed")
+
+    def poll_once(self) -> List[str]:
+        """One heartbeat + expiry sweep; returns the hosts whose leases
+        were found expired (the chaos drill's assertion surface)."""
+        if not self._registered:
+            self.ledger.register_host_shards(self.host_id, self.self_shards)
+            self._registered = True
+        self.ledger.heartbeat_host(self.host_id)
+        self.heartbeats += 1
+        dead: List[str] = []
+        for host, shards in self.ledger.expired_hosts(self.ttl_s):
+            if host == self.host_id:
+                # our own lease lapsed (we were the partitioned side):
+                # re-register rather than amputate ourselves
+                self._registered = False
+                continue
+            dead.append(host)
+            self.expiries_seen += 1
+            if self._m_expiries is not None:
+                self._m_expiries.inc()
+            logger.warning("host lease expired: %s (shards %s); "
+                           "quarantining", host, shards)
+            for idx in shards:
+                try:
+                    self.quarantine_fn(int(idx), f"lease:{host}")
+                except Exception:
+                    logger.exception("lease-driven quarantine of shard "
+                                     "%s failed", idx)
+        return dead
 
 
 def failover_source(shard_supervisor: ShardSupervisor) -> Callable[[], dict]:

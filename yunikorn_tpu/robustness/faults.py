@@ -137,3 +137,118 @@ class FaultPlane:
             time.sleep(delay)
         if exc is not None:
             raise exc
+
+
+class NetPartitioned(ConnectionError):
+    """The ledger transport is partitioned/down for this frame.
+
+    A ConnectionError subclass on purpose: the LedgerClient's retry path
+    and `classify_error` both already treat ConnectionError as transient,
+    so injected partitions exercise the EXACT production error path."""
+
+
+class NetFaultPlane:
+    """Network fault family for the ledger transport (round 22).
+
+    Where FaultPlane scripts faults per supervised (path, tier) attempt,
+    NetFaultPlane scripts them per transport FRAME: both ends of the
+    ledger socket call `on_frame(op)` before touching the wire, which may
+    sleep (delay rules), raise NetPartitioned (drop/partition/flap), or
+    ask the caller to send the frame more than once (duplicate) — the
+    exact abuse the idempotency layer must absorb. Driven from
+    `trace_replay --fault netsplit|ledger-lag` and the chaos suites."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._drop = 0              # frames to drop (inf = until heal)
+        self._delay_s = 0.0
+        self._delay_times = 0
+        self._dup = 0               # frames to duplicate
+        self._partition_until = 0.0  # inf = until heal()
+        self._flap_period_s = 0.0
+        self._flap_down = 0.0
+        self._flap_anchor = 0.0
+        self.frames = 0
+        self.dropped = 0
+        self.delayed = 0
+        self.duplicated = 0
+
+    # -- scripting ---------------------------------------------------------
+    def drop(self, times: float = 1) -> None:
+        """Drop the next `times` frames (each surfaces as a transient
+        connection error the client retries under its deadline)."""
+        with self._mu:
+            self._drop += times
+
+    def delay(self, seconds: float, times: float = float("inf")) -> None:
+        """Stall the next `times` frames by `seconds` (ledger-lag shape:
+        frames arrive, late — deadlines and backoff do the work)."""
+        with self._mu:
+            self._delay_s = float(seconds)
+            self._delay_times = times
+
+    def duplicate(self, times: float = 1) -> None:
+        """Send the next `times` frames twice (idempotency-cache abuse)."""
+        with self._mu:
+            self._dup += times
+
+    def partition(self, seconds: Optional[float] = None) -> None:
+        """Hard partition: every frame fails until `seconds` elapse (or
+        until heal() when None) — the netsplit shape that must open the
+        breaker and push the client into degraded mode."""
+        with self._mu:
+            self._partition_until = (float("inf") if seconds is None
+                                     else time.time() + float(seconds))
+
+    def flap(self, period_s: float, down_fraction: float = 0.5) -> None:
+        """Periodic partition: down for `down_fraction` of every period.
+        The wedge/leak storm shape — repeated open/half-open/close breaker
+        cycles with journal replay on every heal."""
+        with self._mu:
+            self._flap_period_s = max(float(period_s), 1e-6)
+            self._flap_down = min(max(float(down_fraction), 0.0), 1.0)
+            self._flap_anchor = time.time()
+
+    def heal(self) -> None:
+        """Clear partition/flap/delay/drop state (the network comes back)."""
+        with self._mu:
+            self._drop = 0
+            self._delay_s = 0.0
+            self._delay_times = 0
+            self._partition_until = 0.0
+            self._flap_period_s = 0.0
+
+    # -- the seam ----------------------------------------------------------
+    def on_frame(self, op: str) -> int:
+        """Called before each frame exchange. Returns the send count
+        (1, or 2+ for duplicated frames); may sleep; raises NetPartitioned
+        while the transport is down."""
+        now = time.time()
+        delay = 0.0
+        sends = 1
+        with self._mu:
+            self.frames += 1
+            if now < self._partition_until:
+                self.dropped += 1
+                raise NetPartitioned(f"ledger transport partitioned ({op})")
+            if self._flap_period_s > 0.0:
+                phase = ((now - self._flap_anchor) % self._flap_period_s)
+                if phase < self._flap_period_s * self._flap_down:
+                    self.dropped += 1
+                    raise NetPartitioned(
+                        f"ledger transport flapped down ({op})")
+            if self._drop > 0:
+                self._drop -= 1
+                self.dropped += 1
+                raise NetPartitioned(f"ledger frame dropped ({op})")
+            if self._delay_times > 0 and self._delay_s > 0.0:
+                self._delay_times -= 1
+                self.delayed += 1
+                delay = self._delay_s
+            if self._dup > 0:
+                self._dup -= 1
+                self.duplicated += 1
+                sends = 2
+        if delay > 0:
+            time.sleep(delay)
+        return sends
